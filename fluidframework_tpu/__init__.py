@@ -1,0 +1,29 @@
+"""fluidframework_tpu — a TPU-native collaborative-data framework.
+
+A ground-up re-design of Fluid Framework's capabilities (reference:
+ChumpChief/FluidFramework v2.111.0) for TPU execution: distributed data
+structures (SharedString/merge-tree, SharedMap, SharedMatrix, SharedTree)
+whose sequenced-op application pipeline is expressed as pure integer-tensor
+kernels in JAX/XLA, so that batches of totally-ordered CRDT ops across
+thousands of documents are applied per `shard_map` step on a TPU mesh.
+
+Layering (mirrors reference SURVEY.md §1, re-designed TPU-first):
+
+- ``protocol``  — wire contracts: sequenced messages, stamp encoding, codecs
+                  (ref: common/lib/protocol-definitions, protocol-base)
+- ``server``    — ordering service: deli-equivalent sequencer, in-memory
+                  local service (ref: server/routerlicious deli/memory-orderer)
+- ``ops``       — the TPU kernels: columnar merge-tree / map / matrix apply
+                  (replaces ref packages/dds/* hot paths with tensor kernels)
+- ``dds``       — host-side DDS classes + pure-Python differential oracles
+- ``tree``      — SharedTree: EditManager, rebaser change family, forest
+- ``runtime``   — container runtime control plane: channels, batching,
+                  pending state (ref: packages/runtime/container-runtime)
+- ``loader``    — container lifecycle + delta manager (ref: packages/loader)
+- ``driver``    — service drivers (local in-memory) (ref: packages/drivers)
+- ``parallel``  — mesh construction, doc-axis sharding, collective helpers
+- ``models``    — assembled end-to-end engines (the benchmark targets)
+- ``utils``     — telemetry, config provider, id compressor
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,7 @@
+"""Host-side DDS implementations and pure-Python differential oracles.
+
+The oracles (``*_ref.py``) implement the reference's convergence semantics
+exactly, in plain Python, and serve as the differential-testing contract for
+the TPU kernels in ``fluidframework_tpu.ops`` — the same role the TypeScript
+implementations play for the reference's fuzz suites.
+"""

@@ -1,0 +1,270 @@
+"""Pure-Python merge-tree oracle with reference-exact convergence semantics.
+
+This is the differential-testing contract for the TPU kernel
+(``fluidframework_tpu.ops.mergetree_kernel``): a flat list-of-segments
+implementation of the reference's merge-tree CRDT, behaviorally equivalent to
+merge-tree/src/mergeTree.ts on the op-application path but with none of the
+B-tree machinery (the B-tree + PartialSequenceLengths exist only to make CPU
+queries O(log n); a flat walk is the clearest statement of the semantics).
+
+Semantics captured (studied from the reference, re-implemented):
+
+- **Visibility** (perspective.ts ``PriorPerspective``): a segment is present
+  from perspective ``(refSeq, viewClient)`` iff its insert has occurred
+  (acked with seq <= refSeq, or issued by viewClient) and no remove on it has
+  occurred.
+
+- **Insert walk + tie-break** (mergeTree.ts ``insertRecursive`` /
+  ``breakTie:1811``): an insert at position P walks segments left-to-right
+  consuming perspective-visible length.  Landing mid-segment splits it.
+  Landing on a boundary, the insert skips past invisible segments UNLESS the
+  incoming stamp is greater than the segment's insert stamp (so among
+  concurrent inserts at one position, later-sequenced ops sit closer to the
+  front, and local unacked segments — which outrank every acked stamp — stay
+  in front of incoming remote inserts), or the segment was removed by an
+  acked remove stamped after the incoming insert (reconnect rebase case).
+
+- **Set-remove** (mergeTree.ts ``markRangeRemoved:2292``): removes exactly
+  the perspective-visible segments in [P1, P2), splitting boundary segments;
+  overlapping removes keep the earliest stamp as the winner (removes[0]).
+
+- **Annotate** (mergeTree.ts ``annotateRange:2009`` + PropertiesManager):
+  per-(segment, key) last-writer-wins by stamp order; a pending local
+  annotate outranks (masks) every acked one until acked itself.
+
+- **Ack** (client.ts ``ackPendingSegment``): the originating client converts
+  pending stamps (localSeq) to acked stamps (seq) when its own op returns.
+
+- **Zamboni** (zamboni.ts:33): segments whose winning remove is acked at or
+  below the MSN are unreferenceable from every legal perspective and are
+  evicted.
+
+Overlapping removes: the FULL list of remove stamps is retained per segment
+(reference ``seg.removes``, kept stamp-sorted).  This is required for
+correctness, not just attribution: a segment must be invisible to any
+perspective whose client is among the removers, even when the *winning*
+(earliest) remove is outside that perspective's refSeq
+(perspective.ts ``isSegmentPresent``: ``removes.some(hasOccurred)``).
+The TPU kernel carries a fixed number of remover slots per segment with
+overflow detection for the same reason.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from ..protocol.stamps import (
+    ALL_ACKED,
+    NO_REMOVE,
+    acked,
+    encode_stamp,
+    has_occurred,
+)
+
+
+@dataclass
+class Segment:
+    """One run of text plus its operation stamps (columnar tuple on TPU)."""
+
+    text: str
+    ins_key: int
+    ins_client: int
+    # Overlapping remove stamps as (key, client), sorted by key; the first
+    # entry is the winning (earliest) remove — reference seg.removes[0].
+    removes: list[tuple[int, int]] = field(default_factory=list)
+    # prop id -> (value, stamp key of the write that set it)
+    props: dict[int, tuple[int, int]] = field(default_factory=dict)
+
+    @property
+    def rem_key(self) -> int:
+        return self.removes[0][0] if self.removes else NO_REMOVE
+
+    def visible(self, ref_seq: int, view_client: int) -> bool:
+        if not has_occurred(self.ins_key, self.ins_client, ref_seq, view_client):
+            return False
+        return not any(
+            has_occurred(key, client, ref_seq, view_client)
+            for key, client in self.removes
+        )
+
+
+class RefMergeTree:
+    """Flat-array merge-tree replica for one document."""
+
+    def __init__(self, local_client: int = -3) -> None:
+        self.segments: list[Segment] = []
+        self.local_client = local_client
+        self.min_seq = 0
+
+    # ------------------------------------------------------------------ views
+    def visible_text(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> str:
+        vc = self.local_client if view_client is None else view_client
+        return "".join(
+            s.text for s in self.segments if s.visible(ref_seq, vc)
+        )
+
+    def visible_length(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> int:
+        vc = self.local_client if view_client is None else view_client
+        return sum(len(s.text) for s in self.segments if s.visible(ref_seq, vc))
+
+    def annotations(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> list[dict[int, int]]:
+        """Per visible character: {prop_id: value} (for differential tests)."""
+        vc = self.local_client if view_client is None else view_client
+        out: list[dict[int, int]] = []
+        for s in self.segments:
+            if s.visible(ref_seq, vc):
+                props = {k: v for k, (v, _key) in sorted(s.props.items())}
+                out.extend(props for _ in s.text)
+        return out
+
+    # ------------------------------------------------------------- primitives
+    def _split(self, i: int, offset: int) -> None:
+        """Split segment i at text offset, preserving all stamps (ref split)."""
+        seg = self.segments[i]
+        assert 0 < offset < len(seg.text)
+        left = replace(
+            seg, text=seg.text[:offset], removes=list(seg.removes), props=dict(seg.props)
+        )
+        right = replace(
+            seg, text=seg.text[offset:], removes=list(seg.removes), props=dict(seg.props)
+        )
+        self.segments[i : i + 1] = [left, right]
+
+    def _tiebreak(self, seg: Segment, op_key: int) -> bool:
+        """mergeTree.ts breakTie leaf case (pos == 0, invisible segment)."""
+        if op_key > seg.ins_key:
+            return True
+        return (
+            bool(seg.removes)
+            and acked(seg.removes[0][0])
+            and seg.removes[0][0] > op_key
+        )
+
+    def _find_insert_index(
+        self, pos: int, op_key: int, ref_seq: int, view_client: int
+    ) -> int:
+        """Replicates the inserting walk; may split a segment. Returns index
+        at which to insert the new segment into ``self.segments``."""
+        rem = pos
+        i = 0
+        while i < len(self.segments):
+            seg = self.segments[i]
+            vlen = len(seg.text) if seg.visible(ref_seq, view_client) else 0
+            if rem < vlen:
+                if rem == 0:
+                    return i
+                self._split(i, rem)
+                return i + 1
+            if rem == 0 and vlen == 0 and self._tiebreak(seg, op_key):
+                return i
+            rem -= vlen
+            i += 1
+        if rem != 0:
+            raise ValueError(f"insert position {pos} beyond visible length")
+        return len(self.segments)
+
+    def _range_indices(
+        self, pos1: int, pos2: int, ref_seq: int, view_client: int
+    ) -> list[int]:
+        """Split boundaries and return indices of perspective-visible segments
+        wholly inside [pos1, pos2)."""
+        assert pos1 <= pos2
+        out: list[int] = []
+        covered = 0
+        i = 0
+        while i < len(self.segments) and covered < pos2:
+            seg = self.segments[i]
+            if not seg.visible(ref_seq, view_client):
+                i += 1
+                continue
+            seg_end = covered + len(seg.text)
+            if seg_end <= pos1:
+                covered = seg_end
+                i += 1
+                continue
+            if covered < pos1:
+                # Split off the prefix before the range.
+                self._split(i, pos1 - covered)
+                covered = pos1
+                i += 1
+                continue
+            if seg_end > pos2:
+                # Split off the suffix after the range.
+                self._split(i, pos2 - covered)
+                seg_end = pos2
+            out.append(i)
+            covered = seg_end
+            i += 1
+        if covered < pos2:
+            raise ValueError(f"range [{pos1},{pos2}) beyond visible length")
+        return out
+
+    # -------------------------------------------------------------------- ops
+    def apply_insert(
+        self,
+        pos: int,
+        text: str,
+        op_key: int,
+        op_client: int,
+        ref_seq: int,
+    ) -> None:
+        idx = self._find_insert_index(pos, op_key, ref_seq, op_client)
+        self.segments.insert(
+            idx, Segment(text=text, ins_key=op_key, ins_client=op_client)
+        )
+
+    def apply_remove(
+        self, pos1: int, pos2: int, op_key: int, op_client: int, ref_seq: int
+    ) -> None:
+        for i in self._range_indices(pos1, pos2, ref_seq, op_client):
+            seg = self.segments[i]
+            # Overlapping removes accumulate, stamp-sorted (ref seg.removes).
+            seg.removes.append((op_key, op_client))
+            seg.removes.sort()
+
+    def apply_annotate(
+        self,
+        pos1: int,
+        pos2: int,
+        prop: int,
+        value: int,
+        op_key: int,
+        op_client: int,
+        ref_seq: int,
+    ) -> None:
+        for i in self._range_indices(pos1, pos2, ref_seq, op_client):
+            seg = self.segments[i]
+            prev = seg.props.get(prop)
+            # LWW by stamp order; pending local writes outrank acked remotes.
+            if prev is None or op_key > prev[1]:
+                seg.props[prop] = (value, op_key)
+
+    # -------------------------------------------------------------------- ack
+    def ack(self, local_seq: int, seq: int) -> None:
+        """Convert pending stamps with this localSeq to the acked seq."""
+        local_key = encode_stamp(-1, local_seq)
+        for seg in self.segments:
+            if seg.ins_key == local_key:
+                seg.ins_key = seq
+            if any(key == local_key for key, _ in seg.removes):
+                seg.removes = sorted(
+                    (seq if key == local_key else key, client)
+                    for key, client in seg.removes
+                )
+            for prop, (value, key) in list(seg.props.items()):
+                if key == local_key:
+                    seg.props[prop] = (value, seq)
+
+    # --------------------------------------------------------------- lifetime
+    def update_min_seq(self, min_seq: int) -> None:
+        if min_seq > self.min_seq:
+            self.min_seq = min_seq
+            self.zamboni()
+
+    def zamboni(self) -> None:
+        """Evict segments unreferenceable from any legal perspective."""
+        self.segments = [
+            s
+            for s in self.segments
+            if not (s.removes and acked(s.removes[0][0]) and s.removes[0][0] <= self.min_seq)
+        ]

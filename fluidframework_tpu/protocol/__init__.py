@@ -1,0 +1,40 @@
+"""Protocol layer: wire message contracts and operation stamp encoding.
+
+Reference parity: common/lib/protocol-definitions (ISequencedDocumentMessage,
+IDocumentMessage), packages/dds/merge-tree/src/stamps.ts (OperationStamp
+ordering), packages/dds/merge-tree/src/ops.ts (MergeTreeDeltaType).
+"""
+
+from .stamps import (
+    LOCAL_BASE,
+    NO_REMOVE,
+    NON_COLLAB_CLIENT,
+    UNIVERSAL_SEQ,
+    acked,
+    encode_stamp,
+    has_occurred,
+    stamp_gt,
+)
+from .messages import (
+    DeltaType,
+    MessageType,
+    SequencedMessage,
+    UnsequencedMessage,
+    Nack,
+)
+
+__all__ = [
+    "LOCAL_BASE",
+    "NO_REMOVE",
+    "NON_COLLAB_CLIENT",
+    "UNIVERSAL_SEQ",
+    "acked",
+    "encode_stamp",
+    "has_occurred",
+    "stamp_gt",
+    "DeltaType",
+    "MessageType",
+    "SequencedMessage",
+    "UnsequencedMessage",
+    "Nack",
+]

@@ -1,0 +1,150 @@
+"""Wire message contracts.
+
+Reference parity: common/lib/protocol-definitions ``IDocumentMessage`` /
+``ISequencedDocumentMessage`` (op envelope stamped by the ordering service),
+``MessageType`` (op/join/leave/noop/summarize), and merge-tree
+``MergeTreeDeltaType`` (merge-tree/src/ops.ts:61).
+
+Field names keep the reference's JSON wire names (camelCase) in
+``to_json``/``from_json`` so op traces are interchangeable; in-memory we use
+snake_case dataclasses.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from enum import IntEnum
+from typing import Any
+
+
+class MessageType:
+    """Protocol-level message types (subset the framework uses)."""
+
+    OP = "op"
+    NOOP = "noop"
+    JOIN = "join"
+    LEAVE = "leave"
+    PROPOSE = "propose"
+    REJECT = "reject"
+    SUMMARIZE = "summarize"
+    SUMMARY_ACK = "summaryAck"
+    SUMMARY_NACK = "summaryNack"
+    SIGNAL = "signal"  # unsequenced broadcast (presence)
+
+
+class DeltaType(IntEnum):
+    """Merge-tree op types (reference MergeTreeDeltaType, ops.ts:61)."""
+
+    INSERT = 0
+    REMOVE = 1
+    ANNOTATE = 2
+    GROUP = 3
+    OBLITERATE = 4
+    OBLITERATE_SIDED = 5
+
+
+@dataclass
+class UnsequencedMessage:
+    """A client op before ordering (reference IDocumentMessage)."""
+
+    client_id: str
+    client_seq: int  # clientSequenceNumber: per-client monotone counter
+    ref_seq: int  # referenceSequenceNumber: last seq client had applied
+    type: str = MessageType.OP
+    contents: Any = None
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "clientId": self.client_id,
+                "clientSequenceNumber": self.client_seq,
+                "referenceSequenceNumber": self.ref_seq,
+                "type": self.type,
+                "contents": self.contents,
+            },
+            separators=(",", ":"),
+        )
+
+    @staticmethod
+    def from_json(raw: str) -> "UnsequencedMessage":
+        d = json.loads(raw)
+        return UnsequencedMessage(
+            client_id=d["clientId"],
+            client_seq=d["clientSequenceNumber"],
+            ref_seq=d["referenceSequenceNumber"],
+            type=d.get("type", MessageType.OP),
+            contents=d.get("contents"),
+        )
+
+
+@dataclass
+class SequencedMessage:
+    """An op after the sequencer stamped a total order position.
+
+    Reference ISequencedDocumentMessage: sequenceNumber is the total-order
+    position; minimumSequenceNumber (MSN) is the collab-window floor — every
+    connected client has applied at least this seq, so state below it may be
+    compacted (zamboni / trunk eviction).
+    """
+
+    client_id: str
+    client_seq: int
+    ref_seq: int
+    seq: int
+    min_seq: int
+    type: str = MessageType.OP
+    contents: Any = None
+    timestamp: float = 0.0
+    # Short numeric client id assigned by quorum join order (the id used in
+    # stamps; reference attributes ops via the quorum's client table).
+    short_client: int = -1
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "clientId": self.client_id,
+                "clientSequenceNumber": self.client_seq,
+                "referenceSequenceNumber": self.ref_seq,
+                "sequenceNumber": self.seq,
+                "minimumSequenceNumber": self.min_seq,
+                "type": self.type,
+                "contents": self.contents,
+                "timestamp": self.timestamp,
+                "shortClient": self.short_client,
+            },
+            separators=(",", ":"),
+        )
+
+    @staticmethod
+    def from_json(raw: str) -> "SequencedMessage":
+        d = json.loads(raw)
+        return SequencedMessage(
+            client_id=d["clientId"],
+            client_seq=d["clientSequenceNumber"],
+            ref_seq=d["referenceSequenceNumber"],
+            seq=d["sequenceNumber"],
+            min_seq=d["minimumSequenceNumber"],
+            type=d.get("type", MessageType.OP),
+            contents=d.get("contents"),
+            timestamp=d.get("timestamp", 0.0),
+            short_client=d.get("shortClient", -1),
+        )
+
+
+@dataclass
+class Nack:
+    """Rejection of a client op (reference INack): bad refSeq / not joined."""
+
+    client_id: str
+    client_seq: int
+    reason: str
+    retry_after: float = 0.0
+
+
+@dataclass
+class SignalMessage:
+    """Unsequenced broadcast (presence path; reference ISignalMessage)."""
+
+    client_id: str
+    contents: Any = None

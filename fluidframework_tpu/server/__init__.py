@@ -1,0 +1,10 @@
+"""Ordering service: sequencer + in-memory local service.
+
+Reference parity: server/routerlicious deli lambda (the sequencer),
+memory-orderer/local-server (in-process service used by tests).
+"""
+
+from .sequencer import Sequencer, ClientEntry
+from .local_service import LocalService, LocalDocument
+
+__all__ = ["Sequencer", "ClientEntry", "LocalService", "LocalDocument"]

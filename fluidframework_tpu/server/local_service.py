@@ -1,0 +1,116 @@
+"""In-process ordering service for tests and local development.
+
+Reference parity: memory-orderer ``LocalOrderer`` + local-server
+``LocalDeltaConnectionServer`` (the full deli pipeline in-process, no
+Kafka/Mongo/Redis) — the backbone of the reference's integration tests.
+
+Deterministic delivery control: ops are ticketed immediately but delivery to
+subscribers is explicit via ``process_all`` / ``process_some``, mirroring the
+reference's ``MockContainerRuntimeFactory.processAllMessages`` pattern that
+DDS tests use to control interleaving.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable
+
+from ..protocol.messages import Nack, SequencedMessage, UnsequencedMessage
+from .sequencer import Sequencer
+
+Subscriber = Callable[[SequencedMessage], None]
+
+
+class LocalDocument:
+    """One ordered document: a sequencer plus broadcast fan-out."""
+
+    def __init__(self, doc_id: str) -> None:
+        self.doc_id = doc_id
+        self.sequencer = Sequencer()
+        self._subscribers: dict[str, Subscriber] = {}
+        self._nack_handlers: dict[str, Callable[[Nack], None]] = {}
+        self._pending: deque[SequencedMessage] = deque()
+        self.nacks: list[Nack] = []
+
+    def connect(
+        self,
+        client_id: str,
+        subscriber: Subscriber,
+        on_nack: Callable[[Nack], None] | None = None,
+    ) -> SequencedMessage:
+        """Join a client and subscribe it to the broadcast stream.
+
+        Late joiners are caught up synchronously with the already-delivered
+        prefix of the op log (snapshot-free catch-up; the reference loads a
+        snapshot plus trailing ops — the trailing-ops path is what this is).
+        Messages still queued for delivery arrive through the normal pump.
+        """
+        already_delivered = len(self.sequencer.log) - len(self._pending)
+        for msg in self.sequencer.log[:already_delivered]:
+            subscriber(msg)
+        join = self.sequencer.join(client_id)
+        self._subscribers[client_id] = subscriber
+        if on_nack is not None:
+            self._nack_handlers[client_id] = on_nack
+        self._pending.append(join)
+        return join
+
+    def disconnect(self, client_id: str) -> None:
+        leave = self.sequencer.leave(client_id)
+        self._subscribers.pop(client_id, None)
+        self._nack_handlers.pop(client_id, None)
+        self._pending.append(leave)
+
+    def submit(self, msg: UnsequencedMessage) -> SequencedMessage | Nack:
+        """Ticket an op; queues the sequenced result for broadcast.
+
+        Nacks are routed back to the submitting client's nack handler (the
+        reference sends them on the socket to the offending client only).
+        """
+        out = self.sequencer.ticket(msg)
+        if isinstance(out, Nack):
+            self.nacks.append(out)
+            handler = self._nack_handlers.get(msg.client_id)
+            if handler is not None:
+                handler(out)
+        else:
+            self._pending.append(out)
+        return out
+
+    @property
+    def pending_count(self) -> int:
+        return len(self._pending)
+
+    def process_some(self, count: int) -> int:
+        """Deliver up to ``count`` queued sequenced ops to all subscribers."""
+        delivered = 0
+        while self._pending and delivered < count:
+            msg = self._pending.popleft()
+            for sub in list(self._subscribers.values()):
+                sub(msg)
+            delivered += 1
+        return delivered
+
+    def process_all(self) -> int:
+        return self.process_some(len(self._pending))
+
+
+class LocalService:
+    """A multi-document in-memory service (tinylicious analog)."""
+
+    def __init__(self) -> None:
+        self._docs: dict[str, LocalDocument] = {}
+
+    def document(self, doc_id: str) -> LocalDocument:
+        if doc_id not in self._docs:
+            self._docs[doc_id] = LocalDocument(doc_id)
+        return self._docs[doc_id]
+
+    def documents(self) -> list[LocalDocument]:
+        return list(self._docs.values())
+
+    def process_all(self) -> int:
+        n = 0
+        for doc in self._docs.values():
+            n += doc.process_all()
+        return n

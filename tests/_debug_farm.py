@@ -1,0 +1,76 @@
+"""Shrinker for farm divergence: replay a seed with an event budget to find
+a minimal repro.  Reuses the exact op generator from the farm test so shrink
+results map 1:1 onto test failures.
+
+Usage:  python tests/_debug_farm.py [seed]
+"""
+
+import pathlib
+import random
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+from fluidframework_tpu.server.local_service import LocalDocument
+
+from test_mergetree_oracle import draw_op, issue_op, make_clients, pump
+
+
+def run(seed, trace=None, max_events=None):
+    """Replay the farm schedule for ``seed``; ``max_events`` caps the number
+    of DDS ops issued (for bisection), ``trace`` collects (client, op).
+    Ops past the budget still consume rng draws so the schedule stays
+    aligned with the un-capped run."""
+    rng = random.Random(seed)
+    doc = LocalDocument("d")
+    clients = make_clients(doc, rng.randint(2, 4))
+
+    events = 0
+
+    def budget():
+        nonlocal events
+        events += 1
+        return max_events is None or events <= max_events
+
+    for _round in range(rng.randint(5, 15)):
+        for c in clients:
+            for _ in range(rng.randint(0, 3)):
+                op = draw_op(rng, len(c.text))
+                if budget():
+                    issue_op(c, op)
+                    if trace is not None:
+                        trace.append((c.client_id, op))
+            if rng.random() < 0.7:
+                for m in c.take_outbox():
+                    doc.submit(m)
+        doc.process_some(rng.randint(0, doc.pending_count))
+
+    pump(doc, clients)
+    return [c.text for c in clients], clients, doc
+
+
+if __name__ == "__main__":
+    seed = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+    texts, clients, doc = run(seed)
+    if len(set(texts)) == 1:
+        print(f"seed {seed}: converged to {texts[0]!r}")
+        sys.exit(0)
+    print(f"seed {seed}: DIVERGED")
+    lo = None
+    for n in range(1, 500):
+        texts, clients, doc = run(seed, max_events=n)
+        if len(set(texts)) != 1:
+            lo = n
+            break
+    print("min events to diverge:", lo)
+    if lo:
+        trace = []
+        texts, clients, doc = run(seed, trace=trace, max_events=lo)
+        for e in trace:
+            print(e)
+        for c in clients:
+            print(c.client_id, repr(c.text))
+        print("seq log:")
+        for m in doc.sequencer.log:
+            print(m.seq, m.client_id, m.ref_seq, m.type, m.contents)

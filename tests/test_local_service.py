@@ -1,0 +1,95 @@
+"""Local service behaviors: late-join catch-up, nack routing, wire replay."""
+
+import pytest
+
+from fluidframework_tpu.dds.shared_string import SharedString
+from fluidframework_tpu.protocol.messages import SequencedMessage
+from fluidframework_tpu.server.local_service import LocalDocument, LocalService
+
+
+def test_late_joiner_catches_up_with_delivered_log():
+    doc = LocalDocument("d")
+    a = SharedString(client_id="a")
+    doc.connect(a.client_id, a.process)
+    doc.process_all()
+    a.insert_text(0, "abc")
+    for m in a.take_outbox():
+        doc.submit(m)
+    doc.process_all()
+
+    b = SharedString(client_id="b")
+    doc.connect(b.client_id, b.process)
+    doc.process_all()
+    assert b.text == "abc"
+    # And the late joiner can edit at positions only valid post-catch-up.
+    b.insert_text(3, "!")
+    for m in b.take_outbox():
+        doc.submit(m)
+    doc.process_all()
+    assert a.text == b.text == "abc!"
+
+
+def test_nack_routed_to_submitting_client():
+    doc = LocalDocument("d")
+    a = SharedString(client_id="a")
+    doc.connect(a.client_id, a.process, on_nack=a.process_nack)
+    doc.process_all()
+    a.insert_text(0, "x")
+    (msg,) = a.take_outbox()
+    doc.submit(msg)
+    # Replaying the same clientSeq is a duplicate -> nack -> client raises.
+    with pytest.raises(RuntimeError, match="nacked"):
+        doc.submit(msg)
+
+
+def test_edit_before_join_delivery_is_rejected():
+    doc = LocalDocument("d")
+    a = SharedString(client_id="a")
+    doc.connect(a.client_id, a.process)
+    with pytest.raises(RuntimeError, match="join"):
+        a.insert_text(0, "early")
+
+
+def test_wire_replay_reproduces_replica():
+    """Serializing the op log and replaying it through JSON must produce the
+    same converged text (trace interchangeability)."""
+    svc = LocalService()
+    doc = svc.document("d")
+    a = SharedString(client_id="a")
+    b = SharedString(client_id="b")
+    doc.connect(a.client_id, a.process)
+    doc.connect(b.client_id, b.process)
+    doc.process_all()
+    a.insert_text(0, "hello")
+    b.insert_text(0, "world")
+    for c in (a, b):
+        for m in c.take_outbox():
+            doc.submit(m)
+    doc.process_all()
+    a.remove_range(2, 5)
+    for m in a.take_outbox():
+        doc.submit(m)
+    doc.process_all()
+    assert a.text == b.text
+
+    wire = [m.to_json() for m in doc.sequencer.log]
+    observer = SharedString(client_id="observer")
+    for raw in wire:
+        observer.process(SequencedMessage.from_json(raw))
+    assert observer.backend.visible_text() == a.text
+
+
+def test_disconnect_stops_delivery_and_advances_msn():
+    doc = LocalDocument("d")
+    a = SharedString(client_id="a")
+    b = SharedString(client_id="b")
+    doc.connect(a.client_id, a.process)
+    doc.connect(b.client_id, b.process)
+    doc.process_all()
+    doc.disconnect("b")
+    a.insert_text(0, "x")
+    for m in a.take_outbox():
+        doc.submit(m)
+    doc.process_all()
+    assert a.text == "x"
+    assert b.text == ""  # no delivery after disconnect

@@ -1,0 +1,220 @@
+"""Merge-tree oracle: unit semantics + multi-client convergence fuzz.
+
+Mirrors the reference's merge-tree test strategy (SURVEY.md §4): directed
+unit tests for tie-break/visibility edge cases plus randomized "farm" rounds
+where N clients edit concurrently through the sequencer and must converge.
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.mergetree_ref import RefMergeTree
+from fluidframework_tpu.dds.shared_string import SharedString
+from fluidframework_tpu.protocol.stamps import ALL_ACKED, LOCAL_BASE
+from fluidframework_tpu.server.local_service import LocalDocument
+
+
+def make_clients(doc: LocalDocument, n: int) -> list[SharedString]:
+    clients = []
+    for i in range(n):
+        c = SharedString(client_id=f"c{i}")
+        doc.connect(c.client_id, c.process)
+        clients.append(c)
+    doc.process_all()  # deliver joins so short ids are assigned
+    return clients
+
+
+def pump(doc: LocalDocument, clients: list[SharedString]) -> None:
+    """Flush every outbox through the sequencer and deliver everything."""
+    moved = True
+    while moved:
+        moved = False
+        for c in clients:
+            for m in c.take_outbox():
+                doc.submit(m)
+                moved = True
+        if doc.pending_count:
+            doc.process_all()
+            moved = True
+
+
+class TestDirectedSemantics:
+    def test_single_client_insert_remove(self):
+        doc = LocalDocument("d")
+        (a,) = make_clients(doc, 1)
+        a.insert_text(0, "hello world")
+        a.remove_range(5, 11)
+        a.insert_text(5, "!")
+        pump(doc, [a])
+        assert a.text == "hello!"
+
+    def test_concurrent_inserts_same_position_later_seq_wins_front(self):
+        """Two clients insert at pos 0 concurrently: the op sequenced LATER
+        lands closer to the front (reference breakTie: incoming stamp greater
+        than the concurrent segment's stamp goes before it)."""
+        doc = LocalDocument("d")
+        a, b = make_clients(doc, 2)
+        a.insert_text(0, "A")
+        b.insert_text(0, "B")
+        # a's op is submitted first -> seq smaller; b's op sequenced later.
+        pump(doc, [a, b])
+        assert a.text == b.text == "BA"
+
+    def test_local_pending_stays_in_front_of_remote_insert(self):
+        """Reference: local unacked stamps outrank all acked stamps, so a
+        remote insert at the same position does not jump a pending local
+        segment."""
+        doc = LocalDocument("d")
+        a, b = make_clients(doc, 2)
+        b.insert_text(0, "B")
+        for m in b.take_outbox():
+            doc.submit(m)
+        a.insert_text(0, "A")  # pending on a
+        doc.process_all()  # delivers b's op to a while a's op still pending
+        # On a: local pending "A" outranks the acked remote "B".
+        assert a.text == "AB"
+        pump(doc, [a, b])
+        # After a's op is sequenced (later than b's), both converge to "AB".
+        assert a.text == b.text == "AB"
+
+    def test_insert_goes_before_tombstone(self):
+        """Inserting at a boundary adjacent to removed text lands before the
+        tombstone (breakTie: incoming acked stamp > old insert stamp)."""
+        doc = LocalDocument("d")
+        a, b = make_clients(doc, 2)
+        a.insert_text(0, "ab")
+        pump(doc, [a, b])
+        a.remove_range(1, 2)  # remove 'b'
+        pump(doc, [a, b])
+        b.insert_text(1, "X")  # at end of visible text, before tombstone 'b'
+        pump(doc, [a, b])
+        assert a.text == b.text == "aX"
+        # The tombstone is evicted once MSN passes the remove.
+        backend = a.backend
+        assert isinstance(backend, RefMergeTree)
+
+    def test_concurrent_remove_overlap_converges(self):
+        doc = LocalDocument("d")
+        a, b = make_clients(doc, 2)
+        a.insert_text(0, "abcdef")
+        pump(doc, [a, b])
+        a.remove_range(1, 4)
+        b.remove_range(2, 6)
+        pump(doc, [a, b])
+        assert a.text == b.text == "a"
+
+    def test_remove_does_not_affect_concurrent_insert(self):
+        """Set-remove only removes what was visible in the op's perspective:
+        a concurrent insert inside the removed range survives."""
+        doc = LocalDocument("d")
+        a, b = make_clients(doc, 2)
+        a.insert_text(0, "abcd")
+        pump(doc, [a, b])
+        a.remove_range(0, 4)
+        b.insert_text(2, "X")
+        pump(doc, [a, b])
+        assert a.text == b.text == "X"
+
+    def test_annotate_lww_converges(self):
+        doc = LocalDocument("d")
+        a, b = make_clients(doc, 2)
+        a.insert_text(0, "abcd")
+        pump(doc, [a, b])
+        a.annotate_range(0, 3, 7, 100)
+        b.annotate_range(1, 4, 7, 200)
+        pump(doc, [a, b])
+        ann_a = a.backend.annotations(ALL_ACKED, a.short_client)
+        ann_b = b.backend.annotations(ALL_ACKED, b.short_client)
+        assert ann_a == ann_b
+        # b's annotate sequenced later -> wins on the overlap [1,3).
+        assert ann_a == [{7: 100}, {7: 200}, {7: 200}, {7: 200}]
+
+    def test_zamboni_eviction_preserves_text(self):
+        doc = LocalDocument("d")
+        a, b = make_clients(doc, 2)
+        a.insert_text(0, "abcdef")
+        pump(doc, [a, b])
+        a.remove_range(1, 3)
+        pump(doc, [a, b])
+        # Force MSN to advance by having both clients op afterwards.
+        a.insert_text(0, "x")
+        pump(doc, [a, b])
+        b.insert_text(0, "y")
+        pump(doc, [a, b])
+        assert a.text == b.text
+        # Tombstones below MSN are gone on both replicas.
+        for client in (a, b):
+            backend = client.backend
+            assert all(len(s.text) > 0 for s in backend.segments)
+
+
+OPS = ("insert", "insert", "insert", "remove", "annotate")
+
+
+def draw_op(rng: random.Random, n: int, alphabet: str = "abcdefgh") -> tuple:
+    """Draw one random op descriptor against a document of visible length n.
+
+    Pure rng consumption — separated from application so the shrinker in
+    _debug_farm.py can keep rng schedules aligned while skipping issuance.
+    """
+    kind = rng.choice(OPS)
+    if kind == "insert" or n == 0:
+        pos = rng.randint(0, n)
+        text = "".join(rng.choice(alphabet) for _ in range(rng.randint(1, 4)))
+        return ("insert", pos, text)
+    p1 = rng.randint(0, n - 1)
+    p2 = rng.randint(p1 + 1, n)
+    if kind == "remove":
+        return ("remove", p1, p2)
+    return ("annotate", p1, p2, rng.randint(0, 3), rng.randint(0, 1000))
+
+
+def issue_op(c: SharedString, op: tuple) -> None:
+    if op[0] == "insert":
+        c.insert_text(op[1], op[2])
+    elif op[0] == "remove":
+        c.remove_range(op[1], op[2])
+    else:
+        c.annotate_range(op[1], op[2], op[3], op[4])
+
+
+def random_op(rng: random.Random, c: SharedString, alphabet: str) -> None:
+    issue_op(c, draw_op(rng, len(c.text), alphabet))
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_conflict_farm_convergence(seed):
+    """N clients make interleaved concurrent edits with randomized delivery;
+    all replicas (and a pure-observer replica) must converge exactly.
+
+    Reference analog: merge-tree client.conflictFarm.spec.ts.
+    """
+    rng = random.Random(seed)
+    doc = LocalDocument("d")
+    n_clients = rng.randint(2, 4)
+    clients = make_clients(doc, n_clients)
+    observer = SharedString(client_id="observer")  # never edits
+    doc.connect(observer.client_id, observer.process)
+    doc.process_all()
+
+    for _round in range(rng.randint(5, 15)):
+        for c in clients:
+            for _ in range(rng.randint(0, 3)):
+                random_op(rng, c, "abcdefgh")
+            # Randomly flush some outboxes early (partial interleaving).
+            if rng.random() < 0.7:
+                for m in c.take_outbox():
+                    doc.submit(m)
+        # Deliver a random prefix of the sequenced stream.
+        doc.process_some(rng.randint(0, doc.pending_count))
+
+    pump(doc, clients + [observer])
+    texts = {c.text for c in clients}
+    assert len(texts) == 1, f"divergent texts: {texts}"
+    assert observer.backend.visible_text(ALL_ACKED, observer.short_client) == clients[0].text
+    anns = {
+        tuple(map(str, c.backend.annotations(ALL_ACKED, c.short_client)))
+        for c in clients + [observer]
+    }
+    assert len(anns) == 1, "divergent annotations"

@@ -1,0 +1,116 @@
+"""Protocol layer: stamp encoding order and sequencer (deli) semantics."""
+
+import pytest
+
+from fluidframework_tpu.protocol.stamps import (
+    LOCAL_BASE,
+    acked,
+    encode_stamp,
+    has_occurred,
+)
+from fluidframework_tpu.protocol.messages import (
+    MessageType,
+    Nack,
+    SequencedMessage,
+    UnsequencedMessage,
+)
+from fluidframework_tpu.server.sequencer import Sequencer
+
+
+class TestStampEncoding:
+    def test_acked_order_by_seq(self):
+        assert encode_stamp(3) < encode_stamp(7)
+
+    def test_every_acked_below_every_unacked(self):
+        # Reference stamps.ts: acked ops happen-before all local+unacked ops.
+        assert encode_stamp(10**9 // 2) < encode_stamp(-1, local_seq=0)
+
+    def test_unacked_order_by_local_seq(self):
+        assert encode_stamp(-1, local_seq=1) < encode_stamp(-1, local_seq=2)
+
+    def test_acked_predicate(self):
+        assert acked(encode_stamp(5))
+        assert not acked(encode_stamp(-1, local_seq=5))
+
+    def test_has_occurred_ref_seq(self):
+        assert has_occurred(encode_stamp(5), client=1, ref_seq=5, view_client=2)
+        assert not has_occurred(encode_stamp(6), client=1, ref_seq=5, view_client=2)
+
+    def test_has_occurred_same_client(self):
+        # A client has seen all of its own ops regardless of refSeq.
+        assert has_occurred(encode_stamp(6), client=2, ref_seq=5, view_client=2)
+        assert has_occurred(
+            encode_stamp(-1, local_seq=4), client=2, ref_seq=5, view_client=2
+        )
+
+
+def _op(client, cseq, refseq):
+    return UnsequencedMessage(client_id=client, client_seq=cseq, ref_seq=refseq)
+
+
+class TestSequencer:
+    def test_join_assigns_short_ids_in_order(self):
+        s = Sequencer()
+        j1, j2 = s.join("a"), s.join("b")
+        assert (j1.short_client, j2.short_client) == (0, 1)
+        assert (j1.seq, j2.seq) == (1, 2)
+
+    def test_ticket_assigns_monotone_seq(self):
+        s = Sequencer()
+        s.join("a")
+        m1 = s.ticket(_op("a", 1, 1))
+        m2 = s.ticket(_op("a", 2, 1))
+        assert isinstance(m1, SequencedMessage)
+        assert (m1.seq, m2.seq) == (2, 3)
+
+    def test_nack_unjoined(self):
+        s = Sequencer()
+        assert isinstance(s.ticket(_op("ghost", 1, 0)), Nack)
+
+    def test_nack_out_of_order_client_seq(self):
+        s = Sequencer()
+        s.join("a")
+        s.ticket(_op("a", 1, 1))
+        assert isinstance(s.ticket(_op("a", 1, 1)), Nack)  # duplicate
+        assert isinstance(s.ticket(_op("a", 3, 1)), Nack)  # gap
+
+    def test_msn_is_min_ref_seq_over_clients(self):
+        s = Sequencer()
+        s.join("a")
+        s.join("b")
+        m = s.ticket(_op("a", 1, 2))
+        # b has only seen seq 2 at join time; a advanced to 2 -> MSN = 2.
+        assert m.min_seq == 2
+        m2 = s.ticket(_op("b", 1, 3))
+        assert m2.min_seq == 2  # a still at refSeq 2
+
+    def test_msn_advances_when_laggard_leaves(self):
+        s = Sequencer()
+        s.join("a")
+        s.join("b")
+        s.ticket(_op("a", 1, 2))
+        s.leave("b")
+        m = s.ticket(_op("a", 2, 4))
+        assert m.min_seq == 4
+
+    def test_nack_ref_seq_below_msn(self):
+        s = Sequencer()
+        s.join("a")
+        for i in range(1, 6):
+            s.ticket(_op("a", i, i))
+        assert isinstance(s.ticket(_op("a", 6, 1)), Nack)
+
+    def test_checkpoint_restore_roundtrip(self):
+        s = Sequencer()
+        s.join("a")
+        s.ticket(_op("a", 1, 1))
+        s2 = Sequencer.restore(s.checkpoint())
+        m = s2.ticket(_op("a", 2, 2))
+        assert isinstance(m, SequencedMessage)
+        assert m.seq == 3
+
+    def test_wire_roundtrip(self):
+        s = Sequencer()
+        s.join("a")
+        m = s.ticket(_op("a", 1, 1))
+        assert SequencedMessage.from_json(m.to_json()).seq == m.seq
