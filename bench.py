@@ -1,0 +1,152 @@
+"""Benchmark: merge-tree sequenced-op application throughput per chip.
+
+North-star metric (BASELINE.json): merge-tree ops/sec/chip across a fleet of
+concurrent SharedString documents, target >= 1M ops/sec/chip on TPU with
+reference-equivalent semantics (the semantics are enforced by the
+differential test suite; this file measures throughput only).
+
+Workload (config 3 of BASELINE.md, single-writer form): D documents, each
+receiving a stream of sequenced insert/remove ops at uniformly random valid
+positions; ops are applied B per document per device step, with MSN-driven
+zamboni compaction fused into every step.  The whole run (S steps) executes
+as ONE jitted program (scan over steps -> scan over ops) so host dispatch
+and transfer are excluded from the steady-state measurement, exactly as a
+production ingest pipeline would double-buffer uploads.
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def generate_workload(n_docs, ops_per_step, n_steps, ins_len, payload_len, seed=0):
+    """Single-writer random edit traces with positions valid by construction.
+
+    Returns ops[int32 S,D,B,8], payloads[int32 S,D,B,L], min_seqs[int32 S,D].
+    """
+    from fluidframework_tpu.ops import mergetree_kernel as mk
+    from fluidframework_tpu.protocol.stamps import ALL_ACKED
+
+    rng = np.random.default_rng(seed)
+    D, B, S, L = n_docs, ops_per_step, n_steps, payload_len
+    ops = np.zeros((S, D, B, mk.OP_FIELDS), np.int32)
+    payloads = rng.integers(97, 123, size=(S, D, B, L), dtype=np.int32)
+    lengths = np.zeros((D,), np.int64)
+    seq = np.ones((D,), np.int64)
+    for s in range(S):
+        for b in range(B):
+            do_insert = (rng.random(D) < 0.5) | (lengths < 2)
+            pos = (rng.random(D) * (lengths + 1)).astype(np.int64)
+            pos = np.minimum(pos, lengths)
+            # insert: ins_len chars at pos
+            ops[s, :, b, 0] = np.where(do_insert, mk.OpKind.INSERT, mk.OpKind.REMOVE)
+            ops[s, :, b, 1] = seq
+            ops[s, :, b, 2] = 0  # single writer: short client 0
+            ops[s, :, b, 3] = ALL_ACKED  # sequential writer sees everything
+            ops[s, :, b, 4] = np.where(do_insert, pos, np.minimum(pos, lengths - 2))
+            ops[s, :, b, 5] = np.where(do_insert, 0, np.minimum(pos, lengths - 2) + 2)
+            ops[s, :, b, 6] = np.where(do_insert, ins_len, 0)
+            lengths = np.where(do_insert, lengths + ins_len, lengths - 2)
+            seq += 1
+    # MSN floor: everything applied so far is below the window.
+    min_seqs = np.broadcast_to(
+        (np.arange(S, dtype=np.int64)[:, None] + 1) * B, (S, D)
+    ).astype(np.int32)
+    # Layout: the doc axis must be minor ([S,B,F,D]) — trailing dims of 8
+    # would be lane-padded to 128 on TPU (16x memory blowup on upload).
+    ops = np.ascontiguousarray(np.moveaxis(ops, 1, -1))
+    payloads = np.ascontiguousarray(np.moveaxis(payloads, 1, -1))
+    return ops, payloads, min_seqs
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--docs", type=int, default=1024)
+    p.add_argument("--segments", type=int, default=2048)
+    p.add_argument("--text-capacity", type=int, default=16384)
+    p.add_argument("--ops-per-step", type=int, default=16)
+    p.add_argument("--steps", type=int, default=96)
+    p.add_argument("--warmup-steps", type=int, default=16)
+    p.add_argument("--insert-len", type=int, default=4)
+    p.add_argument("--payload-len", type=int, default=8)
+    p.add_argument("--compact-every", type=int, default=4)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops import mergetree_kernel as mk
+
+    D, B = args.docs, args.ops_per_step
+    proto = mk.init_state(
+        max_segments=args.segments,
+        remove_slots=4,
+        prop_slots=2,
+        text_capacity=args.text_capacity,
+    )
+    state = jax.tree.map(lambda x: jnp.broadcast_to(x, (D,) + x.shape), proto)
+
+    # ops arrive as [B, F, D] per step (doc axis minor): vmap over axis 2.
+    apply_batch = jax.vmap(mk.apply_ops, in_axes=(0, 2, 2))
+    compact_batch = jax.vmap(lambda s, m: mk.compact(mk.set_min_seq(s, m)))
+
+    ce = args.compact_every
+
+    def run(state, all_ops, all_payloads, all_minseqs):
+        def body(carry, xs):
+            s, i = carry
+            ops, payloads, min_seqs = xs
+            s = apply_batch(s, ops, payloads)
+            s = jax.lax.cond(
+                (i + 1) % ce == 0,
+                lambda s: compact_batch(s, min_seqs),
+                lambda s: s,
+                s,
+            )
+            return (s, i + 1), None
+
+        (s, _), _ = jax.lax.scan(
+            body, (state, jnp.zeros((), jnp.int32)), (all_ops, all_payloads, all_minseqs)
+        )
+        return s
+
+    runner = jax.jit(run, donate_argnums=(0,))
+
+    total_steps = args.warmup_steps + args.steps
+    ops, payloads, min_seqs = generate_workload(
+        D, B, total_steps, args.insert_len, args.payload_len
+    )
+    w = args.warmup_steps
+    dev_w = (jnp.asarray(ops[:w]), jnp.asarray(payloads[:w]), jnp.asarray(min_seqs[:w]))
+    dev_t = (jnp.asarray(ops[w:]), jnp.asarray(payloads[w:]), jnp.asarray(min_seqs[w:]))
+
+    # Warmup: compiles the runner (scan lengths differ -> compile both once).
+    state = runner(state, *dev_w)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    state = runner(state, *dev_t)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    errors = int(np.asarray(jnp.sum(state.error != 0)))
+    n_ops = args.steps * D * B
+    ops_per_sec = n_ops / dt
+    result = {
+        "metric": "mergetree_ops_per_sec_per_chip",
+        "value": round(ops_per_sec, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(ops_per_sec / 1e6, 4),
+    }
+    if errors:
+        result["error_docs"] = errors
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

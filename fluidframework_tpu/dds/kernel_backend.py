@@ -1,0 +1,112 @@
+"""Host adapter: run a SharedString client on the TPU merge-tree kernel.
+
+Implements the ``MergeTreeBackend`` protocol (the channel-boundary analog)
+over a single-document ``DocState``, so the exact same client/service test
+harness drives either the Python oracle or the JAX kernel — the differential
+oracle setup the reference achieves with its fuzz suites.
+
+This adapter is the *correctness* path (one jitted call per op).  The
+*throughput* path batches ops across documents first — see
+``models/doc_batch_engine.py``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from ..ops import mergetree_kernel as mk
+from ..protocol.stamps import ALL_ACKED
+
+
+@jax.jit
+def _apply_one(state: mk.DocState, op, payload) -> mk.DocState:
+    return mk.apply_op(state, op, payload)
+
+
+@jax.jit
+def _compact(state: mk.DocState) -> mk.DocState:
+    return mk.compact(state)
+
+
+class KernelMergeTree:
+    """Single-doc merge-tree replica backed by the columnar kernel."""
+
+    def __init__(
+        self,
+        max_segments: int = 512,
+        remove_slots: int = 4,
+        prop_slots: int = 4,
+        text_capacity: int = 8192,
+        max_insert_len: int = 64,
+    ) -> None:
+        self.state = mk.init_state(
+            max_segments, remove_slots, prop_slots, text_capacity
+        )
+        self.max_insert_len = max_insert_len
+        self._empty_payload = np.zeros((max_insert_len,), np.int32)
+        # Host-interned property ids -> kernel prop slots.
+        self._prop_slot: dict[int, int] = {}
+
+    # ------------------------------------------------------------------ utils
+    def _op(self, kind, key=0, client=-1, ref_seq=0, pos1=0, pos2=0, a=0, b=0):
+        return np.array(
+            [kind, key, client, ref_seq, pos1, pos2, a, b], np.int32
+        )
+
+    def _step(self, op, payload=None) -> None:
+        p = self._empty_payload if payload is None else payload
+        self.state = _apply_one(self.state, op, p)
+
+    def check_errors(self) -> int:
+        return int(self.state.error)
+
+    def _slot_for(self, prop: int) -> int:
+        if prop not in self._prop_slot:
+            slot = len(self._prop_slot)
+            if slot >= len(self.state.prop_keys):
+                raise ValueError(f"out of prop slots for prop id {prop}")
+            self._prop_slot[prop] = slot
+        return self._prop_slot[prop]
+
+    # ---------------------------------------------------------------- backend
+    def apply_insert(self, pos, text, op_key, op_client, ref_seq) -> None:
+        for op, payload in mk.encode_insert(
+            pos, text, op_key, op_client, ref_seq, self.max_insert_len
+        ):
+            self._step(op, payload)
+
+    def apply_remove(self, pos1, pos2, op_key, op_client, ref_seq) -> None:
+        self._step(
+            self._op(
+                mk.OpKind.REMOVE, key=op_key, client=op_client, ref_seq=ref_seq,
+                pos1=pos1, pos2=pos2,
+            )
+        )
+
+    def apply_annotate(self, pos1, pos2, prop, value, op_key, op_client, ref_seq) -> None:
+        self._step(
+            self._op(
+                mk.OpKind.ANNOTATE, key=op_key, client=op_client, ref_seq=ref_seq,
+                pos1=pos1, pos2=pos2, a=self._slot_for(prop), b=value,
+            )
+        )
+
+    def ack(self, local_seq, seq) -> None:
+        self._step(self._op(mk.OpKind.ACK, a=local_seq, b=seq))
+
+    def update_min_seq(self, min_seq) -> None:
+        prev = int(self.state.min_seq)
+        if min_seq > prev:
+            self.state = mk.set_min_seq(self.state, min_seq)
+            self.state = _compact(self.state)
+
+    def visible_text(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> str:
+        vc = -3 if view_client is None else view_client
+        return mk.visible_text(self.state, ref_seq, vc)
+
+    def annotations(self, ref_seq: int = ALL_ACKED, view_client: int | None = None):
+        vc = -3 if view_client is None else view_client
+        raw = mk.annotations(self.state, ref_seq, vc)
+        inv = {v: k for k, v in self._prop_slot.items()}
+        return [{inv[p]: v for p, v in d.items()} for d in raw]

@@ -1,0 +1,11 @@
+"""Assembled end-to-end engines (the benchmark targets).
+
+``DocBatchEngine`` is the flagship: a server-side replica of thousands of
+documents whose sequenced-op streams are applied in batched device steps —
+the TPU-native expression of the reference's inbound-op hot path
+(ContainerRuntime.process -> DDS apply) across a whole fleet of containers.
+"""
+
+from .doc_batch_engine import DocBatchEngine
+
+__all__ = ["DocBatchEngine"]

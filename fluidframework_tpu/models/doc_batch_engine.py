@@ -1,0 +1,214 @@
+"""DocBatchEngine: batched sequenced-op application across many documents.
+
+The north-star configuration (BASELINE.json): thousands of SharedString
+documents, each with its own totally-ordered op stream, applied in lockstep
+device steps — ``vmap`` of the per-doc merge-tree kernel over a leading
+document axis, sharded over a TPU mesh along ``docs``.
+
+Host/device split (mirrors the reference's seam at
+ContainerRuntime.processInboundMessages, containerRuntime.ts:3428 — where
+contiguous ops are bunched before DDS apply; here the bunch becomes a
+[D, B] tensor step):
+
+- host: per-doc staging queues of sequenced messages, op encoding (stamp
+  keys, positions, payload codepoints), quorum (clientId -> short id)
+- device: ``step`` = vmap(scan(apply_op)) — applies up to B ops for each of
+  D documents in one XLA program
+
+This engine is the pure-replica path (no local pending ops): every op is a
+remote sequenced apply, exactly the scenario of a server-side/materialized
+replica fleet.  Client-side engines with pending/ack live in dds/.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dds.shared_string import SharedString  # re-exported convenience
+from ..ops import mergetree_kernel as mk
+from ..parallel.mesh import doc_mesh, shard_docs
+from ..protocol.messages import DeltaType, MessageType, SequencedMessage
+
+
+@dataclass
+class _DocHost:
+    """Host-side per-document bookkeeping."""
+
+    quorum: dict[str, int] = field(default_factory=dict)
+    queue: list[np.ndarray] = field(default_factory=list)
+    payloads: list[np.ndarray] = field(default_factory=list)
+    min_seq: int = 0
+    # Property id -> kernel prop slot (interned per document).
+    prop_slot: dict[int, int] = field(default_factory=dict)
+
+
+class DocBatchEngine:
+    """A fleet of merge-tree replicas stepped as one batched device program."""
+
+    def __init__(
+        self,
+        n_docs: int,
+        max_segments: int = 512,
+        remove_slots: int = 4,
+        prop_slots: int = 4,
+        text_capacity: int = 16384,
+        max_insert_len: int = 64,
+        ops_per_step: int = 16,
+        mesh=None,
+        use_mesh: bool = True,
+    ) -> None:
+        self.n_docs = n_docs
+        self.max_insert_len = max_insert_len
+        self.ops_per_step = ops_per_step
+        self.hosts = [_DocHost() for _ in range(n_docs)]
+
+        if use_mesh:
+            self.mesh = mesh if mesh is not None else doc_mesh()
+            n_shards = self.mesh.devices.size
+        else:
+            self.mesh = None
+            n_shards = 1
+        # Device capacity rounds up to a mesh multiple (padding docs are
+        # inert: their queues stay empty so they only ever apply noops).
+        self.capacity = -(-n_docs // n_shards) * n_shards
+
+        proto = mk.init_state(max_segments, remove_slots, prop_slots, text_capacity)
+        self.state = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (self.capacity,) + x.shape), proto
+        )
+        if self.mesh is not None:
+            docs_sharding = shard_docs(self.mesh)
+            self.state = jax.tree.map(
+                lambda x: jax.device_put(x, docs_sharding), self.state
+            )
+
+        batched = jax.vmap(mk.apply_ops)
+
+        def _step(state, ops, payloads):
+            new = batched(state, ops, payloads)
+            return new
+
+        def _compact(state, min_seqs):
+            state = jax.vmap(mk.set_min_seq)(state, min_seqs)
+            return jax.vmap(mk.compact)(state)
+
+        self._step = jax.jit(_step, donate_argnums=(0,))
+        self._compact = jax.jit(_compact, donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ ingest
+    def ingest(self, doc_idx: int, msg: SequencedMessage) -> None:
+        """Stage one sequenced message for a document (host-side decode).
+
+        This is the engine's inbound seam: the equivalent of
+        DeltaManager -> ContainerRuntime.process for one container, except
+        application is deferred to the next batched device step.
+        """
+        h = self.hosts[doc_idx]
+        if msg.type == MessageType.JOIN:
+            h.quorum[msg.contents["clientId"]] = msg.contents["short"]
+            h.min_seq = max(h.min_seq, msg.min_seq)
+            return
+        if msg.type != MessageType.OP:
+            h.min_seq = max(h.min_seq, msg.min_seq)
+            return
+        c = msg.contents
+        kind = c["type"]
+        client = h.quorum[msg.client_id]
+        if kind == DeltaType.INSERT:
+            for op, payload in mk.encode_insert(
+                c["pos1"], c["seg"], msg.seq, client, msg.ref_seq,
+                self.max_insert_len,
+            ):
+                h.queue.append(op)
+                h.payloads.append(payload)
+        elif kind == DeltaType.REMOVE:
+            h.queue.append(
+                np.array(
+                    [mk.OpKind.REMOVE, msg.seq, client, msg.ref_seq,
+                     c["pos1"], c["pos2"], 0, 0],
+                    np.int32,
+                )
+            )
+            h.payloads.append(np.zeros((self.max_insert_len,), np.int32))
+        elif kind == DeltaType.ANNOTATE:
+            for prop, value in c["props"].items():
+                slot = self._prop_slot_for(h, int(prop))
+                h.queue.append(
+                    np.array(
+                        [mk.OpKind.ANNOTATE, msg.seq, client, msg.ref_seq,
+                         c["pos1"], c["pos2"], slot, value],
+                        np.int32,
+                    )
+                )
+                h.payloads.append(np.zeros((self.max_insert_len,), np.int32))
+        else:
+            raise ValueError(f"unsupported op type {kind}")
+        h.min_seq = max(h.min_seq, msg.min_seq)
+
+    def _prop_slot_for(self, h: _DocHost, prop: int) -> int:
+        """Intern a property id to a kernel prop slot (range-checked)."""
+        if prop not in h.prop_slot:
+            slot = len(h.prop_slot)
+            if slot >= len(self.state.prop_keys):
+                raise ValueError(
+                    f"document exhausted its {len(self.state.prop_keys)} prop "
+                    f"slots; raise prop_slots to accommodate prop id {prop}"
+                )
+            h.prop_slot[prop] = slot
+        return h.prop_slot[prop]
+
+    # ------------------------------------------------------------------- step
+    def pending_ops(self) -> int:
+        return sum(len(h.queue) for h in self.hosts)
+
+    def build_step_batch(self) -> tuple[np.ndarray, np.ndarray] | None:
+        """Dequeue up to ops_per_step ops per doc into padded [D,B] arrays."""
+        B = self.ops_per_step
+        if self.pending_ops() == 0:
+            return None
+        ops = np.zeros((self.capacity, B, mk.OP_FIELDS), np.int32)
+        payloads = np.zeros((self.capacity, B, self.max_insert_len), np.int32)
+        for d, h in enumerate(self.hosts):
+            take = min(B, len(h.queue))
+            for j in range(take):
+                ops[d, j] = h.queue[j]
+                payloads[d, j] = h.payloads[j]
+            del h.queue[:take]
+            del h.payloads[:take]
+        return ops, payloads
+
+    def step(self) -> int:
+        """Run device steps until all staged ops are applied; returns steps."""
+        steps = 0
+        while True:
+            batch = self.build_step_batch()
+            if batch is None:
+                return steps
+            ops, payloads = batch
+            self.state = self._step(self.state, jnp.asarray(ops), jnp.asarray(payloads))
+            steps += 1
+
+    def compact(self) -> None:
+        """Advance MSNs and run zamboni eviction across the fleet."""
+        mins = [h.min_seq for h in self.hosts]
+        mins += [0] * (self.capacity - self.n_docs)
+        self.state = self._compact(self.state, jnp.asarray(mins, jnp.int32))
+
+    # ------------------------------------------------------------------ views
+    def doc_state(self, doc_idx: int) -> mk.DocState:
+        return jax.tree.map(lambda x: x[doc_idx], self.state)
+
+    def text(self, doc_idx: int) -> str:
+        return mk.visible_text(self.doc_state(doc_idx))
+
+    def annotations(self, doc_idx: int) -> list[dict[int, int]]:
+        raw = mk.annotations(self.doc_state(doc_idx))
+        inv = {v: k for k, v in self.hosts[doc_idx].prop_slot.items()}
+        return [{inv[p]: v for p, v in d.items()} for d in raw]
+
+    def errors(self) -> np.ndarray:
+        return np.asarray(self.state.error)

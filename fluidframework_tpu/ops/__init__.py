@@ -1,0 +1,30 @@
+"""TPU kernels: columnar CRDT op application as integer-tensor programs.
+
+These kernels replace the reference's hot TypeScript paths (merge-tree
+Client.applyMsg, map/matrix kernels, EditManager rebase) with pure JAX
+functions over SoA int32 arrays, designed so that `vmap` over a document
+axis + `shard_map` over a TPU mesh applies whole batches of sequenced ops
+for thousands of documents per step.
+"""
+
+from .mergetree_kernel import (
+    DocState,
+    OpKind,
+    apply_op,
+    apply_ops,
+    compact,
+    init_state,
+    make_noop,
+    visible_text,
+)
+
+__all__ = [
+    "DocState",
+    "OpKind",
+    "apply_op",
+    "apply_ops",
+    "compact",
+    "init_state",
+    "make_noop",
+    "visible_text",
+]

@@ -1,0 +1,484 @@
+"""Columnar merge-tree kernel: sequenced-op application as tensor ops.
+
+This is the TPU-native replacement for the reference's merge-tree apply path
+(merge-tree/src/client.ts Client.applyMsg -> mergeTree.ts insertSegments /
+markRangeRemoved / annotateRange + blockUpdatePathLengths).  The reference
+maintains a B-tree of segments with per-block PartialSequenceLengths so CPU
+position resolution is O(log n); here the segment store is a flat SoA of
+int32 arrays and every position query is a perspective-masked prefix sum —
+O(S) work but fully data-parallel on the VPU, and `vmap`-able over a
+document axis so one device step applies ops for thousands of docs.
+
+Semantics are bit-identical to ``dds/mergetree_ref.py`` (the oracle), which
+itself mirrors the reference:
+
+- visibility = hasOccurred(insert) && !any(hasOccurred(remove_r))
+- insert boundary tie-break = reference breakTie (mergeTree.ts:1811)
+- overlapping removes kept in R slots per segment (reference seg.removes)
+- annotate per-(segment, prop) LWW by stamp key
+- ack rewrites pending stamp keys (localSeq -> seq) in place
+
+Design notes (TPU):
+
+- All state is int32, and every per-segment array is 1-D over the segment
+  axis ([S], so [D, S] after vmap).  The R remove slots and P prop slots are
+  tuples of such arrays rather than [S,R]/[R,S] matrices: trailing dims of
+  2-8 get lane-padded to 128 on TPU (16-64x physical blowup), and XLA's
+  layout assignment can pick the small axis as minor even for [R,S].  Tuples
+  of 2-D-after-vmap leaves make every layout trivially optimal.
+- Within one document, ops are inherently sequential (each op's position
+  depends on prior ops); `lax.scan` applies an op batch per doc.  The
+  document axis supplies the parallelism (`vmap`, sharded by `shard_map`).
+- Mutation = masked gather/select: inserting a segment shifts the suffix of
+  every per-segment array by one slot (a vectorized O(S) move, not a
+  data-dependent loop).
+- Capacity overflow (segments, text pool, remove slots) sets an error bit
+  instead of trapping; the host inspects error flags and reacts (grow +
+  re-replay, or route the doc to the host oracle).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..protocol.stamps import ALL_ACKED, LOCAL_BASE, NO_REMOVE
+
+I32 = jnp.int32
+
+# Error flag bits.
+ERR_SEG_OVERFLOW = 1
+ERR_TEXT_OVERFLOW = 2
+ERR_REM_OVERFLOW = 4
+ERR_POS_RANGE = 8
+
+
+class OpKind:
+    NOOP = 0
+    INSERT = 1
+    REMOVE = 2
+    ANNOTATE = 3
+    ACK = 4
+
+
+# Op row layout (int32[OP_FIELDS]):
+#   0 kind | 1 key | 2 client | 3 ref_seq | 4 pos1 | 5 pos2 | 6 a | 7 b
+# a/b meaning per kind: INSERT a=text_len, REMOVE -, ANNOTATE a=prop_slot
+# b=value, ACK a=local_seq b=seq.
+OP_FIELDS = 8
+
+
+class DocState(NamedTuple):
+    """SoA replica state for one document (or [D, ...] for a doc batch)."""
+
+    text: jnp.ndarray         # int32[T] codepoint pool (append-only)
+    text_end: jnp.ndarray     # int32 scalar
+    nseg: jnp.ndarray         # int32 scalar: live segment count
+    seg_start: jnp.ndarray    # int32[S] offset into text pool
+    seg_len: jnp.ndarray      # int32[S]
+    ins_key: jnp.ndarray      # int32[S] insert stamp key
+    ins_client: jnp.ndarray   # int32[S] insert short client id
+    rem_keys: tuple           # R x int32[S] remove stamp keys (NO_REMOVE empty)
+    rem_clients: tuple        # R x int32[S]
+    prop_keys: tuple          # P x int32[S] LWW stamp key per prop (-1 unset)
+    prop_vals: tuple          # P x int32[S]
+    min_seq: jnp.ndarray      # int32 scalar (collab-window floor)
+    error: jnp.ndarray        # int32 scalar bitmask
+
+
+def init_state(
+    max_segments: int = 512,
+    remove_slots: int = 4,
+    prop_slots: int = 4,
+    text_capacity: int = 8192,
+) -> DocState:
+    S, R, P, T = max_segments, remove_slots, prop_slots, text_capacity
+    return DocState(
+        text=jnp.zeros((T,), I32),
+        text_end=jnp.zeros((), I32),
+        nseg=jnp.zeros((), I32),
+        seg_start=jnp.zeros((S,), I32),
+        seg_len=jnp.zeros((S,), I32),
+        ins_key=jnp.zeros((S,), I32),
+        ins_client=jnp.full((S,), -1, I32),
+        rem_keys=tuple(jnp.full((S,), NO_REMOVE, I32) for _ in range(R)),
+        rem_clients=tuple(jnp.full((S,), -1, I32) for _ in range(R)),
+        prop_keys=tuple(jnp.full((S,), -1, I32) for _ in range(P)),
+        prop_vals=tuple(jnp.zeros((S,), I32) for _ in range(P)),
+        min_seq=jnp.zeros((), I32),
+        error=jnp.zeros((), I32),
+    )
+
+
+def make_noop(op_fields: int = OP_FIELDS) -> np.ndarray:
+    return np.zeros((op_fields,), np.int32)
+
+
+def encode_insert(
+    pos: int,
+    text: str,
+    op_key: int,
+    op_client: int,
+    ref_seq: int,
+    max_insert_len: int,
+) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Encode one insert as (op_row, payload) pairs, chunking long text.
+
+    Chunks share the op's stamp and insert left-to-right at pos+i: since the
+    boundary walk treats same-stamp segments identically, chunks always land
+    adjacently — equivalent to the reference's single unbounded segment.
+    This is THE insert encoding; every ingest path must use it so chunk
+    placement can never diverge between host adapters.
+    """
+    out: list[tuple[np.ndarray, np.ndarray]] = []
+    for i in range(0, len(text), max_insert_len):
+        chunk = text[i : i + max_insert_len]
+        payload = np.zeros((max_insert_len,), np.int32)
+        payload[: len(chunk)] = [ord(ch) for ch in chunk]
+        op = np.array(
+            [OpKind.INSERT, op_key, op_client, ref_seq, pos + i, 0, len(chunk), 0],
+            np.int32,
+        )
+        out.append((op, payload))
+    return out
+
+
+def _any_tree(masks) -> jnp.ndarray:
+    return functools.reduce(jnp.logical_or, masks)
+
+
+def _min_tree(arrays) -> jnp.ndarray:
+    return functools.reduce(jnp.minimum, arrays)
+
+
+# --------------------------------------------------------------------------
+# Visibility / geometry primitives
+# --------------------------------------------------------------------------
+
+def _alive(s: DocState) -> jnp.ndarray:
+    return jnp.arange(s.seg_len.shape[0], dtype=I32) < s.nseg
+
+
+def _visible(s: DocState, ref_seq, client) -> jnp.ndarray:
+    """Perspective mask over segments (ref perspective.ts isSegmentPresent)."""
+    ins_occ = (s.ins_key <= ref_seq) | (s.ins_client == client)
+    rem_occ = _any_tree(
+        [(k <= ref_seq) | (c == client) for k, c in zip(s.rem_keys, s.rem_clients)]
+    )
+    return _alive(s) & ins_occ & ~rem_occ
+
+
+def _vis_lengths(s: DocState, vis: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    vlen = jnp.where(vis, s.seg_len, 0)
+    excl = jnp.cumsum(vlen) - vlen  # exclusive prefix
+    return vlen, excl
+
+
+def _first_true(mask: jnp.ndarray, default: jnp.ndarray) -> jnp.ndarray:
+    idx = jnp.argmax(mask)
+    return jnp.where(jnp.any(mask), idx.astype(I32), default)
+
+
+def _shift_right(arr, k, newval):
+    """arr with a slot opened at k: [0..k-1] keep, [k]=newval, [k+1..] shifted."""
+    idx = jnp.arange(arr.shape[0], dtype=I32)
+    prev = arr[jnp.maximum(idx - 1, 0)]
+    return jnp.where(idx < k, arr, jnp.where(idx == k, newval, prev))
+
+
+class _NewSeg(NamedTuple):
+    seg_start: jnp.ndarray
+    seg_len: jnp.ndarray
+    ins_key: jnp.ndarray
+    ins_client: jnp.ndarray
+    rem_keys: tuple
+    rem_clients: tuple
+    prop_keys: tuple
+    prop_vals: tuple
+
+
+def _open_slot(s: DocState, k, do: jnp.ndarray, new: _NewSeg) -> DocState:
+    """Conditionally (``do``) shift all per-segment arrays right at ``k`` and
+    write the new segment's values there.  Capacity overflow sets error."""
+    S = s.seg_len.shape[0]
+    overflow = do & (s.nseg >= S)
+    do = do & ~overflow
+
+    def sh(arr, newval):
+        return jnp.where(do, _shift_right(arr, k, newval), arr)
+
+    return s._replace(
+        seg_start=sh(s.seg_start, new.seg_start),
+        seg_len=sh(s.seg_len, new.seg_len),
+        ins_key=sh(s.ins_key, new.ins_key),
+        ins_client=sh(s.ins_client, new.ins_client),
+        rem_keys=tuple(sh(a, v) for a, v in zip(s.rem_keys, new.rem_keys)),
+        rem_clients=tuple(sh(a, v) for a, v in zip(s.rem_clients, new.rem_clients)),
+        prop_keys=tuple(sh(a, v) for a, v in zip(s.prop_keys, new.prop_keys)),
+        prop_vals=tuple(sh(a, v) for a, v in zip(s.prop_vals, new.prop_vals)),
+        nseg=s.nseg + do.astype(I32),
+        error=s.error | jnp.where(overflow, ERR_SEG_OVERFLOW, 0),
+    )
+
+
+def _ensure_boundary(s: DocState, pos, ref_seq, client) -> DocState:
+    """Split the segment containing ``pos`` strictly inside it, if any.
+
+    Mirrors the reference's split-on-walk (ensureIntervalBoundary /
+    insertingWalk split path): after this, ``pos`` falls on a segment
+    boundary of the perspective-visible sequence.
+    """
+    vis = _visible(s, ref_seq, client)
+    vlen, excl = _vis_lengths(s, vis)
+    mid = vis & (excl < pos) & (pos < excl + vlen)
+    k = _first_true(mid, jnp.asarray(0, I32))  # default unused when ~do
+    do = jnp.any(mid)
+    off = pos - excl[k]
+    right = _NewSeg(
+        seg_start=s.seg_start[k] + off,
+        seg_len=s.seg_len[k] - off,
+        ins_key=s.ins_key[k],
+        ins_client=s.ins_client[k],
+        rem_keys=tuple(a[k] for a in s.rem_keys),
+        rem_clients=tuple(a[k] for a in s.rem_clients),
+        prop_keys=tuple(a[k] for a in s.prop_keys),
+        prop_vals=tuple(a[k] for a in s.prop_vals),
+    )
+    s2 = _open_slot(s, k + 1, do, right)
+    # Trim the left half (only when the split actually happened).
+    new_len = jnp.where(do, off, s2.seg_len[k])
+    return s2._replace(seg_len=s2.seg_len.at[k].set(new_len))
+
+
+# --------------------------------------------------------------------------
+# Op branches
+# --------------------------------------------------------------------------
+
+def _tiebreak(s: DocState, op_key) -> jnp.ndarray:
+    """Reference breakTie (mergeTree.ts:1811) as a per-segment mask."""
+    rem0 = _min_tree(s.rem_keys)  # removes[0] = earliest remove stamp
+    rem_clause = (rem0 < LOCAL_BASE) & (rem0 > op_key)
+    return (op_key > s.ins_key) | rem_clause
+
+
+def _do_insert(s: DocState, op, payload) -> DocState:
+    pos, key, client, ref_seq = op[4], op[1], op[2], op[3]
+    text_len = op[6]
+    s = _ensure_boundary(s, pos, ref_seq, client)
+    vis = _visible(s, ref_seq, client)
+    vlen, excl = _vis_lengths(s, vis)
+    total = jnp.sum(vlen)
+    # Boundary walk: insert before the first segment at/after pos that is
+    # visible or wins the tie-break; else append at nseg.
+    stop = _alive(s) & (excl >= pos) & ((vlen > 0) | _tiebreak(s, key))
+    k = _first_true(stop, s.nseg)
+
+    # Copy payload into the text pool (masked scatter, OOB indices dropped).
+    T = s.text.shape[0]
+    tpos = jnp.arange(payload.shape[0], dtype=I32)
+    text_over = s.text_end + text_len > T
+    dst = jnp.where((tpos < text_len) & ~text_over, s.text_end + tpos, T)
+    text = s.text.at[dst].set(payload, mode="drop")
+
+    R = len(s.rem_keys)
+    P = len(s.prop_keys)
+    zero = jnp.zeros((), I32)
+    new = _NewSeg(
+        seg_start=s.text_end,
+        seg_len=text_len,
+        ins_key=key,
+        ins_client=client,
+        rem_keys=tuple(jnp.full((), NO_REMOVE, I32) for _ in range(R)),
+        rem_clients=tuple(jnp.full((), -1, I32) for _ in range(R)),
+        prop_keys=tuple(jnp.full((), -1, I32) for _ in range(P)),
+        prop_vals=tuple(zero for _ in range(P)),
+    )
+    ok = ~text_over & (pos <= total)
+    s = _open_slot(s, k, ok, new)
+    return s._replace(
+        text=jnp.where(text_over, s.text, text),
+        text_end=s.text_end + jnp.where(ok, text_len, 0),
+        error=s.error
+        | jnp.where(text_over, ERR_TEXT_OVERFLOW, 0)
+        | jnp.where(pos > total, ERR_POS_RANGE, 0),
+    )
+
+
+def _mark_range(s: DocState, op) -> tuple[DocState, jnp.ndarray]:
+    """Split at both boundaries; return mask of visible segments inside."""
+    pos1, pos2, client, ref_seq = op[4], op[5], op[2], op[3]
+    s = _ensure_boundary(s, pos1, ref_seq, client)
+    s = _ensure_boundary(s, pos2, ref_seq, client)
+    vis = _visible(s, ref_seq, client)
+    vlen, excl = _vis_lengths(s, vis)
+    total = jnp.sum(vlen)
+    mark = vis & (excl >= pos1) & (excl + vlen <= pos2) & (vlen > 0)
+    s = s._replace(error=s.error | jnp.where(pos2 > total, ERR_POS_RANGE, 0))
+    return s, mark
+
+
+def _do_remove(s: DocState, op, payload) -> DocState:
+    key, client = op[1], op[2]
+    s, mark = _mark_range(s, op)
+    # First free slot per segment, cascading over the R slot arrays.
+    rem_keys = list(s.rem_keys)
+    rem_clients = list(s.rem_clients)
+    placed = jnp.zeros_like(mark)
+    for r in range(len(rem_keys)):
+        free = rem_keys[r] == NO_REMOVE
+        sel = mark & free & ~placed
+        rem_keys[r] = jnp.where(sel, key, rem_keys[r])
+        rem_clients[r] = jnp.where(sel, client, rem_clients[r])
+        placed = placed | sel
+    return s._replace(
+        rem_keys=tuple(rem_keys),
+        rem_clients=tuple(rem_clients),
+        error=s.error | jnp.where(jnp.any(mark & ~placed), ERR_REM_OVERFLOW, 0),
+    )
+
+
+def _do_annotate(s: DocState, op, payload) -> DocState:
+    key, prop_slot, value = op[1], op[6], op[7]
+    s, mark = _mark_range(s, op)
+    prop_keys = list(s.prop_keys)
+    prop_vals = list(s.prop_vals)
+    for p in range(len(prop_keys)):
+        # LWW by stamp key: pending local writes outrank acked remotes.
+        win = (prop_slot == p) & mark & (key > prop_keys[p])
+        prop_keys[p] = jnp.where(win, key, prop_keys[p])
+        prop_vals[p] = jnp.where(win, value, prop_vals[p])
+    return s._replace(prop_keys=tuple(prop_keys), prop_vals=tuple(prop_vals))
+
+
+def _do_ack(s: DocState, op, payload) -> DocState:
+    local_seq, seq = op[6], op[7]
+    local_key = LOCAL_BASE + local_seq
+    return s._replace(
+        ins_key=jnp.where(s.ins_key == local_key, seq, s.ins_key),
+        rem_keys=tuple(jnp.where(a == local_key, seq, a) for a in s.rem_keys),
+        prop_keys=tuple(jnp.where(a == local_key, seq, a) for a in s.prop_keys),
+    )
+
+
+def apply_op(s: DocState, op: jnp.ndarray, payload: jnp.ndarray) -> DocState:
+    """Apply one op row (+ its text payload row) to one document."""
+    kind = op[0]
+    branches = [
+        lambda s, op, p: s,  # NOOP
+        _do_insert,
+        _do_remove,
+        _do_annotate,
+        _do_ack,
+    ]
+    s = jax.lax.switch(kind, branches, s, op, payload)
+    return s
+
+
+def apply_ops(s: DocState, ops: jnp.ndarray, payloads: jnp.ndarray) -> DocState:
+    """Apply a batch of ops to one document, in order (lax.scan).
+
+    ops: int32[B, OP_FIELDS]; payloads: int32[B, MAX_INSERT_LEN].
+    This is the per-document sequential spine; parallelism comes from
+    `jax.vmap(apply_ops)` over a leading document axis.
+    """
+
+    def step(carry, xs):
+        op, payload = xs
+        return apply_op(carry, op, payload), None
+
+    out, _ = jax.lax.scan(step, s, (ops, payloads))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Compaction (zamboni)
+# --------------------------------------------------------------------------
+
+def compact(s: DocState) -> DocState:
+    """Evict segments whose winning remove is acked at or below min_seq.
+
+    Reference zamboni.ts:33 — such segments are invisible to every legal
+    perspective (refSeq >= minSeq), so dropping them is unobservable.
+    Stable-compacts the arrays with an argsort gather.
+    """
+    alive = _alive(s)
+    rem0 = _min_tree(s.rem_keys)
+    dead = alive & (rem0 < LOCAL_BASE) & (rem0 <= s.min_seq)
+    keep = alive & ~dead
+    # Stable order: kept segments first, in original order.
+    order = jnp.argsort(~keep, stable=True)
+    n_keep = jnp.sum(keep).astype(I32)
+    idx = jnp.arange(keep.shape[0], dtype=I32)
+
+    def g(arr, fill):
+        return jnp.where(idx < n_keep, arr[order], fill)
+
+    return s._replace(
+        seg_start=g(s.seg_start, 0),
+        seg_len=g(s.seg_len, 0),
+        ins_key=g(s.ins_key, 0),
+        ins_client=g(s.ins_client, -1),
+        rem_keys=tuple(g(a, NO_REMOVE) for a in s.rem_keys),
+        rem_clients=tuple(g(a, -1) for a in s.rem_clients),
+        prop_keys=tuple(g(a, -1) for a in s.prop_keys),
+        prop_vals=tuple(g(a, 0) for a in s.prop_vals),
+        nseg=n_keep,
+    )
+
+
+def set_min_seq(s: DocState, min_seq) -> DocState:
+    return s._replace(min_seq=jnp.maximum(s.min_seq, jnp.asarray(min_seq, I32)))
+
+
+# --------------------------------------------------------------------------
+# Host-side views (pull arrays off device; numpy)
+# --------------------------------------------------------------------------
+
+def _host_vis(s: DocState, ref_seq: int, view_client: int):
+    nseg = int(s.nseg)
+    ins_key = np.asarray(s.ins_key)[:nseg]
+    ins_client = np.asarray(s.ins_client)[:nseg]
+    rem_keys = np.stack([np.asarray(a)[:nseg] for a in s.rem_keys])
+    rem_clients = np.stack([np.asarray(a)[:nseg] for a in s.rem_clients])
+    ins_occ = (ins_key <= ref_seq) | (ins_client == view_client)
+    rem_occ = ((rem_keys <= ref_seq) | (rem_clients == view_client)).any(axis=0)
+    return nseg, ins_occ & ~rem_occ
+
+
+def visible_text(s: DocState, ref_seq: int = ALL_ACKED, view_client: int = -3) -> str:
+    """Materialize the perspective-visible text on the host."""
+    nseg, vis = _host_vis(s, ref_seq, view_client)
+    text = np.asarray(s.text)
+    start = np.asarray(s.seg_start)[:nseg]
+    length = np.asarray(s.seg_len)[:nseg]
+    parts = [
+        "".join(chr(c) for c in text[start[i] : start[i] + length[i]])
+        for i in range(nseg)
+        if vis[i]
+    ]
+    return "".join(parts)
+
+
+def annotations(
+    s: DocState, ref_seq: int = ALL_ACKED, view_client: int = -3
+) -> list[dict[int, int]]:
+    """Per visible character: {prop_slot: value} (differential-test view)."""
+    nseg, vis = _host_vis(s, ref_seq, view_client)
+    length = np.asarray(s.seg_len)[:nseg]
+    prop_keys = np.stack([np.asarray(a)[:nseg] for a in s.prop_keys])
+    prop_vals = np.stack([np.asarray(a)[:nseg] for a in s.prop_vals])
+    out: list[dict[int, int]] = []
+    for i in range(nseg):
+        if not vis[i]:
+            continue
+        props = {
+            p: int(prop_vals[p, i])
+            for p in range(prop_keys.shape[0])
+            if prop_keys[p, i] >= 0
+        }
+        out.extend(props for _ in range(length[i]))
+    return out

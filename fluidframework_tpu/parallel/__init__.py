@@ -1,0 +1,5 @@
+"""Mesh construction and document-axis sharding helpers."""
+
+from .mesh import doc_mesh, shard_docs, replicate
+
+__all__ = ["doc_mesh", "shard_docs", "replicate"]

@@ -1,0 +1,35 @@
+"""Device mesh + sharding for the document axis.
+
+The reference's scale-out axis is per-document sharding (Kafka partitions by
+documentId; each deli/lambda instance owns a disjoint doc set —
+SURVEY.md §2.6).  The TPU-native equivalent is a 1-D ``Mesh`` over a ``docs``
+axis: replica state arrays are sharded on their leading document dimension,
+op batches likewise, and the per-step computation is purely doc-parallel so
+XLA partitions it with zero collectives on the hot path (collectives appear
+only in aggregate metrics/reductions).
+
+Multi-host pods extend the same mesh across hosts: the doc axis rides
+ICI within a slice and DCN across slices — no code change, just a larger
+``jax.devices()`` list.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def doc_mesh(devices=None, axis: str = "docs") -> Mesh:
+    """A 1-D mesh over all (or the given) devices for document parallelism."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devs.reshape(-1), (axis,))
+
+
+def shard_docs(mesh: Mesh, axis: str = "docs") -> NamedSharding:
+    """Sharding for arrays with a leading document dimension."""
+    return NamedSharding(mesh, P(axis))
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,15 +1,23 @@
-"""Test configuration: force an 8-device virtual CPU mesh.
+"""Test configuration: force an 8-device virtual CPU platform.
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
-validated on a virtual 8-device CPU platform exactly as the driver's
-``dryrun_multichip`` does.  Must run before the first ``import jax``.
+validated on a virtual 8-device CPU mesh exactly as the driver's
+``dryrun_multichip`` does.
+
+Note: this image's sitecustomize registers the axon TPU plugin and forces
+``jax_platforms=axon,cpu`` *after* env-var processing, so JAX_PLATFORMS=cpu
+alone is not enough — we must override the config after importing jax (but
+before any backend initializes).
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

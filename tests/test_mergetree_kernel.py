@@ -1,0 +1,138 @@
+"""Differential tests: TPU merge-tree kernel vs the Python oracle.
+
+The same client/service harness drives both backends through identical
+schedules; final visible text and annotations must match exactly.  This is
+the kernel-equivalence oracle the build plan calls for (SURVEY.md §7.9).
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.kernel_backend import KernelMergeTree
+from fluidframework_tpu.dds.shared_string import SharedString
+from fluidframework_tpu.protocol.stamps import ALL_ACKED
+from fluidframework_tpu.server.local_service import LocalDocument
+
+from test_mergetree_oracle import draw_op, issue_op, pump
+
+
+class TestDirectedKernel:
+    def _doc_with(self, n):
+        doc = LocalDocument("d")
+        clients = [
+            SharedString(client_id=f"c{i}", backend=KernelMergeTree())
+            for i in range(n)
+        ]
+        for c in clients:
+            doc.connect(c.client_id, c.process)
+        doc.process_all()
+        return doc, clients
+
+    def test_insert_remove_single(self):
+        doc, (a,) = self._doc_with(1)
+        a.insert_text(0, "hello world")
+        a.remove_range(5, 11)
+        a.insert_text(5, "!")
+        pump(doc, [a])
+        assert a.text == "hello!"
+        assert a.backend.check_errors() == 0
+
+    def test_concurrent_inserts_tiebreak(self):
+        doc, (a, b) = self._doc_with(2)
+        a.insert_text(0, "A")
+        b.insert_text(0, "B")
+        pump(doc, [a, b])
+        assert a.text == b.text == "BA"
+
+    def test_local_pending_ahead_of_remote(self):
+        doc, (a, b) = self._doc_with(2)
+        b.insert_text(0, "B")
+        for m in b.take_outbox():
+            doc.submit(m)
+        a.insert_text(0, "A")
+        doc.process_all()
+        assert a.text == "AB"
+        pump(doc, [a, b])
+        assert a.text == b.text == "AB"
+
+    def test_remove_spares_concurrent_insert(self):
+        doc, (a, b) = self._doc_with(2)
+        a.insert_text(0, "abcd")
+        pump(doc, [a, b])
+        a.remove_range(0, 4)
+        b.insert_text(2, "X")
+        pump(doc, [a, b])
+        assert a.text == b.text == "X"
+
+    def test_annotate_lww(self):
+        doc, (a, b) = self._doc_with(2)
+        a.insert_text(0, "abcd")
+        pump(doc, [a, b])
+        a.annotate_range(0, 3, 7, 100)
+        b.annotate_range(1, 4, 7, 200)
+        pump(doc, [a, b])
+        ann_a = a.backend.annotations(ALL_ACKED, a.short_client)
+        ann_b = b.backend.annotations(ALL_ACKED, b.short_client)
+        assert ann_a == ann_b == [{7: 100}, {7: 200}, {7: 200}, {7: 200}]
+
+    def test_long_insert_chunks_match_oracle(self):
+        doc, (a,) = self._doc_with(1)
+        long_text = "".join(chr(ord("a") + i % 26) for i in range(200))
+        a.insert_text(0, long_text)
+        a.insert_text(100, "MID")
+        pump(doc, [a])
+        assert a.text == long_text[:100] + "MID" + long_text[100:]
+
+    def test_segment_overflow_sets_error_flag(self):
+        doc, (a,) = self._doc_with(1)
+        small = SharedString(
+            client_id="s", backend=KernelMergeTree(max_segments=4)
+        )
+        doc.connect(small.client_id, small.process)
+        doc.process_all()
+        for i in range(6):
+            small.insert_text(0, "x")
+        assert small.backend.check_errors() != 0
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_differential_farm(seed):
+    """Randomized concurrent schedule on kernel-backed clients; every
+    sequenced stream is mirrored into an oracle replica and compared."""
+    rng = random.Random(1000 + seed)
+    doc = LocalDocument("d")
+    n = rng.randint(2, 3)
+    clients = [
+        SharedString(client_id=f"c{i}", backend=KernelMergeTree(max_insert_len=8))
+        for i in range(n)
+    ]
+    oracle = SharedString(client_id="oracle")  # oracle observer replica
+    for c in clients:
+        doc.connect(c.client_id, c.process)
+    doc.connect(oracle.client_id, oracle.process)
+    doc.process_all()
+
+    for _round in range(rng.randint(4, 8)):
+        for c in clients:
+            for _ in range(rng.randint(0, 2)):
+                issue_op(c, draw_op(rng, len(c.text)))
+            if rng.random() < 0.7:
+                for m in c.take_outbox():
+                    doc.submit(m)
+        doc.process_some(rng.randint(0, doc.pending_count))
+
+    pump(doc, clients + [oracle])
+    expected = oracle.text
+    for c in clients:
+        assert c.backend.check_errors() == 0
+        assert c.text == expected, f"kernel diverged from oracle (seed {seed})"
+    def canon(replica):
+        return tuple(
+            tuple(sorted(d.items()))
+            for d in replica.backend.annotations(ALL_ACKED, replica.short_client)
+        )
+
+    anns = {canon(c) for c in clients}
+    anns.add(canon(oracle))
+    assert len(anns) == 1, "annotation divergence"

@@ -214,7 +214,10 @@ def test_conflict_farm_convergence(seed):
     assert len(texts) == 1, f"divergent texts: {texts}"
     assert observer.backend.visible_text(ALL_ACKED, observer.short_client) == clients[0].text
     anns = {
-        tuple(map(str, c.backend.annotations(ALL_ACKED, c.short_client)))
+        tuple(
+            tuple(sorted(d.items()))
+            for d in c.backend.annotations(ALL_ACKED, c.short_client)
+        )
         for c in clients + [observer]
     }
     assert len(anns) == 1, "divergent annotations"
