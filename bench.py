@@ -118,16 +118,17 @@ def main() -> None:
 
     runner = jax.jit(run, donate_argnums=(0,))
 
-    total_steps = args.warmup_steps + args.steps
+    # Warmup and timed runs must share the SAME shapes, or jit re-traces and
+    # the timed region would include a fresh XLA compile.
+    total_steps = 2 * args.steps
     ops, payloads, min_seqs = generate_workload(
         D, B, total_steps, args.insert_len, args.payload_len
     )
-    w = args.warmup_steps
+    w = args.steps
     dev_w = (jnp.asarray(ops[:w]), jnp.asarray(payloads[:w]), jnp.asarray(min_seqs[:w]))
     dev_t = (jnp.asarray(ops[w:]), jnp.asarray(payloads[w:]), jnp.asarray(min_seqs[w:]))
 
-    # Warmup: compiles the runner (scan lengths differ -> compile both once).
-    state = runner(state, *dev_w)
+    state = runner(state, *dev_w)  # compiles; also warms caches
     jax.block_until_ready(state)
     t0 = time.perf_counter()
     state = runner(state, *dev_t)

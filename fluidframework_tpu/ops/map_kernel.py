@@ -1,0 +1,103 @@
+"""Columnar SharedMap kernel: batched last-writer-wins application.
+
+Reference parity: map's ``MapKernel`` (packages/dds/map/src/mapKernel.ts) —
+the *sequenced* state is simply every set/delete/clear applied in sequence
+order (LWW by total order); the optimistic local overlay (pending keys
+masking remote values, mapKernel.ts:707-852) lives host-side in
+``dds/shared_map.py`` because it is per-client, not replicated state.
+
+Unlike the merge-tree, map application has no intra-batch position
+dependence, so a whole [B]-op batch collapses into ONE data-parallel
+resolution (no lax.scan): for each key slot, the winning op is the last
+set/delete after the last clear; keys untouched since the last clear are
+wiped.  This makes SharedMap the cheapest DDS on TPU by far — a [D, K, B]
+mask reduction per step.
+
+Keys and values are host-interned to int32 ids (the channel adapter owns
+the intern tables and reverse maps).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+
+ERR_KEY_OVERFLOW = 1
+
+
+class MapOpKind:
+    NOOP = 0
+    SET = 1
+    DELETE = 2
+    CLEAR = 3
+
+
+class MapState(NamedTuple):
+    """Per-map sequenced state over K interned key slots."""
+
+    values: jnp.ndarray   # int32[K] interned value ids
+    present: jnp.ndarray  # int32[K] 0/1
+    val_seq: jnp.ndarray  # int32[K] seq of the winning write (attribution)
+    error: jnp.ndarray    # int32 scalar
+
+
+def init_state(max_keys: int = 256) -> MapState:
+    K = max_keys
+    return MapState(
+        values=jnp.zeros((K,), I32),
+        present=jnp.zeros((K,), I32),
+        val_seq=jnp.zeros((K,), I32),
+        error=jnp.zeros((), I32),
+    )
+
+
+def apply_batch(
+    s: MapState,
+    kinds: jnp.ndarray,   # int32[B]
+    key_ids: jnp.ndarray, # int32[B] (-1 for clear/noop)
+    values: jnp.ndarray,  # int32[B]
+    seqs: jnp.ndarray,    # int32[B]
+) -> MapState:
+    """Apply B sequenced ops (already in sequence order) in one shot."""
+    K = s.values.shape[0]
+    B = kinds.shape[0]
+    bpos = jnp.arange(B, dtype=I32) + 1  # 1-based op positions
+    # Last clear position in the batch (0 = none).
+    last_clear = jnp.max(jnp.where(kinds == MapOpKind.CLEAR, bpos, 0))
+    # Per key: position of the last set/delete at/after the last clear.
+    is_write = (kinds == MapOpKind.SET) | (kinds == MapOpKind.DELETE)
+    eligible = is_write & (bpos > last_clear)
+    hit = (key_ids[None, :] == jnp.arange(K, dtype=I32)[:, None]) & eligible[None, :]
+    win = jnp.max(jnp.where(hit, bpos[None, :], 0), axis=1)  # [K], 0 = none
+    wb = jnp.maximum(win - 1, 0)
+    win_kind = kinds[wb]
+    win_val = values[wb]
+    win_seq = seqs[wb]
+    has_win = win > 0
+    cleared = (last_clear > 0) & ~has_win
+    new_present = jnp.where(
+        has_win,
+        (win_kind == MapOpKind.SET).astype(I32),
+        jnp.where(cleared, 0, s.present),
+    )
+    new_values = jnp.where(has_win & (win_kind == MapOpKind.SET), win_val, s.values)
+    new_seq = jnp.where(
+        has_win, win_seq, jnp.where(cleared, 0, s.val_seq)
+    )
+    return s._replace(values=new_values, present=new_present, val_seq=new_seq)
+
+
+# Batched over a leading map/document axis.
+apply_batch_fleet = jax.vmap(apply_batch)
+
+
+def host_items(s: MapState) -> dict[int, int]:
+    """{key_id: value_id} of present entries (host view)."""
+    present = np.asarray(s.present).astype(bool)
+    values = np.asarray(s.values)
+    return {int(k): int(values[k]) for k in np.nonzero(present)[0]}

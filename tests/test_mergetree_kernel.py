@@ -14,7 +14,7 @@ from fluidframework_tpu.dds.shared_string import SharedString
 from fluidframework_tpu.protocol.stamps import ALL_ACKED
 from fluidframework_tpu.server.local_service import LocalDocument
 
-from test_mergetree_oracle import draw_op, issue_op, pump
+from test_mergetree_oracle import canon_annotations, draw_op, issue_op, pump
 
 
 class TestDirectedKernel:
@@ -127,12 +127,6 @@ def test_differential_farm(seed):
     for c in clients:
         assert c.backend.check_errors() == 0
         assert c.text == expected, f"kernel diverged from oracle (seed {seed})"
-    def canon(replica):
-        return tuple(
-            tuple(sorted(d.items()))
-            for d in replica.backend.annotations(ALL_ACKED, replica.short_client)
-        )
-
-    anns = {canon(c) for c in clients}
-    anns.add(canon(oracle))
+    anns = {canon_annotations(c) for c in clients}
+    anns.add(canon_annotations(oracle))
     assert len(anns) == 1, "annotation divergence"

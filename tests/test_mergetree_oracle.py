@@ -183,6 +183,15 @@ def random_op(rng: random.Random, c: SharedString, alphabet: str) -> None:
     issue_op(c, draw_op(rng, len(c.text), alphabet))
 
 
+def canon_annotations(replica) -> tuple:
+    """Order-insensitive canonical form of a replica's annotations (dict
+    iteration order differs between backends; content must not)."""
+    return tuple(
+        tuple(sorted(d.items()))
+        for d in replica.backend.annotations(ALL_ACKED, replica.short_client)
+    )
+
+
 @pytest.mark.parametrize("seed", range(25))
 def test_conflict_farm_convergence(seed):
     """N clients make interleaved concurrent edits with randomized delivery;
@@ -213,11 +222,5 @@ def test_conflict_farm_convergence(seed):
     texts = {c.text for c in clients}
     assert len(texts) == 1, f"divergent texts: {texts}"
     assert observer.backend.visible_text(ALL_ACKED, observer.short_client) == clients[0].text
-    anns = {
-        tuple(
-            tuple(sorted(d.items()))
-            for d in c.backend.annotations(ALL_ACKED, c.short_client)
-        )
-        for c in clients + [observer]
-    }
+    anns = {canon_annotations(c) for c in clients + [observer]}
     assert len(anns) == 1, "divergent annotations"

@@ -1,0 +1,147 @@
+"""SharedMatrix: permutation convergence, LWW/FWW cells, handle remapping."""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.shared_matrix import SharedMatrix
+from fluidframework_tpu.server.local_service import LocalDocument
+
+
+def make_matrices(doc, n):
+    ms = []
+    for i in range(n):
+        m = SharedMatrix(client_id=f"c{i}")
+        doc.connect(m.client_id, m.process)
+        ms.append(m)
+    doc.process_all()
+    return ms
+
+
+def pump(doc, ms):
+    moved = True
+    while moved:
+        moved = False
+        for m in ms:
+            for msg in m.take_outbox():
+                doc.submit(msg)
+                moved = True
+        if doc.pending_count:
+            doc.process_all()
+            moved = True
+
+
+class TestSharedMatrix:
+    def test_basic_grid(self):
+        doc = LocalDocument("d")
+        (a,) = make_matrices(doc, 1)
+        a.insert_rows(0, 2)
+        a.insert_cols(0, 3)
+        pump(doc, [a])
+        a.set_cell(0, 0, "x")
+        a.set_cell(1, 2, "y")
+        pump(doc, [a])
+        assert a.to_grid() == [["x", None, None], [None, None, "y"]]
+
+    def test_optimistic_cell_read_before_ack(self):
+        doc = LocalDocument("d")
+        (a,) = make_matrices(doc, 1)
+        a.insert_rows(0, 1)
+        a.insert_cols(0, 1)
+        pump(doc, [a])
+        a.set_cell(0, 0, 42)
+        assert a.get_cell(0, 0) == 42  # pending overlay
+        pump(doc, [a])
+        assert a.get_cell(0, 0) == 42  # consensus after ack
+
+    def test_concurrent_row_inserts_converge(self):
+        doc = LocalDocument("d")
+        a, b = make_matrices(doc, 2)
+        a.insert_cols(0, 1)
+        pump(doc, [a, b])
+        a.insert_rows(0, 1)
+        b.insert_rows(0, 1)
+        pump(doc, [a, b])
+        a.set_cell(0, 0, "top")
+        b.set_cell(1, 0, "bottom")
+        pump(doc, [a, b])
+        assert a.to_grid() == b.to_grid() == [["top"], ["bottom"]]
+
+    def test_lww_cell_conflict(self):
+        doc = LocalDocument("d")
+        a, b = make_matrices(doc, 2)
+        a.insert_rows(0, 1)
+        a.insert_cols(0, 1)
+        pump(doc, [a, b])
+        a.set_cell(0, 0, "first")
+        b.set_cell(0, 0, "second")  # sequenced later -> LWW winner
+        pump(doc, [a, b])
+        assert a.get_cell(0, 0) == b.get_cell(0, 0) == "second"
+
+    def test_fww_cell_conflict(self):
+        doc = LocalDocument("d")
+        a, b = make_matrices(doc, 2)
+        a.insert_rows(0, 1)
+        a.insert_cols(0, 1)
+        pump(doc, [a, b])
+        a.switch_to_fww()
+        b.switch_to_fww()
+        a.set_cell(0, 0, "first")
+        b.set_cell(0, 0, "second")  # concurrent (refSeq < a's write) -> loses
+        pump(doc, [a, b])
+        assert a.cells == b.cells
+        assert a.get_cell(0, 0) == "first"
+        # A later non-concurrent write still wins under FWW.
+        b.set_cell(0, 0, "third")
+        pump(doc, [a, b])
+        assert a.get_cell(0, 0) == b.get_cell(0, 0) == "third"
+
+    def test_remove_rows_with_concurrent_cell_write(self):
+        doc = LocalDocument("d")
+        a, b = make_matrices(doc, 2)
+        a.insert_rows(0, 2)
+        a.insert_cols(0, 1)
+        pump(doc, [a, b])
+        a.remove_rows(0, 1)
+        b.set_cell(0, 0, "doomed")  # writes into the removed row
+        pump(doc, [a, b])
+        assert a.to_grid() == b.to_grid()
+        assert a.row_count == 1
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_matrix_farm_convergence(seed):
+    """Concurrent row/col inserts/removes + cell writes with randomized
+    delivery must converge to identical grids on all replicas."""
+    rng = random.Random(seed)
+    doc = LocalDocument("d")
+    ms = make_matrices(doc, rng.randint(2, 3))
+    for _round in range(rng.randint(3, 7)):
+        for m in ms:
+            for _ in range(rng.randint(0, 3)):
+                r = rng.random()
+                nrows = len(m.rows.handles(2**30 - 1, m.short_client))
+                ncols = len(m.cols.handles(2**30 - 1, m.short_client))
+                if r < 0.25 or nrows == 0:
+                    m.insert_rows(rng.randint(0, nrows), rng.randint(1, 2))
+                elif r < 0.45 or ncols == 0:
+                    m.insert_cols(rng.randint(0, ncols), rng.randint(1, 2))
+                elif r < 0.55 and nrows > 1:
+                    p = rng.randint(0, nrows - 1)
+                    m.remove_rows(p, 1)
+                elif r < 0.62 and ncols > 1:
+                    p = rng.randint(0, ncols - 1)
+                    m.remove_cols(p, 1)
+                elif ncols > 0 and nrows > 0:
+                    m.set_cell(
+                        rng.randint(0, nrows - 1), rng.randint(0, ncols - 1),
+                        rng.randint(0, 999),
+                    )
+            if rng.random() < 0.7:
+                for msg in m.take_outbox():
+                    doc.submit(msg)
+        doc.process_some(rng.randint(0, doc.pending_count))
+    pump(doc, ms)
+    grids = [m.to_grid() for m in ms]
+    for g in grids[1:]:
+        assert g == grids[0], f"grid divergence (seed {seed})"
