@@ -1,0 +1,262 @@
+"""Channel-contract DDS implementations (the runtime-hosted forms).
+
+These are the DDSes as plugged into the runtime layer through the channel
+boundary (runtime/channel.py) — the reference's SharedObject subclasses seen
+through IChannelFactory/IDeltaHandler (shared-object-base/src/sharedObject.ts).
+The standalone classes in shared_string.py / shared_map.py remain the
+direct-wire forms used by the kernel differential harnesses; the op formats
+and CRDT semantics are identical.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..protocol.stamps import ALL_ACKED, encode_stamp
+from .mergetree_ref import RefMergeTree
+from ..runtime.channel import Channel, MessageCollection
+
+
+class SharedStringChannel(Channel):
+    """SharedString over the channel boundary (ref SharedStringClass +
+    merge-tree Client, sequence/src/sharedString.ts, merge-tree/src/client.ts).
+
+    Local metadata per pending op: {"localSeq": n} — round-tripped by the
+    container's PendingStateManager for ack zip and resubmit.
+    """
+
+    channel_type = "sharedString"
+
+    def __init__(self, channel_id: str, backend: RefMergeTree | None = None) -> None:
+        super().__init__(channel_id)
+        self.backend = backend if backend is not None else RefMergeTree()
+        self._local_seq = 0
+
+    # ------------------------------------------------------------ local edits
+    def _next_local_seq(self) -> int:
+        self._local_seq += 1
+        return self._local_seq
+
+    def insert_text(self, pos: int, text: str) -> None:
+        assert text
+        ls = self._next_local_seq()
+        self.backend.apply_insert(
+            pos, text, encode_stamp(-1, ls), self.backend.local_client, ALL_ACKED
+        )
+        self.submit_local_message(
+            {"type": 0, "pos1": pos, "seg": text}, {"localSeq": ls}
+        )
+
+    def remove_range(self, pos1: int, pos2: int) -> None:
+        assert pos1 < pos2
+        ls = self._next_local_seq()
+        self.backend.apply_remove(
+            pos1, pos2, encode_stamp(-1, ls), self.backend.local_client, ALL_ACKED
+        )
+        self.submit_local_message(
+            {"type": 1, "pos1": pos1, "pos2": pos2}, {"localSeq": ls}
+        )
+
+    def annotate_range(self, pos1: int, pos2: int, prop: int, value: int) -> None:
+        assert pos1 < pos2
+        ls = self._next_local_seq()
+        self.backend.apply_annotate(
+            pos1, pos2, prop, value,
+            encode_stamp(-1, ls), self.backend.local_client, ALL_ACKED,
+        )
+        self.submit_local_message(
+            {"type": 2, "pos1": pos1, "pos2": pos2, "props": {str(prop): value}},
+            {"localSeq": ls},
+        )
+
+    # ---------------------------------------------------------------- inbound
+    def process_messages(self, collection: MessageCollection) -> None:
+        env = collection.envelope
+        for m in collection.messages:
+            if m.local:
+                self.backend.ack(
+                    m.local_metadata["localSeq"],
+                    env.seq,
+                    self._connection.short_id(env.client_id),
+                )
+            else:
+                self._apply_remote(m.contents, env)
+        self.backend.update_min_seq(env.min_seq)
+
+    def _apply_remote(self, c: dict, env) -> None:
+        client = self._connection.short_id(env.client_id)
+        if c["type"] == 0:
+            self.backend.apply_insert(c["pos1"], c["seg"], env.seq, client, env.ref_seq)
+        elif c["type"] == 1:
+            self.backend.apply_remove(
+                c["pos1"], c["pos2"], env.seq, client, env.ref_seq
+            )
+        elif c["type"] == 2:
+            for prop, value in c["props"].items():
+                self.backend.apply_annotate(
+                    c["pos1"], c["pos2"], int(prop), value, env.seq, client, env.ref_seq
+                )
+        else:
+            raise ValueError(f"unsupported merge-tree op type {c['type']}")
+
+    def on_min_seq(self, min_seq: int) -> None:
+        self.backend.update_min_seq(min_seq)
+
+    # ----------------------------------------------------- reconnect / stash
+    def resubmit(self, contents: Any, local_metadata: Any, squash: bool = False) -> None:
+        regenerated = self.backend.regenerate_pending(
+            local_metadata["localSeq"], self._next_local_seq, squash=squash
+        )
+        for fresh_ls, op in regenerated:
+            self.submit_local_message(op, {"localSeq": fresh_ls})
+
+    def apply_stashed(self, contents: Any) -> Any:
+        """Re-mint a stashed op as a fresh local edit (ref applyStashedOp,
+        merge-tree client.ts:1329): apply locally with a pending stamp, do
+        NOT submit — the pending-state replay will resubmit it."""
+        c = contents
+        ls = self._next_local_seq()
+        key = encode_stamp(-1, ls)
+        short = self.backend.local_client
+        if c["type"] == 0:
+            self.backend.apply_insert(c["pos1"], c["seg"], key, short, ALL_ACKED)
+        elif c["type"] == 1:
+            self.backend.apply_remove(c["pos1"], c["pos2"], key, short, ALL_ACKED)
+        elif c["type"] == 2:
+            for prop, value in c["props"].items():
+                self.backend.apply_annotate(
+                    c["pos1"], c["pos2"], int(prop), value, key, short, ALL_ACKED
+                )
+        else:
+            raise ValueError(f"unsupported merge-tree op type {c['type']}")
+        return {"localSeq": ls}
+
+    # ------------------------------------------------------------------ views
+    @property
+    def text(self) -> str:
+        # Local view: all acked ops + own pending (sentinel-stamped) ops.
+        return self.backend.visible_text(ALL_ACKED, self.backend.local_client)
+
+
+class SharedMapChannel(Channel):
+    """SharedMap over the channel boundary (ref MapKernel, map/src/mapKernel.ts).
+
+    Sequenced state applies ops in order; local reads overlay the pending
+    list (a pending set/delete/clear masks remote values until acked —
+    mapKernel.ts:707-852). Pending ops live here (keyed by the metadata the
+    container round-trips) so resubmit/rollback are exact.
+    """
+
+    channel_type = "sharedMap"
+
+    def __init__(self, channel_id: str) -> None:
+        super().__init__(channel_id)
+        self.sequenced: dict[str, Any] = {}
+        self._pending: list[tuple[int, dict]] = []  # (pending_id, op)
+        self._next_pending = 0
+
+    # ------------------------------------------------------------ local edits
+    def set(self, key: str, value: Any) -> None:
+        self._submit({"type": "set", "key": key, "value": value})
+
+    def delete(self, key: str) -> None:
+        self._submit({"type": "delete", "key": key})
+
+    def clear(self) -> None:
+        self._submit({"type": "clear"})
+
+    def _submit(self, op: dict) -> None:
+        self._next_pending += 1
+        self._pending.append((self._next_pending, op))
+        self.submit_local_message(op, {"pendingId": self._next_pending})
+
+    # ---------------------------------------------------------------- inbound
+    def process_messages(self, collection: MessageCollection) -> None:
+        for m in collection.messages:
+            if m.local:
+                pid = m.local_metadata["pendingId"]
+                assert self._pending and self._pending[0][0] == pid, "pending skew"
+                self._pending.pop(0)
+            self._apply(m.contents)
+
+    def _apply(self, op: dict) -> None:
+        kind = op["type"]
+        if kind == "set":
+            self.sequenced[op["key"]] = op["value"]
+        elif kind == "delete":
+            self.sequenced.pop(op["key"], None)
+        elif kind == "clear":
+            self.sequenced.clear()
+        else:
+            raise ValueError(f"unknown map op {kind}")
+
+    # ----------------------------------------------------- reconnect / stash
+    def resubmit(self, contents: Any, local_metadata: Any, squash: bool = False) -> None:
+        # LWW ops are position-free: verbatim resubmission is exact. The
+        # pending entry stays in place; re-register its id with the metadata.
+        pid = local_metadata["pendingId"]
+        assert any(p[0] == pid for p in self._pending), "resubmit of unknown pending op"
+        self.submit_local_message(contents, {"pendingId": pid})
+
+    def apply_stashed(self, contents: Any) -> Any:
+        self._next_pending += 1
+        self._pending.append((self._next_pending, contents))
+        return {"pendingId": self._next_pending}
+
+    def rollback(self, contents: Any, local_metadata: Any) -> None:
+        pid = local_metadata["pendingId"]
+        assert self._pending and self._pending[-1][0] == pid, (
+            "rollback must undo the latest local op first"
+        )
+        self._pending.pop()
+
+    # ------------------------------------------------------------ checkpoint
+    def summarize(self) -> dict[str, Any]:
+        return {"entries": dict(self.sequenced)}
+
+    def load(self, summary: dict[str, Any]) -> None:
+        self.sequenced = dict(summary["entries"])
+
+    # ------------------------------------------------------------------ views
+    def get(self, key: str) -> Any:
+        for _pid, op in reversed(self._pending):
+            if op["type"] == "clear":
+                return None
+            if op.get("key") == key:
+                return op["value"] if op["type"] == "set" else None
+        return self.sequenced.get(key)
+
+    def keys(self) -> set[str]:
+        out = set(self.sequenced)
+        for _pid, op in self._pending:
+            if op["type"] == "set":
+                out.add(op["key"])
+            elif op["type"] == "delete":
+                out.discard(op["key"])
+            else:
+                out.clear()
+        return out
+
+    def items(self) -> dict[str, Any]:
+        return {k: self.get(k) for k in self.keys()}
+
+
+class _SimpleFactory:
+    def __init__(self, channel_type: str, cls: type[Channel]) -> None:
+        self.channel_type = channel_type
+        self._cls = cls
+
+    def create(self, channel_id: str) -> Channel:
+        return self._cls(channel_id)
+
+
+SharedStringFactory = _SimpleFactory(SharedStringChannel.channel_type, SharedStringChannel)
+SharedMapFactory = _SimpleFactory(SharedMapChannel.channel_type, SharedMapChannel)
+
+
+def default_registry() -> dict[str, Any]:
+    """Type string -> factory map (ref ISharedObjectRegistry)."""
+    return {
+        SharedStringFactory.channel_type: SharedStringFactory,
+        SharedMapFactory.channel_type: SharedMapFactory,
+    }

@@ -95,6 +95,13 @@ class RefMergeTree:
         self.segments: list[Segment] = []
         self.local_client = local_client
         self.min_seq = 0
+        # Stamp keys minted by regenerate_pending during a reconnect replay.
+        # When regenerating a LATER pending op, segments carrying these keys
+        # must count as "will be sequenced before it" even though the fresh
+        # keys are numerically larger than the op's own old key (replay
+        # re-stamps in pending order, so fresh keys of earlier ops exceed
+        # every original pending key).
+        self._regenerated_keys: set[int] = set()
 
     # ------------------------------------------------------------------ views
     def visible_text(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> str:
@@ -240,20 +247,174 @@ class RefMergeTree:
                 seg.props[prop] = (value, op_key)
 
     # -------------------------------------------------------------------- ack
-    def ack(self, local_seq: int, seq: int) -> None:
-        """Convert pending stamps with this localSeq to the acked seq."""
+    def ack(self, local_seq: int, seq: int, client: int | None = None) -> None:
+        """Convert pending stamps with this localSeq to the acked seq.
+
+        ``client`` (when given) re-stamps the client id to the identity the
+        op was sequenced under — channel-hosted replicas stamp local pending
+        ops with ``local_client`` and learn their short id only at ack, which
+        keeps views stable across reconnection identity changes.
+        """
         local_key = encode_stamp(-1, local_seq)
+        self._regenerated_keys.discard(local_key)
         for seg in self.segments:
             if seg.ins_key == local_key:
                 seg.ins_key = seq
+                if client is not None:
+                    seg.ins_client = client
             if any(key == local_key for key, _ in seg.removes):
                 seg.removes = sorted(
-                    (seq if key == local_key else key, client)
-                    for key, client in seg.removes
+                    (seq if key == local_key else key,
+                     client if client is not None and key == local_key else c)
+                    for key, c in seg.removes
                 )
             for prop, (value, key) in list(seg.props.items()):
                 if key == local_key:
                     seg.props[prop] = (value, seq)
+
+    # --------------------------------------------------------------- reconnect
+    def _squashed(self, seg: Segment) -> bool:
+        """A pending insert later covered by a pending remove: under squash
+        resubmission the pair cancels and the segment never materializes
+        remotely (ref reSubmitCore(squash), channel.ts:160)."""
+        return not acked(seg.ins_key) and any(not acked(k) for k, _c in seg.removes)
+
+    def _visible_at_prefix(
+        self, seg: Segment, max_key: int, exclude_key: int, squash: bool = False
+    ) -> bool:
+        """Visibility in the local view truncated at pending key ``max_key``:
+        everything acked plus own pending ops with stamp key < ``max_key``
+        (``exclude_key`` additionally hides one remove stamp — the op being
+        regenerated itself). This is the perspective a *resubmitted* op must
+        encode positions in: earlier pending ops will be sequenced before it,
+        later pending ops after (ref client.ts regeneratePendingOp:1452).
+        Under ``squash``, squashed-out segments vanish from position space."""
+        if squash and self._squashed(seg):
+            return False
+        if not self._occurred_before(seg.ins_key, max_key):
+            return False
+        return not any(
+            self._occurred_before(key, max_key) and key != exclude_key
+            for key, _client in seg.removes
+        )
+
+    def _occurred_before(self, key: int, max_key: int) -> bool:
+        """Will the op with this stamp be sequenced before the pending op
+        whose (original) key is ``max_key``? True for acked ops, earlier
+        original pending ops, and already-regenerated ops of this replay."""
+        return acked(key) or key < max_key or key in self._regenerated_keys
+
+    def regenerate_pending(
+        self,
+        local_seq: int,
+        new_local_seq,
+        squash: bool = False,
+        new_client: int | None = None,
+    ) -> list[tuple[int, dict]]:
+        """Re-mint the pending op with this localSeq against current state.
+
+        Returns ``[(fresh_local_seq, wire_op_dict), ...]``: a remove/annotate
+        whose range was split by interleaved acked removes becomes multiple
+        ops; an op whose target content vanished — or, under ``squash``, an
+        insert that a later pending remove fully covers — becomes zero ops.
+        ``new_local_seq()`` allocates a fresh localSeq per emitted op and the
+        affected segments are RE-STAMPED with it, so each re-minted op acks
+        independently (ref regeneratePendingOp mints new segment groups,
+        client.ts:1452).
+        """
+        key = encode_stamp(-1, local_seq)
+        # (kind, pos1, pos2, payload, [segments]) collected before re-stamping
+        # so position math sees unmodified stamps throughout.
+        plans: list[tuple[int, int, int, object, list[Segment]]] = []
+
+        # Pending insert: contiguous run of segments carrying this ins stamp.
+        ins_segs: list[Segment] = []
+        pos = 0
+        ins_pos = -1
+        for seg in self.segments:
+            if seg.ins_key == key and not (squash and self._squashed(seg)):
+                if ins_pos < 0:
+                    ins_pos = pos
+                ins_segs.append(seg)
+            if self._visible_at_prefix(seg, key, exclude_key=-1, squash=squash):
+                pos += len(seg.text)
+        if ins_pos >= 0:
+            plans.append((0, ins_pos, -1, "".join(s.text for s in ins_segs), ins_segs))
+
+        # Pending remove / annotate: maximal visible runs carrying the stamp.
+        pos = 0
+        rem_run: tuple[int, int, list[Segment]] | None = None
+        ann_run: tuple[int, int, dict, list[Segment]] | None = None
+
+        def flush_remove() -> None:
+            nonlocal rem_run
+            if rem_run is not None:
+                plans.append((1, rem_run[0], rem_run[1], None, rem_run[2]))
+            rem_run = None
+
+        def flush_annotate() -> None:
+            nonlocal ann_run
+            if ann_run is not None:
+                plans.append((2, ann_run[0], ann_run[1], ann_run[2], ann_run[3]))
+            ann_run = None
+
+        for seg in self.segments:
+            if not self._visible_at_prefix(seg, key, exclude_key=key, squash=squash):
+                continue  # invisible: breaks neither runs nor position space
+            if any(k == key for k, _c in seg.removes):
+                if rem_run is None:
+                    rem_run = (pos, pos + len(seg.text), [seg])
+                else:
+                    rem_run = (rem_run[0], pos + len(seg.text), rem_run[2] + [seg])
+            else:
+                flush_remove()
+            props = {str(p): v for p, (v, k) in seg.props.items() if k == key}
+            if props:
+                if ann_run is None or props != ann_run[2]:
+                    flush_annotate()
+                    ann_run = (pos, pos + len(seg.text), props, [seg])
+                else:
+                    ann_run = (ann_run[0], pos + len(seg.text), props, ann_run[3] + [seg])
+            else:
+                flush_annotate()
+            pos += len(seg.text)
+        flush_remove()
+        flush_annotate()
+
+        # Squashed segments are dead: never resubmitted, never acked. Drop.
+        if squash:
+            self.segments = [s for s in self.segments if not self._squashed(s)]
+
+        out: list[tuple[int, dict]] = []
+        for kind, pos1, pos2, payload, segs in plans:
+            fresh = new_local_seq()
+            fresh_key = encode_stamp(-1, fresh)
+            self._regenerated_keys.add(fresh_key)
+            if kind == 0:
+                for s in segs:
+                    s.ins_key = fresh_key
+                    if new_client is not None:
+                        # Resubmission happens under a new connection identity;
+                        # remote replicas will stamp the new short id.
+                        s.ins_client = new_client
+                out.append((fresh, {"type": 0, "pos1": pos1, "seg": payload}))
+            elif kind == 1:
+                for s in segs:
+                    s.removes = sorted(
+                        (fresh_key if k == key else k,
+                         new_client if new_client is not None and k == key else c)
+                        for k, c in s.removes
+                    )
+                out.append((fresh, {"type": 1, "pos1": pos1, "pos2": pos2}))
+            else:
+                for s in segs:
+                    for p, (v, k) in list(s.props.items()):
+                        if k == key:
+                            s.props[p] = (v, fresh_key)
+                out.append(
+                    (fresh, {"type": 2, "pos1": pos1, "pos2": pos2, "props": payload})
+                )
+        return out
 
     # --------------------------------------------------------------- lifetime
     def update_min_seq(self, min_seq: int) -> None:

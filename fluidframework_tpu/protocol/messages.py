@@ -53,6 +53,9 @@ class UnsequencedMessage:
     ref_seq: int  # referenceSequenceNumber: last seq client had applied
     type: str = MessageType.OP
     contents: Any = None
+    # Op metadata (reference IDocumentMessage.metadata): batch markers /
+    # batch ids ride here, opaque to the sequencer.
+    metadata: Any = None
 
     def to_json(self) -> str:
         return json.dumps(
@@ -62,6 +65,7 @@ class UnsequencedMessage:
                 "referenceSequenceNumber": self.ref_seq,
                 "type": self.type,
                 "contents": self.contents,
+                "metadata": self.metadata,
             },
             separators=(",", ":"),
         )
@@ -75,6 +79,7 @@ class UnsequencedMessage:
             ref_seq=d["referenceSequenceNumber"],
             type=d.get("type", MessageType.OP),
             contents=d.get("contents"),
+            metadata=d.get("metadata"),
         )
 
 
@@ -95,6 +100,7 @@ class SequencedMessage:
     min_seq: int
     type: str = MessageType.OP
     contents: Any = None
+    metadata: Any = None
     timestamp: float = 0.0
     # Short numeric client id assigned by quorum join order (the id used in
     # stamps; reference attributes ops via the quorum's client table).
@@ -110,6 +116,7 @@ class SequencedMessage:
                 "minimumSequenceNumber": self.min_seq,
                 "type": self.type,
                 "contents": self.contents,
+                "metadata": self.metadata,
                 "timestamp": self.timestamp,
                 "shortClient": self.short_client,
             },
@@ -127,6 +134,7 @@ class SequencedMessage:
             min_seq=d["minimumSequenceNumber"],
             type=d.get("type", MessageType.OP),
             contents=d.get("contents"),
+            metadata=d.get("metadata"),
             timestamp=d.get("timestamp", 0.0),
             short_client=d.get("shortClient", -1),
         )

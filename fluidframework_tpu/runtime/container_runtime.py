@@ -1,0 +1,307 @@
+"""ContainerRuntime: per-container orchestration of the full op lifecycle.
+
+Reference parity: container-runtime/src/containerRuntime.ts — inbound
+``process`` (:3181) → ungroup/decompress/unchunk → duplicate-batch drop →
+pending zip (:3280) → bunching (:3428) → datastore dispatch; outbound submit
+→ Outbox → flush-at-turn-end; PendingStateManager replay on reconnect;
+getPendingLocalState/rehydrate for offline resume (container.ts:1152 +
+pendingStateManager.ts); quorum short-id table from sequenced joins.
+
+Connection identity semantics (the subtle part, mirrored from the
+reference's connection state machine): on reconnect the container keeps
+matching in-flight ops from its PREVIOUS identity during catch-up (pending
+messages record the identity they were flushed under), and only after its
+own new join is sequenced — i.e. provably after every old in-flight op —
+does it resubmit what's still pending, under the new identity but with the
+ORIGINAL batch ids (fork detection).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..protocol.messages import MessageType, Nack, SequencedMessage
+from .channel import MessageEnvelope, bunch_contiguous
+from .datastore import DataStoreRuntime
+from .op_lifecycle import (
+    DuplicateBatchDetector,
+    InboundRuntimeMessage,
+    Outbox,
+    RemoteMessageProcessor,
+)
+from .pending_state import PendingStateManager
+
+
+from .errors import ContainerForkError, DataProcessingError  # noqa: F401 (re-export)
+
+
+class ContainerRuntime:
+    """One collaborative container: datastores + op lifecycle + connection."""
+
+    def __init__(self, registry: dict[str, Any], container_id: str = "container") -> None:
+        self.id = container_id
+        self._registry = registry
+        self._datastores: dict[str, DataStoreRuntime] = {}
+        self._psm = PendingStateManager()
+        self._rmp = RemoteMessageProcessor()
+        self._detector = DuplicateBatchDetector()
+        self._quorum: dict[str, int] = {}
+        self._document = None
+        self._outbox: Outbox | None = None
+        self.client_id: str | None = None
+        self.joined = False
+        self.ref_seq = 0
+        self.min_seq = 0
+        self.closed = False
+        self.close_error: Exception | None = None
+        self._detached_counter = 0
+        self._stash: dict[str, Any] | None = None
+        self._processing_inbound = False
+
+    # -------------------------------------------------------------- datastores
+    def create_datastore(self, ds_id: str) -> DataStoreRuntime:
+        if ds_id in self._datastores:
+            raise ValueError(f"datastore {ds_id!r} already exists")
+
+        def submit(contents: dict, metadata: Any, _ds_id: str = ds_id) -> None:
+            self._submit_datastore_op(_ds_id, contents, metadata)
+
+        ds = DataStoreRuntime(
+            ds_id,
+            self._registry,
+            submit,
+            lambda cid: self._quorum[cid],
+            lambda: self.client_id,
+        )
+        self._datastores[ds_id] = ds
+        return ds
+
+    def datastore(self, ds_id: str) -> DataStoreRuntime:
+        return self._datastores[ds_id]
+
+    # ----------------------------------------------------------------- outbound
+    def _submit_datastore_op(self, ds_id: str, contents: dict, metadata: Any) -> None:
+        if self._processing_inbound:
+            # Reentrancy guard (ref ensureNoDataModelChanges,
+            # containerRuntime.ts:1500): minting local ops from inside
+            # inbound op application breaks ref-seq consistency.
+            raise RuntimeError("local edit during inbound op processing")
+        if self._outbox is None:
+            # Disconnected/detached: stage into a connectionless outbox whose
+            # flushes park in the pending list until a connection exists.
+            self._outbox = Outbox(client_id="")
+        self._outbox.submit({"address": ds_id, "contents": contents}, metadata)
+
+    def flush(self) -> None:
+        """End-of-turn flush (ref Outbox.flush at JS microtask end)."""
+        if self._outbox is None:
+            return
+        if self._outbox.client_id == "":
+            # Not connected: park staged messages as unsent pending state.
+            self._detached_counter += 1
+            batch = self._outbox.flush(self.ref_seq, batch_id=f"unsent_{self.id}_{self._detached_counter}")
+            if batch is not None:
+                self._psm.on_flush_batch(batch.messages, batch.batch_id, client_id="")
+            return
+        batch = self._outbox.flush(self.ref_seq)
+        if batch is None:
+            return
+        self._psm.on_flush_batch(batch.messages, batch.batch_id, self._outbox.client_id)
+        for wire in batch.wire_messages:
+            if self._document is None:
+                break  # a nack mid-batch dropped the connection
+            self._document.submit(wire)
+
+    def rollback_staged(self) -> None:
+        """Undo every staged-but-unflushed local op, newest first (ref
+        Outbox rollback used by transaction abort paths)."""
+        if self._outbox is None:
+            return
+        while True:
+            m = self._outbox.pop_staged()
+            if m is None:
+                break
+            self._datastores[m.contents["address"]].rollback(
+                m.contents["contents"], m.local_metadata
+            )
+
+    @property
+    def pending_op_count(self) -> int:
+        return self._psm.pending_count
+
+    # --------------------------------------------------------------- connection
+    def connect(self, document, client_id: str, stash: str | None = None) -> None:
+        """Join a document. Catch-up is synchronous (the local service replays
+        the delivered prefix through our subscriber before ticketing the
+        join). A stash (from get_pending_local_state) is applied at the exact
+        sequence point it was taken (ref applyStashedOpsAt)."""
+        if self._document is not None:
+            raise RuntimeError("already connected; disconnect first")
+        if stash is not None:
+            self._stash = PendingStateManager.parse_local_state(stash)
+        self._document = document
+        self.client_id = client_id
+        self.joined = False
+        self._outbox = self._adopt_outbox(client_id)
+        document.connect(client_id, self._on_sequenced, self._on_nack)
+        self._maybe_apply_stash(catch_up_done=True)
+
+    def _adopt_outbox(self, client_id: str) -> Outbox:
+        """A fresh outbox for this connection; anything staged while
+        disconnected is flushed into pending first (it replays on join)."""
+        if self._outbox is not None and not self._outbox.is_empty:
+            assert self._outbox.client_id == ""
+            self._detached_counter += 1
+            batch = self._outbox.flush(
+                self.ref_seq, batch_id=f"unsent_{self.id}_{self._detached_counter}"
+            )
+            self._psm.on_flush_batch(batch.messages, batch.batch_id, client_id="")
+        return Outbox(client_id=client_id)
+
+    def disconnect(self) -> None:
+        if self._document is None:
+            return
+        self.flush()  # anything staged rides out before the leave
+        if self._document is None:
+            return  # the flush was nacked; _on_nack already dropped the link
+        self._document.disconnect(self.client_id)
+        self._document = None
+        self._outbox = None
+        self.joined = False
+
+    def close(self, error: Exception | None = None) -> None:
+        """Terminal: detach from the document and refuse further work (ref
+        Container.close on DataProcessingError)."""
+        if self._document is not None:
+            self._document.disconnect(self.client_id)
+            self._document = None
+        self._outbox = None
+        self.joined = False
+        self.closed = True
+        self.close_error = error
+
+    def _on_nack(self, nack: Nack) -> None:
+        """A nack invalidates the connection: drop it and let the host
+        reconnect (ref ConnectionManager reconnect-on-nack)."""
+        if self._document is not None:
+            self._document.disconnect(self.client_id)
+            self._document = None
+            self._outbox = None
+            self.joined = False
+
+    # ----------------------------------------------------------------- inbound
+    def _on_sequenced(self, msg: SequencedMessage) -> None:
+        if self.closed:
+            return
+        if self._stash is not None and msg.seq > self._stash["refSeq"]:
+            self._maybe_apply_stash(catch_up_done=False)
+        self.ref_seq = msg.seq
+        new_min = msg.min_seq > self.min_seq
+        self.min_seq = max(self.min_seq, msg.min_seq)
+
+        if msg.type == MessageType.JOIN:
+            self._quorum[msg.contents["clientId"]] = msg.contents["short"]
+            if msg.contents["clientId"] == self.client_id and not self.joined:
+                self.joined = True
+                self._replay_pending()
+        elif msg.type == MessageType.LEAVE:
+            self._quorum.pop(msg.contents["clientId"], None)
+        elif msg.type == MessageType.OP:
+            try:
+                self._process_op(msg)
+            except DataProcessingError as e:
+                # Close THIS container only; other replicas keep receiving
+                # the broadcast (the reference closes the faulted container,
+                # not the service).
+                self.close(e)
+                return
+
+        if new_min:
+            for ds in self._datastores.values():
+                ds.on_min_seq(self.min_seq)
+
+    def _process_op(self, msg: SequencedMessage) -> None:
+        inbound = self._rmp.process(msg)
+        if not inbound:
+            return  # partial chunk
+        batch_id = inbound[0].batch_id
+        local = (
+            self._psm.has_pending and self._psm.head_client_id == msg.client_id
+        )
+        if not local:
+            if batch_id is not None and batch_id in self._psm.pending_batch_ids():
+                raise ContainerForkError(
+                    f"remote batch {batch_id!r} matches a pending local batch: "
+                    "container fork detected"
+                )
+            if self._detector.observe(batch_id, msg.seq, msg.min_seq):
+                return  # duplicate resubmission of an already-sequenced batch
+        else:
+            self._detector.observe(batch_id, msg.seq, msg.min_seq)
+
+        zipped: list[tuple[InboundRuntimeMessage, Any]] = []
+        for m in inbound:
+            md = self._psm.match_inbound(m.contents) if local else None
+            zipped.append((m, md))
+
+        # Bunch contiguous same-datastore messages (containerRuntime.ts:3428).
+        self._processing_inbound = True
+        try:
+            env = MessageEnvelope(
+                client_id=msg.client_id,
+                seq=msg.seq,
+                min_seq=msg.min_seq,
+                ref_seq=msg.ref_seq,
+            )
+            bunch_contiguous(
+                (
+                    (m.contents["address"], (m.contents["contents"], local, md))
+                    for m, md in zipped
+                ),
+                lambda addr, run: self._datastores[addr].process_messages(env, run),
+            )
+        finally:
+            self._processing_inbound = False
+
+    # --------------------------------------------------------------- reconnect
+    def _replay_pending(self) -> None:
+        """Resubmit everything still pending, under the current identity but
+        with original batch ids (ref replayPendingStates)."""
+        groups = self._psm.take_pending_for_replay()
+        for group in groups:
+            for p in group:
+                self._datastores[p.contents["address"]].resubmit(
+                    p.contents["contents"], p.local_metadata
+                )
+            batch = self._outbox.flush(self.ref_seq, batch_id=group[0].batch_id)
+            if batch is None:
+                continue  # squashed/cancelled out entirely
+            self._psm.on_flush_batch(batch.messages, batch.batch_id, self.client_id)
+            for wire in batch.wire_messages:
+                if self._document is None:
+                    break
+                self._document.submit(wire)
+
+    # ------------------------------------------------------------------- stash
+    def get_pending_local_state(self) -> str:
+        """Serialize pending-op state for offline resume (container.ts:1152)."""
+        self.flush()
+        return self._psm.get_local_state(self.ref_seq)
+
+    def _maybe_apply_stash(self, catch_up_done: bool) -> None:
+        if self._stash is None:
+            return
+        if not catch_up_done and self.ref_seq < self._stash["refSeq"]:
+            return
+        if catch_up_done and self.ref_seq < self._stash["refSeq"]:
+            raise RuntimeError(
+                f"stash taken at seq {self._stash['refSeq']} but the op log "
+                f"only reaches {self.ref_seq}; stale service?"
+            )
+        stash, self._stash = self._stash, None
+        for entry in stash["pending"]:
+            contents = entry["contents"]
+            md = self._datastores[contents["address"]].apply_stashed(
+                contents["contents"]
+            )
+            self._psm.add_stashed(contents, md, entry["batchId"])

@@ -1,0 +1,135 @@
+"""DataStoreRuntime: hosts channels, routes envelopes, owns the registry.
+
+Reference parity: datastore/src/dataStoreRuntime.ts — ``FluidDataStoreRuntime``
+(:258), ``ISharedObjectRegistry`` (:156, type string -> IChannelFactory),
+``createChannel`` (:699), envelope routing via ChannelDeltaConnection.
+
+Envelope nesting (ref channelCollection.ts:290): a datastore-level op is
+``{"address": <channel id>, "contents": <dds op>}``; the container adds one
+more ``{"address": <datastore id>, "contents": ...}`` wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from .channel import (
+    Channel,
+    ChannelDeltaConnection,
+    ChannelFactory,
+    ChannelMessage,
+    MessageCollection,
+    MessageEnvelope,
+    bunch_contiguous,
+)
+
+
+class DataStoreRuntime:
+    """One data store: a registry-driven collection of channels."""
+
+    def __init__(
+        self,
+        ds_id: str,
+        registry: dict[str, ChannelFactory],
+        submit_fn: Callable[[dict, Any], None],
+        quorum_fn: Callable[[str], int],
+        client_id_fn: Callable[[], str],
+    ) -> None:
+        self.id = ds_id
+        self._registry = registry
+        self._submit = submit_fn
+        self._quorum = quorum_fn
+        self._client_id = client_id_fn
+        self._channels: dict[str, Channel] = {}
+
+    # ------------------------------------------------------------- channels
+    def create_channel(self, channel_type: str, channel_id: str) -> Channel:
+        if channel_id in self._channels:
+            raise ValueError(f"channel {channel_id!r} already exists")
+        factory = self._registry.get(channel_type)
+        if factory is None:
+            raise KeyError(
+                f"no factory for channel type {channel_type!r} "
+                f"(registered: {sorted(self._registry)})"
+            )
+        channel = factory.create(channel_id)
+        self._bind(channel)
+        return channel
+
+    def _bind(self, channel: Channel) -> None:
+        cid = channel.id
+
+        def submit(contents: Any, local_metadata: Any) -> None:
+            self._submit({"address": cid, "contents": contents}, local_metadata)
+
+        channel.connect(ChannelDeltaConnection(submit, self._quorum, self._client_id))
+        self._channels[cid] = channel
+
+    def get_channel(self, channel_id: str) -> Channel:
+        return self._channels[channel_id]
+
+    @property
+    def channels(self) -> dict[str, Channel]:
+        return dict(self._channels)
+
+    # --------------------------------------------------------------- inbound
+    def process_messages(
+        self, envelope: MessageEnvelope, messages: list[tuple[dict, bool, Any]]
+    ) -> None:
+        """Route a bunch of datastore-level messages to channels.
+
+        ``messages`` items are (datastore-op, local, local_metadata); runs of
+        contiguous same-channel messages become one MessageCollection (the
+        bunching seam, containerRuntime.ts:3428).
+        """
+        def dispatch(addr: str, run: list[ChannelMessage]) -> None:
+            if addr not in self._channels:
+                raise KeyError(f"datastore {self.id!r}: unknown channel {addr!r}")
+            self._channels[addr].process_messages(
+                MessageCollection(envelope=envelope, messages=run)
+            )
+
+        bunch_contiguous(
+            (
+                (
+                    contents["address"],
+                    ChannelMessage(
+                        contents=contents["contents"],
+                        local=local,
+                        local_metadata=local_metadata,
+                    ),
+                )
+                for contents, local, local_metadata in messages
+            ),
+            dispatch,
+        )
+
+    # ---------------------------------------------------- reconnect / stash
+    def resubmit(self, contents: dict, local_metadata: Any, squash: bool = False) -> None:
+        self._channels[contents["address"]].resubmit(
+            contents["contents"], local_metadata, squash
+        )
+
+    def apply_stashed(self, contents: dict) -> Any:
+        return self._channels[contents["address"]].apply_stashed(contents["contents"])
+
+    def on_min_seq(self, min_seq: int) -> None:
+        for ch in self._channels.values():
+            ch.on_min_seq(min_seq)
+
+    def rollback(self, contents: dict, local_metadata: Any) -> None:
+        self._channels[contents["address"]].rollback(contents["contents"], local_metadata)
+
+    # ------------------------------------------------------------ checkpoint
+    def summarize(self) -> dict[str, Any]:
+        return {
+            "channels": {
+                cid: {"type": ch.channel_type, "summary": ch.summarize()}
+                for cid, ch in self._channels.items()
+            }
+        }
+
+    def load(self, summary: dict[str, Any]) -> None:
+        for cid, entry in summary["channels"].items():
+            channel = self.create_channel(entry["type"], cid)
+            channel.load(entry["summary"])

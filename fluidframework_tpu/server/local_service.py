@@ -56,10 +56,12 @@ class LocalDocument:
         return join
 
     def disconnect(self, client_id: str) -> None:
-        leave = self.sequencer.leave(client_id)
         self._subscribers.pop(client_id, None)
         self._nack_handlers.pop(client_id, None)
-        self._pending.append(leave)
+        # A client can bail out mid-catch-up, before its join was ticketed
+        # (e.g. fork detection closes the container); nothing to leave then.
+        if client_id in self.sequencer.clients():
+            self._pending.append(self.sequencer.leave(client_id))
 
     def submit(self, msg: UnsequencedMessage) -> SequencedMessage | Nack:
         """Ticket an op; queues the sequenced result for broadcast.
@@ -92,7 +94,12 @@ class LocalDocument:
         return delivered
 
     def process_all(self) -> int:
-        return self.process_some(len(self._pending))
+        """Drain the delivery queue, including messages enqueued by
+        subscribers reacting to deliveries (reconnect replay, resubmit)."""
+        n = 0
+        while self._pending:
+            n += self.process_some(len(self._pending))
+        return n
 
 
 class LocalService:

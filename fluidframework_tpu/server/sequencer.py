@@ -141,6 +141,7 @@ class Sequencer:
             min_seq=self.min_seq,
             type=msg.type,
             contents=msg.contents,
+            metadata=msg.metadata,
             timestamp=time.time(),
             short_client=entry.short_client,
         )

@@ -1,0 +1,354 @@
+"""Runtime control-plane tests: op lifecycle, channel routing, pending state,
+reconnect/resubmit, offline stash, fork detection.
+
+Mirrors the reference's test strategy (SURVEY.md §4): mock-service driven
+multi-client convergence with explicit delivery control, plus targeted unit
+tests of the batching machinery (opLifecycle tests in container-runtime).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.protocol.messages import SequencedMessage, UnsequencedMessage
+from fluidframework_tpu.runtime import (
+    ContainerRuntime,
+    Outbox,
+    RemoteMessageProcessor,
+)
+from fluidframework_tpu.runtime.container_runtime import ContainerForkError
+from fluidframework_tpu.server.local_service import LocalService
+
+
+# --------------------------------------------------------------------------
+# op lifecycle unit tests
+# --------------------------------------------------------------------------
+
+def _roundtrip(outbox: Outbox, ref_seq: int = 0):
+    """Flush the outbox and run its wire messages through inbound processing."""
+    batch = outbox.flush(ref_seq)
+    rmp = RemoteMessageProcessor()
+    inbound = []
+    for i, wire in enumerate(batch.wire_messages):
+        seq = 100 + i
+        inbound.extend(
+            rmp.process(
+                SequencedMessage(
+                    client_id=wire.client_id,
+                    client_seq=wire.client_seq,
+                    ref_seq=wire.ref_seq,
+                    type=wire.type,
+                    contents=wire.contents,
+                    seq=seq,
+                    min_seq=0,
+                    metadata=wire.metadata,
+                )
+            )
+        )
+    return batch, inbound
+
+
+def test_grouping_roundtrip():
+    ob = Outbox("c1")
+    ops = [{"address": "ds", "contents": {"n": i}} for i in range(5)]
+    for op in ops:
+        ob.submit(op)
+    batch, inbound = _roundtrip(ob)
+    assert len(batch.wire_messages) == 1  # grouped into one wire message
+    assert [m.contents for m in inbound] == ops
+    assert [m.index for m in inbound] == list(range(5))
+    assert all(m.batch_id == batch.batch_id for m in inbound)
+
+
+def test_compression_roundtrip():
+    ob = Outbox("c1", compression_threshold=128)
+    op = {"address": "ds", "contents": {"blob": "x" * 4096}}
+    ob.submit(op)
+    batch, inbound = _roundtrip(ob)
+    wire = batch.wire_messages[0]
+    assert wire.contents["type"] == "compressed"
+    assert len(str(wire.contents)) < 1000  # actually compressed
+    assert [m.contents for m in inbound] == [op]
+
+
+def test_chunking_roundtrip():
+    ob = Outbox("c1", compression_threshold=10**9, max_chunk_size=100)
+    op = {"address": "ds", "contents": {"blob": "ab" * 300}}
+    ob.submit(op)
+    batch, inbound = _roundtrip(ob)
+    assert len(batch.wire_messages) > 1  # split into chunks
+    assert [m.contents for m in inbound] == [op]
+
+
+def test_single_message_not_grouped():
+    ob = Outbox("c1")
+    ob.submit({"address": "ds", "contents": {"n": 1}})
+    batch, inbound = _roundtrip(ob)
+    assert batch.wire_messages[0].contents == {"address": "ds", "contents": {"n": 1}}
+
+
+# --------------------------------------------------------------------------
+# container fixtures
+# --------------------------------------------------------------------------
+
+def make_container(doc, name: str, stash: str | None = None) -> ContainerRuntime:
+    c = ContainerRuntime(default_registry(), container_id=name)
+    ds = c.create_datastore("root")
+    ds.create_channel("sharedString", "text")
+    ds.create_channel("sharedMap", "meta")
+    c.connect(doc, name, stash=stash)
+    return c
+
+
+def text_of(c: ContainerRuntime) -> str:
+    return c.datastore("root").get_channel("text").text
+
+
+def map_of(c: ContainerRuntime):
+    return c.datastore("root").get_channel("meta")
+
+
+def string_of(c: ContainerRuntime):
+    return c.datastore("root").get_channel("text")
+
+
+def test_two_client_convergence():
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    b = make_container(doc, "B")
+    doc.process_all()  # joins
+
+    string_of(a).insert_text(0, "hello")
+    map_of(a).set("k", 1)
+    a.flush()
+    string_of(b).insert_text(0, "world")
+    map_of(b).set("k", 2)
+    b.flush()
+    doc.process_all()
+
+    assert text_of(a) == text_of(b)
+    assert map_of(a).get("k") == map_of(b).get("k")
+    assert a.pending_op_count == 0 and b.pending_op_count == 0
+    # Batch atomicity: each flush was one wire message (one seq for 2 ops).
+    assert doc.sequencer.seq == 2 + 2  # 2 joins + 2 grouped batches
+
+
+def test_interleaved_edits_converge():
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    b = make_container(doc, "B")
+    doc.process_all()
+
+    string_of(a).insert_text(0, "abcdef")
+    a.flush()
+    doc.process_all()
+
+    # Concurrent: A removes [1,4), B inserts at 2 — classic merge-tree case.
+    string_of(a).remove_range(1, 4)
+    a.flush()
+    string_of(b).insert_text(2, "XY")
+    b.flush()
+    doc.process_all()
+
+    assert text_of(a) == text_of(b)
+
+
+def test_rollback_staged_ops():
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    doc.process_all()
+    map_of(a).set("k", 1)
+    a.flush()
+    doc.process_all()
+
+    map_of(a).set("k", 99)
+    map_of(a).delete("k")
+    assert map_of(a).get("k") is None
+    a.rollback_staged()
+    assert map_of(a).get("k") == 1
+    a.flush()
+    doc.process_all()
+    assert a.pending_op_count == 0
+    assert map_of(a).get("k") == 1
+
+
+def test_reconnect_in_flight_ops_ack_under_old_identity():
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    b = make_container(doc, "B")
+    doc.process_all()
+
+    string_of(a).insert_text(0, "hi")
+    a.flush()  # ticketed but NOT yet delivered
+    a.disconnect()
+    a.connect(doc, "A2")
+    doc.process_all()
+
+    assert a.pending_op_count == 0
+    assert text_of(a) == text_of(b) == "hi"
+
+
+def test_offline_edits_replay_on_connect():
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    b = make_container(doc, "B")
+    doc.process_all()
+    string_of(a).insert_text(0, "base")
+    a.flush()
+    doc.process_all()
+
+    a.disconnect()
+    # Offline edits on A; meanwhile B keeps editing.
+    string_of(a).insert_text(4, "!")
+    map_of(a).set("who", "a")
+    a.flush()
+    string_of(b).insert_text(0, ">>")
+    b.flush()
+    doc.process_all()  # B's edit sequences while A is away
+
+    a.connect(doc, "A2")
+    doc.process_all()
+
+    assert text_of(a) == text_of(b)
+    assert "!" in text_of(a) and ">>" in text_of(a)
+    assert map_of(b).get("who") == "a"
+    assert a.pending_op_count == 0
+
+
+def test_resubmit_rebases_positions():
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    b = make_container(doc, "B")
+    doc.process_all()
+    string_of(a).insert_text(0, "abcdef")
+    a.flush()
+    doc.process_all()
+
+    a.disconnect()
+    string_of(a).remove_range(1, 3)  # "bc" out -> "adef" locally
+    assert text_of(a) == "adef"
+    string_of(b).insert_text(0, "ZZ")  # sequences before A's reconnect
+    b.flush()
+    doc.process_all()
+
+    a.connect(doc, "A2")
+    doc.process_all()
+
+    assert text_of(a) == text_of(b) == "ZZadef"
+
+
+def test_stash_rehydrate():
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    b = make_container(doc, "B")
+    doc.process_all()
+    string_of(a).insert_text(0, "base")
+    a.flush()
+    doc.process_all()
+
+    a.disconnect()
+    string_of(a).insert_text(4, "++")
+    map_of(a).set("stashed", True)
+    stash = a.get_pending_local_state()
+
+    # Fresh process: rehydrate from stash, connect, replay.
+    a2 = make_container(doc, "A2", stash=stash)
+    doc.process_all()
+
+    assert text_of(a2) == text_of(b) == "base++"
+    assert map_of(b).get("stashed") is True
+    assert a2.pending_op_count == 0
+
+
+def test_fork_detection_on_double_rehydrate():
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    doc.process_all()
+    a.disconnect()
+    map_of(a).set("k", "v")
+    stash = a.get_pending_local_state()
+
+    a2 = make_container(doc, "twin1", stash=stash)
+    doc.process_all()  # twin1's replay sequences
+
+    # The second twin detects the fork during catch-up and closes ITSELF;
+    # the first twin and the service are unaffected (ref: faulted container
+    # closes with DataProcessingError, broadcast continues).
+    twin2 = make_container(doc, "twin2", stash=stash)
+    doc.process_all()
+    assert twin2.closed
+    assert isinstance(twin2.close_error, ContainerForkError)
+    assert not a2.closed
+    assert map_of(a2).get("k") == "v"
+
+
+def test_multiple_offline_inserts_keep_relative_positions():
+    # Regression: replay re-stamps earlier pending ops with fresh localSeqs;
+    # later pending ops' regenerated positions must still count them.
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    b = make_container(doc, "B")
+    doc.process_all()
+
+    a.disconnect()
+    string_of(a).insert_text(0, "ab")
+    string_of(a).insert_text(2, "cd")
+    string_of(a).insert_text(1, "X")
+    assert text_of(a) == "aXbcd"
+    a.connect(doc, "A2")
+    doc.process_all()
+
+    assert text_of(a) == text_of(b) == "aXbcd"
+
+
+def test_reentrancy_guard():
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    doc.process_all()
+
+    real_map = map_of(a)
+
+    class Evil:
+        def process_messages(self, collection):
+            # A DDS minting ops from inside inbound processing must trip
+            # the guard (ref ensureNoDataModelChanges).
+            real_map.set("evil", 1)
+
+        def on_min_seq(self, min_seq):
+            pass
+
+    b = make_container(doc, "B")
+    doc.process_all()
+    # Replace A's map channel handler with a reentrant one.
+    a.datastore("root")._channels["meta"] = Evil()
+    map_of(b).set("x", 1)
+    b.flush()
+    with pytest.raises(RuntimeError, match="local edit during inbound"):
+        doc.process_all()
+
+
+def test_squash_cancels_insert_remove_pair():
+    from fluidframework_tpu.dds.mergetree_ref import RefMergeTree
+    from fluidframework_tpu.protocol.stamps import ALL_ACKED, encode_stamp
+
+    t = RefMergeTree()
+    t.apply_insert(0, "keep", 1, 7, 1)  # acked baseline
+    t.apply_insert(2, "abc", encode_stamp(-1, 1), t.local_client, ALL_ACKED)
+    t.apply_remove(2, 5, encode_stamp(-1, 2), t.local_client, ALL_ACKED)
+
+    alloc = iter(range(10, 20))
+    ops1 = t.regenerate_pending(1, lambda: next(alloc), squash=True)
+    ops2 = t.regenerate_pending(2, lambda: next(alloc), squash=True)
+    assert ops1 == [] and ops2 == []  # pair cancelled
+    assert t.visible_text() == "keep"
