@@ -138,39 +138,23 @@ class SharedStringChannel(Channel):
         return self.backend.visible_text(ALL_ACKED, self.backend.local_client)
 
 
-class SharedMapChannel(Channel):
-    """SharedMap over the channel boundary (ref MapKernel, map/src/mapKernel.ts).
-
-    Sequenced state applies ops in order; local reads overlay the pending
-    list (a pending set/delete/clear masks remote values until acked —
-    mapKernel.ts:707-852). Pending ops live here (keyed by the metadata the
-    container round-trips) so resubmit/rollback are exact.
-    """
-
-    channel_type = "sharedMap"
+class PendingOverlayChannel(Channel):
+    """Base for LWW-style DDSes: sequenced state + an ordered overlay of
+    pending local ops. Owns the pendingId bookkeeping shared by map/cell:
+    head-pop on ack, verbatim resubmit (position-free ops), stash re-entry,
+    newest-first rollback. Subclasses implement ``_apply`` (sequenced state
+    transition) and read through ``self._pending`` for optimistic views."""
 
     def __init__(self, channel_id: str) -> None:
         super().__init__(channel_id)
-        self.sequenced: dict[str, Any] = {}
         self._pending: list[tuple[int, dict]] = []  # (pending_id, op)
         self._next_pending = 0
-
-    # ------------------------------------------------------------ local edits
-    def set(self, key: str, value: Any) -> None:
-        self._submit({"type": "set", "key": key, "value": value})
-
-    def delete(self, key: str) -> None:
-        self._submit({"type": "delete", "key": key})
-
-    def clear(self) -> None:
-        self._submit({"type": "clear"})
 
     def _submit(self, op: dict) -> None:
         self._next_pending += 1
         self._pending.append((self._next_pending, op))
         self.submit_local_message(op, {"pendingId": self._next_pending})
 
-    # ---------------------------------------------------------------- inbound
     def process_messages(self, collection: MessageCollection) -> None:
         for m in collection.messages:
             if m.local:
@@ -180,17 +164,8 @@ class SharedMapChannel(Channel):
             self._apply(m.contents)
 
     def _apply(self, op: dict) -> None:
-        kind = op["type"]
-        if kind == "set":
-            self.sequenced[op["key"]] = op["value"]
-        elif kind == "delete":
-            self.sequenced.pop(op["key"], None)
-        elif kind == "clear":
-            self.sequenced.clear()
-        else:
-            raise ValueError(f"unknown map op {kind}")
+        raise NotImplementedError
 
-    # ----------------------------------------------------- reconnect / stash
     def resubmit(self, contents: Any, local_metadata: Any, squash: bool = False) -> None:
         # LWW ops are position-free: verbatim resubmission is exact. The
         # pending entry stays in place; re-register its id with the metadata.
@@ -209,6 +184,43 @@ class SharedMapChannel(Channel):
             "rollback must undo the latest local op first"
         )
         self._pending.pop()
+
+
+class SharedMapChannel(PendingOverlayChannel):
+    """SharedMap over the channel boundary (ref MapKernel, map/src/mapKernel.ts).
+
+    Sequenced state applies ops in order; local reads overlay the pending
+    list (a pending set/delete/clear masks remote values until acked —
+    mapKernel.ts:707-852).
+    """
+
+    channel_type = "sharedMap"
+
+    def __init__(self, channel_id: str) -> None:
+        super().__init__(channel_id)
+        self.sequenced: dict[str, Any] = {}
+
+    # ------------------------------------------------------------ local edits
+    def set(self, key: str, value: Any) -> None:
+        self._submit({"type": "set", "key": key, "value": value})
+
+    def delete(self, key: str) -> None:
+        self._submit({"type": "delete", "key": key})
+
+    def clear(self) -> None:
+        self._submit({"type": "clear"})
+
+    # ---------------------------------------------------------------- inbound
+    def _apply(self, op: dict) -> None:
+        kind = op["type"]
+        if kind == "set":
+            self.sequenced[op["key"]] = op["value"]
+        elif kind == "delete":
+            self.sequenced.pop(op["key"], None)
+        elif kind == "clear":
+            self.sequenced.clear()
+        else:
+            raise ValueError(f"unknown map op {kind}")
 
     # ------------------------------------------------------------ checkpoint
     def summarize(self) -> dict[str, Any]:
@@ -241,17 +253,19 @@ class SharedMapChannel(Channel):
         return {k: self.get(k) for k in self.keys()}
 
 
-class _SimpleFactory:
-    def __init__(self, channel_type: str, cls: type[Channel]) -> None:
-        self.channel_type = channel_type
+class ChannelTypeFactory:
+    """Minimal IChannelFactory: a type string bound to a constructor."""
+
+    def __init__(self, cls: type[Channel]) -> None:
+        self.channel_type = cls.channel_type
         self._cls = cls
 
     def create(self, channel_id: str) -> Channel:
         return self._cls(channel_id)
 
 
-SharedStringFactory = _SimpleFactory(SharedStringChannel.channel_type, SharedStringChannel)
-SharedMapFactory = _SimpleFactory(SharedMapChannel.channel_type, SharedMapChannel)
+SharedStringFactory = ChannelTypeFactory(SharedStringChannel)
+SharedMapFactory = ChannelTypeFactory(SharedMapChannel)
 
 
 def default_registry() -> dict[str, Any]:

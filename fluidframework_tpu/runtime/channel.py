@@ -74,17 +74,28 @@ class ChannelDeltaConnection:
 
     def __init__(
         self,
-        submit_fn: Callable[[Any, Any], None],
+        submit_fn: Callable[..., None],
         quorum_fn: Callable[[str], int],
         client_id_fn: Callable[[], str],
+        members_fn: Callable[[], list[str]] | None = None,
+        ref_seq_fn: Callable[[], int] | None = None,
     ) -> None:
         self._submit = submit_fn
         self._quorum = quorum_fn
         self._client_id = client_id_fn
+        self._members = members_fn or (lambda: [])
+        self._ref_seq = ref_seq_fn or (lambda: 0)
         self.connected = False
 
-    def submit(self, contents: Any, local_metadata: Any = None) -> None:
-        self._submit(contents, local_metadata)
+    def submit(self, contents: Any, local_metadata: Any = None, internal: bool = False) -> None:
+        """``internal=True`` marks protocol-internal ops a DDS mints while
+        PROCESSING inbound messages (e.g. PactMap accept signoffs) — exempt
+        from the reentrancy guard that blocks user edits in that window."""
+        self._submit(contents, local_metadata, internal)
+
+    def ref_seq(self) -> int:
+        """Last sequence number the hosting container has processed."""
+        return self._ref_seq()
 
     def short_id(self, client_id: str) -> int:
         """Numeric join-order id for a client (the quorum table lookup)."""
@@ -93,6 +104,11 @@ class ChannelDeltaConnection:
     def client_id(self) -> str:
         """The hosting container's current connection identity."""
         return self._client_id()
+
+    def quorum_members(self) -> list[str]:
+        """Currently joined client ids, in join order (consensus DDSes use
+        this as the signoff set at proposal-sequencing time)."""
+        return self._members()
 
 
 class Channel(ABC):
@@ -116,10 +132,12 @@ class Channel(ABC):
     def is_attached(self) -> bool:
         return self._connection is not None
 
-    def submit_local_message(self, contents: Any, local_metadata: Any = None) -> None:
+    def submit_local_message(
+        self, contents: Any, local_metadata: Any = None, internal: bool = False
+    ) -> None:
         if self._connection is None:
             raise RuntimeError(f"channel {self.id!r} is not attached")
-        self._connection.submit(contents, local_metadata)
+        self._connection.submit(contents, local_metadata, internal)
 
     # --------------------------------------------------------------- inbound
     @abstractmethod
@@ -145,6 +163,11 @@ class Channel(ABC):
 
     def on_min_seq(self, min_seq: int) -> None:
         """Collab-window floor advanced (drives compaction). Default no-op."""
+
+    def on_client_leave(self, client_id: str, seq: int) -> None:
+        """A client's leave was sequenced at ``seq``. Consensus DDSes (task
+        queues, ordered collections) release that client's holdings here
+        (ref quorum removeMember listeners). Default no-op."""
 
     def rollback(self, contents: Any, local_metadata: Any) -> None:
         """Undo one not-yet-flushed local op (ref IDeltaHandler.rollback)."""

@@ -54,6 +54,7 @@ class ContainerRuntime:
         self.min_seq = 0
         self.closed = False
         self.close_error: Exception | None = None
+        self._expected_join_seq = -1
         self._detached_counter = 0
         self._stash: dict[str, Any] | None = None
         self._processing_inbound = False
@@ -63,8 +64,10 @@ class ContainerRuntime:
         if ds_id in self._datastores:
             raise ValueError(f"datastore {ds_id!r} already exists")
 
-        def submit(contents: dict, metadata: Any, _ds_id: str = ds_id) -> None:
-            self._submit_datastore_op(_ds_id, contents, metadata)
+        def submit(
+            contents: dict, metadata: Any, internal: bool = False, _ds_id: str = ds_id
+        ) -> None:
+            self._submit_datastore_op(_ds_id, contents, metadata, internal)
 
         ds = DataStoreRuntime(
             ds_id,
@@ -72,6 +75,8 @@ class ContainerRuntime:
             submit,
             lambda cid: self._quorum[cid],
             lambda: self.client_id,
+            lambda: list(self._quorum),
+            lambda: self.ref_seq,
         )
         self._datastores[ds_id] = ds
         return ds
@@ -80,8 +85,10 @@ class ContainerRuntime:
         return self._datastores[ds_id]
 
     # ----------------------------------------------------------------- outbound
-    def _submit_datastore_op(self, ds_id: str, contents: dict, metadata: Any) -> None:
-        if self._processing_inbound:
+    def _submit_datastore_op(
+        self, ds_id: str, contents: dict, metadata: Any, internal: bool = False
+    ) -> None:
+        if self._processing_inbound and not internal:
             # Reentrancy guard (ref ensureNoDataModelChanges,
             # containerRuntime.ts:1500): minting local ops from inside
             # inbound op application breaks ref-seq consistency.
@@ -143,7 +150,14 @@ class ContainerRuntime:
         self.client_id = client_id
         self.joined = False
         self._outbox = self._adopt_outbox(client_id)
-        document.connect(client_id, self._on_sequenced, self._on_nack)
+        self._expected_join_seq = -1  # catch-up must not match any join
+        join_msg = document.connect(client_id, self._on_sequenced, self._on_nack)
+        if self.closed:
+            # Catch-up closed us (e.g. fork detection) but the join was
+            # still ticketed: leave cleanly so we don't pin the MSN forever.
+            document.disconnect(client_id)
+            return
+        self._expected_join_seq = join_msg.seq
         self._maybe_apply_stash(catch_up_done=True)
 
     def _adopt_outbox(self, client_id: str) -> Outbox:
@@ -193,6 +207,10 @@ class ContainerRuntime:
     def _on_sequenced(self, msg: SequencedMessage) -> None:
         if self.closed:
             return
+        if msg.seq <= self.ref_seq:
+            # Already processed (reconnect catch-up replays the full log;
+            # ref DeltaManager drops ops at/below lastProcessedSequenceNumber).
+            return
         if self._stash is not None and msg.seq > self._stash["refSeq"]:
             self._maybe_apply_stash(catch_up_done=False)
         self.ref_seq = msg.seq
@@ -201,11 +219,16 @@ class ContainerRuntime:
 
         if msg.type == MessageType.JOIN:
             self._quorum[msg.contents["clientId"]] = msg.contents["short"]
-            if msg.contents["clientId"] == self.client_id and not self.joined:
+            # Only THIS connection's join (matched by exact seq) flips us to
+            # joined — a stale join of the same client id replayed during
+            # catch-up must not trigger a premature pending replay.
+            if msg.seq == self._expected_join_seq and not self.joined:
                 self.joined = True
                 self._replay_pending()
         elif msg.type == MessageType.LEAVE:
             self._quorum.pop(msg.contents["clientId"], None)
+            for ds in self._datastores.values():
+                ds.on_client_leave(msg.contents["clientId"], msg.seq)
         elif msg.type == MessageType.OP:
             try:
                 self._process_op(msg)

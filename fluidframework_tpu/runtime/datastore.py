@@ -34,12 +34,16 @@ class DataStoreRuntime:
         submit_fn: Callable[[dict, Any], None],
         quorum_fn: Callable[[str], int],
         client_id_fn: Callable[[], str],
+        members_fn: Callable[[], list[str]] | None = None,
+        ref_seq_fn: Callable[[], int] | None = None,
     ) -> None:
         self.id = ds_id
         self._registry = registry
         self._submit = submit_fn
         self._quorum = quorum_fn
         self._client_id = client_id_fn
+        self._members = members_fn
+        self._ref_seq = ref_seq_fn
         self._channels: dict[str, Channel] = {}
 
     # ------------------------------------------------------------- channels
@@ -59,10 +63,14 @@ class DataStoreRuntime:
     def _bind(self, channel: Channel) -> None:
         cid = channel.id
 
-        def submit(contents: Any, local_metadata: Any) -> None:
-            self._submit({"address": cid, "contents": contents}, local_metadata)
+        def submit(contents: Any, local_metadata: Any, internal: bool = False) -> None:
+            self._submit({"address": cid, "contents": contents}, local_metadata, internal)
 
-        channel.connect(ChannelDeltaConnection(submit, self._quorum, self._client_id))
+        channel.connect(
+            ChannelDeltaConnection(
+                submit, self._quorum, self._client_id, self._members, self._ref_seq
+            )
+        )
         self._channels[cid] = channel
 
     def get_channel(self, channel_id: str) -> Channel:
@@ -116,6 +124,10 @@ class DataStoreRuntime:
     def on_min_seq(self, min_seq: int) -> None:
         for ch in self._channels.values():
             ch.on_min_seq(min_seq)
+
+    def on_client_leave(self, client_id: str, seq: int) -> None:
+        for ch in self._channels.values():
+            ch.on_client_leave(client_id, seq)
 
     def rollback(self, contents: dict, local_metadata: Any) -> None:
         self._channels[contents["address"]].rollback(contents["contents"], local_metadata)

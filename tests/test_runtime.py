@@ -338,6 +338,66 @@ def test_reentrancy_guard():
         doc.process_all()
 
 
+def test_reconnect_does_not_reapply_processed_ops():
+    # Regression: catch-up replays the full log; ops already processed
+    # (seq <= ref_seq) must be dropped even after the duplicate-batch
+    # detector evicted their batch ids past the MSN floor.
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    b = make_container(doc, "B")
+    doc.process_all()
+    string_of(b).insert_text(0, "x")
+    b.flush(); doc.process_all()
+    for i in range(3):  # advance MSN so batch ids evict
+        string_of(a).insert_text(0, str(i))
+        a.flush(); doc.process_all()
+        string_of(b).insert_text(0, "y")
+        b.flush(); doc.process_all()
+    before = text_of(a)
+    a.disconnect()
+    a.connect(doc, "A2")
+    doc.process_all()
+    assert text_of(a) == text_of(b) == before
+
+
+def test_same_client_id_reconnect_replays_offline_edits():
+    # Regression: the OLD join replayed during catch-up must not trigger a
+    # premature pending replay (which the sequencer would nack).
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    b = make_container(doc, "B")
+    doc.process_all()
+    a.disconnect()
+    string_of(a).insert_text(0, "offline")
+    a.flush()
+    a.connect(doc, "A")  # SAME identity
+    doc.process_all()
+    assert a.joined
+    assert a.pending_op_count == 0
+    assert text_of(a) == text_of(b) == "offline"
+
+
+def test_closed_during_catchup_leaves_cleanly():
+    # Regression: a container that closes itself during catch-up (fork
+    # detection) must not stay joined and pin the MSN.
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    doc.process_all()
+    a.disconnect()
+    map_of(a).set("k", "v")
+    stash = a.get_pending_local_state()
+    t1 = make_container(doc, "twin1", stash=stash)
+    doc.process_all()
+    t2 = make_container(doc, "twin2", stash=stash)
+    doc.process_all()
+    assert t2.closed
+    assert "twin2" not in doc.sequencer.clients()
+    assert not t1.closed
+
+
 def test_squash_cancels_insert_remove_pair():
     from fluidframework_tpu.dds.mergetree_ref import RefMergeTree
     from fluidframework_tpu.protocol.stamps import ALL_ACKED, encode_stamp

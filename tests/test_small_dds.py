@@ -1,0 +1,274 @@
+"""Small DDS family tests: cell, counter, consensus queue, register
+collection, task manager, pact map — multi-client convergence through the
+full runtime over the in-process service (SURVEY.md §4.1 pattern)."""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.dds.small import SMALL_DDS_FACTORIES
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+
+def registry():
+    r = default_registry()
+    r.update(SMALL_DDS_FACTORIES)
+    return r
+
+
+CHANNELS = [
+    ("sharedCell", "cell"),
+    ("sharedCounter", "counter"),
+    ("consensusQueue", "queue"),
+    ("consensusRegisterCollection", "regs"),
+    ("taskManager", "tasks"),
+    ("pactMap", "pact"),
+]
+
+
+def mk(doc, name, stash=None):
+    c = ContainerRuntime(registry(), container_id=name)
+    ds = c.create_datastore("root")
+    for ctype, cid in CHANNELS:
+        ds.create_channel(ctype, cid)
+    c.connect(doc, name, stash=stash)
+    return c
+
+
+def ch(c, cid):
+    return c.datastore("root").get_channel(cid)
+
+
+@pytest.fixture
+def pair():
+    svc = LocalService()
+    doc = svc.document("d")
+    a, b = mk(doc, "A"), mk(doc, "B")
+    doc.process_all()
+    return doc, a, b
+
+
+# ------------------------------------------------------------------- cell
+
+def test_cell_lww_and_overlay(pair):
+    doc, a, b = pair
+    ch(a, "cell").set({"v": 1})
+    assert ch(a, "cell").get() == {"v": 1}  # optimistic
+    assert ch(b, "cell").get() is None
+    a.flush()
+    ch(b, "cell").set({"v": 2})
+    b.flush()
+    doc.process_all()
+    # B's set sequenced after A's: LWW winner everywhere.
+    assert ch(a, "cell").get() == ch(b, "cell").get() == {"v": 2}
+    ch(a, "cell").delete()
+    a.flush()
+    doc.process_all()
+    assert ch(b, "cell").empty and ch(a, "cell").get() is None
+
+
+# ---------------------------------------------------------------- counter
+
+def test_counter_commutes(pair):
+    doc, a, b = pair
+    ch(a, "counter").increment(5)
+    ch(b, "counter").increment(-2)
+    assert ch(a, "counter").value == 5  # local overlay
+    assert ch(b, "counter").value == -2
+    a.flush(); b.flush()
+    doc.process_all()
+    assert ch(a, "counter").value == ch(b, "counter").value == 3
+    ch(a, "counter").increment(10)
+    assert ch(a, "counter").value == 13
+    a.flush(); doc.process_all()
+    assert ch(b, "counter").value == 13
+    with pytest.raises(TypeError):
+        ch(a, "counter").increment(1.5)
+
+
+# ------------------------------------------------------------------ queue
+
+def test_consensus_queue_acquire_complete(pair):
+    doc, a, b = pair
+    ch(a, "queue").add("job1")
+    ch(a, "queue").add("job2")
+    a.flush()
+    ha = ch(a, "queue").acquire()
+    a.flush()
+    hb = ch(b, "queue").acquire()
+    b.flush()
+    assert not ha.settled  # consensus: nothing until sequenced
+    doc.process_all()
+    assert ha.settled and ha.acquired and ha.value == "job1"
+    assert hb.settled and hb.acquired and hb.value == "job2"
+    assert ch(a, "queue").data == ch(b, "queue").data == []
+    ch(a, "queue").complete(ha)
+    ch(b, "queue").release(hb)
+    a.flush(); b.flush()
+    doc.process_all()
+    # job1 completed; job2 released back.
+    assert ch(a, "queue").data == ch(b, "queue").data == ["job2"]
+
+
+def test_consensus_queue_releases_on_leave(pair):
+    doc, a, b = pair
+    ch(a, "queue").add("x")
+    a.flush(); doc.process_all()
+    hb = ch(b, "queue").acquire()
+    b.flush(); doc.process_all()
+    assert hb.acquired
+    b.disconnect()
+    doc.process_all()
+    # B left holding "x": it returns to the queue on A's replica.
+    assert ch(a, "queue").data == ["x"]
+
+
+def test_acquire_on_empty_queue_settles_unacquired(pair):
+    doc, a, b = pair
+    h = ch(a, "queue").acquire()
+    a.flush(); doc.process_all()
+    assert h.settled and not h.acquired
+
+
+# -------------------------------------------------------------- registers
+
+def test_register_concurrent_versions(pair):
+    doc, a, b = pair
+    wa = ch(a, "regs").write("k", "from-a")
+    wb = ch(b, "regs").write("k", "from-b")
+    a.flush(); b.flush()
+    doc.process_all()
+    # Concurrent writes: A sequenced first and wins atomic; both versions kept.
+    assert ch(a, "regs").read("k") == ch(b, "regs").read("k") == "from-a"
+    assert ch(a, "regs").read("k", "lww") == "from-b"
+    assert set(ch(b, "regs").read_versions("k")) == {"from-a", "from-b"}
+    assert ch(a, "regs").write_result(wa) is True
+    assert ch(b, "regs").write_result(wb) is False
+
+    # A non-concurrent later write supersedes all versions.
+    wc = ch(b, "regs").write("k", "final")
+    b.flush(); doc.process_all()
+    assert ch(a, "regs").read_versions("k") == ["final"]
+    assert ch(a, "regs").read("k") == "final"
+    assert ch(b, "regs").write_result(wc) is True
+
+
+# ------------------------------------------------------------ task manager
+
+def test_task_manager_election_and_leave(pair):
+    doc, a, b = pair
+    ch(a, "tasks").volunteer("t")
+    ch(b, "tasks").volunteer("t")
+    a.flush(); b.flush()
+    doc.process_all()
+    assert ch(a, "tasks").assignee("t") == "A"
+    assert ch(a, "tasks").assigned("t") and not ch(b, "tasks").assigned("t")
+    assert ch(b, "tasks").queued("t")
+
+    a.disconnect()  # assignee leaves -> lock passes to B
+    doc.process_all()
+    assert ch(b, "tasks").assigned("t")
+
+    ch(b, "tasks").complete("t")
+    b.flush(); doc.process_all()
+    assert ch(b, "tasks").assignee("t") is None
+
+
+def test_task_manager_abandon(pair):
+    doc, a, b = pair
+    ch(a, "tasks").volunteer("t")
+    a.flush(); doc.process_all()
+    ch(a, "tasks").abandon("t")
+    a.flush(); doc.process_all()
+    assert ch(b, "tasks").assignee("t") is None
+
+
+# --------------------------------------------------------------- pact map
+
+def test_pact_map_requires_all_signoffs(pair):
+    doc, a, b = pair
+    ch(a, "pact").set("policy", "strict")
+    a.flush()
+    doc.process_all()  # set sequences; A and B auto-submit accepts...
+    a.flush(); b.flush()  # ...which ride the next flush
+    doc.process_all()
+    assert ch(a, "pact").get("policy") == ch(b, "pact").get("policy") == "strict"
+    assert not ch(a, "pact").is_pending("policy")
+
+
+def test_pact_map_pending_until_signoff(pair):
+    doc, a, b = pair
+    ch(a, "pact").set("k", 1)
+    a.flush()
+    doc.process_all()
+    a.flush()  # only A's accept goes out; B withholds
+    doc.process_all()
+    assert ch(a, "pact").get("k") is None
+    assert ch(a, "pact").is_pending("k")
+    assert ch(a, "pact").get_pending("k") == 1
+    b.flush()  # B's accept
+    doc.process_all()
+    assert ch(b, "pact").get("k") == 1
+
+
+def test_pact_map_leave_counts_as_signoff(pair):
+    doc, a, b = pair
+    ch(a, "pact").set("k", "v")
+    a.flush()
+    doc.process_all()
+    a.flush()  # A accepts; B never does
+    doc.process_all()
+    assert ch(a, "pact").is_pending("k")
+    b.disconnect()  # B leaves -> implicit signoff
+    doc.process_all()
+    assert ch(a, "pact").get("k") == "v"
+
+
+def test_pact_map_rejects_stale_proposal(pair):
+    doc, a, b = pair
+    ch(a, "pact").set("k", "first")
+    a.flush(); doc.process_all()
+    a.flush(); b.flush(); doc.process_all()  # accepted
+    assert ch(b, "pact").get("k") == "first"
+
+    # B proposes concurrently-with-acceptance... a second set while nothing
+    # is pending and with knowledge of accepted value: valid.
+    ch(b, "pact").set("k", "second")
+    b.flush(); doc.process_all()
+    a.flush(); b.flush(); doc.process_all()
+    assert ch(a, "pact").get("k") == ch(b, "pact").get("k") == "second"
+
+
+# ---------------------------------------------------------- reconnect/stash
+
+def test_small_dds_reconnect_replay(pair):
+    doc, a, b = pair
+    ch(a, "counter").increment(7)
+    ch(a, "cell").set("offline")
+    a.disconnect()
+    a.flush()
+    a.connect(doc, "A2")
+    doc.process_all()
+    assert ch(b, "counter").value == 7
+    assert ch(b, "cell").get() == "offline"
+    assert ch(a, "counter").value == 7
+
+
+def test_small_dds_summary_roundtrip(pair):
+    doc, a, b = pair
+    ch(a, "cell").set(42)
+    ch(a, "counter").increment(9)
+    ch(a, "regs").write("r", "v")
+    ch(a, "tasks").volunteer("t")
+    a.flush(); doc.process_all()
+
+    summary = a.datastore("root").summarize()
+    c = ContainerRuntime(registry(), container_id="C")
+    ds = c.create_datastore("root")
+    ds.load(summary)
+    assert ds.get_channel("cell").get() == 42
+    assert ds.get_channel("counter").value == 9
+    assert ds.get_channel("regs").read("r") == "v"
+    assert ds.get_channel("tasks").assignee("t") == "A"
