@@ -32,6 +32,7 @@ which the sequencer re-stamps on resubmission).
 
 from __future__ import annotations
 
+import uuid as _uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -40,11 +41,19 @@ from .channels import ChannelTypeFactory, PendingOverlayChannel
 
 
 class _VerbatimResubmitChannel(Channel):
-    """Base for position-free DDSes: resubmit re-sends contents unchanged;
-    stashed ops re-enter the local pending queue."""
+    """Base for position-free DDSes: resubmit re-sends contents unchanged.
+
+    Stashed ops apply no optimistic state (consensus semantics — nothing
+    changes until sequencing); rehydrate just re-enters them into the pending
+    queue for verbatim resubmission, with any local completion handles
+    resolving as unavailable (ref consensusOrderedCollection.ts:438 — stashed
+    ops are resubmitted with a no-op resolve)."""
 
     def resubmit(self, contents: Any, local_metadata: Any, squash: bool = False) -> None:
         self.submit_local_message(contents, local_metadata)
+
+    def apply_stashed(self, contents: Any) -> Any:
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -175,10 +184,13 @@ class ConsensusQueue(_VerbatimResubmitChannel):
         self.submit_local_message({"opName": "add", "value": value})
 
     def acquire(self) -> AcquireHandle:
-        """Request the head item; resolves at sequencing (consensus)."""
+        """Request the head item; resolves at sequencing (consensus).
+
+        The acquire id is a fresh UUID (ref consensusOrderedCollection.ts:411)
+        — NOT derived from the client id, which is None for detached
+        containers and would collide across clients acquiring pre-connect."""
         self._next_acquire += 1
-        conn = self._connection
-        acquire_id = f"{conn.client_id()}:{self._next_acquire}"
+        acquire_id = _uuid.uuid4().hex
         handle = AcquireHandle(acquire_id)
         self._handles[acquire_id] = handle
         self.submit_local_message({"opName": "acquire", "acquireId": acquire_id})
@@ -254,7 +266,7 @@ class _Register:
     versions: list[tuple[int, Any]] = field(default_factory=list)  # (seq, value)
 
 
-class ConsensusRegisterCollection(Channel):
+class ConsensusRegisterCollection(_VerbatimResubmitChannel):
     """Per-key register keeping concurrent versions
     (consensusRegisterCollection.ts processInboundWrite:352):
 
@@ -328,8 +340,12 @@ class ConsensusRegisterCollection(Channel):
     def keys(self) -> list[str]:
         return list(self.data)
 
-    def resubmit(self, contents: Any, local_metadata: Any, squash: bool = False) -> None:
-        self.submit_local_message(contents, local_metadata)
+    def apply_stashed(self, contents: Any) -> Any:
+        # Mint a fresh writeId so the ack path can record the outcome; the
+        # original promise is gone with the stashed session (ref
+        # consensusRegisterCollection.ts:434).
+        self._next_write += 1
+        return {"writeId": self._next_write}
 
     def summarize(self) -> dict[str, Any]:
         return {

@@ -1,0 +1,26 @@
+"""Cross-cutting utilities: distributed ID compression, telemetry, config.
+
+Reference parity: packages/runtime/id-compressor, packages/utils/
+telemetry-utils, packages/common/core-interfaces config contracts.
+"""
+
+from .config import CachedConfigProvider, ConfigTypes, MonitoringContext
+from .id_compressor import IdCompressor, IdCreationRange
+from .telemetry import (
+    Logger,
+    PerformanceEvent,
+    SampledTelemetryHelper,
+    create_child_logger,
+)
+
+__all__ = [
+    "CachedConfigProvider",
+    "ConfigTypes",
+    "IdCompressor",
+    "IdCreationRange",
+    "Logger",
+    "MonitoringContext",
+    "PerformanceEvent",
+    "SampledTelemetryHelper",
+    "create_child_logger",
+]

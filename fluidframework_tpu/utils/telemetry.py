@@ -1,0 +1,177 @@
+"""Structured telemetry: child loggers, performance spans, sampled helpers.
+
+Reference parity: packages/utils/telemetry-utils/src/logger.ts —
+``createChildLogger`` with inherited properties (:161,432), ``PerformanceEvent``
+spans (:690), and ``SampledTelemetryHelper`` (sampledTelemetryHelper.ts) which
+aggregates hot-path measurements and emits one event every N calls (wired into
+every DDS op apply in the reference, sharedObject.ts:100-104).
+
+Host-side only: nothing here touches the device path. Events are plain dicts
+delivered to a sink callable, so tests can assert on them (ref mockLogger.ts).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+Sink = Callable[[dict[str, Any]], None]
+
+
+class Logger:
+    """A namespace-prefixed structured logger with inherited properties."""
+
+    def __init__(
+        self,
+        namespace: str = "",
+        sink: Sink | None = None,
+        properties: dict[str, Any] | None = None,
+    ) -> None:
+        self.namespace = namespace
+        self._sink = sink
+        self.properties = dict(properties or {})
+        self.events: list[dict[str, Any]] = []  # retained when no sink (mock mode)
+
+    def send(self, event: dict[str, Any]) -> None:
+        out = dict(self.properties)
+        out.update(event)
+        if self.namespace and "eventName" in out:
+            out["eventName"] = f"{self.namespace}:{out['eventName']}"
+        if self._sink is not None:
+            self._sink(out)
+        else:
+            self.events.append(out)
+
+    # Category helpers (ref ITelemetryLoggerExt send{Telemetry,Error,Perf}Event)
+    def generic(self, event_name: str, **props: Any) -> None:
+        self.send({"eventName": event_name, "category": "generic", **props})
+
+    def error(self, event_name: str, error: BaseException | str = "", **props: Any) -> None:
+        self.send(
+            {
+                "eventName": event_name,
+                "category": "error",
+                "error": str(error),
+                **props,
+            }
+        )
+
+    def performance(self, event_name: str, duration_s: float, **props: Any) -> None:
+        self.send(
+            {
+                "eventName": event_name,
+                "category": "performance",
+                "duration": duration_s,
+                **props,
+            }
+        )
+
+    def matching(self, **filters: Any) -> list[dict[str, Any]]:
+        """Mock-mode assertion helper (ref mockLogger matchEvents)."""
+        return [
+            e
+            for e in self.events
+            if all(e.get(k) == v for k, v in filters.items())
+        ]
+
+
+def create_child_logger(
+    parent: Logger, namespace: str = "", properties: dict[str, Any] | None = None
+) -> Logger:
+    """Child logger: prefixes the namespace, inherits + overrides properties,
+    shares the parent's sink/event buffer (ref logger.ts:161)."""
+    # Route through parent.send: the parent applies its own namespace prefix
+    # and properties, so the child carries only its own segment/overrides.
+    return Logger(namespace=namespace, sink=parent.send, properties=properties)
+
+
+class PerformanceEvent:
+    """A span: start/end/cancel with duration, used around phases like
+    container load and summarize (ref logger.ts:690). Context-manager form
+    reports success on clean exit, error on exception."""
+
+    def __init__(self, logger: Logger, event_name: str, **props: Any) -> None:
+        self.logger = logger
+        self.event_name = event_name
+        self.props = props
+        self._start = time.perf_counter()
+        self._done = False
+
+    def end(self, **props: Any) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.logger.performance(
+            f"{self.event_name}_end",
+            time.perf_counter() - self._start,
+            **{**self.props, **props},
+        )
+
+    def cancel(self, error: BaseException | str = "", **props: Any) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.logger.error(
+            f"{self.event_name}_cancel", error, **{**self.props, **props}
+        )
+
+    def __enter__(self) -> "PerformanceEvent":
+        return self
+
+    def __exit__(self, exc_type, exc, _tb) -> None:
+        if exc is None:
+            self.end()
+        else:
+            self.cancel(exc)
+
+
+@dataclass
+class _SampleBucket:
+    count: int = 0
+    total_s: float = 0.0
+    min_s: float = float("inf")
+    max_s: float = 0.0
+
+
+class SampledTelemetryHelper:
+    """Aggregate hot-path timings, emit one event per ``sample_every`` calls
+    per bucket key (ref sampledTelemetryHelper.ts). Cheap enough to wrap every
+    op-apply: one perf_counter pair + dict update per call."""
+
+    def __init__(
+        self, logger: Logger, event_name: str, sample_every: int = 100
+    ) -> None:
+        self.logger = logger
+        self.event_name = event_name
+        self.sample_every = sample_every
+        self._buckets: dict[str, _SampleBucket] = {}
+
+    def measure(self, fn: Callable[[], Any], bucket: str = "") -> Any:
+        start = time.perf_counter()
+        out = fn()
+        self.record(time.perf_counter() - start, bucket)
+        return out
+
+    def record(self, duration_s: float, bucket: str = "") -> None:
+        b = self._buckets.setdefault(bucket, _SampleBucket())
+        b.count += 1
+        b.total_s += duration_s
+        b.min_s = min(b.min_s, duration_s)
+        b.max_s = max(b.max_s, duration_s)
+        if b.count >= self.sample_every:
+            self.flush(bucket)
+
+    def flush(self, bucket: str = "") -> None:
+        b = self._buckets.pop(bucket, None)
+        if b is None or b.count == 0:
+            return
+        self.logger.performance(
+            self.event_name,
+            b.total_s,
+            bucket=bucket,
+            count=b.count,
+            avg=b.total_s / b.count,
+            min=b.min_s,
+            max=b.max_s,
+        )

@@ -1,0 +1,179 @@
+"""Tests for utils: id-compressor cluster semantics, telemetry, config.
+
+Modeled on the reference's id-compressor suite behaviors
+(packages/runtime/id-compressor/src/test/): local-then-final lifecycle,
+eager finals, cross-client normalization, stable-ID round trips, and
+deterministic finalization across replicas.
+"""
+
+import pytest
+
+from fluidframework_tpu.utils import (
+    CachedConfigProvider,
+    IdCompressor,
+    Logger,
+    MonitoringContext,
+    PerformanceEvent,
+    SampledTelemetryHelper,
+    create_child_logger,
+)
+
+
+class TestIdCompressor:
+    def test_local_ids_are_negative_gen_counts(self):
+        c = IdCompressor()
+        assert c.generate_compressed_id() == -1
+        assert c.generate_compressed_id() == -2
+
+    def test_finalize_makes_op_space_final(self):
+        c = IdCompressor()
+        a, b = c.generate_compressed_id(), c.generate_compressed_id()
+        rng = c.take_next_creation_range()
+        assert (rng.first_gen_count, rng.last_gen_count) == (1, 2)
+        c.finalize_creation_range(rng)
+        assert c.normalize_to_op_space(a) == 0
+        assert c.normalize_to_op_space(b) == 1
+        # Unfinalized IDs stay local in op space.
+        e = c.generate_compressed_id()
+        if e < 0:  # not eager-finalized (capacity may cover it)
+            assert c.normalize_to_op_space(e) == e
+
+    def test_eager_finals_after_cluster_exists(self):
+        c = IdCompressor(cluster_capacity=4)
+        c.generate_compressed_id()
+        c.finalize_creation_range(c.take_next_creation_range())
+        # Cluster reserved capacity 1+4; next IDs land inside it already-final.
+        nxt = c.generate_compressed_id()
+        assert nxt >= 0
+        assert c.decompress(nxt)  # own eager final decompresses
+
+    def test_cross_client_normalization_and_stable_ids(self):
+        a = IdCompressor()
+        b = IdCompressor()
+        ida = a.generate_compressed_id()
+        rng = a.take_next_creation_range()
+        # Total order: both replicas finalize A's range identically.
+        a.finalize_creation_range(rng)
+        b.finalize_creation_range(rng)
+        wire = a.normalize_to_op_space(ida)
+        assert wire >= 0
+        got = b.normalize_to_session_space(wire, a.session_id)
+        assert got == wire  # foreign finals stay final
+        assert b.decompress(got) == a.decompress(ida)
+        # B can route A's *local* wire form too (delivered before A finalized).
+        got2 = b.normalize_to_session_space(-1, a.session_id)
+        assert got2 == wire
+
+    def test_recompress_round_trip(self):
+        c = IdCompressor()
+        i = c.generate_compressed_id()
+        stable = c.decompress(i)
+        assert c.recompress(stable) == i
+        c.finalize_creation_range(c.take_next_creation_range())
+        # After finalize, recompress returns the final form.
+        assert c.recompress(stable) == c.normalize_to_op_space(i)
+
+    def test_out_of_order_finalization_rejected(self):
+        a = IdCompressor()
+        a.generate_compressed_id()
+        r1 = a.take_next_creation_range()
+        a.generate_compressed_id()
+        r2 = a.take_next_creation_range()
+        b = IdCompressor()
+        with pytest.raises(ValueError, match="out of order"):
+            b.finalize_creation_range(r2)
+        b.finalize_creation_range(r1)
+        b.finalize_creation_range(r2)
+
+    def test_deterministic_across_replicas(self):
+        compressors = [IdCompressor() for _ in range(3)]
+        ranges = []
+        for c in compressors:
+            for _ in range(5):
+                c.generate_compressed_id()
+            ranges.append(c.take_next_creation_range())
+        for c in compressors:
+            for r in ranges:
+                c.finalize_creation_range(r)
+        # Identical finalized state everywhere.
+        states = [c.serialize(with_session=False) for c in compressors]
+        assert states[0] == states[1] == states[2]
+
+    def test_serialize_round_trip(self):
+        c = IdCompressor()
+        for _ in range(3):
+            c.generate_compressed_id()
+        c.finalize_creation_range(c.take_next_creation_range())
+        c2 = IdCompressor.deserialize(c.serialize())
+        assert c2.session_id == c.session_id
+        assert c2.normalize_to_op_space(-1) == c.normalize_to_op_space(-1)
+        assert c2.decompress(-3) == c.decompress(-3)
+
+    def test_cluster_expansion_in_place(self):
+        c = IdCompressor(cluster_capacity=2)
+        for _ in range(2):
+            c.generate_compressed_id()
+        c.finalize_creation_range(c.take_next_creation_range())
+        # Generate more than remaining capacity; expansion (same session owns
+        # the newest final block) must keep final IDs contiguous.
+        for _ in range(6):
+            c.generate_compressed_id()
+        c.finalize_creation_range(c.take_next_creation_range())
+        finals = [c.normalize_to_op_space(-(g + 1)) for g in range(8)]
+        assert finals == list(range(8))
+
+
+class TestTelemetry:
+    def test_child_logger_namespacing_and_properties(self):
+        root = Logger("root", properties={"docId": "d1"})
+        child = create_child_logger(root, "runtime", {"layer": "runtime"})
+        child.generic("opApplied", count=3)
+        (e,) = root.events
+        assert e["eventName"] == "root:runtime:opApplied"
+        assert e["docId"] == "d1" and e["layer"] == "runtime" and e["count"] == 3
+
+    def test_performance_event_span(self):
+        log = Logger()
+        with PerformanceEvent(log, "load", docId="d"):
+            pass
+        (e,) = log.matching(category="performance")
+        assert e["eventName"] == "load_end" and e["duration"] >= 0
+
+    def test_performance_event_cancel_on_error(self):
+        log = Logger()
+        with pytest.raises(RuntimeError):
+            with PerformanceEvent(log, "load"):
+                raise RuntimeError("boom")
+        (e,) = log.matching(category="error")
+        assert e["eventName"] == "load_cancel" and "boom" in e["error"]
+
+    def test_sampled_helper_aggregates(self):
+        log = Logger()
+        h = SampledTelemetryHelper(log, "applyOp", sample_every=10)
+        for _ in range(25):
+            h.record(0.001, bucket="insert")
+        events = log.matching(eventName="applyOp")
+        assert len(events) == 2  # two full samples of 10; 5 pending
+        assert all(e["count"] == 10 for e in events)
+        h.flush("insert")
+        assert log.matching(eventName="applyOp")[-1]["count"] == 5
+
+
+class TestConfig:
+    def test_layered_typed_reads(self):
+        cfg = CachedConfigProvider(
+            {"FluidTpu.A": "true", "FluidTpu.N": "42"},
+            {"FluidTpu.A": "false", "FluidTpu.B": 7},
+        )
+        assert cfg.get_bool("FluidTpu.A") is True  # first provider wins
+        assert cfg.get_number("FluidTpu.N") == 42.0
+        assert cfg.get_number("FluidTpu.B") == 7
+        assert cfg.get_bool("FluidTpu.Missing", default=False) is False
+        assert cfg.get_string("FluidTpu.A") == "true"
+
+    def test_monitoring_context_child(self):
+        mc = MonitoringContext(Logger("root"))
+        child = mc.child("dds", docId="d9")
+        child.logger.generic("x")
+        (e,) = mc.logger.events
+        assert e["eventName"] == "root:dds:x" and e["docId"] == "d9"
