@@ -12,8 +12,8 @@ from __future__ import annotations
 
 from typing import Any
 
-from ..protocol.stamps import ALL_ACKED, encode_stamp
-from .mergetree_ref import RefMergeTree
+from ..protocol.stamps import ALL_ACKED, acked, encode_stamp
+from .mergetree_ref import RefMergeTree, Segment
 from ..runtime.channel import Channel, MessageCollection
 
 
@@ -130,6 +130,39 @@ class SharedStringChannel(Channel):
         else:
             raise ValueError(f"unsupported merge-tree op type {c['type']}")
         return {"localSeq": ls}
+
+    # ------------------------------------------------------------ checkpoint
+    def summarize(self) -> dict[str, Any]:
+        """Merge-tree snapshot: the acked segment array with full stamps
+        (ref snapshotV1.ts:42 — header + segment chunks; we keep one chunk;
+        stamps above minSeq are required so concurrent in-flight remote ops
+        rebase correctly against the loaded state)."""
+        segs = []
+        for s in self.backend.segments:
+            if not acked(s.ins_key) or any(not acked(k) for k, _c in s.removes):
+                raise RuntimeError("summarize with pending merge-tree state")
+            segs.append(
+                {
+                    "text": s.text,
+                    "ins": [s.ins_key, s.ins_client],
+                    "removes": [[k, c] for k, c in s.removes],
+                    "props": {str(p): [v, k] for p, (v, k) in s.props.items()},
+                }
+            )
+        return {"segments": segs, "minSeq": self.backend.min_seq}
+
+    def load(self, summary: dict[str, Any]) -> None:
+        self.backend.min_seq = summary["minSeq"]
+        self.backend.segments = [
+            Segment(
+                text=e["text"],
+                ins_key=e["ins"][0],
+                ins_client=e["ins"][1],
+                removes=[(k, c) for k, c in e["removes"]],
+                props={int(p): (v, k) for p, (v, k) in e["props"].items()},
+            )
+            for e in summary["segments"]
+        ]
 
     # ------------------------------------------------------------------ views
     @property

@@ -176,7 +176,6 @@ class ConsensusQueue(_VerbatimResubmitChannel):
         self.data: list[Any] = []
         # acquireId -> (value, clientId) for in-flight acquired items.
         self.job_tracking: dict[str, tuple[Any, str]] = {}
-        self._next_acquire = 0
         self._handles: dict[str, AcquireHandle] = {}
 
     # ------------------------------------------------------------------- api
@@ -189,7 +188,6 @@ class ConsensusQueue(_VerbatimResubmitChannel):
         The acquire id is a fresh UUID (ref consensusOrderedCollection.ts:411)
         — NOT derived from the client id, which is None for detached
         containers and would collide across clients acquiring pre-connect."""
-        self._next_acquire += 1
         acquire_id = _uuid.uuid4().hex
         handle = AcquireHandle(acquire_id)
         self._handles[acquire_id] = handle

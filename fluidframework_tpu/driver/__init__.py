@@ -1,0 +1,26 @@
+"""Driver layer: the service abstraction between loader and ordering service.
+
+Reference parity: packages/common/driver-definitions (IDocumentServiceFactory
+/ IDocumentService / IDocumentDeltaConnection / IDocumentStorageService /
+IDocumentDeltaStorageService) + packages/drivers/local-driver.
+"""
+
+from .definitions import (
+    DeltaConnection,
+    DeltaStorageService,
+    DocumentService,
+    DocumentServiceFactory,
+    DriverError,
+    StorageService,
+)
+from .local_driver import LocalDocumentServiceFactory
+
+__all__ = [
+    "DeltaConnection",
+    "DeltaStorageService",
+    "DocumentService",
+    "DocumentServiceFactory",
+    "DriverError",
+    "LocalDocumentServiceFactory",
+    "StorageService",
+]

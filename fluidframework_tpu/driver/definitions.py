@@ -1,0 +1,94 @@
+"""Service abstraction contracts (ref packages/common/driver-definitions).
+
+The loader talks only to these interfaces; concrete drivers bind them to a
+transport (in-memory local service here; a networked service would bind
+sockets/REST the same way). Error taxonomy mirrors the reference's
+DriverError categories enough for retry logic (can_retry).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..protocol.messages import Nack, SequencedMessage, SignalMessage
+
+
+class DriverError(Exception):
+    """Driver-layer failure (ref IDriverErrorBase): carries retryability."""
+
+    def __init__(self, message: str, can_retry: bool = True) -> None:
+        super().__init__(message)
+        self.can_retry = can_retry
+
+
+class DeltaConnection:
+    """A live ordered-op stream connection (ref IDocumentDeltaConnection).
+
+    ``join_msg`` is the ticketed join for write connections (None for read).
+    ``checkpoint_seq`` is the newest seq already broadcast before this
+    connection opened — the gap [last_known+1, checkpoint_seq] must be
+    fetched from delta storage; everything above arrives via the listener.
+    """
+
+    client_id: str
+    mode: str  # "write" | "read"
+    join_msg: SequencedMessage | None
+    checkpoint_seq: int
+
+    def submit(self, message: Any) -> None:
+        raise NotImplementedError
+
+    def submit_signal(self, content: Any) -> None:
+        raise NotImplementedError
+
+    def disconnect(self) -> None:
+        raise NotImplementedError
+
+    @property
+    def connected(self) -> bool:
+        raise NotImplementedError
+
+
+class DeltaStorageService:
+    """Historical sequenced-op reads (ref IDocumentDeltaStorageService)."""
+
+    def get_deltas(self, from_seq: int, to_seq: int) -> list[SequencedMessage]:
+        """Inclusive range; may return fewer (caller re-requests)."""
+        raise NotImplementedError
+
+
+class StorageService:
+    """Snapshot/blob storage (ref IDocumentStorageService)."""
+
+    def get_latest_snapshot(self) -> tuple[int, dict] | None:
+        raise NotImplementedError
+
+    def write_snapshot(self, seq: int, summary: dict) -> None:
+        raise NotImplementedError
+
+
+class DocumentService:
+    """One document's service endpoints (ref IDocumentService)."""
+
+    def connect_to_delta_stream(
+        self,
+        client_id: str,
+        listener: Callable[[SequencedMessage], None],
+        nack_listener: Callable[[Nack], None] | None = None,
+        signal_listener: Callable[[SignalMessage], None] | None = None,
+        mode: str = "write",
+    ) -> DeltaConnection:
+        raise NotImplementedError
+
+    def connect_to_delta_storage(self) -> DeltaStorageService:
+        raise NotImplementedError
+
+    def connect_to_storage(self) -> StorageService:
+        raise NotImplementedError
+
+
+class DocumentServiceFactory:
+    """Resolves a document id to its service (ref IDocumentServiceFactory)."""
+
+    def create_document_service(self, doc_id: str) -> DocumentService:
+        raise NotImplementedError

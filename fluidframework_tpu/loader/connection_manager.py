@@ -1,0 +1,91 @@
+"""Connection state machine: open/close, identity epochs, backoff, modes.
+
+Reference parity: container-loader/src/connectionManager.ts (:140) — each
+(re)connection is a fresh identity (the reference's server assigns a new
+clientId per socket; here the manager derives ``base~epochN``), reconnects
+apply exponential backoff (tracked as a delay value — the host owns the
+clock), and connections are "read" or "write": read connections never join
+the quorum and cannot submit (read→write escalation reconnects in write
+mode, connectionManager.ts read/write escalation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..driver.definitions import DeltaConnection, DocumentService
+from ..protocol.messages import Nack, SequencedMessage, SignalMessage
+
+
+class ConnectionManager:
+    INITIAL_BACKOFF_S = 0.5
+    MAX_BACKOFF_S = 8.0
+
+    def __init__(self, service: DocumentService, base_client_id: str) -> None:
+        self._service = service
+        self._base = base_client_id
+        self._epoch = 0
+        self.connection: DeltaConnection | None = None
+        self.connect_count = 0
+        self.next_backoff_s = 0.0  # advisory delay before the next attempt
+
+    # --------------------------------------------------------------- identity
+    def next_client_id(self) -> str:
+        """The identity the NEXT connection will use (stable until open)."""
+        return self._base if self._epoch == 0 else f"{self._base}~r{self._epoch}"
+
+    @property
+    def client_id(self) -> str | None:
+        return self.connection.client_id if self.connection else None
+
+    @property
+    def connected(self) -> bool:
+        return self.connection is not None and self.connection.connected
+
+    @property
+    def mode(self) -> str | None:
+        return self.connection.mode if self.connection else None
+
+    # ------------------------------------------------------------------ open
+    def open(
+        self,
+        listener: Callable[[SequencedMessage], None],
+        nack_listener: Callable[[Nack], None] | None = None,
+        signal_listener: Callable[[SignalMessage], None] | None = None,
+        mode: str = "write",
+    ) -> DeltaConnection:
+        if self.connected:
+            raise RuntimeError("already connected")
+        client_id = self.next_client_id()
+        self._epoch += 1
+
+        def on_nack(nack: Nack) -> None:
+            # The connection already tore itself down; escalate backoff so
+            # the next attempt is delayed (ref reconnect-on-nack with delay;
+            # retry_after from the server overrides).
+            self._bump_backoff(nack.retry_after)
+            if nack_listener is not None:
+                nack_listener(nack)
+
+        conn = self._service.connect_to_delta_stream(
+            client_id, listener, on_nack, signal_listener, mode=mode
+        )
+        self.connection = conn
+        self.connect_count += 1
+        return conn
+
+    def close(self) -> None:
+        if self.connection is not None:
+            self.connection.disconnect()
+            self.connection = None
+
+    def reset_backoff(self) -> None:
+        self.next_backoff_s = 0.0
+
+    def _bump_backoff(self, retry_after: float = 0.0) -> None:
+        if retry_after > 0:
+            self.next_backoff_s = retry_after
+        elif self.next_backoff_s == 0.0:
+            self.next_backoff_s = self.INITIAL_BACKOFF_S
+        else:
+            self.next_backoff_s = min(self.next_backoff_s * 2, self.MAX_BACKOFF_S)

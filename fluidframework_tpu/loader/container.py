@@ -1,0 +1,202 @@
+"""Container: the loader-level lifecycle object tying all layers together.
+
+Reference parity: container-loader/src/container.ts — ``Container.load``
+(:324) = snapshot fetch → runtime boot → delta-stream connect → gap replay;
+``createDetached`` (:382) + ``attach``; ``getPendingLocalState`` (:1152);
+close semantics. The Container owns the ProtocolHandler (quorum/proposals),
+the DeltaManager (ordered pump + gap repair) and the ContainerRuntime
+(op application), and drives reconnect/escalation.
+
+Layering note (SURVEY §1): the ContainerRuntime never sees the driver — it
+talks to the DeltaManager through the same document-adapter contract the
+unit tests use to wire it straight to a LocalDocument.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..driver.definitions import DocumentServiceFactory
+from ..protocol.messages import MessageType, SignalMessage
+from ..runtime.container_runtime import ContainerRuntime
+from .delta_manager import DeltaManager
+from .protocol import ProtocolHandler
+
+
+class Container:
+    """One loaded collaborative document (ref IContainer)."""
+
+    def __init__(self, runtime: ContainerRuntime, registry: dict[str, Any]) -> None:
+        self.runtime = runtime
+        self._registry = registry
+        self.protocol: ProtocolHandler | None = None
+        self.delta_manager: DeltaManager | None = None
+        self._storage = None
+        self._service = None
+        self.attached = False
+        self._stash: str | None = None
+        self._mode = "write"
+
+    # ------------------------------------------------------------------ load
+    @staticmethod
+    def load(
+        doc_id: str,
+        service_factory: DocumentServiceFactory,
+        registry: dict[str, Any],
+        client_id: str,
+        stash: str | None = None,
+        mode: str = "write",
+    ) -> "Container":
+        """Boot from the service: latest snapshot + trailing ops + live
+        stream (call stack SURVEY §3.1)."""
+        service = service_factory.create_document_service(doc_id)
+        storage = service.connect_to_storage()
+        runtime = ContainerRuntime(registry, container_id=client_id)
+        protocol = ProtocolHandler()
+        snap = storage.get_latest_snapshot()
+        base_seq = 0
+        if snap is not None:
+            base_seq, summary = snap
+            runtime.load_snapshot(summary["runtime"])
+            protocol.load(summary["protocol"])
+        c = Container(runtime, registry)
+        c._service = service
+        c._storage = storage
+        c.protocol = protocol
+        c.delta_manager = DeltaManager(
+            service, protocol, base_client_id=client_id, last_processed_seq=base_seq
+        )
+        c.attached = True
+        c._stash = stash
+        c.connect(mode=mode)
+        return c
+
+    # ------------------------------------------------- detached create/attach
+    @staticmethod
+    def create_detached(registry: dict[str, Any], container_id: str = "detached") -> "Container":
+        """A container with no service: build structure + edit locally;
+        everything parks as pending until attach (ref createDetached :382)."""
+        return Container(ContainerRuntime(registry, container_id=container_id), registry)
+
+    def attach(
+        self,
+        doc_id: str,
+        service_factory: DocumentServiceFactory,
+        client_id: str,
+    ) -> None:
+        """Bind a detached container to a document: write a structure-only
+        snapshot at seq 0 (the channel layout; detached content replays as
+        trailing ops on join — the reference bakes detached state into the
+        initial summary, an equivalent bootstrap), then connect."""
+        if self.attached:
+            raise RuntimeError("already attached")
+        service = service_factory.create_document_service(doc_id)
+        storage = service.connect_to_storage()
+        if storage.get_latest_snapshot() is None:
+            structure = {
+                "runtime": {
+                    "seq": 0,
+                    "minSeq": 0,
+                    "quorum": {},
+                    "datastores": {
+                        ds_id: ds.structure_summary()
+                        for ds_id, ds in self.runtime.datastores.items()
+                    },
+                },
+                "protocol": ProtocolHandler().summarize(),
+            }
+            storage.write_snapshot(0, structure)
+        self._service = service
+        self._storage = storage
+        self.protocol = ProtocolHandler()
+        self.delta_manager = DeltaManager(
+            service, self.protocol, base_client_id=client_id, last_processed_seq=0
+        )
+        self.attached = True
+        self.connect()
+
+    # ------------------------------------------------------------- connection
+    def connect(self, mode: str | None = None) -> None:
+        """(Re)open a connection in ``mode`` — defaults to the container's
+        current mode, so reconnect never silently escalates read→write."""
+        if not self.attached:
+            raise RuntimeError("connect before attach")
+        mode = self._mode if mode is None else mode
+        self._mode = mode
+        if mode == "write":
+            stash, self._stash = self._stash, None
+            self.runtime.connect(
+                self.delta_manager,
+                self.delta_manager.connection_manager.next_client_id(),
+                stash=stash,
+            )
+        else:
+            self.delta_manager.connect_read(self.runtime.process_sequenced)
+
+    def disconnect(self) -> None:
+        if self.runtime.has_document:
+            self.runtime.disconnect()
+        else:
+            self.delta_manager.connection_manager.close()
+
+    def reconnect(self) -> None:
+        """New connection epoch; pending ops resubmit after the new join
+        sequences (call stack SURVEY §3.6)."""
+        self.disconnect()
+        self.connect()
+
+    def escalate_to_write(self) -> None:
+        """read → write escalation (ref connectionManager read/write modes):
+        reconnect in write mode; parked local edits replay on join."""
+        self.delta_manager.connection_manager.close()
+        self.connect(mode="write")
+
+    @property
+    def connected(self) -> bool:
+        return (
+            self.delta_manager is not None
+            and self.delta_manager.connection_manager.connected
+        )
+
+    @property
+    def joined(self) -> bool:
+        return self.runtime.joined
+
+    def close(self, error: Exception | None = None) -> None:
+        if self.delta_manager is not None:
+            self.delta_manager.connection_manager.close()
+        self.runtime.close(error)
+
+    # --------------------------------------------------------------- proposals
+    def propose(self, key: str, value: Any) -> None:
+        """Quorum proposal; accepted (on every replica) once the MSN passes
+        its sequence number (ref quorum.ts propose)."""
+        self.runtime.submit_protocol_message(
+            MessageType.PROPOSE, {"key": key, "value": value}
+        )
+
+    # ---------------------------------------------------------------- signals
+    def submit_signal(self, content: Any) -> None:
+        self.delta_manager.submit_signal(content)
+
+    def on_signal(self, listener: Callable[[SignalMessage], None]) -> None:
+        self.delta_manager.on_signal(listener)
+
+    # ------------------------------------------------------------- checkpoint
+    def summarize_to_storage(self) -> int:
+        """Write a full snapshot at the current seq (client-driven summary;
+        the election/heuristics live in runtime/summary.py). Requires no
+        local pending ops — the reference's summarizer is a dedicated client
+        with none, so acked state == full state."""
+        if self.runtime.pending_op_count:
+            raise RuntimeError("cannot summarize with pending local ops")
+        seq = self.runtime.ref_seq
+        self._storage.write_snapshot(
+            seq,
+            {"runtime": self.runtime.summarize(), "protocol": self.protocol.summarize()},
+        )
+        return seq
+
+    # ------------------------------------------------------------------ stash
+    def get_pending_local_state(self) -> str:
+        return self.runtime.get_pending_local_state()

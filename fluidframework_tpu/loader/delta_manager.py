@@ -1,0 +1,150 @@
+"""DeltaManager: the ordered inbound pump with gap repair.
+
+Reference parity: container-loader/src/deltaManager.ts (:154) — inbound ops
+are delivered strictly in sequence order: duplicates (seq <= last processed)
+are dropped, out-of-order arrivals are parked and the missing range is
+fetched from delta storage (``fetchMissingDeltas`` :560); outbound ops ride
+the current connection. The manager ALSO implements the document-adapter
+contract the ContainerRuntime connects to (connect/disconnect/submit), so
+the runtime is agnostic to whether it is wired straight to a LocalDocument
+(unit tests) or through driver + loader (this path).
+
+Handler chain: every in-order sequenced message flows to the protocol
+handler first (quorum/proposals), then to the runtime subscriber.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..driver.definitions import DocumentService
+from ..protocol.messages import Nack, SequencedMessage, SignalMessage
+from .connection_manager import ConnectionManager
+from .protocol import ProtocolHandler
+
+
+class DeltaManager:
+    def __init__(
+        self,
+        service: DocumentService,
+        protocol: ProtocolHandler,
+        base_client_id: str,
+        last_processed_seq: int = 0,
+    ) -> None:
+        self._service = service
+        self._storage = service.connect_to_delta_storage()
+        self.protocol = protocol
+        self.connection_manager = ConnectionManager(service, base_client_id)
+        self.last_processed_seq = last_processed_seq
+        self._runtime_handler: Callable[[SequencedMessage], None] | None = None
+        self._signal_listeners: list[Callable[[SignalMessage], None]] = []
+        self._parked: dict[int, SequencedMessage] = {}  # out-of-order arrivals
+        self._paused = False
+        self._pause_buffer: list[SequencedMessage] = []
+
+    # ------------------------------------------------------- handler plumbing
+    def on_signal(self, listener: Callable[[SignalMessage], None]) -> None:
+        self._signal_listeners.append(listener)
+
+    def _deliver(self, msg: SequencedMessage) -> None:
+        """In-order delivery point: protocol first, then runtime."""
+        self.last_processed_seq = msg.seq
+        self.protocol.process_message(msg)
+        if self._runtime_handler is not None:
+            self._runtime_handler(msg)
+
+    def _on_stream(self, msg: SequencedMessage) -> None:
+        if self._paused:
+            self._pause_buffer.append(msg)
+            return
+        self._process_inbound(msg)
+
+    def _process_inbound(self, msg: SequencedMessage) -> None:
+        if msg.seq <= self.last_processed_seq:
+            return  # duplicate (reconnect overlap)
+        if msg.seq > self.last_processed_seq + 1:
+            # Gap: park this op, repair from delta storage (deltaManager.ts:560).
+            self._parked[msg.seq] = msg
+            self._fetch_missing(self.last_processed_seq + 1, msg.seq - 1)
+        else:
+            self._deliver(msg)
+        # Drain any parked ops that are now contiguous.
+        while self.last_processed_seq + 1 in self._parked:
+            self._deliver(self._parked.pop(self.last_processed_seq + 1))
+
+    def _fetch_missing(self, from_seq: int, to_seq: int) -> None:
+        while from_seq <= to_seq:
+            got = self._storage.get_deltas(from_seq, to_seq)
+            for m in got:
+                if m.seq == self.last_processed_seq + 1:
+                    self._deliver(m)
+            if self.last_processed_seq + 1 == from_seq:
+                raise RuntimeError(
+                    f"delta storage cannot supply seq {from_seq} "
+                    f"(requested [{from_seq}, {to_seq}]): unrepairable gap"
+                )
+            from_seq = self.last_processed_seq + 1
+
+    def _on_signal_msg(self, sig: SignalMessage) -> None:
+        for listener in self._signal_listeners:
+            listener(sig)
+
+    # ----------------------------------------------------------- pause/resume
+    def pause(self) -> None:
+        """Hold inbound processing (ref DeltaQueue pause — used by the
+        summarizer to snapshot at a stable seq)."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+        buffered, self._pause_buffer = self._pause_buffer, []
+        for msg in buffered:
+            self._process_inbound(msg)
+
+    # ---------------------------------------- document adapter (runtime side)
+    def connect(
+        self,
+        client_id: str,
+        subscriber: Callable[[SequencedMessage], None],
+        on_nack: Callable[[Nack], None] | None = None,
+    ) -> SequencedMessage:
+        """ContainerRuntime's document.connect: open a write connection,
+        repair the snapshot→stream gap synchronously, return the join.
+
+        ``client_id`` must be the ConnectionManager's ``next_client_id()``
+        (the Container hands it down)."""
+        assert client_id == self.connection_manager.next_client_id()
+        self._runtime_handler = subscriber
+        conn = self.connection_manager.open(
+            self._on_stream, on_nack, self._on_signal_msg, mode="write"
+        )
+        self._catch_up(conn.checkpoint_seq)
+        self.connection_manager.reset_backoff()
+        return conn.join_msg
+
+    def connect_read(self, subscriber: Callable[[SequencedMessage], None]) -> None:
+        """Read-mode connect: stream + catch-up, no join, no submit."""
+        self._runtime_handler = subscriber
+        conn = self.connection_manager.open(
+            self._on_stream, None, self._on_signal_msg, mode="read"
+        )
+        self._catch_up(conn.checkpoint_seq)
+
+    def _catch_up(self, checkpoint_seq: int) -> None:
+        if checkpoint_seq > self.last_processed_seq:
+            self._fetch_missing(self.last_processed_seq + 1, checkpoint_seq)
+
+    def disconnect(self, client_id: str) -> None:
+        self.connection_manager.close()
+
+    def submit(self, wire: Any) -> None:
+        conn = self.connection_manager.connection
+        if conn is None or not conn.connected:
+            raise RuntimeError("submit while disconnected")
+        conn.submit(wire)
+
+    def submit_signal(self, content: Any) -> None:
+        conn = self.connection_manager.connection
+        if conn is None or not conn.connected:
+            raise RuntimeError("signal while disconnected")
+        conn.submit_signal(content)

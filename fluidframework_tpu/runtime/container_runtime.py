@@ -84,6 +84,19 @@ class ContainerRuntime:
     def datastore(self, ds_id: str) -> DataStoreRuntime:
         return self._datastores[ds_id]
 
+    @property
+    def datastores(self) -> dict[str, DataStoreRuntime]:
+        return dict(self._datastores)
+
+    @property
+    def has_document(self) -> bool:
+        """Whether a document link is live (loader checks before disconnect)."""
+        return self._document is not None
+
+    def process_sequenced(self, msg: SequencedMessage) -> None:
+        """Public inbound entry for loader-driven read connections."""
+        self._on_sequenced(msg)
+
     # ----------------------------------------------------------------- outbound
     def _submit_datastore_op(
         self, ds_id: str, contents: dict, metadata: Any, internal: bool = False
@@ -103,10 +116,12 @@ class ContainerRuntime:
         """End-of-turn flush (ref Outbox.flush at JS microtask end)."""
         if self._outbox is None:
             return
-        if self._outbox.client_id == "":
-            # Not connected: park staged messages as unsent pending state.
+        if self._outbox.client_id == "" or not self.joined:
+            # Not connected — or connected but our join hasn't sequenced yet
+            # (the reference holds outbound until connected): park staged
+            # messages as unsent pending state; they replay on join.
             self._detached_counter += 1
-            batch = self._outbox.flush(self.ref_seq, batch_id=f"unsent_{self.id}_{self._detached_counter}")
+            batch = self._outbox.park(f"unsent_{self.id}_{self._detached_counter}")
             if batch is not None:
                 self._psm.on_flush_batch(batch.messages, batch.batch_id, client_id="")
             return
@@ -166,9 +181,7 @@ class ContainerRuntime:
         if self._outbox is not None and not self._outbox.is_empty:
             assert self._outbox.client_id == ""
             self._detached_counter += 1
-            batch = self._outbox.flush(
-                self.ref_seq, batch_id=f"unsent_{self.id}_{self._detached_counter}"
-            )
+            batch = self._outbox.park(f"unsent_{self.id}_{self._detached_counter}")
             self._psm.on_flush_batch(batch.messages, batch.batch_id, client_id="")
         return Outbox(client_id=client_id)
 
@@ -304,6 +317,46 @@ class ContainerRuntime:
                 if self._document is None:
                     break
                 self._document.submit(wire)
+
+    # ---------------------------------------------------------------- protocol
+    def submit_protocol_message(self, mtype: str, contents: Any) -> None:
+        """Send a protocol-level message (e.g. quorum propose) through the
+        current connection, sharing the op clientSeq counter (the reference
+        routes proposals through the same DeltaManager outbound path)."""
+        if (
+            self._outbox is None
+            or self._outbox.client_id == ""
+            or self._document is None
+            or not self.joined
+        ):
+            raise RuntimeError("protocol message requires a joined write connection")
+        self.flush()
+        if self._document is None:
+            raise RuntimeError("connection dropped during flush")
+        self._document.submit(self._outbox.mint_direct(mtype, contents, self.ref_seq))
+
+    # -------------------------------------------------------------- checkpoint
+    def summarize(self) -> dict[str, Any]:
+        """Runtime state checkpoint: quorum short-id table + every datastore
+        (ref ContainerRuntime.summarize; incremental tree walk lives in
+        runtime/summary.py)."""
+        return {
+            "seq": self.ref_seq,
+            "minSeq": self.min_seq,
+            "quorum": dict(self._quorum),
+            "datastores": {k: ds.summarize() for k, ds in self._datastores.items()},
+        }
+
+    def load_snapshot(self, summary: dict[str, Any]) -> None:
+        """Boot from a checkpoint (ref Container.load snapshot path). Must be
+        called before any datastore creation or op processing."""
+        if self._datastores or self.ref_seq != 0:
+            raise RuntimeError("load_snapshot on a non-fresh runtime")
+        self.ref_seq = summary["seq"]
+        self.min_seq = summary.get("minSeq", 0)
+        self._quorum = dict(summary["quorum"])
+        for ds_id, ds_summary in summary["datastores"].items():
+            self.create_datastore(ds_id).load(ds_summary)
 
     # ------------------------------------------------------------------- stash
     def get_pending_local_state(self) -> str:

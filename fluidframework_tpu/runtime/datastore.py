@@ -144,4 +144,16 @@ class DataStoreRuntime:
     def load(self, summary: dict[str, Any]) -> None:
         for cid, entry in summary["channels"].items():
             channel = self.create_channel(entry["type"], cid)
-            channel.load(entry["summary"])
+            # A None summary is structure-only (detached attach writes the
+            # channel layout; content replays as trailing ops).
+            if entry["summary"] is not None:
+                channel.load(entry["summary"])
+
+    def structure_summary(self) -> dict[str, Any]:
+        """Layout-only summary: channel ids + types, no state."""
+        return {
+            "channels": {
+                cid: {"type": ch.channel_type, "summary": None}
+                for cid, ch in self._channels.items()
+            }
+        }

@@ -165,6 +165,31 @@ class Outbox:
             )
         return FlushedBatch(wire_messages=wire, messages=staged, batch_id=batch_id)
 
+    def park(self, batch_id: str) -> FlushedBatch | None:
+        """Drain staged messages WITHOUT minting wire messages or consuming
+        clientSeq numbers — used when disconnected or pre-join, where the
+        batch goes straight to pending state and replays later (wire
+        identity is assigned by the replay flush)."""
+        if not self._staged:
+            return None
+        staged, self._staged = self._staged, []
+        return FlushedBatch(wire_messages=[], messages=staged, batch_id=batch_id)
+
+    def mint_direct(self, mtype: str, contents: Any, ref_seq: int) -> UnsequencedMessage:
+        """A standalone non-OP wire message (protocol propose/summarize)
+        sharing this connection's clientSeq counter — the sequencer enforces
+        per-client contiguity, so ALL outbound traffic must thread through
+        one counter. Caller must flush staged ops first to keep submission
+        order consistent."""
+        assert not self._staged, "flush before minting a direct message"
+        return UnsequencedMessage(
+            client_id=self.client_id,
+            client_seq=self._next_client_seq(),
+            ref_seq=ref_seq,
+            type=mtype,
+            contents=contents,
+        )
+
 
 @dataclass
 class InboundRuntimeMessage:

@@ -15,10 +15,11 @@ from __future__ import annotations
 from collections import deque
 from typing import Callable
 
-from ..protocol.messages import Nack, SequencedMessage, UnsequencedMessage
+from ..protocol.messages import Nack, SequencedMessage, SignalMessage, UnsequencedMessage
 from .sequencer import Sequencer
 
 Subscriber = Callable[[SequencedMessage], None]
+SignalSubscriber = Callable[[SignalMessage], None]
 
 
 class LocalDocument:
@@ -31,6 +32,10 @@ class LocalDocument:
         self._nack_handlers: dict[str, Callable[[Nack], None]] = {}
         self._pending: deque[SequencedMessage] = deque()
         self.nacks: list[Nack] = []
+        # Snapshot store (historian/gitrest analog): newest-last list of
+        # (seq, summary) checkpoints; the driver storage service reads these.
+        self._snapshots: list[tuple[int, dict]] = []
+        self._signal_subscribers: dict[str, SignalSubscriber] = {}
 
     def connect(
         self,
@@ -58,6 +63,7 @@ class LocalDocument:
     def disconnect(self, client_id: str) -> None:
         self._subscribers.pop(client_id, None)
         self._nack_handlers.pop(client_id, None)
+        self._signal_subscribers.pop(client_id, None)
         # A client can bail out mid-catch-up, before its join was ticketed
         # (e.g. fork detection closes the container); nothing to leave then.
         if client_id in self.sequencer.clients():
@@ -78,6 +84,66 @@ class LocalDocument:
         else:
             self._pending.append(out)
         return out
+
+    def connect_stream(
+        self,
+        client_id: str,
+        subscriber: Subscriber,
+        on_nack: Callable[[Nack], None] | None = None,
+        mode: str = "write",
+    ) -> tuple[SequencedMessage | None, int]:
+        """Driver-style connect: subscribe WITHOUT catch-up replay.
+
+        The reference's ``connect_document`` handshake joins the socket room
+        and returns connection details; the client fetches the gap between
+        its snapshot and the stream head from delta storage itself. Returns
+        ``(join_msg, delivered_seq)``: ``join_msg`` is the ticketed join
+        (None in read mode — read clients never enter the quorum,
+        ref connectionManager.ts read/write modes), ``delivered_seq`` the
+        highest seq already broadcast — everything above it will arrive
+        through this subscription.
+        """
+        delivered = len(self.sequencer.log) - len(self._pending)
+        delivered_seq = self.sequencer.log[delivered - 1].seq if delivered else 0
+        join = None
+        if mode == "write":
+            join = self.sequencer.join(client_id)
+            self._pending.append(join)
+        self._subscribers[client_id] = subscriber
+        if on_nack is not None:
+            self._nack_handlers[client_id] = on_nack
+        return join, delivered_seq
+
+    def subscribe_signals(self, client_id: str, subscriber: SignalSubscriber) -> None:
+        self._signal_subscribers[client_id] = subscriber
+
+    def submit_signal(self, client_id: str, contents) -> None:
+        """Unsequenced broadcast (ref broadcaster signal path / nexus signal
+        relay): delivered synchronously to every signal subscriber, sender
+        included — per-sender order preserved, no total order, no log."""
+        sig = SignalMessage(client_id=client_id, contents=contents)
+        for sub in list(self._signal_subscribers.values()):
+            sub(sig)
+
+    def ops_range(self, from_seq: int, to_seq: int) -> list[SequencedMessage]:
+        """Sequenced ops with from_seq <= seq <= to_seq (delta storage read;
+        ref deltaStorageService). Seqs are dense (every ticket increments),
+        so this is an index slice — O(range), not O(log)."""
+        log = self.sequencer.log
+        if not log or to_seq < from_seq:
+            return []
+        base = log[0].seq  # first seq in the log (starting_seq + 1)
+        lo = max(from_seq - base, 0)
+        hi = min(to_seq - base + 1, len(log))
+        return log[lo:hi] if lo < hi else []
+
+    def save_snapshot(self, seq: int, summary: dict) -> None:
+        if self._snapshots and seq < self._snapshots[-1][0]:
+            raise ValueError("snapshot seq regression")
+        self._snapshots.append((seq, summary))
+
+    def latest_snapshot(self) -> tuple[int, dict] | None:
+        return self._snapshots[-1] if self._snapshots else None
 
     @property
     def pending_count(self) -> int:

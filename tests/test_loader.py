@@ -1,0 +1,264 @@
+"""Loader + driver layer tests: load/attach, catch-up, snapshots, quorum,
+reconnect epochs, read/write escalation, gap repair, signals.
+
+Models the reference's container-loader tests + local-server integration
+suites (SURVEY §4.4): full Loader→Runtime→DDS stacks against the in-process
+service through the driver interfaces.
+"""
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.server import LocalService
+
+
+@pytest.fixture
+def env():
+    svc = LocalService()
+    return svc, LocalDocumentServiceFactory(svc)
+
+
+def load(factory, name, **kw):
+    c = Container.load("doc", factory, default_registry(), name, **kw)
+    return c
+
+
+def string_of(c):
+    return c.runtime.datastore("root").get_channel("text")
+
+
+def boot_doc(factory):
+    """First client creates the structure via detached create + attach."""
+    d = Container.create_detached(default_registry(), container_id="creator")
+    ds = d.runtime.create_datastore("root")
+    ds.create_channel("sharedString", "text")
+    ds.create_channel("sharedMap", "meta")
+    return d
+
+
+class TestLoadAttach:
+    def test_detached_attach_then_load_converges(self, env):
+        svc, factory = env
+        d = boot_doc(factory)
+        string_of(d).insert_text(0, "hello")  # detached edit parks
+        d.runtime.flush()
+        d.attach("doc", factory, "creator")
+        svc.process_all()
+        assert string_of(d).text == "hello"
+
+        # Second client loads purely from the service (snapshot has the
+        # structure; content arrives as trailing ops).
+        c2 = load(factory, "reader")
+        svc.process_all()
+        assert string_of(c2).text == "hello"
+
+        # Live collaboration after load.
+        string_of(c2).insert_text(5, "!")
+        c2.runtime.flush()
+        svc.process_all()
+        assert string_of(d).text == "hello!"
+
+    def test_load_from_snapshot_with_trailing_ops(self, env):
+        svc, factory = env
+        d = boot_doc(factory)
+        d.attach("doc", factory, "creator")
+        string_of(d).insert_text(0, "base")
+        d.runtime.flush()
+        svc.process_all()
+
+        # Snapshot at current seq, then more ops after it.
+        seq = d.summarize_to_storage()
+        assert seq == d.runtime.ref_seq
+        string_of(d).insert_text(4, " + trailing")
+        d.runtime.flush()
+        svc.process_all()
+
+        c2 = load(factory, "late")
+        svc.process_all()
+        assert string_of(c2).text == "base + trailing"
+        # The loader started from the snapshot: its delta manager only
+        # processed ops above the snapshot seq.
+        assert c2.delta_manager.last_processed_seq >= seq
+
+    def test_read_mode_then_escalate(self, env):
+        svc, factory = env
+        d = boot_doc(factory)
+        d.attach("doc", factory, "creator")
+        string_of(d).insert_text(0, "abc")
+        d.runtime.flush()
+        svc.process_all()
+
+        r = load(factory, "viewer", mode="read")
+        svc.process_all()
+        assert string_of(r).text == "abc"
+        assert not r.joined  # read connections never join the quorum
+        assert "viewer" not in svc.document("doc").sequencer.clients()
+
+        # Local edit while read-only parks; escalation replays it.
+        string_of(r).insert_text(3, "!")
+        r.runtime.flush()
+        r.escalate_to_write()
+        svc.process_all()
+        assert string_of(d).text == "abc!"
+        assert string_of(r).text == "abc!"
+
+
+class TestQuorum:
+    def test_propose_accepts_on_msn(self, env):
+        svc, factory = env
+        d = boot_doc(factory)
+        d.attach("doc", factory, "creator")
+        c2 = load(factory, "other")
+        svc.process_all()
+
+        d.propose("code", {"package": "fluidframework-tpu@0.1"})
+        svc.process_all()
+        # Proposal sequenced but MSN hasn't passed it: still pending until
+        # every client references a later seq.
+        accepted_now = d.protocol.quorum.has("code")
+        string_of(c2).insert_text(0, "x")
+        c2.runtime.flush()
+        string_of(d).insert_text(0, "y")
+        d.runtime.flush()
+        svc.process_all()
+        assert d.protocol.quorum.get("code") == {"package": "fluidframework-tpu@0.1"}
+        assert c2.protocol.quorum.get("code") == {"package": "fluidframework-tpu@0.1"}
+        # Accept seq identical on both replicas.
+        assert (
+            d.protocol.quorum.values["code"][1] == c2.protocol.quorum.values["code"][1]
+        )
+        assert not accepted_now or d.protocol.quorum.values["code"][1] <= d.protocol.min_seq
+
+    def test_quorum_membership_tracks_joins_leaves(self, env):
+        svc, factory = env
+        d = boot_doc(factory)
+        d.attach("doc", factory, "creator")
+        c2 = load(factory, "other")
+        svc.process_all()
+        assert set(d.protocol.quorum.members) == {"creator", "other"}
+        c2.disconnect()
+        svc.process_all()
+        assert set(d.protocol.quorum.members) == {"creator"}
+
+
+class TestReconnect:
+    def test_reconnect_new_epoch_replays_pending(self, env):
+        svc, factory = env
+        d = boot_doc(factory)
+        d.attach("doc", factory, "creator")
+        c2 = load(factory, "other")
+        svc.process_all()
+
+        c2.disconnect()
+        string_of(c2).insert_text(0, "offline")
+        c2.runtime.flush()  # parks as pending
+        c2.reconnect()
+        svc.process_all()
+        assert string_of(d).text == "offline"
+        assert c2.delta_manager.connection_manager.client_id == "other~r1"
+        assert c2.joined
+
+    def test_nack_then_reconnect(self, env):
+        svc, factory = env
+        d = boot_doc(factory)
+        d.attach("doc", factory, "creator")
+        c2 = load(factory, "other")
+        svc.process_all()
+
+        # Force a nack: corrupt the client's view by submitting with a future
+        # refSeq via the raw connection.
+        from fluidframework_tpu.protocol.messages import UnsequencedMessage
+
+        conn = c2.delta_manager.connection_manager.connection
+        conn.submit(
+            UnsequencedMessage(
+                client_id=conn.client_id, client_seq=999, ref_seq=10**9
+            )
+        )
+        assert not c2.connected
+        assert c2.delta_manager.connection_manager.next_backoff_s > 0
+        c2.reconnect()
+        svc.process_all()
+        string_of(c2).insert_text(0, "recovered")
+        c2.runtime.flush()
+        svc.process_all()
+        assert string_of(d).text == "recovered"
+
+
+class TestDeltaManager:
+    def test_gap_repair_from_delta_storage(self, env):
+        svc, factory = env
+        d = boot_doc(factory)
+        d.attach("doc", factory, "creator")
+        string_of(d).insert_text(0, "abcdef")
+        d.runtime.flush()
+        svc.process_all()
+
+        c2 = load(factory, "other")
+        svc.process_all()
+        # Simulate a dropped broadcast: deliver an op out of order directly.
+        doc = svc.document("doc")
+        string_of(d).insert_text(6, "XYZ")
+        d.runtime.flush()
+        # Tip the queue: skip delivery for c2 by delivering only to d, then
+        # inject the NEXT op to c2 first (out-of-order arrival).
+        string_of(d).insert_text(9, "!")
+        d.runtime.flush()
+        msgs = list(doc.sequencer.log[-2:])
+        # Deliver newest first to c2's delta manager: forces gap fetch.
+        c2.delta_manager._on_stream(msgs[1])
+        assert string_of(c2).text == "abcdefXYZ!"
+        svc.process_all()  # regular delivery still consistent (dedup)
+        assert string_of(c2).text == "abcdefXYZ!"
+        assert string_of(d).text == "abcdefXYZ!"
+
+    def test_pause_resume(self, env):
+        svc, factory = env
+        d = boot_doc(factory)
+        d.attach("doc", factory, "creator")
+        c2 = load(factory, "other")
+        svc.process_all()
+        c2.delta_manager.pause()
+        string_of(d).insert_text(0, "zz")
+        d.runtime.flush()
+        svc.process_all()
+        assert string_of(c2).text == ""
+        c2.delta_manager.resume()
+        assert string_of(c2).text == "zz"
+
+
+class TestSignals:
+    def test_signal_broadcast_unsequenced(self, env):
+        svc, factory = env
+        d = boot_doc(factory)
+        d.attach("doc", factory, "creator")
+        c2 = load(factory, "other")
+        svc.process_all()
+        got = []
+        c2.on_signal(lambda s: got.append((s.client_id, s.contents)))
+        d.submit_signal({"cursor": [1, 2]})
+        assert got == [("creator", {"cursor": [1, 2]})]
+        # Signals leave no trace in the op log.
+        before = len(svc.document("doc").sequencer.log)
+        d.submit_signal({"cursor": [3, 4]})
+        assert len(svc.document("doc").sequencer.log) == before
+
+
+class TestStash:
+    def test_stash_through_loader(self, env):
+        svc, factory = env
+        d = boot_doc(factory)
+        d.attach("doc", factory, "creator")
+        c2 = load(factory, "other")
+        svc.process_all()
+
+        c2.disconnect()
+        string_of(c2).insert_text(0, "stashed-edit ")
+        stash = c2.get_pending_local_state()
+
+        c3 = load(factory, "resumed", stash=stash)
+        svc.process_all()
+        assert string_of(c3).text == "stashed-edit "
+        assert string_of(d).text == "stashed-edit "
