@@ -303,7 +303,10 @@ SharedMapFactory = ChannelTypeFactory(SharedMapChannel)
 
 def default_registry() -> dict[str, Any]:
     """Type string -> factory map (ref ISharedObjectRegistry)."""
+    from .tree import SharedTreeFactory
+
     return {
         SharedStringFactory.channel_type: SharedStringFactory,
         SharedMapFactory.channel_type: SharedMapFactory,
+        SharedTreeFactory.channel_type: SharedTreeFactory,
     }

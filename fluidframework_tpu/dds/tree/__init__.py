@@ -1,0 +1,74 @@
+"""SharedTree: the flagship hierarchical DDS, TPU-native re-design.
+
+Reference parity: packages/dds/tree (SharedTreeKernel sharedTree.ts:176,
+SharedTreeCore sharedTreeCore.ts:92, EditManager editManager.ts:73, the
+ChangeRebaser contract changeRebaser.ts:41, modular change family under
+feature-libraries/, chunked forest uniformChunk.ts:42, simple-tree typed
+API).
+
+Architecture here (tpu-first, not a port):
+- ``forest``       — object forest (host) + columnar uniform chunks (the
+                     device-friendly value representation).
+- ``changeset``    — one uniform mark-based changeset algebra (sequence
+                     fields subsume value/optional fields); pure functions
+                     rebase/invert/apply with enrichment for repair data.
+- ``editmanager``  — trunk + simulated per-peer branches; deterministic
+                     trunk construction gives convergence by construction.
+- ``schema``       — stored schema + typed simple-tree view layer.
+- ``shared_tree``  — the channel-boundary DDS wiring it all together.
+
+The batched/TPU form of the hot rebase arithmetic lives in
+``fluidframework_tpu.ops.tree_kernel``.
+"""
+
+from .changeset import (
+    Insert,
+    Mark,
+    Modify,
+    NodeChange,
+    Remove,
+    Skip,
+    apply_node_change,
+    change_from_json,
+    change_to_json,
+    invert_node_change,
+    rebase_node_change,
+)
+from .editmanager import EditManager, TrunkCommit
+from .forest import Forest, Node, UniformChunk
+from .schema import (
+    FieldKind,
+    FieldSchema,
+    LeafKind,
+    NodeSchema,
+    SchemaRegistry,
+    TreeView,
+)
+from .shared_tree import SharedTreeChannel, SharedTreeFactory
+
+__all__ = [
+    "EditManager",
+    "FieldKind",
+    "FieldSchema",
+    "Forest",
+    "Insert",
+    "LeafKind",
+    "Mark",
+    "Modify",
+    "Node",
+    "NodeChange",
+    "NodeSchema",
+    "Remove",
+    "SchemaRegistry",
+    "SharedTreeChannel",
+    "SharedTreeFactory",
+    "Skip",
+    "TreeView",
+    "TrunkCommit",
+    "UniformChunk",
+    "apply_node_change",
+    "change_from_json",
+    "change_to_json",
+    "invert_node_change",
+    "rebase_node_change",
+]

@@ -1,0 +1,398 @@
+"""The SharedTree changeset algebra: marks, rebase, invert, apply.
+
+Reference parity: the ChangeRebaser contract (tree/src/core/rebase/
+changeRebaser.ts:41 — rebase/invert laws) realized by one uniform mark-based
+field change kind (sequence-field, feature-libraries/sequence-field/), which
+subsumes the reference's optional/value fields (a value field is a
+1-element sequence; a set is remove+insert). Node value overwrites are a
+separate LWW slot on ``NodeChange`` like the reference's value changesets.
+
+Coordinates discipline: ``rebase(a, b)`` requires a and b to share an input
+context and returns a in the context *after* b. Convergence does NOT rely on
+OT transform properties — the EditManager constructs the trunk version of
+every commit deterministically from the same inputs on every replica
+(editmanager.py), so identical state follows by construction; the rebase
+laws are still property-tested (tests/test_tree_changeset.py) because they
+are what makes rebased edits preserve intent.
+
+Tie-break rules (deterministic, documented contract):
+- concurrent inserts at one position: the earlier-sequenced content stays
+  left; a rebased insert lands after it.
+- an insert into a concurrently-removed range slides to the range start.
+- remove/remove overlap: the later remove drops the overlap (cells already
+  gone); modify under a removed node is dropped.
+- concurrent value sets: later-sequenced wins (rebased set survives).
+
+Enrichment (repair data): ``apply_node_change`` fills ``Remove.detached``
+and value-change old values in place, so applied changes are invertible —
+the reference's resubmit/undo enrichment (defaultResubmitMachine.ts).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .forest import Node
+
+
+# ---------------------------------------------------------------------------
+# Mark model
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Skip:
+    """Pass over ``count`` nodes unchanged (consumes N, produces N)."""
+
+    count: int
+
+
+@dataclass
+class Insert:
+    """Insert ``content`` at the current position (consumes 0, produces N)."""
+
+    content: list[Node]
+
+
+@dataclass
+class Remove:
+    """Remove ``count`` nodes (consumes N, produces 0). ``detached`` holds
+    the removed subtrees once applied (repair data for invert/revive)."""
+
+    count: int
+    detached: Optional[list[Node]] = None
+
+
+@dataclass
+class Modify:
+    """Apply a nested NodeChange to one node (consumes 1, produces 1)."""
+
+    change: "NodeChange"
+
+
+Mark = Skip | Insert | Remove | Modify
+
+
+@dataclass
+class NodeChange:
+    """Changes to one node: an optional value overwrite plus per-field mark
+    lists. ``value`` is (new,) before apply and (new, old) after (enriched
+    for invert)."""
+
+    value: Optional[tuple] = None
+    fields: dict[str, list[Mark]] = field(default_factory=dict)
+
+    def is_empty(self) -> bool:
+        return self.value is None and not any(self.fields.values())
+
+
+# ---------------------------------------------------------------------------
+# Codec (wire format for ops/summaries)
+# ---------------------------------------------------------------------------
+
+
+def marks_to_json(marks: list[Mark]) -> list:
+    out = []
+    for m in marks:
+        if isinstance(m, Skip):
+            out.append(["s", m.count])
+        elif isinstance(m, Insert):
+            out.append(["i", [n.to_json() for n in m.content]])
+        elif isinstance(m, Remove):
+            out.append(
+                ["r", m.count]
+                if m.detached is None
+                else ["r", m.count, [n.to_json() for n in m.detached]]
+            )
+        else:
+            out.append(["m", change_to_json(m.change)])
+    return out
+
+
+def marks_from_json(data: list) -> list[Mark]:
+    out: list[Mark] = []
+    for e in data:
+        kind = e[0]
+        if kind == "s":
+            out.append(Skip(e[1]))
+        elif kind == "i":
+            out.append(Insert([Node.from_json(n) for n in e[1]]))
+        elif kind == "r":
+            out.append(
+                Remove(e[1], [Node.from_json(n) for n in e[2]] if len(e) > 2 else None)
+            )
+        else:
+            out.append(Modify(change_from_json(e[1])))
+    return out
+
+
+def change_to_json(change: NodeChange) -> dict:
+    out: dict[str, Any] = {}
+    if change.value is not None:
+        out["v"] = list(change.value)
+    if change.fields:
+        out["f"] = {k: marks_to_json(m) for k, m in change.fields.items()}
+    return out
+
+
+def change_from_json(data: dict) -> NodeChange:
+    return NodeChange(
+        value=tuple(data["v"]) if "v" in data else None,
+        fields={k: marks_from_json(m) for k, m in data.get("f", {}).items()},
+    )
+
+
+def clone_change(change: NodeChange) -> NodeChange:
+    return change_from_json(change_to_json(change))
+
+
+# ---------------------------------------------------------------------------
+# Rebase
+# ---------------------------------------------------------------------------
+
+
+def _consumes(m: Mark) -> int:
+    if isinstance(m, (Skip, Remove)):
+        return m.count
+    if isinstance(m, Modify):
+        return 1
+    return 0
+
+
+def _split(m: Mark, n: int) -> tuple[Mark, Mark | None]:
+    """Split a consuming mark into a prefix consuming n and the remainder."""
+    c = _consumes(m)
+    assert 0 < n <= c
+    if n == c:
+        return m, None
+    if isinstance(m, Skip):
+        return Skip(n), Skip(c - n)
+    if isinstance(m, Remove):
+        det = m.detached
+        return (
+            Remove(n, det[:n] if det is not None else None),
+            Remove(c - n, det[n:] if det is not None else None),
+        )
+    raise AssertionError("Modify cannot be split")
+
+
+class _MarkStream:
+    """Cursor over a mark list with implicit infinite trailing Skip."""
+
+    def __init__(self, marks: list[Mark]) -> None:
+        self._marks = [m for m in marks if _consumes(m) > 0 or isinstance(m, Insert)]
+        self._i = 0
+
+    def peek(self) -> Mark | None:
+        return self._marks[self._i] if self._i < len(self._marks) else None
+
+    def pop(self) -> Mark:
+        m = self._marks[self._i]
+        self._i += 1
+        return m
+
+    def push_front(self, m: Mark) -> None:
+        self._i -= 1
+        self._marks[self._i] = m
+
+    def exhausted(self) -> bool:
+        return self._i >= len(self._marks)
+
+
+def _emit(out: list[Mark], m: Mark) -> None:
+    """Append a mark, coalescing adjacent same-kind Skip/Remove runs."""
+    if isinstance(m, Skip) and m.count == 0:
+        return
+    if isinstance(m, Remove) and m.count == 0:
+        return
+    if out:
+        last = out[-1]
+        if isinstance(last, Skip) and isinstance(m, Skip):
+            out[-1] = Skip(last.count + m.count)
+            return
+        if (
+            isinstance(last, Remove)
+            and isinstance(m, Remove)
+            and (last.detached is None) == (m.detached is None)
+        ):
+            out[-1] = Remove(
+                last.count + m.count,
+                (last.detached + m.detached) if last.detached is not None else None,
+            )
+            return
+        if isinstance(last, Insert) and isinstance(m, Insert):
+            out[-1] = Insert(last.content + m.content)
+            return
+    out.append(m)
+
+
+def rebase_marks(a: list[Mark], b: list[Mark], a_after: bool = True) -> list[Mark]:
+    """Rebase mark list ``a`` over ``b`` (same input context) — the result
+    reads against the context with b applied.
+
+    ``a_after`` is the tie-break side (sided OT): True when a is the
+    later-sequenced change (its inserts land after b's at a shared position);
+    False when a is the earlier-sequenced/trunk change being carried over a
+    local pending one (its inserts stay left). The two sides are exact
+    mirrors, which is what makes the convergence square commute."""
+    sa, sb = _MarkStream(a), _MarkStream(b)
+    out: list[Mark] = []
+    while not (sa.exhausted() and sb.exhausted()):
+        ma, mb = sa.peek(), sb.peek()
+        a_ins = ma is not None and isinstance(ma, Insert)
+        b_ins = mb is not None and isinstance(mb, Insert)
+        # Tie at one boundary: the winner's (earlier-sequenced) content lands
+        # left; skipping b's content keeps a's ranges from swallowing it.
+        if b_ins and (a_after or not a_ins):
+            sb.pop()
+            _emit(out, Skip(len(mb.content)))
+            continue
+        if a_ins:
+            sa.pop()
+            _emit(out, ma)
+            continue
+        if ma is None:
+            # a is done; the rest of b only affects positions a never touches.
+            break
+        if mb is None:
+            sa.pop()
+            _emit(out, ma)
+            continue
+        # Both consume input: advance over min(count) positions together.
+        n = min(_consumes(ma), _consumes(mb))
+        a_part, a_rest = _split(sa.pop(), n) if not isinstance(ma, Modify) else (sa.pop(), None)
+        b_part, b_rest = _split(sb.pop(), n) if not isinstance(mb, Modify) else (sb.pop(), None)
+        if a_rest is not None:
+            sa.push_front(a_rest)
+        if b_rest is not None:
+            sb.push_front(b_rest)
+        if isinstance(b_part, Remove):
+            # Those positions are gone: a's skip/remove/modify there drops.
+            continue
+        if isinstance(a_part, Modify) and isinstance(b_part, Modify):
+            _emit(out, Modify(rebase_node_change(a_part.change, b_part.change, a_after)))
+        else:
+            # b Skip or b Modify leave a's mark structurally intact.
+            _emit(out, a_part)
+    return out
+
+
+def rebase_node_change(a: NodeChange, b: NodeChange, a_after: bool = True) -> NodeChange:
+    """Rebase one node's change over another's. Value: the later-sequenced
+    set wins (LWW) — a keeps its value when it is the later side, and drops
+    it when the earlier side is carried over a later set. Fields: pairwise
+    sided mark rebase."""
+    value = a.value
+    if a.value is not None and b.value is not None and not a_after:
+        value = None
+    out = NodeChange(value=value)
+    for key, a_marks in a.fields.items():
+        b_marks = b.fields.get(key)
+        out.fields[key] = (
+            rebase_marks(a_marks, b_marks, a_after) if b_marks else list(a_marks)
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Invert (requires an applied/enriched change)
+# ---------------------------------------------------------------------------
+
+
+def invert_marks(marks: list[Mark]) -> list[Mark]:
+    out: list[Mark] = []
+    for m in marks:
+        if isinstance(m, Skip):
+            _emit(out, m)
+        elif isinstance(m, Insert):
+            _emit(out, Remove(len(m.content), [n.clone() for n in m.content]))
+        elif isinstance(m, Remove):
+            assert m.detached is not None, "invert of unapplied remove"
+            _emit(out, Insert([n.clone() for n in m.detached]))
+        else:
+            _emit(out, Modify(invert_node_change(m.change)))
+    return out
+
+
+def invert_node_change(change: NodeChange) -> NodeChange:
+    value = None
+    if change.value is not None:
+        assert len(change.value) == 2, "invert of unapplied value change"
+        value = (change.value[1], change.value[0])
+    return NodeChange(
+        value=value,
+        fields={k: invert_marks(m) for k, m in change.fields.items()},
+    )
+
+
+# ---------------------------------------------------------------------------
+# Apply (mutates the forest; enriches the change in place)
+# ---------------------------------------------------------------------------
+
+
+def apply_marks(nodes: list[Node], marks: list[Mark]) -> None:
+    pos = 0
+    for m in marks:
+        if isinstance(m, Skip):
+            pos += m.count
+        elif isinstance(m, Insert):
+            nodes[pos:pos] = [n.clone() for n in m.content]
+            pos += len(m.content)
+        elif isinstance(m, Remove):
+            assert pos + m.count <= len(nodes), "remove past end of field"
+            m.detached = [n for n in nodes[pos : pos + m.count]]
+            del nodes[pos : pos + m.count]
+        else:
+            apply_node_change(nodes[pos], m.change)
+            pos += 1
+    assert pos <= len(nodes), "marks walk past end of field"
+
+
+def apply_node_change(node: Node, change: NodeChange) -> None:
+    if change.value is not None:
+        new = change.value[0]
+        change.value = (new, node.value)
+        node.value = new
+    for key, marks in change.fields.items():
+        apply_marks(node.fields.setdefault(key, []), marks)
+
+
+# ---------------------------------------------------------------------------
+# Edit builders (path-addressed convenience constructors)
+# ---------------------------------------------------------------------------
+
+
+def _wrap(path: list[tuple[str, int]], leaf: NodeChange) -> NodeChange:
+    """Nest a NodeChange under a path of (field_key, index) steps."""
+    for key, idx in reversed(path):
+        leaf = NodeChange(fields={key: [Skip(idx), Modify(leaf)]} if idx else {key: [Modify(leaf)]})
+    return leaf
+
+
+def make_set_value(path: list[tuple[str, int]], value: Any) -> NodeChange:
+    """Overwrite the leaf value of the node at ``path``."""
+    assert path, "cannot set a value on the virtual root"
+    prefix, (key, idx) = path[:-1], path[-1]
+    inner = NodeChange(value=(value,))
+    marks: list[Mark] = [Skip(idx)] if idx else []
+    marks.append(Modify(inner))
+    return _wrap(prefix, NodeChange(fields={key: marks}))
+
+
+def make_insert(
+    path: list[tuple[str, int]], field_key: str, index: int, content: list[Node]
+) -> NodeChange:
+    """Insert ``content`` at ``index`` of ``field_key`` under the node at
+    ``path`` (path [] addresses the virtual root / root field)."""
+    marks: list[Mark] = [Skip(index)] if index else []
+    marks.append(Insert([n.clone() for n in content]))
+    return _wrap(path, NodeChange(fields={field_key: marks}))
+
+
+def make_remove(
+    path: list[tuple[str, int]], field_key: str, index: int, count: int
+) -> NodeChange:
+    marks: list[Mark] = [Skip(index)] if index else []
+    marks.append(Remove(count))
+    return _wrap(path, NodeChange(fields={field_key: marks}))

@@ -1,0 +1,193 @@
+"""EditManager: deterministic trunk construction from sequenced changesets.
+
+Reference parity: tree/src/shared-tree-core/editManager.ts:73 — a trunk of
+sequenced commits plus per-peer branches that cache each peer's in-flight
+context, with MSN-driven trunk eviction (trimHistory :847,
+advanceMinimumSequenceNumber :247).
+
+Design (derived, not ported): for every peer P we simulate P's local branch
+— ``base`` is the highest trunk sequence number P has integrated (its last
+refSeq) and ``inflight`` holds P's submitted-but-not-yet-base-advanced
+changes in P-local coordinates. Because every replica runs this exact
+deterministic procedure over the same sequenced stream, every replica
+computes the identical trunk version of every commit — convergence by
+construction, independent of OT transform properties.
+
+Integration of a commit c from P (refSeq r, seq s):
+1. advance P's branch base to r: walk trunk commits in (base, r]; P's own
+   commits must head ``inflight`` (FIFO) and pop; others bridge-transform
+   the inflight list (the same sandwich rebase P performed locally).
+2. translate c to trunk coordinates: walk trunk commits in (r, s) on a COPY
+   of the inflight list (P hasn't seen them): own commits pop from the copy,
+   others rebase both the copy and c. FIFO ordering guarantees the copy
+   drains exactly when c's turn comes.
+3. append the original-coordinates c to P's inflight and the trunk-coords
+   version to the trunk.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from .changeset import (
+    NodeChange,
+    change_from_json,
+    change_to_json,
+    clone_change,
+    rebase_node_change,
+)
+
+
+@dataclass
+class TrunkCommit:
+    seq: int
+    client_id: str
+    revision: str
+    change: NodeChange  # trunk coordinates (context = previous trunk commit)
+
+
+@dataclass
+class PeerBranch:
+    base: int  # trunk seq this peer has integrated (its max refSeq seen)
+    inflight: list[tuple[str, NodeChange]] = field(default_factory=list)
+
+
+def bridge(inflight: list[tuple[str, NodeChange]], incoming: NodeChange) -> tuple[
+    list[tuple[str, NodeChange]], NodeChange
+]:
+    """Transform an incoming change through a branch's in-flight list: returns
+    (inflight rebased over incoming, incoming rebased past the inflight) —
+    the standard OT bridge both the EditManager and the local branch use.
+
+    Sides: ``incoming`` is sequenced (earlier) and the in-flight changes are
+    not (later), so the in-flight rebases with a_after=True and the incoming
+    carries over them with a_after=False — the mirrored pair that makes both
+    orders of application converge."""
+    x = incoming
+    out = []
+    for rev, f in inflight:
+        out.append((rev, rebase_node_change(f, x, a_after=True)))
+        x = rebase_node_change(x, f, a_after=False)
+    return out, x
+
+
+class EditManager:
+    """Trunk + peer branches for one SharedTree instance."""
+
+    def __init__(self) -> None:
+        self.trunk: list[TrunkCommit] = []
+        self.trunk_base = 0  # all commits with seq <= trunk_base are evicted
+        self.peers: dict[str, PeerBranch] = {}
+
+    # ------------------------------------------------------------------ query
+    def _trunk_range(self, lo: int, hi: int) -> list[TrunkCommit]:
+        """Trunk commits with lo < seq <= hi (retained window only)."""
+        assert lo >= self.trunk_base, (
+            f"trunk history below {self.trunk_base} was evicted (asked for {lo})"
+        )
+        return [t for t in self.trunk if lo < t.seq <= hi]
+
+    # -------------------------------------------------------------- integrate
+    def add_sequenced(
+        self,
+        client_id: str,
+        revision: str,
+        change: NodeChange,
+        ref_seq: int,
+        seq: int,
+    ) -> NodeChange:
+        """Integrate one sequenced changeset; returns its trunk-coordinates
+        version (what a caller applies to trunk-tip state)."""
+        br = self.peers.get(client_id)
+        if br is None:
+            br = self.peers[client_id] = PeerBranch(base=max(ref_seq, self.trunk_base))
+        # 1. advance the peer's base to its refSeq.
+        self._advance(client_id, br, ref_seq)
+        # 2. translate to trunk coordinates over commits the peer hasn't seen.
+        # Range is (ref_seq, seq] over the EXISTING trunk: grouped batches
+        # give several commits one sequence number, and earlier same-seq
+        # commits from this client are part of this commit's context.
+        scratch = [(rev, clone_change(ch)) for rev, ch in br.inflight]
+        c = clone_change(change)
+        for t in self._trunk_range(ref_seq, seq):
+            if t.client_id == client_id:
+                assert scratch and scratch[0][0] == t.revision, "peer FIFO skew"
+                scratch.pop(0)
+            else:
+                scratch, x = bridge(scratch, t.change)
+                c = rebase_node_change(c, x)
+        assert not scratch, "peer had unsequenced ops ahead of this commit"
+        br.inflight.append((revision, clone_change(change)))
+        self.trunk.append(TrunkCommit(seq=seq, client_id=client_id, revision=revision, change=c))
+        return c
+
+    def _advance(self, client_id: str, br: PeerBranch, upto: int) -> None:
+        for t in self._trunk_range(br.base, upto):
+            if t.client_id == client_id:
+                assert br.inflight and br.inflight[0][0] == t.revision, "peer FIFO skew"
+                br.inflight.pop(0)
+            else:
+                br.inflight, _ = bridge(br.inflight, t.change)
+        br.base = max(br.base, upto)
+
+    # -------------------------------------------------------------- lifecycle
+    def on_client_leave(self, client_id: str) -> None:
+        self.peers.pop(client_id, None)
+
+    def advance_min_seq(self, min_seq: int) -> None:
+        """MSN floor advanced: every future refSeq is >= min_seq, so advance
+        all peer branches there and evict the trunk prefix (trimHistory)."""
+        if min_seq <= self.trunk_base:
+            return
+        for client_id, br in self.peers.items():
+            if br.base < min_seq:
+                self._advance(client_id, br, min_seq)
+        self.trunk = [t for t in self.trunk if t.seq > min_seq]
+        self.trunk_base = min_seq
+
+    # ------------------------------------------------------------ checkpoint
+    def summarize(self) -> dict[str, Any]:
+        """Trunk tail + peer branches (ref editManagerSummarizer.ts) — both
+        are required for a loading client to integrate in-flight remote ops
+        whose refSeq predates the snapshot sequence number."""
+        return {
+            "trunkBase": self.trunk_base,
+            "trunk": [
+                {
+                    "seq": t.seq,
+                    "client": t.client_id,
+                    "rev": t.revision,
+                    "change": change_to_json(t.change),
+                }
+                for t in self.trunk
+            ],
+            "peers": {
+                cid: {
+                    "base": br.base,
+                    "inflight": [
+                        [rev, change_to_json(ch)] for rev, ch in br.inflight
+                    ],
+                }
+                for cid, br in self.peers.items()
+            },
+        }
+
+    def load(self, data: dict[str, Any]) -> None:
+        self.trunk_base = data["trunkBase"]
+        self.trunk = [
+            TrunkCommit(
+                seq=t["seq"],
+                client_id=t["client"],
+                revision=t["rev"],
+                change=change_from_json(t["change"]),
+            )
+            for t in data["trunk"]
+        ]
+        self.peers = {
+            cid: PeerBranch(
+                base=p["base"],
+                inflight=[(rev, change_from_json(ch)) for rev, ch in p["inflight"]],
+            )
+            for cid, p in data["peers"].items()
+        }

@@ -1,0 +1,258 @@
+"""Tree state store: object forest + columnar uniform chunks.
+
+Reference parity: the object forest (tree/src/feature-libraries/object-forest/)
+is the general-purpose mutable store; ``UniformChunk``
+(feature-libraries/chunked-forest/uniformChunk.ts:42) is the reference's
+columnar, shape-deduplicated value representation — reproduced here as a
+numpy-backed column store because it is exactly the layout TPU kernels want
+(see ops/tree_kernel.py for the batched value-update kernels over chunk
+columns).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+import numpy as np
+
+
+@dataclass
+class Node:
+    """One tree node: a type tag, an optional leaf value, and named fields
+    each holding an ordered sequence of child nodes (every field is a
+    sequence; value/optional fields are schema-constrained sequences, the
+    same unification the reference's modular schema uses)."""
+
+    type: str
+    value: Any = None
+    fields: dict[str, list["Node"]] = field(default_factory=dict)
+
+    # ------------------------------------------------------------------ codec
+    def to_json(self) -> dict:
+        out: dict[str, Any] = {"t": self.type}
+        if self.value is not None:
+            out["v"] = self.value
+        if self.fields:
+            out["f"] = {
+                k: [c.to_json() for c in children] for k, children in self.fields.items()
+            }
+        return out
+
+    @staticmethod
+    def from_json(data: dict) -> "Node":
+        return Node(
+            type=data["t"],
+            value=data.get("v"),
+            fields={
+                k: [Node.from_json(c) for c in children]
+                for k, children in data.get("f", {}).items()
+            },
+        )
+
+    def clone(self) -> "Node":
+        return Node.from_json(self.to_json())
+
+    def child(self, field_key: str, index: int) -> "Node":
+        return self.fields[field_key][index]
+
+    def equal(self, other: "Node") -> bool:
+        return self.to_json() == other.to_json()
+
+
+ROOT_FIELD = ""
+
+
+class Forest:
+    """The document's tree state: a virtual root node whose ``ROOT_FIELD``
+    sequence holds the root content. Mutated only through changeset apply
+    (changeset.apply_node_change) so every replica performs identical
+    transitions."""
+
+    def __init__(self) -> None:
+        self.root = Node(type="__root__")
+        self.root.fields[ROOT_FIELD] = []
+
+    # ------------------------------------------------------------------ views
+    @property
+    def root_field(self) -> list[Node]:
+        return self.root.fields.setdefault(ROOT_FIELD, [])
+
+    def node_at(self, path: list[tuple[str, int]]) -> Node:
+        """Resolve a path of (field_key, index) steps from the virtual root."""
+        node = self.root
+        for key, idx in path:
+            node = node.fields[key][idx]
+        return node
+
+    def iter_nodes(self) -> Iterator[tuple[list[tuple[str, int]], Node]]:
+        """Depth-first cursor over (path, node) — the forest cursor analog
+        (reference ITreeCursor over object forest)."""
+
+        def walk(node: Node, path: list[tuple[str, int]]):
+            for key, children in node.fields.items():
+                for i, child in enumerate(children):
+                    cpath = path + [(key, i)]
+                    yield cpath, child
+                    yield from walk(child, cpath)
+
+        yield from walk(self.root, [])
+
+    # ------------------------------------------------------------------ codec
+    def to_json(self) -> dict:
+        return {"root": [n.to_json() for n in self.root_field]}
+
+    def load_json(self, data: dict) -> None:
+        self.root = Node(type="__root__")
+        self.root.fields[ROOT_FIELD] = [Node.from_json(n) for n in data["root"]]
+
+    def equal(self, other: "Forest") -> bool:
+        return self.to_json() == other.to_json()
+
+
+# ---------------------------------------------------------------------------
+# Uniform chunks: columnar representation of shape-uniform subtree arrays
+# ---------------------------------------------------------------------------
+
+_NUMERIC_KINDS = {"int", "float"}
+
+
+@dataclass
+class UniformChunk:
+    """A run of sibling subtrees that all share one shape, stored as value
+    columns (one column per leaf position in the shape) — the reference's
+    chunked-forest layout (uniformChunk.ts:42) and the natural device layout:
+    numeric columns are contiguous ndarrays a kernel can gather/scatter.
+
+    ``shape``   — the per-subtree template as a Node with leaf values elided
+                  (value slots marked by leaf type tag).
+    ``columns`` — list (one per leaf slot, in cursor order) of length-N
+                  arrays/lists of values.
+    """
+
+    shape: Node
+    columns: list[Any]
+    count: int
+
+    @staticmethod
+    def try_encode(nodes: list[Node]) -> "UniformChunk | None":
+        """Columnarize if every node shares the same shape (type structure);
+        returns None when the run is not uniform."""
+        if len(nodes) < 2:
+            return None
+        template = _shape_of(nodes[0])
+        for n in nodes[1:]:
+            if _shape_of(n).to_json() != template.to_json():
+                return None
+        slots = [[] for _ in range(_leaf_count(template))]
+        for n in nodes:
+            for i, v in enumerate(_leaf_values(n)):
+                slots[i].append(v)
+        columns: list[Any] = []
+        for col in slots:
+            if all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in col):
+                columns.append(np.asarray(col))
+            else:
+                columns.append(list(col))
+        return UniformChunk(shape=template, columns=columns, count=len(nodes))
+
+    def decode(self) -> list[Node]:
+        out = []
+        for i in range(self.count):
+            values = [
+                (c[i].item() if isinstance(c, np.ndarray) else c[i])
+                for c in self.columns
+            ]
+            out.append(_fill_shape(self.shape, iter(values)))
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "shape": self.shape.to_json(),
+            "count": self.count,
+            "columns": [
+                c.tolist() if isinstance(c, np.ndarray) else c for c in self.columns
+            ],
+        }
+
+    @staticmethod
+    def from_json(data: dict) -> "UniformChunk":
+        return UniformChunk(
+            shape=Node.from_json(data["shape"]),
+            count=data["count"],
+            columns=[
+                np.asarray(c)
+                if c and all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in c)
+                else c
+                for c in data["columns"]
+            ],
+        )
+
+
+def _shape_of(node: Node) -> Node:
+    """Type structure with values elided (leaf slots keep only their type)."""
+    return Node(
+        type=node.type,
+        value=None,
+        fields={k: [_shape_of(c) for c in v] for k, v in node.fields.items()},
+    )
+
+
+def _leaf_count(shape: Node) -> int:
+    n = 1 if not shape.fields else 0
+    for children in shape.fields.values():
+        for c in children:
+            n += _leaf_count(c)
+    return n
+
+
+def _leaf_values(node: Node) -> list[Any]:
+    if not node.fields:
+        return [node.value]
+    out = []
+    for children in node.fields.values():
+        for c in children:
+            out.extend(_leaf_values(c))
+    return out
+
+
+def _fill_shape(shape: Node, values: Iterator[Any]) -> Node:
+    if not shape.fields:
+        return Node(type=shape.type, value=next(values))
+    return Node(
+        type=shape.type,
+        fields={
+            k: [_fill_shape(c, values) for c in children]
+            for k, children in shape.fields.items()
+        },
+    )
+
+
+def encode_field_chunked(nodes: list[Node]) -> list[dict]:
+    """Summary codec for a field: greedy runs of shape-uniform siblings become
+    uniform chunks, the rest stay plain nodes (reference forest-summary with
+    incremental chunk reuse is approximated by whole-field chunk encode)."""
+    out: list[dict] = []
+    i = 0
+    while i < len(nodes):
+        j = i + 1
+        template = _shape_of(nodes[i]).to_json()
+        while j < len(nodes) and _shape_of(nodes[j]).to_json() == template:
+            j += 1
+        chunk = UniformChunk.try_encode(nodes[i:j]) if j - i >= 4 else None
+        if chunk is not None:
+            out.append({"chunk": chunk.to_json()})
+        else:
+            out.extend({"node": n.to_json()} for n in nodes[i:j])
+        i = j
+    return out
+
+
+def decode_field_chunked(entries: list[dict]) -> list[Node]:
+    out: list[Node] = []
+    for e in entries:
+        if "chunk" in e:
+            out.extend(UniformChunk.from_json(e["chunk"]).decode())
+        else:
+            out.append(Node.from_json(e["node"]))
+    return out

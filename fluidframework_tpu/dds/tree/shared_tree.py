@@ -1,0 +1,181 @@
+"""SharedTree over the channel boundary.
+
+Reference parity: SharedTreeKernel (tree/src/shared-tree/sharedTree.ts:176)
++ SharedTreeCore (shared-tree-core/sharedTreeCore.ts:92): sequenced edits
+flow into the EditManager, the forest tracks trunk-tip state overlaid with
+the local branch, resubmit rebases pending edits onto the current trunk
+(defaultResubmitMachine.ts), and summaries carry forest + EditManager state
+(editManagerSummarizer.ts, forest-summary).
+
+Wire op formats:
+  {"type": "edit", "rev": str, "change": <changeset json>}
+  {"type": "schema", "schema": <schema json>}   (LWW by sequence order)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ...runtime.channel import Channel, MessageCollection
+from .changeset import (
+    NodeChange,
+    apply_node_change,
+    change_from_json,
+    change_to_json,
+    clone_change,
+    invert_node_change,
+)
+from .editmanager import EditManager, bridge
+from .forest import Forest, Node, decode_field_chunked, encode_field_chunked, ROOT_FIELD
+from .schema import SchemaRegistry, TreeView
+
+
+class SharedTreeChannel(Channel):
+    """One replica of a SharedTree document."""
+
+    channel_type = "sharedTree"
+
+    def __init__(self, channel_id: str) -> None:
+        super().__init__(channel_id)
+        self.forest = Forest()  # trunk-tip state + local pending overlay
+        self.em = EditManager()
+        self.schema = SchemaRegistry()
+        # Local branch: pending edits in trunk-tip coordinates, continuously
+        # rebased as remote commits land (the sandwich).
+        self._local_pending: list[tuple[str, NodeChange]] = []
+        self._rev_counter = 0
+        self.on_change: Callable[[], None] | None = None  # view invalidation
+
+    # ------------------------------------------------------------ local edits
+    def _mint_revision(self) -> str:
+        self._rev_counter += 1
+        owner = self._connection.client_id() if self._connection else "detached"
+        return f"{owner}:{self._rev_counter}"
+
+    def submit_change(self, change: NodeChange) -> None:
+        """Apply a local edit optimistically and stage it for sequencing.
+        The forest apply enriches the change (repair data), and the enriched
+        form is what goes on the wire so every replica integrates the exact
+        same changeset object."""
+        rev = self._mint_revision()
+        apply_node_change(self.forest.root, change)
+        self._local_pending.append((rev, change))
+        self.submit_local_message(
+            {"type": "edit", "rev": rev, "change": change_to_json(change)},
+            {"rev": rev},
+        )
+        self._notify()
+
+    def set_schema(self, registry: SchemaRegistry) -> None:
+        self.schema = registry
+        self.submit_local_message(
+            {"type": "schema", "schema": registry.to_json()}, {"rev": None}
+        )
+
+    @property
+    def view(self) -> TreeView:
+        return TreeView(self.forest, self.submit_change, self.schema)
+
+    def _notify(self) -> None:
+        if self.on_change is not None:
+            self.on_change()
+
+    # ---------------------------------------------------------------- inbound
+    def process_messages(self, collection: MessageCollection) -> None:
+        env = collection.envelope
+        for m in collection.messages:
+            c = m.contents
+            if c["type"] == "schema":
+                self.schema = SchemaRegistry.from_json(c["schema"])
+                continue
+            change = change_from_json(c["change"])
+            trunk_change = self.em.add_sequenced(
+                client_id=env.client_id,
+                revision=c["rev"],
+                change=change,
+                ref_seq=env.ref_seq,
+                seq=env.seq,
+            )
+            if m.local:
+                # Our own edit reached the trunk: the forest already shows it.
+                assert self._local_pending and self._local_pending[0][0] == c["rev"], (
+                    "local branch FIFO skew"
+                )
+                self._local_pending.pop(0)
+            else:
+                # Sandwich: rebase the local branch over the new trunk commit
+                # and apply its bridged form to the optimistic forest.
+                self._local_pending, x = bridge(self._local_pending, clone_change(trunk_change))
+                apply_node_change(self.forest.root, x)
+        self.em.advance_min_seq(env.min_seq)
+        self._notify()
+
+    def on_min_seq(self, min_seq: int) -> None:
+        self.em.advance_min_seq(min_seq)
+
+    def on_client_leave(self, client_id: str, seq: int) -> None:
+        self.em.on_client_leave(client_id)
+
+    # ----------------------------------------------------- reconnect / stash
+    def resubmit(self, contents: Any, local_metadata: Any, squash: bool = False) -> None:
+        """Resubmit the CURRENT (trunk-tip rebased) form of the pending edit
+        — merge-tree regeneratePendingOp's analog for tree changesets."""
+        if contents["type"] == "schema":
+            self.submit_local_message(contents, {"rev": None})
+            return
+        rev = local_metadata["rev"]
+        for r, change in self._local_pending:
+            if r == rev:
+                self.submit_local_message(
+                    {"type": "edit", "rev": rev, "change": change_to_json(change)},
+                    {"rev": rev},
+                )
+                return
+        raise AssertionError(f"resubmit of unknown pending edit {rev}")
+
+    def apply_stashed(self, contents: Any) -> Any:
+        if contents["type"] == "schema":
+            self.schema = SchemaRegistry.from_json(contents["schema"])
+            return {"rev": None}
+        change = change_from_json(contents["change"])
+        rev = contents["rev"]
+        apply_node_change(self.forest.root, change)
+        self._local_pending.append((rev, change))
+        self._notify()
+        return {"rev": rev}
+
+    def rollback(self, contents: Any, local_metadata: Any) -> None:
+        rev = local_metadata["rev"]
+        assert self._local_pending and self._local_pending[-1][0] == rev, (
+            "rollback must undo the latest local edit first"
+        )
+        _, change = self._local_pending.pop()
+        apply_node_change(self.forest.root, invert_node_change(change))
+        self._notify()
+
+    # ------------------------------------------------------------ checkpoint
+    def summarize(self) -> dict[str, Any]:
+        if self._local_pending:
+            raise RuntimeError("summarize with pending tree edits")
+        return {
+            "forest": encode_field_chunked(self.forest.root_field),
+            "editManager": self.em.summarize(),
+            "schema": self.schema.to_json(),
+        }
+
+    def load(self, summary: dict[str, Any]) -> None:
+        self.forest.root = Node(type="__root__")
+        self.forest.root.fields[ROOT_FIELD] = decode_field_chunked(summary["forest"])
+        self.em.load(summary["editManager"])
+        self.schema = SchemaRegistry.from_json(summary["schema"])
+        self._notify()
+
+
+class _Factory:
+    channel_type = SharedTreeChannel.channel_type
+
+    def create(self, channel_id: str) -> SharedTreeChannel:
+        return SharedTreeChannel(channel_id)
+
+
+SharedTreeFactory = _Factory()

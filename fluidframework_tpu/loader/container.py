@@ -199,4 +199,10 @@ class Container:
 
     # ------------------------------------------------------------------ stash
     def get_pending_local_state(self) -> str:
+        if self._stash is not None:
+            # A stash held through a read-mode session was never applied
+            # (only a write connection replays it): hand back the original
+            # rather than the runtime's empty pending set, so offline edits
+            # survive a read-only load/save cycle.
+            return self._stash
         return self.runtime.get_pending_local_state()

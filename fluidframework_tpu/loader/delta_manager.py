@@ -72,17 +72,28 @@ class DeltaManager:
         while self.last_processed_seq + 1 in self._parked:
             self._deliver(self._parked.pop(self.last_processed_seq + 1))
 
+    # Zero-progress reads are retried: get_deltas is allowed to return fewer
+    # ops than asked (a networked delta store can lag the broadcast stream
+    # briefly), so only a persistently empty window is an unrepairable gap.
+    GAP_FETCH_RETRIES = 8
+
     def _fetch_missing(self, from_seq: int, to_seq: int) -> None:
+        stalls = 0
         while from_seq <= to_seq:
             got = self._storage.get_deltas(from_seq, to_seq)
             for m in got:
                 if m.seq == self.last_processed_seq + 1:
                     self._deliver(m)
             if self.last_processed_seq + 1 == from_seq:
-                raise RuntimeError(
-                    f"delta storage cannot supply seq {from_seq} "
-                    f"(requested [{from_seq}, {to_seq}]): unrepairable gap"
-                )
+                stalls += 1
+                if stalls >= self.GAP_FETCH_RETRIES:
+                    raise RuntimeError(
+                        f"delta storage cannot supply seq {from_seq} "
+                        f"(requested [{from_seq}, {to_seq}]) after "
+                        f"{stalls} attempts: unrepairable gap"
+                    )
+                continue
+            stalls = 0
             from_seq = self.last_processed_seq + 1
 
     def _on_signal_msg(self, sig: SignalMessage) -> None:

@@ -58,6 +58,11 @@ class ContainerRuntime:
         self._detached_counter = 0
         self._stash: dict[str, Any] | None = None
         self._processing_inbound = False
+        # Quorum proposals in flight on the current connection; a dropped
+        # connection rejects them (the reference rejects the propose promise
+        # on disconnect so callers can retry — quorum.ts propose).
+        self._inflight_proposals: list[dict] = []
+        self.rejected_proposals: list[dict] = []
 
     # -------------------------------------------------------------- datastores
     def create_datastore(self, ds_id: str) -> DataStoreRuntime:
@@ -195,6 +200,7 @@ class ContainerRuntime:
         self._document = None
         self._outbox = None
         self.joined = False
+        self._reject_inflight_proposals()
 
     def close(self, error: Exception | None = None) -> None:
         """Terminal: detach from the document and refuse further work (ref
@@ -206,6 +212,7 @@ class ContainerRuntime:
         self.joined = False
         self.closed = True
         self.close_error = error
+        self._reject_inflight_proposals()
 
     def _on_nack(self, nack: Nack) -> None:
         """A nack invalidates the connection: drop it and let the host
@@ -215,6 +222,15 @@ class ContainerRuntime:
             self._document = None
             self._outbox = None
             self.joined = False
+            self._reject_inflight_proposals()
+
+    def _reject_inflight_proposals(self) -> None:
+        """A dropped connection cannot sequence what it had in flight:
+        surface unacked proposals so the host can retry (ref quorum.ts
+        rejects the propose promise on disconnect)."""
+        if self._inflight_proposals:
+            self.rejected_proposals.extend(self._inflight_proposals)
+            self._inflight_proposals.clear()
 
     # ----------------------------------------------------------------- inbound
     def _on_sequenced(self, msg: SequencedMessage) -> None:
@@ -224,6 +240,11 @@ class ContainerRuntime:
             # Already processed (reconnect catch-up replays the full log;
             # ref DeltaManager drops ops at/below lastProcessedSequenceNumber).
             return
+        if self._outbox is not None and not self._outbox.is_empty:
+            # Ref-seq consistency (ref containerRuntime.ts:3188): staged
+            # local ops must go out stamped with their authoring context
+            # before any inbound op advances this container's state.
+            self.flush()
         if self._stash is not None and msg.seq > self._stash["refSeq"]:
             self._maybe_apply_stash(catch_up_done=False)
         self.ref_seq = msg.seq
@@ -242,6 +263,13 @@ class ContainerRuntime:
             self._quorum.pop(msg.contents["clientId"], None)
             for ds in self._datastores.values():
                 ds.on_client_leave(msg.contents["clientId"], msg.seq)
+        elif msg.type == MessageType.PROPOSE:
+            if (
+                msg.client_id == self.client_id
+                and self._inflight_proposals
+                and self._inflight_proposals[0]["contents"] == msg.contents
+            ):
+                self._inflight_proposals.pop(0)  # sequenced: no longer at risk
         elif msg.type == MessageType.OP:
             try:
                 self._process_op(msg)
@@ -333,6 +361,7 @@ class ContainerRuntime:
         self.flush()
         if self._document is None:
             raise RuntimeError("connection dropped during flush")
+        self._inflight_proposals.append({"type": mtype, "contents": contents})
         self._document.submit(self._outbox.mint_direct(mtype, contents, self.ref_seq))
 
     # -------------------------------------------------------------- checkpoint

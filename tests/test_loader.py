@@ -246,6 +246,32 @@ class TestSignals:
         assert len(svc.document("doc").sequencer.log) == before
 
 
+class TestProposalRejection:
+    def test_disconnect_before_sequencing_rejects_proposal(self, env):
+        """A proposal in flight when the connection drops is surfaced as
+        rejected (the reference rejects the propose promise on disconnect)
+        instead of vanishing silently."""
+        svc, factory = env
+        d = boot_doc(factory)
+        d.attach("doc", factory, "creator")
+        svc.process_all()
+
+        d.propose("code", {"package": "pkg@1"})
+        d.disconnect()  # before the proposal is delivered back
+        assert d.runtime.rejected_proposals == [
+            {"type": "propose", "contents": {"key": "code", "value": {"package": "pkg@1"}}}
+        ]
+        svc.process_all()
+        # A sequenced proposal is NOT rejected by a later disconnect.
+        d.connect()
+        svc.process_all()
+        d.runtime.rejected_proposals.clear()
+        d.propose("code", {"package": "pkg@2"})
+        svc.process_all()
+        d.disconnect()
+        assert d.runtime.rejected_proposals == []
+
+
 class TestStash:
     def test_stash_through_loader(self, env):
         svc, factory = env

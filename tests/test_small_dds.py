@@ -203,14 +203,16 @@ def test_pact_map_pending_until_signoff(pair):
     ch(a, "pact").set("k", 1)
     a.flush()
     doc.process_all()
-    a.flush()  # only A's accept goes out; B withholds
-    doc.process_all()
+    a.flush()  # A's accept goes out
+    # Deliver only A's accept: B's accept (auto-flushed on inbound per the
+    # ref-seq consistency rule) stays queued at the service.
+    doc.process_some(1)
     assert ch(a, "pact").get("k") is None
     assert ch(a, "pact").is_pending("k")
     assert ch(a, "pact").get_pending("k") == 1
-    b.flush()  # B's accept
-    doc.process_all()
+    doc.process_all()  # B's accept lands
     assert ch(b, "pact").get("k") == 1
+    assert ch(a, "pact").get("k") == 1
 
 
 def test_pact_map_leave_counts_as_signoff(pair):
@@ -218,11 +220,9 @@ def test_pact_map_leave_counts_as_signoff(pair):
     ch(a, "pact").set("k", "v")
     a.flush()
     doc.process_all()
-    a.flush()  # A accepts; B never does
-    doc.process_all()
-    assert ch(a, "pact").is_pending("k")
-    b.disconnect()  # B leaves -> implicit signoff
-    doc.process_all()
+    b.disconnect()  # B leaves before its accept ever goes out
+    a.flush()  # A accepts
+    doc.process_all()  # A's accept + B's leave -> implicit signoff
     assert ch(a, "pact").get("k") == "v"
 
 

@@ -1,0 +1,296 @@
+"""SharedTree changeset algebra unit tests: apply/invert/rebase laws.
+
+Mirrors the reference's axiomatic rebaser tests
+(tree/src/test/rebaserAxiomaticTests.ts, exhaustiveRebaserUtils.ts): the
+ChangeRebaser laws (changeRebaser.ts:41) checked over enumerated edit pairs,
+plus forest/uniform-chunk codecs.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import pytest
+
+from fluidframework_tpu.dds.tree import (
+    Forest,
+    Insert,
+    Modify,
+    Node,
+    NodeChange,
+    Remove,
+    Skip,
+    UniformChunk,
+    apply_node_change,
+    change_from_json,
+    change_to_json,
+    invert_node_change,
+    rebase_node_change,
+)
+from fluidframework_tpu.dds.tree.changeset import (
+    clone_change,
+    make_insert,
+    make_remove,
+    make_set_value,
+)
+from fluidframework_tpu.dds.tree.forest import (
+    decode_field_chunked,
+    encode_field_chunked,
+)
+from fluidframework_tpu.dds.tree.schema import build_node, leaf
+
+
+def num_array(*values) -> Forest:
+    f = Forest()
+    f.root_field.extend(leaf(v) for v in values)
+    return f
+
+
+def values(f: Forest) -> list:
+    return [n.value for n in f.root_field]
+
+
+def apply_root(f: Forest, change: NodeChange) -> NodeChange:
+    apply_node_change(f.root, change)
+    return change
+
+
+# --------------------------------------------------------------------------
+# apply + invert
+# --------------------------------------------------------------------------
+
+def test_apply_insert_remove_modify():
+    f = num_array(1, 2, 3)
+    apply_root(f, make_insert([], "", 1, [leaf(9)]))
+    assert values(f) == [1, 9, 2, 3]
+    apply_root(f, make_remove([], "", 0, 2))
+    assert values(f) == [2, 3]
+    apply_root(f, make_set_value([("", 1)], 30))
+    assert values(f) == [2, 30]
+
+
+def test_apply_enriches_repair_data():
+    f = num_array(1, 2, 3)
+    ch = apply_root(f, make_remove([], "", 1, 2))
+    removed = ch.fields[""][1]
+    assert isinstance(removed, Remove)
+    assert [n.value for n in removed.detached] == [2, 3]
+    ch2 = apply_root(f, make_set_value([("", 0)], 100))
+    mod = ch2.fields[""][0]
+    assert mod.change.value == (100, 1)  # (new, old) after apply
+
+
+def test_invert_roundtrip_exhaustive():
+    """invert(c) applied after c restores the state, over an enumeration of
+    single edits on a small array (the compose(c, invert(c)) == identity law
+    checked extensionally)."""
+    edits = []
+    for i in range(4):
+        edits.append(make_insert([], "", i, [leaf(99)]))
+    for i in range(3):
+        edits.append(make_set_value([("", i)], 50 + i))
+    for i, n in itertools.product(range(4), range(1, 3)):
+        if i + n <= 3:
+            edits.append(make_remove([], "", i, n))
+    for e in edits:
+        f = num_array(1, 2, 3)
+        before = f.to_json()
+        applied = apply_root(f, clone_change(e))
+        apply_root(f, invert_node_change(applied))
+        assert f.to_json() == before, f"invert failed for {change_to_json(e)}"
+
+
+def test_codec_roundtrip():
+    ch = NodeChange(
+        value=(5, 2),
+        fields={
+            "a": [Skip(2), Insert([leaf(1), build_node("p", x=2)]), Remove(3)],
+            "b": [Modify(NodeChange(value=("s",)))],
+        },
+    )
+    assert change_to_json(change_from_json(change_to_json(ch))) == change_to_json(ch)
+
+
+# --------------------------------------------------------------------------
+# rebase: convergence squares and tie-breaks
+# --------------------------------------------------------------------------
+
+def converge(start: Forest, a: NodeChange, b: NodeChange) -> tuple[list, list]:
+    """The convergence square with a sequenced before b: replica 1 (observer)
+    applies a then rebase(b, a, after=True); replica 2 (author of b) applied
+    b locally, then carries the earlier-sequenced a over its pending b with
+    rebase(a, b, after=False). Both must land on identical state."""
+    f1 = Forest()
+    f1.load_json(start.to_json())
+    apply_root(f1, clone_change(a))
+    apply_root(f1, rebase_node_change(clone_change(b), a, a_after=True))
+    f2 = Forest()
+    f2.load_json(start.to_json())
+    apply_root(f2, clone_change(b))
+    apply_root(f2, rebase_node_change(clone_change(a), b, a_after=False))
+    return values(f1), values(f2)
+
+
+def test_concurrent_insert_tiebreak():
+    # Earlier-sequenced (applied-first) content stays left.
+    start = num_array(0, 1)
+    a = make_insert([], "", 1, [leaf(10)])
+    b = make_insert([], "", 1, [leaf(20)])
+    v1, v2 = converge(start, a, b)
+    assert v1 == v2 == [0, 10, 20, 1]
+    v1b, v2b = converge(start, b, a)
+    assert v1b == v2b == [0, 20, 10, 1]
+
+
+def test_insert_into_removed_range_slides_to_start():
+    start = num_array(0, 1, 2, 3)
+    rm = make_remove([], "", 1, 2)
+    ins = make_insert([], "", 2, [leaf(9)])
+    v1, _ = converge(start, rm, ins)
+    assert v1 == [0, 9, 3]
+
+
+def test_overlapping_removes_drop_overlap():
+    start = num_array(0, 1, 2, 3, 4)
+    a = make_remove([], "", 1, 2)  # removes 1,2
+    b = make_remove([], "", 2, 2)  # removes 2,3
+    v1, v2 = converge(start, a, b)
+    assert v1 == v2 == [0, 4]
+
+
+def test_modify_under_removed_node_drops():
+    start = num_array(0, 1, 2)
+    rm = make_remove([], "", 1, 1)
+    sv = make_set_value([("", 1)], 99)
+    v1, v2 = converge(start, rm, sv)
+    assert v1 == v2 == [0, 2]
+
+
+def test_concurrent_value_sets_lww():
+    start = num_array(7)
+    a = make_set_value([("", 0)], 1)
+    b = make_set_value([("", 0)], 2)
+    # a sequenced first, b second: b wins.
+    v1, _ = converge(start, a, b)
+    assert v1 == [2]
+    v1, _ = converge(start, b, a)
+    assert v1 == [1]
+
+
+def test_nested_field_rebase_independent_subtrees():
+    root = build_node("doc", left=[leaf(1), leaf(2)], right=[leaf(3)])
+    start = Forest()
+    start.root_field.append(root)
+    a = make_insert([("", 0)], "left", 0, [leaf(10)])
+    b = make_remove([("", 0)], "right", 0, 1)
+    f1 = Forest(); f1.load_json(start.to_json())
+    apply_root(f1, clone_change(a))
+    apply_root(f1, rebase_node_change(clone_change(b), a, a_after=True))
+    f2 = Forest(); f2.load_json(start.to_json())
+    apply_root(f2, clone_change(b))
+    apply_root(f2, rebase_node_change(clone_change(a), b, a_after=False))
+    assert f1.to_json() == f2.to_json()
+    node = f1.root_field[0]
+    assert [n.value for n in node.fields["left"]] == [10, 1, 2]
+    assert node.fields["right"] == []
+
+
+def test_rebase_square_randomized():
+    """Convergence square over randomized concurrent edit pairs on an array:
+    apply(a) ∘ apply(rebase(b,a)) == apply(b) ∘ apply(rebase(a,b)) must hold
+    for the EditManager's deterministic trunk to preserve intent."""
+    import random
+
+    rng = random.Random(42)
+    for trial in range(300):
+        n = rng.randint(1, 6)
+        start = num_array(*range(n))
+
+        def rand_edit():
+            kind = rng.choice(["ins", "rm", "set"])
+            if kind == "ins":
+                return make_insert([], "", rng.randint(0, n), [leaf(100 + rng.randint(0, 9))])
+            if kind == "rm":
+                i = rng.randint(0, n - 1)
+                return make_remove([], "", i, rng.randint(1, n - i))
+            return make_set_value([("", rng.randint(0, n - 1))], 200 + rng.randint(0, 9))
+
+        a, b = rand_edit(), rand_edit()
+        v1, v2 = converge(start, a, b)
+        assert v1 == v2, (
+            f"trial {trial}: {change_to_json(a)} vs {change_to_json(b)}: {v1} != {v2}"
+        )
+
+
+def test_rebase_square_multimark_fuzz():
+    """The sided square over random MULTI-mark changes (several skips/
+    inserts/removes/modifies per change) — the shape the EditManager bridge
+    actually feeds rebase after splits and recursion."""
+    import random
+
+    from fluidframework_tpu.dds.tree.changeset import Mark
+
+    def rand_marks(rng: random.Random, n: int, tag: int) -> list:
+        marks, pos, v = [], 0, 0
+        while pos < n:
+            r = rng.random()
+            if r < 0.3:
+                k = rng.randint(1, n - pos)
+                marks.append(Skip(k)); pos += k
+            elif r < 0.5:
+                k = rng.randint(1, n - pos)
+                marks.append(Remove(k)); pos += k
+            elif r < 0.7:
+                v += 1
+                marks.append(Insert([leaf(tag * 100 + v)]))
+            elif r < 0.85:
+                marks.append(Modify(NodeChange(value=(tag * 1000 + pos,)))); pos += 1
+            else:
+                break
+        if rng.random() < 0.5:
+            marks.append(Insert([leaf(tag * 100 + 99)]))
+        return marks
+
+    for seed in range(2000):
+        rng = random.Random(seed)
+        n = rng.randint(0, 5)
+        a = NodeChange(fields={"": rand_marks(rng, n, 1)})
+        b = NodeChange(fields={"": rand_marks(rng, n, 2)})
+        start = num_array(*range(n))
+        v1, v2 = converge(start, a, b)
+        assert v1 == v2, f"seed {seed}: {change_to_json(a)} vs {change_to_json(b)}"
+
+
+# --------------------------------------------------------------------------
+# forest: uniform chunks
+# --------------------------------------------------------------------------
+
+def test_uniform_chunk_roundtrip():
+    nodes = [build_node("pt", x=float(i), y=float(-i), tag=f"n{i}") for i in range(16)]
+    chunk = UniformChunk.try_encode(nodes)
+    assert chunk is not None and chunk.count == 16
+    # numeric columns columnarize to ndarrays
+    import numpy as np
+
+    assert sum(isinstance(c, np.ndarray) for c in chunk.columns) == 2
+    decoded = chunk.decode()
+    assert [n.to_json() for n in decoded] == [n.to_json() for n in nodes]
+    rt = UniformChunk.from_json(chunk.to_json()).decode()
+    assert [n.to_json() for n in rt] == [n.to_json() for n in nodes]
+
+
+def test_uniform_chunk_rejects_mixed_shapes():
+    nodes = [build_node("pt", x=1), build_node("pt", y=1)]
+    assert UniformChunk.try_encode(nodes) is None
+
+
+def test_field_chunked_codec_mixed_runs():
+    field = (
+        [build_node("pt", x=i, y=i) for i in range(8)]
+        + [leaf("odd one")]
+        + [leaf(i) for i in range(6)]
+    )
+    entries = encode_field_chunked(field)
+    assert any("chunk" in e for e in entries)
+    decoded = decode_field_chunked(entries)
+    assert [n.to_json() for n in decoded] == [n.to_json() for n in field]
