@@ -1,0 +1,176 @@
+"""Batched SharedTree kernels: rebase position arithmetic + chunk updates.
+
+Reference parity: the hot paths of SharedTree sequenced-edit integration —
+EditManager rebase (tree/src/shared-tree-core/editManager.ts:542,808, the
+per-commit sequence-field mark transforms in feature-libraries/
+sequence-field/) and chunked-forest value updates
+(feature-libraries/chunked-forest/uniformChunk.ts:42).
+
+TPU design, not a port: the host algebra (dds/tree/changeset.py) walks mark
+lists; on device a changeset over one field is a fixed-width columnar
+encoding (kinds[M], counts[M]), and rebasing a BATCH of pending edits over
+it is pure broadcast arithmetic — for every query position, the net shift
+is "inserts at-or-before minus removed-below", computed as an [B, M]
+masked reduction with no data-dependent control flow. The same sided
+tie-break contract as the host algebra (changeset.py rebase_marks) is a
+single >= / > mask choice, so host and device stay bit-identical (enforced
+by tests/test_tree_kernel.py differential fuzz).
+
+Shapes: D docs × M marks × B query positions; everything int32; vmap/
+shard_map over the doc axis is the scale-out path (documents are the
+embarrassing axis, SURVEY §2.6.2).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+I32 = jnp.int32
+
+
+class TreeMarkKind:
+    NOOP = 0   # padding
+    SKIP = 1
+    INSERT = 2
+    REMOVE = 3
+    MODIFY = 4
+
+
+def encode_marks(marks, max_marks: int) -> tuple[np.ndarray, np.ndarray]:
+    """Columnar encode a host mark list (changeset.py Mark objects) to
+    (kinds[M], counts[M]) int32 arrays. Insert counts are content lengths."""
+    from ..dds.tree.changeset import Insert, Modify, Remove, Skip
+
+    kinds = np.zeros((max_marks,), np.int32)
+    counts = np.zeros((max_marks,), np.int32)
+    assert len(marks) <= max_marks, "mark list exceeds kernel width"
+    for i, m in enumerate(marks):
+        if isinstance(m, Skip):
+            kinds[i], counts[i] = TreeMarkKind.SKIP, m.count
+        elif isinstance(m, Insert):
+            kinds[i], counts[i] = TreeMarkKind.INSERT, len(m.content)
+        elif isinstance(m, Remove):
+            kinds[i], counts[i] = TreeMarkKind.REMOVE, m.count
+        elif isinstance(m, Modify):
+            kinds[i], counts[i] = TreeMarkKind.MODIFY, 1
+        else:
+            raise TypeError(m)
+    return kinds, counts
+
+
+def _mark_geometry(kinds: jnp.ndarray, counts: jnp.ndarray):
+    """Per-mark input-space start offsets and effect sizes.
+
+    input-consuming marks: SKIP/REMOVE consume `count`, MODIFY consumes 1,
+    INSERT consumes 0. Returns (in_start[M], ins_len[M], rm_len[M])."""
+    consumed = jnp.where(
+        (kinds == TreeMarkKind.SKIP) | (kinds == TreeMarkKind.REMOVE),
+        counts,
+        jnp.where(kinds == TreeMarkKind.MODIFY, 1, 0),
+    )
+    in_start = jnp.cumsum(consumed) - consumed
+    ins_len = jnp.where(kinds == TreeMarkKind.INSERT, counts, 0)
+    rm_len = jnp.where(kinds == TreeMarkKind.REMOVE, counts, 0)
+    return in_start, ins_len, rm_len
+
+
+def rebase_insert_positions(
+    positions: jnp.ndarray,  # int32[B] insert positions (boundary coords)
+    b_kinds: jnp.ndarray,    # int32[M]
+    b_counts: jnp.ndarray,   # int32[M]
+    a_after: bool,
+) -> jnp.ndarray:
+    """Where does each pending INSERT land after change b applies?
+
+    Mirrors rebase_marks for a = [Skip(p), Insert(..)]: b's removes pull the
+    boundary to the range start; b's inserts at the same boundary shift the
+    pending insert right iff the pending one is the later-sequenced side
+    (a_after=True, the >= mask) — the host tie-break contract."""
+    in_start, ins_len, rm_len = _mark_geometry(b_kinds, b_counts)
+    p = positions[:, None]  # [B, 1]
+    # Removal below the boundary: overlap of [in_start, in_start+rm) with [0, p).
+    rm_below = jnp.clip(p - in_start, 0, rm_len[None, :])  # [B, M]
+    # b-insert shift: at the same post-removal boundary the earlier-sequenced
+    # content stays left for the later side.
+    ins_at = in_start[None, :]
+    shift = jnp.where(
+        (p >= ins_at) if a_after else (p > ins_at), ins_len[None, :], 0
+    )
+    return positions + jnp.sum(shift, axis=1) - jnp.sum(rm_below, axis=1)
+
+
+def rebase_node_positions(
+    positions: jnp.ndarray,  # int32[B] node indices (modify/remove-1 targets)
+    b_kinds: jnp.ndarray,
+    b_counts: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Where does each targeted NODE land after change b — and does it
+    survive? Mirrors rebase_marks for a = [Skip(p), Modify/Remove(1)]:
+    a node inside a b-removed range is dropped (mask 0)."""
+    in_start, ins_len, rm_len = _mark_geometry(b_kinds, b_counts)
+    p = positions[:, None]
+    rm_below = jnp.clip(p - in_start, 0, rm_len[None, :])
+    # Node positions: a b-insert AT the node's index lands before it (the
+    # node's content moves right) — always the >= mask for occupied slots.
+    shift = jnp.where(p >= in_start[None, :], ins_len[None, :], 0)
+    dropped = jnp.any(
+        (rm_len[None, :] > 0) & (p >= in_start[None, :]) & (p < (in_start + rm_len)[None, :]),
+        axis=1,
+    )
+    out = positions + jnp.sum(shift, axis=1) - jnp.sum(rm_below, axis=1)
+    return out, (~dropped).astype(I32)
+
+
+# ---------------------------------------------------------------------------
+# Uniform-chunk value updates (the columnar forest hot path)
+# ---------------------------------------------------------------------------
+
+
+class ChunkState(NamedTuple):
+    """One numeric column of a uniform chunk, with per-row attribution."""
+
+    values: jnp.ndarray   # int32[N]
+    val_seq: jnp.ndarray  # int32[N] seq of winning write
+
+
+def init_chunk(values: np.ndarray) -> ChunkState:
+    v = jnp.asarray(values, I32)
+    return ChunkState(values=v, val_seq=jnp.zeros_like(v))
+
+
+def apply_value_sets(
+    s: ChunkState,
+    idx: jnp.ndarray,   # int32[B] row indices (< 0 = padding)
+    vals: jnp.ndarray,  # int32[B]
+    seqs: jnp.ndarray,  # int32[B] distinct, > 0 (sequence order of the writes)
+) -> ChunkState:
+    """Apply a sequenced batch of value overwrites in ONE scatter pass: for
+    rows hit multiple times the highest-seq write wins (LWW by total order),
+    matching sequential host application exactly.
+
+    Determinism: duplicate-index ``set`` scatters have unspecified order, so
+    the winner per row is picked first with a commutative scatter-MAX of
+    seqs, and only winning lanes scatter values. Padding lanes (idx < 0) are
+    routed out of bounds HIGH (negative indices wrap in XLA, N drops)."""
+    n = s.values.shape[0]
+    valid = idx >= 0
+    safe_idx = jnp.where(valid, idx, n)  # n = dropped by mode="drop"
+    best = jnp.zeros((n,), I32).at[safe_idx].max(
+        jnp.where(valid, seqs, 0), mode="drop"
+    )
+    win = valid & (seqs == best[jnp.where(valid, idx, 0)])
+    win_idx = jnp.where(win, idx, n)
+    values = s.values.at[win_idx].set(vals, mode="drop")
+    val_seq = s.val_seq.at[win_idx].set(seqs, mode="drop")
+    return ChunkState(values=values, val_seq=val_seq)
+
+
+def batched_value_engine(n_docs: int):
+    """The D-doc batched form: vmap of apply_value_sets over the doc axis —
+    the tree analog of the merge-tree doc-batch engine (document sharding is
+    the primary parallel axis, SURVEY §2.6.2)."""
+    return jax.jit(jax.vmap(apply_value_sets))
