@@ -114,7 +114,15 @@ class Forest:
 # Uniform chunks: columnar representation of shape-uniform subtree arrays
 # ---------------------------------------------------------------------------
 
-_NUMERIC_KINDS = {"int", "float"}
+def _encode_column(col: list) -> Any:
+    """ndarray-back a column only when it is type-homogeneous: all int or
+    all float (a mixed column through np.asarray would coerce ints to floats
+    and change values across a summary roundtrip)."""
+    if col and all(type(v) is int for v in col):
+        return np.asarray(col, dtype=np.int64)
+    if col and all(type(v) is float for v in col):
+        return np.asarray(col, dtype=np.float64)
+    return list(col)
 
 
 @dataclass
@@ -148,12 +156,7 @@ class UniformChunk:
         for n in nodes:
             for i, v in enumerate(_leaf_values(n)):
                 slots[i].append(v)
-        columns: list[Any] = []
-        for col in slots:
-            if all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in col):
-                columns.append(np.asarray(col))
-            else:
-                columns.append(list(col))
+        columns: list[Any] = [_encode_column(col) for col in slots]
         return UniformChunk(shape=template, columns=columns, count=len(nodes))
 
     def decode(self) -> list[Node]:
@@ -180,50 +183,48 @@ class UniformChunk:
         return UniformChunk(
             shape=Node.from_json(data["shape"]),
             count=data["count"],
-            columns=[
-                np.asarray(c)
-                if c and all(isinstance(v, (int, float)) and not isinstance(v, bool) for v in c)
-                else c
-                for c in data["columns"]
-            ],
+            columns=[_encode_column(c) for c in data["columns"]],
         )
 
 
 def _shape_of(node: Node) -> Node:
-    """Type structure with values elided (leaf slots keep only their type)."""
+    """Type structure with values elided. Field keys are traversed in sorted
+    order everywhere in this codec: shape equality is dict-order-insensitive,
+    so the value-slot ordering must be too or columns misalign between
+    siblings built with different field insertion orders."""
     return Node(
         type=node.type,
         value=None,
-        fields={k: [_shape_of(c) for c in v] for k, v in node.fields.items()},
+        fields={k: [_shape_of(c) for c in node.fields[k]] for k in sorted(node.fields)},
     )
 
 
 def _leaf_count(shape: Node) -> int:
-    n = 1 if not shape.fields else 0
-    for children in shape.fields.values():
-        for c in children:
+    # EVERY node owns a value slot (a node may carry both a value and
+    # children); structural nodes just column None.
+    n = 1
+    for k in sorted(shape.fields):
+        for c in shape.fields[k]:
             n += _leaf_count(c)
     return n
 
 
 def _leaf_values(node: Node) -> list[Any]:
-    if not node.fields:
-        return [node.value]
-    out = []
-    for children in node.fields.values():
-        for c in children:
+    out = [node.value]
+    for k in sorted(node.fields):
+        for c in node.fields[k]:
             out.extend(_leaf_values(c))
     return out
 
 
 def _fill_shape(shape: Node, values: Iterator[Any]) -> Node:
-    if not shape.fields:
-        return Node(type=shape.type, value=next(values))
+    value = next(values)
     return Node(
         type=shape.type,
+        value=value,
         fields={
-            k: [_fill_shape(c, values) for c in children]
-            for k, children in shape.fields.items()
+            k: [_fill_shape(c, values) for c in shape.fields[k]]
+            for k in sorted(shape.fields)
         },
     )
 

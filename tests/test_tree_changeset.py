@@ -284,6 +284,39 @@ def test_uniform_chunk_rejects_mixed_shapes():
     assert UniformChunk.try_encode(nodes) is None
 
 
+def test_uniform_chunk_preserves_values_on_interior_nodes():
+    # A node may carry BOTH a value and children: the codec must column the
+    # interior value too, not silently drop it.
+    nodes = []
+    for i in range(4):
+        n = build_node("x", c=[leaf(i * 10)])
+        n.value = i
+        nodes.append(n)
+    chunk = UniformChunk.try_encode(nodes)
+    assert chunk is not None
+    assert [n.to_json() for n in chunk.decode()] == [n.to_json() for n in nodes]
+
+
+def test_uniform_chunk_field_insertion_order_does_not_misalign():
+    # Same shape, different dict insertion order: values must land in the
+    # right fields after a roundtrip.
+    a = Node(type="p", fields={"x": [leaf(1)], "y": [leaf("a")]})
+    b = Node(type="p", fields={"y": [leaf("b")], "x": [leaf(2)]})
+    nodes = [a, b, a.clone(), b.clone()]
+    chunk = UniformChunk.try_encode(nodes)
+    assert chunk is not None
+    decoded = chunk.decode()
+    assert [n.to_json() for n in decoded] == [n.to_json() for n in nodes]
+
+
+def test_uniform_chunk_mixed_numeric_column_keeps_types():
+    nodes = [build_node("v", n=x) for x in [1, 2.5, 3, 4]]
+    rt = UniformChunk.from_json(UniformChunk.try_encode(nodes).to_json()).decode()
+    vals = [n.fields["n"][0].value for n in rt]
+    assert vals == [1, 2.5, 3, 4]
+    assert [type(v) for v in vals] == [int, float, int, int]
+
+
 def test_field_chunked_codec_mixed_runs():
     field = (
         [build_node("pt", x=i, y=i) for i in range(8)]
