@@ -66,6 +66,11 @@ class StorageService:
     def write_snapshot(self, seq: int, summary: dict) -> None:
         raise NotImplementedError
 
+    def upload_summary(self, summary_tree: dict) -> str:
+        """Stage an ISummaryTree upload; returns the handle a summarize op
+        carries (ref uploadSummaryWithContext)."""
+        raise NotImplementedError
+
 
 class DocumentService:
     """One document's service endpoints (ref IDocumentService)."""

@@ -92,6 +92,9 @@ class LocalStorageService(StorageService):
     def write_snapshot(self, seq: int, summary: dict) -> None:
         self._doc.save_snapshot(seq, summary)
 
+    def upload_summary(self, summary_tree: dict) -> str:
+        return self._doc.upload_summary(summary_tree)
+
 
 class LocalDocumentService(DocumentService):
     def __init__(self, doc: LocalDocument) -> None:

@@ -197,6 +197,19 @@ class Container:
         )
         return seq
 
+    def make_summary_manager(self, config=None):
+        """Wire a SummaryManager (election + heuristics + incremental
+        summary upload) for this container (ref SummaryManager spawn,
+        summaryManager.ts:95)."""
+        from ..runtime.summary import SummaryManager
+
+        return SummaryManager(
+            self.runtime,
+            self._storage,
+            config=config,
+            protocol_summarize=self.protocol.summarize,
+        )
+
     # ------------------------------------------------------------------ stash
     def get_pending_local_state(self) -> str:
         if self._stash is not None:

@@ -63,6 +63,13 @@ class ContainerRuntime:
         # on disconnect so callers can retry — quorum.ts propose).
         self._inflight_proposals: list[dict] = []
         self.rejected_proposals: list[dict] = []
+        # Summarization state (runtime/summary.py): ops since the last acked
+        # summary drive the RunningSummarizer heuristics; last_summary_ref_seq
+        # is the baseline for incremental handle reuse (refreshLatestSummary).
+        self.ops_since_summary_ack = 0
+        self.last_summary_ref_seq: int | None = None
+        self.on_summary_ack = None
+        self.on_summary_nack = None
 
     # -------------------------------------------------------------- datastores
     def create_datastore(self, ds_id: str) -> DataStoreRuntime:
@@ -228,9 +235,20 @@ class ContainerRuntime:
         """A dropped connection cannot sequence what it had in flight:
         surface unacked proposals so the host can retry (ref quorum.ts
         rejects the propose promise on disconnect)."""
-        if self._inflight_proposals:
-            self.rejected_proposals.extend(self._inflight_proposals)
-            self._inflight_proposals.clear()
+        inflight, self._inflight_proposals = self._inflight_proposals, []
+        for entry in inflight:
+            if entry["type"] == MessageType.SUMMARIZE:
+                # A dropped summarize surfaces as a nack so the summary
+                # manager's heuristics retry on the next connection.
+                if self.on_summary_nack is not None:
+                    self.on_summary_nack(
+                        {
+                            "handle": entry["contents"].get("handle"),
+                            "error": "connection dropped",
+                        }
+                    )
+            else:
+                self.rejected_proposals.append(entry)
 
     # ----------------------------------------------------------------- inbound
     def _on_sequenced(self, msg: SequencedMessage) -> None:
@@ -263,13 +281,24 @@ class ContainerRuntime:
             self._quorum.pop(msg.contents["clientId"], None)
             for ds in self._datastores.values():
                 ds.on_client_leave(msg.contents["clientId"], msg.seq)
-        elif msg.type == MessageType.PROPOSE:
+        elif msg.type in (MessageType.PROPOSE, MessageType.SUMMARIZE):
             if (
                 msg.client_id == self.client_id
                 and self._inflight_proposals
+                and self._inflight_proposals[0]["type"] == msg.type
                 and self._inflight_proposals[0]["contents"] == msg.contents
             ):
                 self._inflight_proposals.pop(0)  # sequenced: no longer at risk
+        elif msg.type == MessageType.SUMMARY_ACK:
+            # A summary is durable: advance the incremental baseline and
+            # reset the heuristics counter (ref refreshLatestSummary).
+            self.last_summary_ref_seq = msg.contents["refSeq"]
+            self.ops_since_summary_ack = 0
+            if self.on_summary_ack is not None:
+                self.on_summary_ack(msg.contents)
+        elif msg.type == MessageType.SUMMARY_NACK:
+            if self.on_summary_nack is not None:
+                self.on_summary_nack(msg.contents)
         elif msg.type == MessageType.OP:
             try:
                 self._process_op(msg)
@@ -303,6 +332,11 @@ class ContainerRuntime:
         else:
             self._detector.observe(batch_id, msg.seq, msg.min_seq)
 
+        # Summary heuristics count runtime ops, not wire messages: a grouped
+        # batch contributes its full op count (ref opsSinceLastSummary) —
+        # counted only after duplicate-batch drops, so resubmitted ops that
+        # never mutate state don't inflate the summarizer's trigger.
+        self.ops_since_summary_ack += len(inbound)
         zipped: list[tuple[InboundRuntimeMessage, Any]] = []
         for m in inbound:
             md = self._psm.match_inbound(m.contents) if local else None
@@ -381,11 +415,40 @@ class ContainerRuntime:
         called before any datastore creation or op processing."""
         if self._datastores or self.ref_seq != 0:
             raise RuntimeError("load_snapshot on a non-fresh runtime")
+        self.last_summary_ref_seq = summary["seq"]
         self.ref_seq = summary["seq"]
         self.min_seq = summary.get("minSeq", 0)
         self._quorum = dict(summary["quorum"])
         for ds_id, ds_summary in summary["datastores"].items():
             self.create_datastore(ds_id).load(ds_summary)
+
+    @property
+    def quorum_table(self) -> dict[str, int]:
+        """client id -> short (join-order) id for current write clients."""
+        return dict(self._quorum)
+
+    def build_summary_tree(self) -> dict[str, Any]:
+        """The incremental runtime summary subtree (ref SummarizerNode walk,
+        summarizerNode.ts:61): channels untouched since the last acked
+        summary emit handles into it instead of content."""
+        from .summary import blob, tree
+
+        covered = self.last_summary_ref_seq
+        return tree(
+            {
+                "seq": blob(self.ref_seq),
+                "minSeq": blob(self.min_seq),
+                "quorum": blob(dict(self._quorum)),
+                "datastores": tree(
+                    {
+                        ds_id: ds.summary_tree(
+                            covered, f"runtime/datastores/{ds_id}"
+                        )
+                        for ds_id, ds in self._datastores.items()
+                    }
+                ),
+            }
+        )
 
     # ------------------------------------------------------------------- stash
     def get_pending_local_state(self) -> str:

@@ -45,6 +45,9 @@ class DataStoreRuntime:
         self._members = members_fn
         self._ref_seq = ref_seq_fn
         self._channels: dict[str, Channel] = {}
+        # channel id -> seq of its last sequenced change (summary dirtiness;
+        # ref SummarizerNode invalidate on op).
+        self.changed_seqs: dict[str, int] = {}
 
     # ------------------------------------------------------------- channels
     def create_channel(self, channel_type: str, channel_id: str) -> Channel:
@@ -93,6 +96,7 @@ class DataStoreRuntime:
         def dispatch(addr: str, run: list[ChannelMessage]) -> None:
             if addr not in self._channels:
                 raise KeyError(f"datastore {self.id!r}: unknown channel {addr!r}")
+            self.changed_seqs[addr] = envelope.seq  # summary dirty tracking
             self._channels[addr].process_messages(
                 MessageCollection(envelope=envelope, messages=run)
             )
@@ -148,6 +152,22 @@ class DataStoreRuntime:
             # channel layout; content replays as trailing ops).
             if entry["summary"] is not None:
                 channel.load(entry["summary"])
+
+    def summary_tree(self, covered_seq: int | None, prefix: str) -> dict[str, Any]:
+        """Incremental summary subtree: a channel whose last sequenced
+        change is at or below ``covered_seq`` (the last acked summary's
+        refSeq) emits a handle to its previous summary content
+        (ref SummarizerNode handle reuse)."""
+        from .summary import blob, handle, tree
+
+        channels: dict[str, Any] = {}
+        for cid, ch in self._channels.items():
+            path = f"{prefix}/channels/{cid}"
+            if covered_seq is not None and self.changed_seqs.get(cid, 0) <= covered_seq:
+                channels[cid] = handle(path)
+            else:
+                channels[cid] = blob({"type": ch.channel_type, "summary": ch.summarize()})
+        return tree({"channels": tree(channels)})
 
     def structure_summary(self) -> dict[str, Any]:
         """Layout-only summary: channel ids + types, no state."""

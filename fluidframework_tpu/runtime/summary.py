@@ -1,0 +1,174 @@
+"""Summarization subsystem: incremental summary trees, election, heuristics.
+
+Reference parity: container-runtime/src/summary/ — ``SummaryManager``
+(summaryManager.ts:95) + ``OrderedClientElection`` (orderedClientElection.ts)
+pick one client to summarize; ``RunningSummarizer`` (runningSummarizer.ts)
+applies op-count/size heuristics; the ``SummarizerNode`` tree walk
+(summarizerNode.ts:61) emits HANDLES for subtrees unchanged since the last
+acked summary so uploads are incremental; the server side (scribe,
+scribe/lambda.ts:65) validates, stores, and acks. ``ISummaryTree`` =
+tree/blob/handle nodes (summaryFormat.ts); refreshLatestSummary
+(summarizerNode.ts:392) advances the baseline on ack.
+
+Flow (call stack SURVEY §3.5):
+  elected client: build tree (handles for clean channels) → upload to
+  storage → submit "summarize" op {handle, refSeq} → server scribe
+  materializes handles against the previous snapshot, stores the full
+  snapshot at refSeq, emits summaryAck → every client refreshes its
+  summary baseline and op counter.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..protocol.messages import MessageType
+
+
+# ---------------------------------------------------------------------------
+# ISummaryTree node builders + handle resolution
+# ---------------------------------------------------------------------------
+
+
+def blob(content: Any) -> dict:
+    return {"type": "blob", "content": content}
+
+
+def tree(entries: dict[str, Any]) -> dict:
+    return {"type": "tree", "entries": entries}
+
+
+def handle(path: str) -> dict:
+    """Reference to the same path in the previous acked summary."""
+    return {"type": "handle", "path": path}
+
+
+def count_nodes(node: dict) -> dict[str, int]:
+    """Diagnostic: how many blobs vs handles a summary tree carries (the
+    incrementality measure the reference's summary telemetry reports)."""
+    out = {"blob": 0, "handle": 0, "tree": 0}
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        out[n["type"]] += 1
+        if n["type"] == "tree":
+            stack.extend(n["entries"].values())
+    return out
+
+
+def materialize(node: dict, prev: dict | None, path: str = "") -> Any:
+    """Resolve a summary tree into plain nested content, replacing handle
+    nodes with the content at the same path of the previous materialized
+    summary (what gitrest does when a summary references parent trees)."""
+    kind = node["type"]
+    if kind == "blob":
+        return node["content"]
+    if kind == "tree":
+        return {
+            name: materialize(child, prev, f"{path}/{name}" if path else name)
+            for name, child in node["entries"].items()
+        }
+    if kind == "handle":
+        if node["path"] != path:
+            raise ValueError(f"handle path {node['path']!r} at {path!r}")
+        if prev is None:
+            raise ValueError(f"handle at {path!r} with no previous summary")
+        cur = prev
+        for part in path.split("/"):
+            if not isinstance(cur, dict) or part not in cur:
+                raise ValueError(f"previous summary lacks {path!r}")
+            cur = cur[part]
+        return cur
+    raise ValueError(f"unknown summary node type {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# Client-side manager (election + heuristics + submit)
+# ---------------------------------------------------------------------------
+
+
+class SummaryConfig:
+    """RunningSummarizer heuristics knobs (ref ISummaryConfiguration)."""
+
+    def __init__(self, max_ops: int = 50) -> None:
+        self.max_ops = max_ops
+
+
+class SummaryManager:
+    """Drives summarization for one container runtime.
+
+    Election (ref OrderedClientElection): the joined write client with the
+    LOWEST short id (earliest join order) is the summarizer; everyone runs
+    the same deterministic rule, so exactly one client acts. The reference
+    spawns a hidden summarizer client; here the elected interactive client
+    summarizes directly at a moment with no local pending ops — same
+    protocol, one process fewer.
+
+    Call ``tick()`` from the host loop (the reference wires this to op
+    events + timers); it submits at most one summary and then waits for the
+    ack/nack before trying again.
+    """
+
+    def __init__(
+        self,
+        runtime,
+        storage,
+        config: SummaryConfig | None = None,
+        protocol_summarize=None,
+    ) -> None:
+        self._runtime = runtime
+        self._storage = storage
+        self.config = config or SummaryConfig()
+        self._protocol_summarize = protocol_summarize or (lambda: {})
+        self._inflight_handle: str | None = None
+        self.submitted = 0
+        self.acked = 0
+        runtime.on_summary_ack = self._on_ack
+        runtime.on_summary_nack = self._on_nack
+
+    # ------------------------------------------------------------------ state
+    def elected_summarizer(self) -> str | None:
+        """client id of the current summarizer (lowest short id in quorum)."""
+        q = self._runtime.quorum_table
+        if not q:
+            return None
+        return min(q, key=lambda cid: q[cid])
+
+    def is_elected(self) -> bool:
+        return (
+            self._runtime.joined
+            and self.elected_summarizer() == self._runtime.client_id
+        )
+
+    # ------------------------------------------------------------------- tick
+    def tick(self) -> bool:
+        """Summarize if warranted; returns True when a summary was submitted."""
+        if (
+            not self.is_elected()
+            or self._inflight_handle is not None
+            or self._runtime.ops_since_summary_ack < self.config.max_ops
+            or self._runtime.pending_op_count > 0
+        ):
+            return False
+        root = tree(
+            {
+                "runtime": self._runtime.build_summary_tree(),
+                "protocol": blob(self._protocol_summarize()),
+            }
+        )
+        h = self._storage.upload_summary(root)
+        self._inflight_handle = h
+        self._runtime.submit_protocol_message(
+            MessageType.SUMMARIZE, {"handle": h, "refSeq": self._runtime.ref_seq}
+        )
+        self.submitted += 1
+        return True
+
+    def _on_ack(self, contents: dict) -> None:
+        if contents.get("handle") == self._inflight_handle:
+            self._inflight_handle = None
+            self.acked += 1
+
+    def _on_nack(self, contents: dict) -> None:
+        if contents.get("handle") == self._inflight_handle:
+            self._inflight_handle = None  # heuristics will retry next tick

@@ -15,7 +15,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Callable
 
-from ..protocol.messages import Nack, SequencedMessage, SignalMessage, UnsequencedMessage
+from ..protocol.messages import MessageType, Nack, SequencedMessage, SignalMessage, UnsequencedMessage
 from .sequencer import Sequencer
 
 Subscriber = Callable[[SequencedMessage], None]
@@ -36,6 +36,10 @@ class LocalDocument:
         # (seq, summary) checkpoints; the driver storage service reads these.
         self._snapshots: list[tuple[int, dict]] = []
         self._signal_subscribers: dict[str, SignalSubscriber] = {}
+        # Staged summary uploads awaiting their summarize op (the reference
+        # uploads the ISummaryTree to storage, then the op carries a handle).
+        self._uploads: dict[str, dict] = {}
+        self._upload_counter = 0
 
     def connect(
         self,
@@ -154,10 +158,55 @@ class LocalDocument:
         delivered = 0
         while self._pending and delivered < count:
             msg = self._pending.popleft()
+            if msg.type == MessageType.SUMMARIZE:
+                self._scribe_process_summarize(msg)
             for sub in list(self._subscribers.values()):
                 sub(msg)
             delivered += 1
         return delivered
+
+    # ------------------------------------------------------------------ scribe
+    def upload_summary(self, summary_tree: dict) -> str:
+        self._upload_counter += 1
+        h = f"upload_{self.doc_id}_{self._upload_counter}"
+        self._uploads[h] = summary_tree
+        return h
+
+    def _scribe_process_summarize(self, msg: SequencedMessage) -> None:
+        """The scribe lambda (scribe/lambda.ts:65): on a sequenced summarize
+        op, materialize the uploaded tree (resolving incremental handles
+        against the previous snapshot), store it keyed at the summary's
+        refSeq, and ack — or nack with the reason."""
+        from ..runtime.summary import materialize
+
+        handle = msg.contents.get("handle")
+        ref_seq = msg.contents.get("refSeq")
+        tree = self._uploads.pop(handle, None)
+        if tree is None:
+            self._pending.append(
+                self.sequencer.mint_service(
+                    MessageType.SUMMARY_NACK,
+                    {"handle": handle, "error": "unknown upload handle"},
+                )
+            )
+            return
+        prev = self._snapshots[-1][1] if self._snapshots else None
+        try:
+            plain = materialize(tree, prev)
+            self.save_snapshot(ref_seq, plain)
+        except ValueError as e:
+            self._pending.append(
+                self.sequencer.mint_service(
+                    MessageType.SUMMARY_NACK, {"handle": handle, "error": str(e)}
+                )
+            )
+            return
+        self._pending.append(
+            self.sequencer.mint_service(
+                MessageType.SUMMARY_ACK,
+                {"handle": handle, "refSeq": ref_seq, "summarySeq": msg.seq},
+            )
+        )
 
     def process_all(self) -> int:
         """Drain the delivery queue, including messages enqueued by

@@ -148,6 +148,25 @@ class Sequencer:
         self.log.append(out)
         return out
 
+    def mint_service(self, mtype: str, contents) -> SequencedMessage:
+        """Service-originated sequenced message (summary acks/nacks — the
+        scribe's voice in the stream, ref scribe/lambda.ts sendSummaryAck)."""
+        self._seq += 1
+        out = SequencedMessage(
+            client_id="__service__",
+            client_seq=0,
+            ref_seq=self._seq - 1,
+            seq=self._seq,
+            min_seq=self.min_seq,
+            type=mtype,
+            contents=contents,
+            metadata=None,
+            timestamp=time.time(),
+            short_client=-1,
+        )
+        self.log.append(out)
+        return out
+
     # ------------------------------------------------------------- checkpoint
     def checkpoint(self) -> dict:
         """Serializable sequencer state (ref deli checkpointManager)."""

@@ -1,0 +1,213 @@
+"""Summarization subsystem tests: summary trees, incremental handles,
+election, heuristics, scribe ack/nack, snapshot boot.
+
+Mirrors the reference's summary suites (container-runtime/src/summary tests
++ e2e summarization benchmarks' correctness assertions, SURVEY §3.5)."""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.runtime.summary import (
+    SummaryConfig,
+    blob,
+    count_nodes,
+    handle,
+    materialize,
+    tree,
+)
+from fluidframework_tpu.server import LocalService
+
+
+# --------------------------------------------------------------------------
+# tree format unit tests
+# --------------------------------------------------------------------------
+
+def test_materialize_blobs_and_trees():
+    t = tree({"a": blob(1), "b": tree({"c": blob({"x": 2})})})
+    assert materialize(t, None) == {"a": 1, "b": {"c": {"x": 2}}}
+
+
+def test_materialize_resolves_handles_against_prev():
+    prev = {"a": 1, "b": {"c": {"x": 2}}}
+    t = tree({"a": blob(10), "b": tree({"c": handle("b/c")})})
+    assert materialize(t, prev) == {"a": 10, "b": {"c": {"x": 2}}}
+
+
+def test_materialize_handle_errors():
+    with pytest.raises(ValueError, match="no previous summary"):
+        materialize(tree({"a": handle("a")}), None)
+    with pytest.raises(ValueError, match="handle path"):
+        materialize(tree({"a": handle("wrong/path")}), {"a": 1})
+    with pytest.raises(ValueError, match="lacks"):
+        materialize(tree({"a": handle("a")}), {"other": 1})
+
+
+# --------------------------------------------------------------------------
+# end-to-end harness
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def env():
+    svc = LocalService()
+    return svc, LocalDocumentServiceFactory(svc)
+
+
+def boot(env, extra_channels=()):
+    svc, factory = env
+    d = Container.create_detached(default_registry(), container_id="creator")
+    ds = d.runtime.create_datastore("root")
+    ds.create_channel("sharedString", "text")
+    ds.create_channel("sharedMap", "meta")
+    for ctype, cid in extra_channels:
+        ds.create_channel(ctype, cid)
+    d.attach("doc", factory, "creator")
+    svc.process_all()
+    return svc, factory, d
+
+
+def load(factory, name, **kw):
+    return Container.load("doc", factory, default_registry(), name, **kw)
+
+
+def text_of(c):
+    return c.runtime.datastore("root").get_channel("text")
+
+
+def map_of(c):
+    return c.runtime.datastore("root").get_channel("meta")
+
+
+def test_summary_flow_ack_and_baseline(env):
+    svc, factory, d = boot(env)
+    sm = d.make_summary_manager(SummaryConfig(max_ops=5))
+    assert sm.is_elected()
+    for i in range(6):
+        text_of(d).insert_text(0, f"x{i}")
+        d.runtime.flush()
+    svc.process_all()
+    assert d.runtime.ops_since_summary_ack >= 5
+    assert sm.tick() is True
+    assert sm.tick() is False  # one in flight at a time
+    svc.process_all()
+    assert sm.acked == 1
+    assert d.runtime.last_summary_ref_seq is not None
+    assert d.runtime.ops_since_summary_ack == 0
+    # The scribe stored a materialized snapshot at the summary refSeq.
+    doc = svc.document("doc")
+    seq, snap = doc.latest_snapshot()
+    assert seq == d.runtime.last_summary_ref_seq
+    assert "runtime" in snap and "protocol" in snap
+
+
+def test_incremental_handles_for_clean_channels(env):
+    svc, factory, d = boot(env)
+    sm = d.make_summary_manager(SummaryConfig(max_ops=1))
+    # Round 1: both channels edited -> all blobs.
+    text_of(d).insert_text(0, "hello")
+    map_of(d).set("k", 1)
+    d.runtime.flush()
+    svc.process_all()
+    assert sm.tick()
+    svc.process_all()
+    t1 = d.runtime.build_summary_tree()
+    # Round 2: only the string changes -> the map summarizes as a handle.
+    text_of(d).insert_text(0, "more ")
+    d.runtime.flush()
+    svc.process_all()
+    t2 = d.runtime.build_summary_tree()
+    channels = t2["entries"]["datastores"]["entries"]["root"]["entries"]["channels"]["entries"]
+    assert channels["meta"]["type"] == "handle"
+    assert channels["text"]["type"] == "blob"
+    # And the full tick-produced summary materializes correctly server-side.
+    assert sm.tick()
+    svc.process_all()
+    assert sm.acked == 2
+    _, snap = svc.document("doc").latest_snapshot()
+    ch = snap["runtime"]["datastores"]["root"]["channels"]
+    assert ch["meta"]["summary"]["entries"] == {"k": 1}
+
+
+def test_loader_boots_from_scribe_snapshot(env):
+    svc, factory, d = boot(env)
+    sm = d.make_summary_manager(SummaryConfig(max_ops=1))
+    text_of(d).insert_text(0, "summarized")
+    map_of(d).set("k", 7)
+    d.runtime.flush()
+    svc.process_all()
+    assert sm.tick()
+    svc.process_all()
+    base = d.runtime.last_summary_ref_seq
+    # Ops after the summary arrive as trailing deltas.
+    text_of(d).insert_text(0, "post-")
+    d.runtime.flush()
+    svc.process_all()
+
+    c2 = load(factory, "late")
+    svc.process_all()
+    assert c2.runtime.last_summary_ref_seq == base  # baseline from snapshot
+    assert text_of(c2).text == text_of(d).text == "post-summarized"
+    assert map_of(c2).get("k") == 7
+    # The late client can itself produce an incremental summary.
+    sm2 = c2.make_summary_manager(SummaryConfig(max_ops=1))
+    assert not sm2.is_elected()  # creator (earlier join) is still elected
+
+
+def test_election_moves_on_disconnect(env):
+    svc, factory, d = boot(env)
+    c2 = load(factory, "second")
+    svc.process_all()
+    sm1 = d.make_summary_manager(SummaryConfig(max_ops=1))
+    sm2 = c2.make_summary_manager(SummaryConfig(max_ops=1))
+    assert sm1.is_elected() and not sm2.is_elected()
+    d.disconnect()
+    svc.process_all()  # leave sequences
+    assert sm2.is_elected()
+    text_of(c2).insert_text(0, "z")
+    c2.runtime.flush()
+    svc.process_all()
+    assert sm2.tick()
+    svc.process_all()
+    assert sm2.acked == 1
+
+
+def test_scribe_nacks_unknown_handle(env):
+    svc, factory, d = boot(env)
+    nacks = []
+    d.runtime.on_summary_nack = lambda c: nacks.append(c)
+    from fluidframework_tpu.protocol.messages import MessageType
+
+    d.runtime.submit_protocol_message(
+        MessageType.SUMMARIZE, {"handle": "bogus", "refSeq": d.runtime.ref_seq}
+    )
+    svc.process_all()
+    assert nacks and nacks[0]["error"] == "unknown upload handle"
+    assert d.runtime.last_summary_ref_seq is None
+
+
+def test_dropped_connection_unsticks_summary_manager(env):
+    svc, factory, d = boot(env)
+    sm = d.make_summary_manager(SummaryConfig(max_ops=1))
+    text_of(d).insert_text(0, "a")
+    d.runtime.flush()
+    svc.process_all()
+    assert sm.tick()
+    # The connection drops with the summarize in flight: the manager is
+    # released immediately (local nack) so it can never wedge...
+    d.disconnect()
+    assert sm._inflight_handle is None
+    d.connect()
+    svc.process_all()
+    # ...and since the op had already reached the ordering service, it still
+    # sequences: the scribe ack lands and advances every replica's baseline.
+    assert d.runtime.last_summary_ref_seq is not None
+    # The manager keeps working on the new connection.
+    text_of(d).insert_text(0, "b")
+    d.runtime.flush()
+    svc.process_all()
+    assert sm.tick()
+    svc.process_all()
+    assert sm.acked >= 1
