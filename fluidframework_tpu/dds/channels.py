@@ -14,6 +14,7 @@ from typing import Any
 
 from ..protocol.stamps import ALL_ACKED, acked, encode_stamp
 from .mergetree_ref import RefMergeTree, Segment
+from .sequence_intervals import IntervalCollection, StringOpLog
 from ..runtime.channel import Channel, MessageCollection
 
 
@@ -31,13 +32,21 @@ class SharedStringChannel(Channel):
         super().__init__(channel_id)
         self.backend = backend if backend is not None else RefMergeTree()
         self._local_seq = 0
+        # Interval collections (ref sequence/src/intervalCollection.ts):
+        # named range sets anchored into this string; endpoints transform
+        # with every sequenced string edit (sequence_intervals.py).
+        self._collections: dict[str, IntervalCollection] = {}
+        self._op_log = StringOpLog()
+        # Converged-event listeners: (kind, pos, length, local_seq|None) per
+        # sequenced edit, in converged coordinates (undo-redo range tracking).
+        self._converged_listeners: list = []
 
     # ------------------------------------------------------------ local edits
     def _next_local_seq(self) -> int:
         self._local_seq += 1
         return self._local_seq
 
-    def insert_text(self, pos: int, text: str) -> None:
+    def insert_text(self, pos: int, text: str) -> int:
         assert text
         ls = self._next_local_seq()
         self.backend.apply_insert(
@@ -46,8 +55,9 @@ class SharedStringChannel(Channel):
         self.submit_local_message(
             {"type": 0, "pos1": pos, "seg": text}, {"localSeq": ls}
         )
+        return ls
 
-    def remove_range(self, pos1: int, pos2: int) -> None:
+    def remove_range(self, pos1: int, pos2: int) -> int:
         assert pos1 < pos2
         ls = self._next_local_seq()
         self.backend.apply_remove(
@@ -56,6 +66,7 @@ class SharedStringChannel(Channel):
         self.submit_local_message(
             {"type": 1, "pos1": pos1, "pos2": pos2}, {"localSeq": ls}
         )
+        return ls
 
     def annotate_range(self, pos1: int, pos2: int, prop: int, value: int) -> None:
         assert pos1 < pos2
@@ -69,41 +80,122 @@ class SharedStringChannel(Channel):
             {"localSeq": ls},
         )
 
+    # ------------------------------------------------------------- intervals
+    def get_interval_collection(self, label: str) -> IntervalCollection:
+        """Named interval collection over this string (ref
+        sharedString.getIntervalCollection)."""
+        if label not in self._collections:
+            self._collections[label] = IntervalCollection(
+                label, self._submit_interval_op
+            )
+        return self._collections[label]
+
+    def _submit_interval_op(self, label: str, op: dict) -> None:
+        self.submit_local_message(
+            {"type": 3, "label": label, "op": op},
+            {"intervalRef": self._connection.ref_seq()},
+        )
+
+    def _resolve_interval_op(self, op: dict, ref_seq: int, sender: int) -> dict:
+        """Resolve the op's endpoints — expressed in the sender's
+        perspective (acked at its refSeq + its own prior ops, all sequenced
+        by now thanks to per-client FIFO) — into converged coordinates, the
+        space interval endpoints live in. Exact perspective walk, so no
+        positional drift between replicas (the merge-tree-reference analog)."""
+        out = dict(op)
+        for k in ("start", "end"):
+            if out.get(k) is not None:
+                out[k] = self.backend.converged_position(out[k], ref_seq, sender)
+        if out.get("end") is not None and out.get("start") is not None and out["end"] < out["start"]:
+            out["end"] = out["start"]
+        return out
+
+    def _record_converged_events(
+        self, kind: str, ranges, seq: int, local_seq: int | None = None
+    ) -> None:
+        """Slide interval endpoints over the converged-coordinate ranges an
+        op touched. Removal ranges come in pre-removal coordinates and are
+        applied back-to-front so earlier positions stay valid."""
+        ordered = ranges if kind == "insert" else list(reversed(ranges))
+        for pos, length in ordered:
+            self._op_log.record(seq, kind, pos, length)
+            for coll in self._collections.values():
+                coll.transform_endpoints(kind, pos, length)
+            for listener in list(self._converged_listeners):
+                listener(kind, pos, length, local_seq)
+
     # ---------------------------------------------------------------- inbound
     def process_messages(self, collection: MessageCollection) -> None:
         env = collection.envelope
         for m in collection.messages:
+            c = m.contents
+            sender = self._connection.short_id(env.client_id)
+            if c["type"] == 3:
+                coll = self.get_interval_collection(c["label"])
+                coll.apply_sequenced(
+                    self._resolve_interval_op(c["op"], env.ref_seq, sender), m.local
+                )
+                continue
+            # Apply, keeping the exact segments this op touched (identity,
+            # not seq: grouped batches share sequence numbers).
+            ins_segs: list = []
+            rem_segs: list = []
             if m.local:
-                self.backend.ack(
-                    m.local_metadata["localSeq"],
-                    env.seq,
-                    self._connection.short_id(env.client_id),
+                ins_segs, rem_segs = self.backend.ack(
+                    m.local_metadata["localSeq"], env.seq, sender
                 )
+            elif c["type"] == 0:
+                ins_segs = [
+                    self.backend.apply_insert(
+                        c["pos1"], c["seg"], env.seq, sender, env.ref_seq
+                    )
+                ]
+            elif c["type"] == 1:
+                rem_segs = self.backend.apply_remove(
+                    c["pos1"], c["pos2"], env.seq, sender, env.ref_seq
+                )
+            elif c["type"] == 2:
+                for prop, value in c["props"].items():
+                    self.backend.apply_annotate(
+                        c["pos1"], c["pos2"], int(prop), value, env.seq, sender, env.ref_seq
+                    )
             else:
-                self._apply_remote(m.contents, env)
-        self.backend.update_min_seq(env.min_seq)
-
-    def _apply_remote(self, c: dict, env) -> None:
-        client = self._connection.short_id(env.client_id)
-        if c["type"] == 0:
-            self.backend.apply_insert(c["pos1"], c["seg"], env.seq, client, env.ref_seq)
-        elif c["type"] == 1:
-            self.backend.apply_remove(
-                c["pos1"], c["pos2"], env.seq, client, env.ref_seq
-            )
-        elif c["type"] == 2:
-            for prop, value in c["props"].items():
-                self.backend.apply_annotate(
-                    c["pos1"], c["pos2"], int(prop), value, env.seq, client, env.ref_seq
+                raise ValueError(f"unsupported merge-tree op type {c['type']}")
+            ls = m.local_metadata["localSeq"] if m.local else None
+            if c["type"] == 0:
+                self._record_converged_events(
+                    "insert", self.backend.converged_insert_ranges(ins_segs), env.seq, ls
                 )
-        else:
-            raise ValueError(f"unsupported merge-tree op type {c['type']}")
+            elif c["type"] == 1:
+                self._record_converged_events(
+                    "remove",
+                    self.backend.converged_removed_ranges(rem_segs, env.seq),
+                    env.seq,
+                    ls,
+                )
+        self.backend.update_min_seq(env.min_seq)
+        self._op_log.trim(env.min_seq)
 
     def on_min_seq(self, min_seq: int) -> None:
         self.backend.update_min_seq(min_seq)
 
     # ----------------------------------------------------- reconnect / stash
     def resubmit(self, contents: Any, local_metadata: Any, squash: bool = False) -> None:
+        if contents.get("type") == 3:
+            # Pending interval op: slide its endpoints over everything
+            # sequenced since it was authored, then resubmit fresh.
+            op = dict(contents["op"])
+            ref = local_metadata["intervalRef"]
+            for k in ("start", "end"):
+                if op.get(k) is not None:
+                    op[k] = self._op_log.transform_from(op[k], ref)
+            if op.get("start") is not None and op.get("end") is not None and op["end"] < op["start"]:
+                op["end"] = op["start"]
+            self.submit_local_message(
+                {"type": 3, "label": contents["label"], "op": op},
+                {"intervalRef": self._connection.ref_seq()},
+            )
+            return
         regenerated = self.backend.regenerate_pending(
             local_metadata["localSeq"], self._next_local_seq, squash=squash
         )
@@ -115,6 +207,10 @@ class SharedStringChannel(Channel):
         merge-tree client.ts:1329): apply locally with a pending stamp, do
         NOT submit — the pending-state replay will resubmit it."""
         c = contents
+        if c.get("type") == 3:
+            coll = self.get_interval_collection(c["label"])
+            coll._pending.append(dict(c["op"]))
+            return {"intervalRef": self._connection.ref_seq()}
         ls = self._next_local_seq()
         key = encode_stamp(-1, ls)
         short = self.backend.local_client
@@ -149,9 +245,19 @@ class SharedStringChannel(Channel):
                     "props": {str(p): [v, k] for p, (v, k) in s.props.items()},
                 }
             )
-        return {"segments": segs, "minSeq": self.backend.min_seq}
+        return {
+            "segments": segs,
+            "minSeq": self.backend.min_seq,
+            "intervals": {
+                label: coll.summarize() for label, coll in self._collections.items()
+            },
+            "opLog": self._op_log.to_json(),
+        }
 
     def load(self, summary: dict[str, Any]) -> None:
+        for label, data in summary.get("intervals", {}).items():
+            self.get_interval_collection(label).load(data)
+        self._op_log.load_json(summary.get("opLog", []))
         self.backend.min_seq = summary["minSeq"]
         self.backend.segments = [
             Segment(

@@ -214,20 +214,23 @@ class RefMergeTree:
         op_key: int,
         op_client: int,
         ref_seq: int,
-    ) -> None:
+    ) -> Segment:
         idx = self._find_insert_index(pos, op_key, ref_seq, op_client)
-        self.segments.insert(
-            idx, Segment(text=text, ins_key=op_key, ins_client=op_client)
-        )
+        seg = Segment(text=text, ins_key=op_key, ins_client=op_client)
+        self.segments.insert(idx, seg)
+        return seg
 
     def apply_remove(
         self, pos1: int, pos2: int, op_key: int, op_client: int, ref_seq: int
-    ) -> None:
+    ) -> list[Segment]:
+        out = []
         for i in self._range_indices(pos1, pos2, ref_seq, op_client):
             seg = self.segments[i]
             # Overlapping removes accumulate, stamp-sorted (ref seg.removes).
             seg.removes.append((op_key, op_client))
             seg.removes.sort()
+            out.append(seg)
+        return out
 
     def apply_annotate(
         self,
@@ -257,20 +260,93 @@ class RefMergeTree:
         """
         local_key = encode_stamp(-1, local_seq)
         self._regenerated_keys.discard(local_key)
+        inserted: list[Segment] = []
+        removed: list[Segment] = []
         for seg in self.segments:
             if seg.ins_key == local_key:
                 seg.ins_key = seq
                 if client is not None:
                     seg.ins_client = client
+                inserted.append(seg)
             if any(key == local_key for key, _ in seg.removes):
                 seg.removes = sorted(
                     (seq if key == local_key else key,
                      client if client is not None and key == local_key else c)
                     for key, c in seg.removes
                 )
+                removed.append(seg)
             for prop, (value, key) in list(seg.props.items()):
                 if key == local_key:
                     seg.props[prop] = (value, seq)
+        return inserted, removed
+
+    # ----------------------------------------------------- converged queries
+    # The "converged view" is the perspective every replica agrees on after
+    # full delivery: acked stamps only (refSeq=ALL_ACKED, a client id that
+    # matches no pending op). Interval-collection endpoints live in these
+    # coordinates (channels.py), so the channel asks, after each sequenced
+    # apply, exactly which converged ranges the op touched.
+
+    def converged_position(self, pos: int, ref_seq: int, view_client: int) -> int:
+        """Translate a position under perspective (ref_seq, view_client)
+        into converged coordinates — the exact slide semantics a merge-tree
+        reference would give: landing inside a segment invisible to the
+        converged view slides to that segment's converged start."""
+        from ..protocol.stamps import NON_COLLAB_CLIENT
+
+        rem = pos
+        conv = 0
+        for seg in self.segments:
+            p_len = len(seg.text) if seg.visible(ref_seq, view_client) else 0
+            c_vis = seg.visible(ALL_ACKED, NON_COLLAB_CLIENT)
+            if rem < p_len:
+                return conv + (rem if c_vis else 0)
+            rem -= p_len
+            if c_vis:
+                conv += len(seg.text)
+        if rem == 0:
+            return conv
+        raise ValueError(f"position {pos} beyond perspective-visible length")
+
+    def converged_insert_ranges(self, segs: list[Segment]) -> list[tuple[int, int]]:
+        """(pos, len) of exactly these just-sequenced segments, in post-apply
+        converged coordinates, ascending. Identity-based so two ops sharing
+        one sequence number (grouped batches) never claim each other's
+        segments."""
+        from ..protocol.stamps import NON_COLLAB_CLIENT
+
+        wanted = {id(s) for s in segs}
+        out: list[tuple[int, int]] = []
+        pos = 0
+        for seg in self.segments:
+            if seg.visible(ALL_ACKED, NON_COLLAB_CLIENT):
+                if id(seg) in wanted:
+                    out.append((pos, len(seg.text)))
+                pos += len(seg.text)
+        return out
+
+    def converged_removed_ranges(
+        self, segs: list[Segment], op_key: int
+    ) -> list[tuple[int, int]]:
+        """(pos, len) of what this remove op (stamp ``op_key``, applied to
+        exactly ``segs``) deleted from the converged view, in PRE-removal
+        converged coordinates, ascending. Segments already dead to the
+        converged view (another acked remove also stamped them) are not
+        re-reported."""
+        wanted = {id(s) for s in segs}
+        out: list[tuple[int, int]] = []
+        pos = 0
+        for seg in self.segments:
+            if not acked(seg.ins_key):
+                continue
+            acked_removes = [k for k, _c in seg.removes if acked(k)]
+            newly = id(seg) in wanted and all(k == op_key for k in acked_removes)
+            alive = not acked_removes
+            if newly:
+                out.append((pos, len(seg.text)))
+            if newly or alive:
+                pos += len(seg.text)
+        return out
 
     # --------------------------------------------------------------- reconnect
     def _squashed(self, seg: Segment) -> bool:

@@ -1,0 +1,222 @@
+"""Interval collections: ranges anchored to SharedString positions.
+
+Reference parity: sequence's ``IntervalCollection``
+(packages/dds/sequence/src/intervalCollection.ts:736) — named collections of
+intervals (id, start, end, properties) anchored into a SharedString, with
+add/change/delete ops sequenced through the string's channel, slide-on-remove
+endpoint semantics, and overlap queries (intervalIndex/).
+
+Design (derived, not ported): the reference anchors endpoints with merge-tree
+local references that slide when segments are removed. Here endpoints live in
+the string's current acked coordinate space and are TRANSFORMED by every
+sequenced string op; incoming interval ops are first transformed over the
+string ops the sender had not seen (its refSeq → now), using a collab-window
+log of string ops. Every replica performs identical deterministic transforms
+in sequence order, so interval state converges exactly like the string
+itself. Endpoint rules (matching reference slide semantics):
+- insert at p, length L: positions > p shift by +L; an endpoint exactly at p
+  stays (anchors bind to the character they precede).
+- remove [a, b): endpoints inside clamp (slide) to a; later positions shift
+  by -(b-a).
+
+Conflict rules: last-writer-wins per interval id for change/delete (delete
+wins over a concurrent change it hasn't seen; a change to a deleted interval
+is a no-op), mirroring intervalCollection.ts ack logic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+
+@dataclass
+class SequenceInterval:
+    interval_id: str
+    start: int
+    end: int
+    props: dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "id": self.interval_id,
+            "start": self.start,
+            "end": self.end,
+            "props": dict(self.props),
+        }
+
+    @staticmethod
+    def from_json(d: dict) -> "SequenceInterval":
+        return SequenceInterval(d["id"], d["start"], d["end"], dict(d["props"]))
+
+
+def transform_position(
+    pos: int, kind: str, op_pos: int, length: int, after: bool = False
+) -> int:
+    """Slide one endpoint over one sequenced string op.
+
+    ``after`` is the insert tie-bias (the reference's reference-type
+    before/after slide flags): when an insert lands exactly AT ``pos``,
+    after=False keeps the position (it binds to the character it precedes;
+    interval semantics), after=True shifts right past the inserted content
+    (range-start tracking for undo)."""
+    if kind == "insert":
+        shift = pos >= op_pos if after else pos > op_pos
+        return pos + length if shift else pos
+    # remove of [op_pos, op_pos + length)
+    if pos <= op_pos:
+        return pos
+    if pos < op_pos + length:
+        return op_pos  # inside the removed range: slide to its start
+    return pos - length
+
+
+class StringOpLog:
+    """Collab-window log of sequenced string edits, for transforming interval
+    ops issued against an older refSeq (the positional analog of creating a
+    merge-tree reference under the op's perspective)."""
+
+    def __init__(self) -> None:
+        self._log: list[tuple[int, str, int, int]] = []  # (seq, kind, pos, len)
+
+    def record(self, seq: int, kind: str, pos: int, length: int) -> None:
+        self._log.append((seq, kind, pos, length))
+
+    def transform_from(self, pos: int, ref_seq: int) -> int:
+        for seq, kind, op_pos, length in self._log:
+            if seq > ref_seq:
+                pos = transform_position(pos, kind, op_pos, length)
+        return pos
+
+    def trim(self, min_seq: int) -> None:
+        self._log = [e for e in self._log if e[0] > min_seq]
+
+    def to_json(self) -> list:
+        return [list(e) for e in self._log]
+
+    def load_json(self, data: list) -> None:
+        self._log = [tuple(e) for e in data]
+
+
+class IntervalCollection:
+    """One named collection. Sequenced state + optimistic pending overlay
+    (pending local add/change/delete mask remote state until acked)."""
+
+    def __init__(self, label: str, submit_fn) -> None:
+        self.label = label
+        self._submit = submit_fn
+        self.sequenced: dict[str, SequenceInterval] = {}
+        self._pending: list[dict] = []  # local ops in flight, in order
+        self._id_counter = 0
+
+    # ------------------------------------------------------------ local edits
+    def add(self, start: int, end: int, props: dict | None = None, interval_id: str | None = None) -> str:
+        assert 0 <= start <= end
+        if interval_id is None:
+            self._id_counter += 1
+            interval_id = f"{self.label}-{self._id_counter}"
+        op = {
+            "action": "add",
+            "id": interval_id,
+            "start": start,
+            "end": end,
+            "props": dict(props or {}),
+        }
+        self._pending.append(op)
+        self._submit(self.label, op)
+        return interval_id
+
+    def change(self, interval_id: str, start: int | None = None, end: int | None = None, props: dict | None = None) -> None:
+        op = {"action": "change", "id": interval_id, "start": start, "end": end, "props": props}
+        self._pending.append(op)
+        self._submit(self.label, op)
+
+    def delete(self, interval_id: str) -> None:
+        op = {"action": "delete", "id": interval_id}
+        self._pending.append(op)
+        self._submit(self.label, op)
+
+    # ---------------------------------------------------------------- inbound
+    def apply_sequenced(self, op: dict, local: bool) -> None:
+        if local:
+            head = self._pending.pop(0)
+            assert head["action"] == op["action"] and head["id"] == op["id"], (
+                "interval pending skew"
+            )
+        action = op["action"]
+        if action == "add":
+            self.sequenced[op["id"]] = SequenceInterval(
+                op["id"], op["start"], op["end"], dict(op["props"])
+            )
+        elif action == "delete":
+            self.sequenced.pop(op["id"], None)
+        elif action == "change":
+            iv = self.sequenced.get(op["id"])
+            if iv is None:
+                return  # changed a concurrently-deleted interval: no-op
+            if op["start"] is not None:
+                iv.start = op["start"]
+            if op["end"] is not None:
+                iv.end = op["end"]
+            if op["props"]:
+                iv.props.update(op["props"])
+        else:
+            raise ValueError(f"unknown interval action {action!r}")
+
+    def transform_endpoints(self, kind: str, pos: int, length: int) -> None:
+        """A sequenced string edit landed: slide every acked endpoint."""
+        for iv in self.sequenced.values():
+            iv.start = transform_position(iv.start, kind, pos, length)
+            iv.end = transform_position(iv.end, kind, pos, length)
+            if iv.end < iv.start:
+                iv.end = iv.start
+
+    # ------------------------------------------------------------------ views
+    def get(self, interval_id: str) -> SequenceInterval | None:
+        """Optimistic read: pending local ops overlay the sequenced state."""
+        iv = self.sequenced.get(interval_id)
+        iv = SequenceInterval.from_json(iv.to_json()) if iv is not None else None
+        for op in self._pending:
+            if op["id"] != interval_id:
+                continue
+            if op["action"] == "add":
+                iv = SequenceInterval(op["id"], op["start"], op["end"], dict(op["props"]))
+            elif op["action"] == "delete":
+                iv = None
+            elif op["action"] == "change" and iv is not None:
+                if op["start"] is not None:
+                    iv.start = op["start"]
+                if op["end"] is not None:
+                    iv.end = op["end"]
+                if op["props"]:
+                    iv.props.update(op["props"])
+        return iv
+
+    def ids(self) -> set[str]:
+        out = set(self.sequenced)
+        for op in self._pending:
+            if op["action"] == "add":
+                out.add(op["id"])
+            elif op["action"] == "delete":
+                out.discard(op["id"])
+        return out
+
+    def __iter__(self) -> Iterator[SequenceInterval]:
+        return iter(sorted((self.get(i) for i in self.ids()), key=lambda v: (v.start, v.end, v.interval_id)))
+
+    def overlapping(self, start: int, end: int) -> list[SequenceInterval]:
+        """Intervals intersecting [start, end], bounds inclusive — the
+        reference's findOverlappingIntervals contract
+        (intervalIndex/overlappingIntervalsIndex.ts)."""
+        return [iv for iv in self if iv.start <= end and iv.end >= start]
+
+    # ------------------------------------------------------------ checkpoint
+    def summarize(self) -> dict:
+        if self._pending:
+            raise RuntimeError("summarize with pending interval ops")
+        return {"intervals": [iv.to_json() for iv in self.sequenced.values()]}
+
+    def load(self, data: dict) -> None:
+        self.sequenced = {
+            e["id"]: SequenceInterval.from_json(e) for e in data["intervals"]
+        }

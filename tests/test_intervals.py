@@ -1,0 +1,229 @@
+"""Interval collection tests: anchoring, slide-on-remove, concurrency,
+reconnect, stash, summaries.
+
+Mirrors the reference's intervalCollection suites
+(packages/dds/sequence/src/test/intervalCollection.spec.ts +
+intervalIndex tests)."""
+
+from __future__ import annotations
+
+import random
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+
+def make_container(doc, name: str, stash: str | None = None) -> ContainerRuntime:
+    c = ContainerRuntime(default_registry(), container_id=name)
+    ds = c.create_datastore("root")
+    ds.create_channel("sharedString", "text")
+    c.connect(doc, name, stash=stash)
+    return c
+
+
+def string_of(c):
+    return c.datastore("root").get_channel("text")
+
+
+def setup_pair():
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    b = make_container(doc, "B")
+    doc.process_all()
+    return svc, doc, a, b
+
+
+def seeded(doc, a, text="hello world"):
+    string_of(a).insert_text(0, text)
+    a.flush()
+    doc.process_all()
+
+
+def ivals(c, label="c1"):
+    coll = string_of(c).get_interval_collection(label)
+    return {iv.interval_id: (iv.start, iv.end) for iv in coll}
+
+
+def test_add_and_converge():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a)
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add(0, 4, {"kind": "word"})
+    # optimistic local read before sequencing
+    assert ca.get(iid).start == 0 and ca.get(iid).end == 4
+    a.flush()
+    doc.process_all()
+    assert ivals(a) == ivals(b) == {iid: (0, 4)}
+    assert string_of(b).get_interval_collection("c1").get(iid).props == {"kind": "word"}
+
+
+def test_endpoints_slide_on_remote_insert_and_remove():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a)  # "hello world"
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add(6, 10)  # "world" minus last char
+    a.flush()
+    doc.process_all()
+    # B inserts before the interval: both endpoints slide right.
+    string_of(b).insert_text(0, ">> ")
+    b.flush()
+    doc.process_all()
+    assert ivals(a) == ivals(b) == {iid: (9, 13)}
+    # B removes a range containing the start: start slides to removal point.
+    string_of(b).remove_range(7, 11)  # removes "llo " -> ">> hewo rld" wait: check below
+    b.flush()
+    doc.process_all()
+    assert ivals(a) == ivals(b)
+    assert string_of(a).text == string_of(b).text
+
+
+def test_concurrent_add_against_unseen_edit():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a, "abcdef")
+    # A inserts at front (sequenced first); B concurrently adds an interval
+    # over "cd" without having seen A's insert.
+    string_of(a).insert_text(0, "XY")
+    a.flush()
+    cb = string_of(b).get_interval_collection("c1")
+    iid = cb.add(2, 4)  # "cd" in B's view
+    b.flush()
+    doc.process_all()
+    # After A's insert, "cd" sits at [4, 6).
+    assert ivals(a) == ivals(b) == {iid: (4, 6)}
+
+
+def test_change_delete_and_concurrent_delete_wins():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a)
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add(0, 5)
+    a.flush()
+    doc.process_all()
+    # A changes while B deletes; delete sequences first -> change no-ops.
+    cb = string_of(b).get_interval_collection("c1")
+    cb.delete(iid)
+    b.flush()
+    ca.change(iid, start=1, end=3)
+    a.flush()
+    doc.process_all()
+    assert ivals(a) == ivals(b) == {}
+
+
+def test_overlapping_query():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a, "0123456789")
+    ca = string_of(a).get_interval_collection("c1")
+    i1 = ca.add(0, 3)
+    i2 = ca.add(5, 8)
+    a.flush()
+    doc.process_all()
+    cb = string_of(b).get_interval_collection("c1")
+    hits = {iv.interval_id for iv in cb.overlapping(2, 6)}
+    assert hits == {i1, i2}
+    assert {iv.interval_id for iv in cb.overlapping(4, 5)} == {i2}
+
+
+def test_reconnect_resubmits_interval_ops():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a, "abcdef")
+    a.disconnect()
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add(2, 4)  # offline
+    string_of(b).insert_text(0, "!!")  # concurrent remote edit
+    b.flush()
+    doc.process_all()
+    a.connect(doc, "A2")
+    doc.process_all()
+    assert ivals(a) == ivals(b) == {iid: (4, 6)}
+
+
+def test_stash_rehydrates_interval_ops():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a, "abcdef")
+    a.disconnect()
+    iid = string_of(a).get_interval_collection("c1").add(1, 3)
+    stash = a.get_pending_local_state()
+    a.close()
+    c = make_container(doc, "A2", stash=stash)
+    doc.process_all()
+    assert ivals(c) == ivals(b) == {iid: (1, 3)}
+
+
+def test_summary_roundtrip_with_intervals():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a, "summary text")
+    ca = string_of(a).get_interval_collection("marks")
+    iid = ca.add(0, 7, {"bold": 1})
+    a.flush()
+    doc.process_all()
+    summary = string_of(a).summarize()
+    from fluidframework_tpu.dds.channels import SharedStringChannel
+
+    fresh = SharedStringChannel("text")
+    fresh.load(summary)
+    got = {iv.interval_id: (iv.start, iv.end) for iv in fresh.get_interval_collection("marks")}
+    assert got == {iid: (0, 7)}
+
+
+def test_interval_farm_convergence():
+    """Randomized string edits + interval ops with partial delivery; all
+    replicas converge on text AND interval state."""
+    for seed in range(6):
+        rng = random.Random(seed)
+        svc = LocalService()
+        doc = svc.document(f"f{seed}")
+        cs = [make_container(doc, f"C{i}") for i in range(3)]
+        doc.process_all()
+        string_of(cs[0]).insert_text(0, "0123456789")
+        cs[0].flush()
+        doc.process_all()
+        for rnd in range(10):
+            for c in cs:
+                s = string_of(c)
+                n = len(s.text)
+                coll = s.get_interval_collection("c")
+                choice = rng.random()
+                if choice < 0.35:
+                    s.insert_text(rng.randint(0, n), rng.choice("xyz") * rng.randint(1, 3))
+                elif choice < 0.55 and n > 2:
+                    i = rng.randint(0, n - 2)
+                    s.remove_range(i, min(n, i + rng.randint(1, 3)))
+                elif choice < 0.8 and n > 1:
+                    i = rng.randint(0, n - 1)
+                    coll.add(i, rng.randint(i, n - 1))
+                else:
+                    existing = sorted(coll.ids())
+                    if existing:
+                        coll.delete(rng.choice(existing))
+                if rng.random() < 0.8:
+                    c.flush()
+            if rng.random() < 0.7:
+                doc.process_all()
+        for c in cs:
+            c.flush()
+        doc.process_all()
+        texts = [string_of(c).text for c in cs]
+        states = [ivals(c, "c") for c in cs]
+        assert texts[0] == texts[1] == texts[2], f"text divergence seed {seed}"
+        assert states[0] == states[1] == states[2], f"interval divergence seed {seed}"
+
+
+def test_batched_same_seq_ops_report_events_once():
+    """Two string ops flushed in ONE batch share a sequence number; interval
+    endpoints must slide by each op's own effect exactly once (review
+    regression: seq-keyed event queries double-counted same-seq bunches)."""
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a, "0123456789")
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add(5, 6)
+    a.flush()
+    doc.process_all()
+    s = string_of(b)
+    s.insert_text(0, "ab")
+    s.insert_text(1, "X")  # same flush -> same wire batch -> same seq
+    b.flush()
+    doc.process_all()
+    assert string_of(a).text == string_of(b).text == "aXb0123456789"
+    assert ivals(a) == ivals(b) == {iid: (8, 9)}
