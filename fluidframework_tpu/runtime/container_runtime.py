@@ -34,6 +34,11 @@ from .pending_state import PendingStateManager
 
 from .errors import ContainerForkError, DataProcessingError  # noqa: F401 (re-export)
 
+# Address reserved for runtime-level ops (datastore/channel attach — the
+# reference's attach messages, channelCollection.ts "attach" type): they ride
+# the normal outbox/batch machinery but dispatch to the runtime itself.
+RUNTIME_ADDRESS = "__runtime__"
+
 
 class ContainerRuntime:
     """One collaborative container: datastores + op lifecycle + connection."""
@@ -95,6 +100,58 @@ class ContainerRuntime:
 
     def datastore(self, ds_id: str) -> DataStoreRuntime:
         return self._datastores[ds_id]
+
+    def submit_datastore_attach(self, ds_id: str) -> None:
+        """Sequence a new datastore's existence + layout so every remote
+        replica instantiates it before its ops arrive (ref data store attach
+        ops, dataStoreContext.ts). Safe to call for snapshot-baked stores:
+        replicas that already have it ignore the op."""
+        ds = self._datastores[ds_id]
+        self._submit_datastore_op(
+            RUNTIME_ADDRESS,
+            {"runtimeOp": "attachDataStore", "id": ds_id, "structure": ds.structure_summary()},
+            None,
+        )
+
+    def submit_channel_attach(self, ds_id: str, channel_id: str) -> None:
+        """Sequence a dynamically-created channel on an existing datastore
+        (ref channelCollection "attach" message)."""
+        ch = self._datastores[ds_id].get_channel(channel_id)
+        self._submit_datastore_op(
+            RUNTIME_ADDRESS,
+            {
+                "runtimeOp": "attachChannel",
+                "ds": ds_id,
+                "id": channel_id,
+                "channelType": ch.channel_type,
+            },
+            None,
+        )
+
+    def _apply_runtime_op(self, inner: dict, seq: int) -> None:
+        """Apply one attach op (shared by inbound dispatch and stash
+        rehydrate). Marks the attached channels dirty at the attach seq so
+        summaries don't emit handles into snapshots predating them."""
+        op = inner["runtimeOp"]
+        if op == "attachDataStore":
+            if inner["id"] not in self._datastores:
+                self.create_datastore(inner["id"]).load(inner["structure"])
+            ds = self._datastores[inner["id"]]
+            for cid in ds.channels:
+                ds.changed_seqs[cid] = max(ds.changed_seqs.get(cid, 0), seq)
+        elif op == "attachChannel":
+            ds = self._datastores[inner["ds"]]
+            if inner["id"] not in ds.channels:
+                ds.create_channel(inner["channelType"], inner["id"])
+            ds.changed_seqs[inner["id"]] = max(
+                ds.changed_seqs.get(inner["id"], 0), seq
+            )
+        else:
+            raise DataProcessingError(f"unknown runtime op {op!r}")
+
+    def _handle_runtime_messages(self, env, run) -> None:
+        for inner, _local, _md in run:
+            self._apply_runtime_op(inner, env.seq)
 
     @property
     def datastores(self) -> dict[str, DataStoreRuntime]:
@@ -356,7 +413,11 @@ class ContainerRuntime:
                     (m.contents["address"], (m.contents["contents"], local, md))
                     for m, md in zipped
                 ),
-                lambda addr, run: self._datastores[addr].process_messages(env, run),
+                lambda addr, run: (
+                    self._handle_runtime_messages(env, run)
+                    if addr == RUNTIME_ADDRESS
+                    else self._datastores[addr].process_messages(env, run)
+                ),
             )
         finally:
             self._processing_inbound = False
@@ -368,6 +429,12 @@ class ContainerRuntime:
         groups = self._psm.take_pending_for_replay()
         for group in groups:
             for p in group:
+                if p.contents["address"] == RUNTIME_ADDRESS:
+                    # Attach ops resubmit verbatim (position-free).
+                    self._submit_datastore_op(
+                        RUNTIME_ADDRESS, p.contents["contents"], p.local_metadata
+                    )
+                    continue
                 self._datastores[p.contents["address"]].resubmit(
                     p.contents["contents"], p.local_metadata
                 )
@@ -469,6 +536,12 @@ class ContainerRuntime:
         stash, self._stash = self._stash, None
         for entry in stash["pending"]:
             contents = entry["contents"]
+            if contents["address"] == RUNTIME_ADDRESS:
+                # Stashed attach op: re-create the structure locally, then
+                # let the pending replay resubmit it verbatim.
+                self._apply_runtime_op(contents["contents"], self.ref_seq)
+                self._psm.add_stashed(contents, None, entry["batchId"])
+                continue
             md = self._datastores[contents["address"]].apply_stashed(
                 contents["contents"]
             )

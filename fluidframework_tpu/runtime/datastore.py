@@ -46,11 +46,26 @@ class DataStoreRuntime:
         self._ref_seq = ref_seq_fn
         self._channels: dict[str, Channel] = {}
         # channel id -> seq of its last sequenced change (summary dirtiness;
-        # ref SummarizerNode invalidate on op).
+        # ref SummarizerNode invalidate on op). Channels created while live
+        # are marked dirty from creation so summaries never emit handles
+        # into snapshots that predate them (the attach op re-marks at its
+        # own seq on every replica).
         self.changed_seqs: dict[str, int] = {}
 
     # ------------------------------------------------------------- channels
     def create_channel(self, channel_type: str, channel_id: str) -> Channel:
+        ch = self._create_channel(channel_type, channel_id)
+        # Dirty from creation: a summary handle may only reference channels
+        # the previous snapshot already carries. (Detached creation marks 0,
+        # which the initial snapshot covers; the attach op re-marks at its
+        # own seq on every replica.)
+        if self._ref_seq is not None:
+            self.changed_seqs[channel_id] = max(
+                self.changed_seqs.get(channel_id, 0), self._ref_seq()
+            )
+        return ch
+
+    def _create_channel(self, channel_type: str, channel_id: str) -> Channel:
         if channel_id in self._channels:
             raise ValueError(f"channel {channel_id!r} already exists")
         factory = self._registry.get(channel_type)
@@ -147,7 +162,9 @@ class DataStoreRuntime:
 
     def load(self, summary: dict[str, Any]) -> None:
         for cid, entry in summary["channels"].items():
-            channel = self.create_channel(entry["type"], cid)
+            # _create_channel: snapshot-loaded channels are covered by that
+            # snapshot, not dirty.
+            channel = self._create_channel(entry["type"], cid)
             # A None summary is structure-only (detached attach writes the
             # channel layout; content replays as trailing ops).
             if entry["summary"] is not None:

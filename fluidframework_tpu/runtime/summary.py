@@ -172,3 +172,7 @@ class SummaryManager:
     def _on_nack(self, contents: dict) -> None:
         if contents.get("handle") == self._inflight_handle:
             self._inflight_handle = None  # heuristics will retry next tick
+            # Retry WITHOUT handles: whatever failed to resolve against the
+            # previous snapshot will upload as a full blob next time (the
+            # reference's safe-retry after summary nack).
+            self._runtime.last_summary_ref_seq = None

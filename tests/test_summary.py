@@ -211,3 +211,64 @@ def test_dropped_connection_unsticks_summary_manager(env):
     assert sm.tick()
     svc.process_all()
     assert sm.acked >= 1
+
+
+def test_dynamic_channel_summarizes_as_blob_then_handle(env):
+    """A channel attached after the last acked summary must upload as a blob
+    (review regression: missing changed_seqs classified it clean, wedging
+    the scribe in a nack loop); once snapshotted it may become a handle."""
+    svc, factory, d = boot(env)
+    sm = d.make_summary_manager(SummaryConfig(max_ops=1))
+    text_of(d).insert_text(0, "x")
+    d.runtime.flush()
+    svc.process_all()
+    assert sm.tick()
+    svc.process_all()
+    assert sm.acked == 1
+
+    ds = d.runtime.datastore("root")
+    ds.create_channel("sharedMap", "newmap")
+    d.runtime.submit_channel_attach("root", "newmap")
+    d.runtime.flush()
+    svc.process_all()
+    ch = d.runtime.build_summary_tree()["entries"]["datastores"]["entries"]["root"][
+        "entries"
+    ]["channels"]["entries"]
+    assert ch["newmap"]["type"] == "blob"
+    assert sm.tick()
+    svc.process_all()
+    assert sm.acked == 2  # scribe stored it; no nack loop
+    _, snap = svc.document("doc").latest_snapshot()
+    assert "newmap" in snap["runtime"]["datastores"]["root"]["channels"]
+    # Untouched since that ack: next tree may reuse a handle for it.
+    ch2 = d.runtime.build_summary_tree()["entries"]["datastores"]["entries"]["root"][
+        "entries"
+    ]["channels"]["entries"]
+    assert ch2["newmap"]["type"] == "handle"
+
+
+def test_summary_nack_retries_without_handles(env):
+    svc, factory, d = boot(env)
+    sm = d.make_summary_manager(SummaryConfig(max_ops=1))
+    text_of(d).insert_text(0, "x")
+    map_of(d).set("k", 1)
+    d.runtime.flush()
+    svc.process_all()
+    assert sm.tick()
+    svc.process_all()
+    assert sm.acked == 1
+    # Server-side snapshot loss: the next incremental summary's handles
+    # cannot resolve -> scribe nacks -> manager retries with full blobs.
+    svc.document("doc")._snapshots.clear()
+    text_of(d).insert_text(0, "y")  # map stays clean -> handle in next tree
+    d.runtime.flush()
+    svc.process_all()
+    assert sm.tick()
+    svc.process_all()
+    assert sm.acked == 1  # nacked
+    assert d.runtime.last_summary_ref_seq is None  # baseline dropped
+    assert sm.tick()  # retry uploads full blobs
+    svc.process_all()
+    assert sm.acked == 2
+    _, snap = svc.document("doc").latest_snapshot()
+    assert snap["runtime"]["datastores"]["root"]["channels"]["meta"]["summary"] is not None
