@@ -45,6 +45,10 @@ class SharedTreeChannel(Channel):
         self._local_pending: list[tuple[str, NodeChange]] = []
         self._rev_counter = 0
         self.on_change: Callable[[], None] | None = None  # view invalidation
+        # Every change applied to the forest, in application order (local
+        # edits and bridged remote commits alike) — the coordinate trail
+        # undo-redo revertibles rebase their inverses over.
+        self.applied_log: list[NodeChange] = []
 
     # ------------------------------------------------------------ local edits
     def _mint_revision(self) -> str:
@@ -59,6 +63,7 @@ class SharedTreeChannel(Channel):
         same changeset object."""
         rev = self._mint_revision()
         apply_node_change(self.forest.root, change)
+        self.applied_log.append(change)
         self._local_pending.append((rev, change))
         self.submit_local_message(
             {"type": "edit", "rev": rev, "change": change_to_json(change)},
@@ -107,6 +112,7 @@ class SharedTreeChannel(Channel):
                 # and apply its bridged form to the optimistic forest.
                 self._local_pending, x = bridge(self._local_pending, clone_change(trunk_change))
                 apply_node_change(self.forest.root, x)
+                self.applied_log.append(x)
         self.em.advance_min_seq(env.min_seq)
         self._notify()
 
@@ -140,6 +146,7 @@ class SharedTreeChannel(Channel):
         change = change_from_json(contents["change"])
         rev = contents["rev"]
         apply_node_change(self.forest.root, change)
+        self.applied_log.append(change)
         self._local_pending.append((rev, change))
         self._notify()
         return {"rev": rev}
@@ -150,7 +157,9 @@ class SharedTreeChannel(Channel):
             "rollback must undo the latest local edit first"
         )
         _, change = self._local_pending.pop()
-        apply_node_change(self.forest.root, invert_node_change(change))
+        inverse = invert_node_change(change)
+        apply_node_change(self.forest.root, inverse)
+        self.applied_log.append(inverse)
         self._notify()
 
     # ------------------------------------------------------------ checkpoint

@@ -1,0 +1,26 @@
+"""Framework / public API layer (SURVEY §2.4).
+
+Reference parity: packages/framework/* — the app-facing surface above the
+runtime: ``fluid-static``'s FluidContainer + schema bootstrap, ``aqueduct``'s
+DataObject authoring model, ``presence`` (ephemeral state over signals),
+``undo-redo`` revertible stacks, the ``attributor`` (who-wrote-what from the
+op stream), and the service-client façade (tinylicious-client analog).
+"""
+
+from .aqueduct import DataObject, DataObjectFactory
+from .attributor import OpStreamAttributor
+from .fluid_static import ContainerSchema, FluidContainer
+from .presence import Presence
+from .service_client import LocalServiceClient
+from .undo_redo import UndoRedoStackManager
+
+__all__ = [
+    "ContainerSchema",
+    "DataObject",
+    "DataObjectFactory",
+    "FluidContainer",
+    "LocalServiceClient",
+    "OpStreamAttributor",
+    "Presence",
+    "UndoRedoStackManager",
+]

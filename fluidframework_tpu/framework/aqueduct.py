@@ -1,0 +1,80 @@
+"""aqueduct: the DataObject high-level authoring model.
+
+Reference parity: packages/framework/aqueduct — ``DataObject`` (a datastore
+with a root SharedMap under which apps organize state and handles to other
+channels) and ``DataObjectFactory`` (type name + channel registry +
+first-time initialization hook), the authoring pattern nearly every Fluid
+example app uses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..runtime.container_runtime import ContainerRuntime
+from ..runtime.datastore import DataStoreRuntime
+
+ROOT_MAP_ID = "root"
+
+
+class DataObject:
+    """A datastore wrapped in the aqueduct conventions: a ``root`` SharedMap
+    plus named helper channels (ref PureDataObject/DataObject)."""
+
+    def __init__(self, datastore: DataStoreRuntime) -> None:
+        self._ds = datastore
+
+    @property
+    def id(self) -> str:
+        return self._ds.id
+
+    @property
+    def root(self):
+        """The root SharedMap (ref DataObject.root)."""
+        return self._ds.get_channel(ROOT_MAP_ID)
+
+    def channel(self, name: str):
+        return self._ds.get_channel(name)
+
+    def create_channel(self, channel_type: str, name: str):
+        return self._ds.create_channel(channel_type, name)
+
+
+class DataObjectFactory:
+    """Creates/loads DataObjects of one named type (ref DataObjectFactory).
+
+    ``initial_channels``: name -> DDS type string, created (with the root
+    map) on first-time initialization. ``initializing_first_time`` runs once
+    on the creating client, before attach (ref initializingFirstTime).
+    """
+
+    def __init__(
+        self,
+        object_type: str,
+        initial_channels: dict[str, str] | None = None,
+        initializing_first_time: Callable[[DataObject], None] | None = None,
+    ) -> None:
+        self.object_type = object_type
+        self.initial_channels = dict(initial_channels or {})
+        self._init_hook = initializing_first_time
+
+    def create(self, runtime: ContainerRuntime, ds_id: str) -> DataObject:
+        ds = runtime.create_datastore(ds_id)
+        ds.create_channel("sharedMap", ROOT_MAP_ID)
+        for name, channel_type in self.initial_channels.items():
+            ds.create_channel(channel_type, name)
+        obj = DataObject(ds)
+        # Sequence the new datastore's layout BEFORE any content op (the
+        # init hook's edits included) so remote replicas instantiate it
+        # first (ref attach ops).
+        runtime.submit_datastore_attach(ds_id)
+        if self._init_hook is not None:
+            self._init_hook(obj)
+        return obj
+
+    def get(self, runtime: ContainerRuntime, ds_id: str) -> DataObject:
+        """Bind to an existing datastore created by this factory elsewhere."""
+        ds = runtime.datastore(ds_id)
+        for name in (ROOT_MAP_ID, *self.initial_channels):
+            ds.get_channel(name)  # raises if the layout doesn't match
+        return DataObject(ds)

@@ -1,0 +1,103 @@
+"""Presence: ephemeral per-session state over signals (never sequenced).
+
+Reference parity: packages/framework/presence* —
+``PresenceDatastoreManagerImpl`` (presence-runtime/src/runtime/
+presenceDatastoreManager.ts:195): per-client latest-value workspaces
+broadcast via ``runtime.submitSignal`` (:343) with a batched outbound queue
+(:473), and a join handshake: a newcomer broadcasts "join" and current
+members respond with their state so the newcomer catches up (protocol.ts).
+Presence data rides signals only — no ops, no sequence numbers, no summary
+footprint.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+class Presence:
+    """One client's view of a presence workspace on a container."""
+
+    def __init__(self, container) -> None:
+        self._container = container
+        self._client_id = container.runtime.client_id
+        # state key -> client id -> value (latest received wins)
+        self._remote: dict[str, dict[str, Any]] = {}
+        self._local: dict[str, Any] = {}
+        self._queue: dict[str, Any] = {}  # batched unflushed local sets
+        self._listeners: list[Callable[[str, str, Any], None]] = []
+        container.on_signal(self._on_signal)
+        # Join handshake: ask current members for their state.
+        container.submit_signal({"presence": "join"})
+
+    # ------------------------------------------------------------------ write
+    def set(self, key: str, value: Any) -> None:
+        """Queue a local state update (batched; ref queued signal sends)."""
+        self._local[key] = value
+        self._queue[key] = value
+
+    def flush(self) -> None:
+        """Broadcast queued updates as ONE signal (ref batch queue :473)."""
+        if not self._queue:
+            return
+        updates, self._queue = self._queue, {}
+        self._container.submit_signal({"presence": "update", "states": updates})
+
+    def set_now(self, key: str, value: Any) -> None:
+        self.set(key, value)
+        self.flush()
+
+    # ------------------------------------------------------------------- read
+    def local(self, key: str) -> Any:
+        return self._local.get(key)
+
+    def states(self, key: str) -> dict[str, Any]:
+        """client id -> latest value, including our own."""
+        out = dict(self._remote.get(key, {}))
+        if key in self._local:
+            out[self._my_id()] = self._local[key]
+        return out
+
+    def remote_states(self, key: str) -> dict[str, Any]:
+        return dict(self._remote.get(key, {}))
+
+    def on_update(self, listener: Callable[[str, str, Any], None]) -> None:
+        """listener(client_id, key, value) per received remote update."""
+        self._listeners.append(listener)
+
+    def _my_id(self) -> str:
+        return self._container.runtime.client_id or self._client_id or ""
+
+    # ---------------------------------------------------------------- inbound
+    def _on_signal(self, sig) -> None:
+        content = sig.contents
+        if not isinstance(content, dict) or "presence" not in content:
+            return
+        if sig.client_id == self._my_id():
+            return
+        kind = content["presence"]
+        if kind == "join":
+            # A newcomer asked for state: respond with ours (ref join
+            # response broadcast). Flush queued values first so the response
+            # is complete.
+            self.flush()
+            if self._local:
+                self._container.submit_signal(
+                    {"presence": "update", "states": dict(self._local)}
+                )
+        elif kind == "update":
+            for key, value in content["states"].items():
+                self._remote.setdefault(key, {})[sig.client_id] = value
+                for listener in self._listeners:
+                    listener(sig.client_id, key, value)
+        elif kind == "leave":
+            self._drop_client(sig.client_id)
+
+    def _drop_client(self, client_id: str) -> None:
+        for per_key in self._remote.values():
+            per_key.pop(client_id, None)
+
+    def leave(self) -> None:
+        """Announce departure (ref disconnect cleanup): peers drop our state."""
+        self._container.submit_signal({"presence": "leave"})
+        self._queue.clear()

@@ -1,0 +1,59 @@
+"""Service-client façade: create/get containers against a service.
+
+Reference parity: packages/service-clients — ``TinyliciousClient`` /
+``AzureClient`` (AzureClient.ts createContainer/getContainer): the
+three-line app entry that hides loader/driver wiring behind a schema, and
+exposes container "services" (audience).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..driver.local_driver import LocalDocumentServiceFactory
+from ..server.local_service import LocalService
+from .fluid_static import ContainerSchema, FluidContainer
+
+
+class Audience:
+    """Joined write clients of a container (ref IServiceAudience)."""
+
+    def __init__(self, container) -> None:
+        self._container = container
+
+    def members(self) -> dict[str, int]:
+        """client id -> join-order short id."""
+        return self._container.runtime.quorum_table
+
+    @property
+    def my_id(self) -> str | None:
+        return self._container.runtime.client_id
+
+
+class LocalServiceClient:
+    """Client for the in-process service (ref TinyliciousClient shape; a
+    networked deployment swaps the DocumentServiceFactory, nothing else)."""
+
+    def __init__(self, service: LocalService | None = None) -> None:
+        self.service = service or LocalService()
+        self._factory = LocalDocumentServiceFactory(self.service)
+        self._counter = 0
+
+    def create_container(
+        self, schema: ContainerSchema, doc_id: str, client_id: str = "creator"
+    ) -> tuple[FluidContainer, dict[str, Any]]:
+        fc = FluidContainer.create_detached(schema, client_id=client_id)
+        fc.attach(doc_id, self._factory, client_id)
+        return fc, self._services(fc)
+
+    def get_container(
+        self, doc_id: str, schema: ContainerSchema, client_id: str | None = None
+    ) -> tuple[FluidContainer, dict[str, Any]]:
+        if client_id is None:
+            self._counter += 1
+            client_id = f"client-{self._counter}"
+        fc = FluidContainer.load(doc_id, self._factory, schema, client_id)
+        return fc, self._services(fc)
+
+    def _services(self, fc: FluidContainer) -> dict[str, Any]:
+        return {"audience": Audience(fc.container)}
