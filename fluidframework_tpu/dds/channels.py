@@ -248,8 +248,12 @@ class SharedStringChannel(Channel):
         return {
             "segments": segs,
             "minSeq": self.backend.min_seq,
+            # Lazily-materialized empty collections are omitted so replicas
+            # that never touched a label summarize identically.
             "intervals": {
-                label: coll.summarize() for label, coll in self._collections.items()
+                label: coll.summarize()
+                for label, coll in self._collections.items()
+                if coll.sequenced or coll._pending
             },
             "opLog": self._op_log.to_json(),
         }

@@ -80,6 +80,22 @@ class StringOpLog:
         self._log: list[tuple[int, str, int, int]] = []  # (seq, kind, pos, len)
 
     def record(self, seq: int, kind: str, pos: int, length: int) -> None:
+        """Append, coalescing contiguous same-seq runs: a pending insert the
+        author's own later edits split acks as several adjacent converged
+        fragments where remote replicas saw one segment — the transform
+        effect is identical (adjacent splits compose), so the log normalizes
+        to the merged form and summaries stay byte-identical across
+        replicas. Inserts record ascending (extend right); removes record
+        back-to-front (extend left)."""
+        if self._log:
+            lseq, lkind, lpos, llen = self._log[-1]
+            if lseq == seq and lkind == kind:
+                if kind == "insert" and lpos + llen == pos:
+                    self._log[-1] = (seq, kind, lpos, llen + length)
+                    return
+                if kind == "remove" and pos + length == lpos:
+                    self._log[-1] = (seq, kind, pos, llen + length)
+                    return
         self._log.append((seq, kind, pos, length))
 
     def transform_from(self, pos: int, ref_seq: int) -> int:

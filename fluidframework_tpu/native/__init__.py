@@ -1,0 +1,13 @@
+"""Native (C++) runtime components.
+
+Where the reference leans on native code for its service hot paths (the
+librdkafka ordering client, SURVEY §2 notes the deli ticket loop as the
+ordering kernel), this package provides C++ equivalents with ctypes
+bindings, built on demand from ``native/`` at the repo root. Everything has
+a pure-Python twin used as the differential oracle; the native form is the
+production path for host-side sequencing around the TPU compute.
+"""
+
+from .sequencer_native import NativeSequencer, native_available
+
+__all__ = ["NativeSequencer", "native_available"]

@@ -209,12 +209,15 @@ class ContainerRuntime:
         if self._outbox is None:
             return
         while True:
-            m = self._outbox.pop_staged()
+            m = self._outbox.peek_staged()
             if m is None:
                 break
+            # Channel rollback first: if a DDS does not support rollback the
+            # op must STAY staged (its effect is still applied locally).
             self._datastores[m.contents["address"]].rollback(
                 m.contents["contents"], m.local_metadata
             )
+            self._outbox.pop_staged()
 
     @property
     def pending_op_count(self) -> int:

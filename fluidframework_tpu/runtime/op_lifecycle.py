@@ -94,6 +94,12 @@ class Outbox:
         self._client_seq += 1
         return self._client_seq
 
+    def peek_staged(self) -> BatchMessage | None:
+        """Newest staged message without removing it (atomic rollback:
+        the channel-level undo must succeed BEFORE the op leaves the
+        outbox, or a failed rollback would orphan applied state)."""
+        return self._staged[-1] if self._staged else None
+
     def pop_staged(self) -> BatchMessage | None:
         """Remove and return the most recently staged message (rollback path,
         ref Outbox/BatchManager rollback for ensureNoDataModelChanges)."""

@@ -1,0 +1,285 @@
+"""The ordering-service lambda pipeline over the ordered log.
+
+Reference parity (SURVEY §2.5, §3.4): stateless fronts write raw client ops
+to the ``rawdeltas`` topic; per-partition micro-services consume:
+
+- ``DeliLambda``  (deli/lambda.ts:245): THE sequencer — tickets raw ops
+  (seq, MSN, nacks) per document and produces to ``deltas``; its state
+  (per-doc sequencer + input offset) checkpoints and restarts losslessly
+  (checkpointManager.ts).
+- ``ScriptoriumLambda`` (scriptorium/lambda.ts:40): batched persistence of
+  sequenced ops into the op store (Mongo analog) — the delta-storage read
+  path serves from here.
+- ``BroadcasterLambda`` (broadcaster/lambda.ts:51): fan-out of sequenced
+  ops to per-document subscribers (Redis pub/sub analog).
+- ``ScribeLambda`` (scribe/lambda.ts:65): watches for summarize ops,
+  materializes + stores snapshots, and emits summary acks back through the
+  ingestion path as service messages.
+
+``PipelineService.pump()`` drives every lambda to quiescence — the
+single-process form of the reference's independently-scaled consumers, with
+the same at-least-once + checkpoint semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..protocol.messages import MessageType, Nack, SequencedMessage, UnsequencedMessage
+from .ordered_log import Topic
+from .sequencer import Sequencer
+
+
+def _make_sequencer(use_native: bool):
+    if use_native:
+        from ..native import NativeSequencer, native_available
+
+        if native_available():
+            return NativeSequencer()
+    return Sequencer()
+
+
+class DeliLambda:
+    """Sequencer lambda for ONE rawdeltas partition (may host many docs)."""
+
+    def __init__(self, rawdeltas: Topic, deltas: Topic, partition: int, use_native: bool = False):
+        self._in = rawdeltas.partition(partition)
+        self._deltas = deltas
+        self._partition = partition
+        self._use_native = use_native
+        self.offset = 0
+        self.sequencers: dict[str, Any] = {}
+        self.nacks: list[tuple[str, Nack]] = []
+
+    def _sequencer(self, doc_id: str):
+        if doc_id not in self.sequencers:
+            self.sequencers[doc_id] = _make_sequencer(self._use_native)
+        return self.sequencers[doc_id]
+
+    def pump(self) -> int:
+        n = 0
+        for rec in self._in.read(self.offset):
+            seqr = self._sequencer(rec.doc_id)
+            kind, payload = rec.payload
+            if kind == "join":
+                out = seqr.join(payload)
+            elif kind == "leave":
+                out = seqr.leave(payload)
+            elif kind == "service":
+                mtype, contents = payload
+                out = seqr.mint_service(mtype, contents)
+            else:  # op
+                out = seqr.ticket(payload)
+                if isinstance(out, Nack):
+                    self.nacks.append((rec.doc_id, out))
+                    out = None
+            if out is not None:
+                self._deltas.produce(rec.doc_id, out)
+            self.offset = rec.offset + 1
+            n += 1
+        return n
+
+    # ------------------------------------------------------------- checkpoint
+    def checkpoint(self) -> dict:
+        """Full restartable state keyed at the input offset (deli
+        checkpointManager: state rides with the Kafka offset)."""
+        docs = {}
+        for doc_id, s in self.sequencers.items():
+            if hasattr(s, "checkpoint_bytes"):
+                docs[doc_id] = {"native": s.checkpoint_bytes().hex()}
+            else:
+                docs[doc_id] = {"py": s.checkpoint()}
+        return {"offset": self.offset, "docs": docs, "useNative": self._use_native}
+
+    @staticmethod
+    def restore(state: dict, rawdeltas: Topic, deltas: Topic, partition: int) -> "DeliLambda":
+        lam = DeliLambda(
+            rawdeltas, deltas, partition, use_native=state.get("useNative", False)
+        )
+        lam.offset = state["offset"]
+        for doc_id, entry in state["docs"].items():
+            if "native" in entry:
+                from ..native import NativeSequencer
+
+                lam.sequencers[doc_id] = NativeSequencer.restore_bytes(
+                    bytes.fromhex(entry["native"])
+                )
+            else:
+                lam.sequencers[doc_id] = Sequencer.restore(entry["py"])
+        return lam
+
+
+class ScriptoriumLambda:
+    """Persists sequenced ops per document with batched inserts."""
+
+    def __init__(self, deltas: Topic, partition: int, batch_size: int = 32):
+        self._in = deltas.partition(partition)
+        self.offset = 0
+        self.batch_size = batch_size
+        self.store: dict[str, list[SequencedMessage]] = {}
+        self._staged: list = []
+        self.insert_batches = 0
+
+    def pump(self) -> int:
+        n = 0
+        for rec in self._in.read(self.offset):
+            self._staged.append((rec.doc_id, rec.payload))
+            if len(self._staged) >= self.batch_size:
+                self._flush()
+            self.offset = rec.offset + 1
+            n += 1
+        self._flush()
+        return n
+
+    def _flush(self) -> None:
+        if not self._staged:
+            return
+        for doc_id, msg in self._staged:
+            self.store.setdefault(doc_id, []).append(msg)
+        self._staged.clear()
+        self.insert_batches += 1
+
+    def ops(self, doc_id: str, from_seq: int, to_seq: int) -> list[SequencedMessage]:
+        return [m for m in self.store.get(doc_id, []) if from_seq <= m.seq <= to_seq]
+
+
+class BroadcasterLambda:
+    """Fans sequenced ops out to per-document subscribers."""
+
+    def __init__(self, deltas: Topic, partition: int):
+        self._in = deltas.partition(partition)
+        self.offset = 0
+        self._subs: dict[str, list[Callable[[SequencedMessage], None]]] = {}
+
+    def subscribe(self, doc_id: str, fn: Callable[[SequencedMessage], None]) -> None:
+        self._subs.setdefault(doc_id, []).append(fn)
+
+    def pump(self) -> int:
+        n = 0
+        for rec in self._in.read(self.offset):
+            for fn in self._subs.get(rec.doc_id, []):
+                fn(rec.payload)
+            self.offset = rec.offset + 1
+            n += 1
+        return n
+
+
+class ScribeLambda:
+    """Summary handling: materialize + store snapshots, ack via ingestion."""
+
+    def __init__(self, deltas: Topic, rawdeltas: Topic, partition: int, uploads: dict):
+        self._in = deltas.partition(partition)
+        self._raw = rawdeltas
+        self.offset = 0
+        self._uploads = uploads  # handle -> summary tree (storage staging)
+        self.snapshots: dict[str, list[tuple[int, dict]]] = {}
+
+    def pump(self) -> int:
+        from ..runtime.summary import materialize
+
+        n = 0
+        for rec in self._in.read(self.offset):
+            msg: SequencedMessage = rec.payload
+            if msg.type == MessageType.SUMMARIZE:
+                handle = msg.contents.get("handle")
+                ref_seq = msg.contents.get("refSeq")
+                tree = self._uploads.pop(handle, None)
+                snaps = self.snapshots.setdefault(rec.doc_id, [])
+                if tree is None:
+                    self._raw.produce(
+                        rec.doc_id,
+                        ("service", (MessageType.SUMMARY_NACK,
+                                     {"handle": handle, "error": "unknown upload handle"})),
+                    )
+                else:
+                    prev = snaps[-1][1] if snaps else None
+                    try:
+                        plain = materialize(tree, prev)
+                        snaps.append((ref_seq, plain))
+                        self._raw.produce(
+                            rec.doc_id,
+                            ("service", (MessageType.SUMMARY_ACK,
+                                         {"handle": handle, "refSeq": ref_seq,
+                                          "summarySeq": msg.seq})),
+                        )
+                    except ValueError as e:
+                        self._raw.produce(
+                            rec.doc_id,
+                            ("service", (MessageType.SUMMARY_NACK,
+                                         {"handle": handle, "error": str(e)})),
+                        )
+            self.offset = rec.offset + 1
+            n += 1
+        return n
+
+
+class PipelineService:
+    """The assembled ordering service: rawdeltas -> deli -> deltas -> fans.
+
+    The document-sharded scale-out axis is the partition count: each
+    partition owns a disjoint document set and its own lambda instances —
+    exactly the reference's per-partition deployment (SURVEY §2.6.2).
+    """
+
+    def __init__(self, n_partitions: int = 4, use_native_sequencer: bool = False):
+        self.rawdeltas = Topic("rawdeltas", n_partitions)
+        self.deltas = Topic("deltas", n_partitions)
+        self.uploads: dict[str, Any] = {}
+        self._upload_counter = 0
+        self.deli = [
+            DeliLambda(self.rawdeltas, self.deltas, p, use_native_sequencer)
+            for p in range(n_partitions)
+        ]
+        self.scriptorium = [
+            ScriptoriumLambda(self.deltas, p) for p in range(n_partitions)
+        ]
+        self.broadcaster = [
+            BroadcasterLambda(self.deltas, p) for p in range(n_partitions)
+        ]
+        self.scribe = [
+            ScribeLambda(self.deltas, self.rawdeltas, p, self.uploads)
+            for p in range(n_partitions)
+        ]
+
+    # -------------------------------------------------------------- front-end
+    def submit_op(self, doc_id: str, msg: UnsequencedMessage) -> None:
+        self.rawdeltas.produce(doc_id, ("op", msg))
+
+    def join(self, doc_id: str, client_id: str) -> None:
+        self.rawdeltas.produce(doc_id, ("join", client_id))
+
+    def leave(self, doc_id: str, client_id: str) -> None:
+        self.rawdeltas.produce(doc_id, ("leave", client_id))
+
+    def upload_summary(self, tree: dict) -> str:
+        self._upload_counter += 1
+        h = f"upload_{self._upload_counter}"
+        self.uploads[h] = tree
+        return h
+
+    def subscribe(self, doc_id: str, fn: Callable[[SequencedMessage], None]) -> None:
+        p = self.deltas.partition_for(doc_id)
+        self.broadcaster[p].subscribe(doc_id, fn)
+
+    # ------------------------------------------------------------------ drive
+    def pump(self, max_rounds: int = 64) -> int:
+        """Run every lambda until the whole pipeline is quiescent (scribe
+        acks feed back into rawdeltas, so multiple rounds may be needed)."""
+        total = 0
+        for _ in range(max_rounds):
+            moved = 0
+            for lam in (*self.deli, *self.scriptorium, *self.broadcaster, *self.scribe):
+                moved += lam.pump()
+            total += moved
+            if moved == 0:
+                return total
+        raise RuntimeError("pipeline failed to quiesce")
+
+    # ------------------------------------------------------------ introspect
+    def ops_of(self, doc_id: str) -> list[SequencedMessage]:
+        p = self.deltas.partition_for(doc_id)
+        return self.scriptorium[p].store.get(doc_id, [])
+
+    def snapshots_of(self, doc_id: str) -> list[tuple[int, dict]]:
+        p = self.deltas.partition_for(doc_id)
+        return self.scribe[p].snapshots.get(doc_id, [])

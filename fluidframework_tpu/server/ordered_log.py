@@ -1,0 +1,66 @@
+"""In-memory ordered log: the Kafka analog the lambda pipeline consumes.
+
+Reference parity: routerlicious' ordering backbone (SURVEY §2.5) — topics
+partitioned by document id, append-only per-partition order, consumer
+offsets checkpointed by each lambda (lambdas-driver/src/partitionManager.ts,
+checkpoint offsets). A networked deployment swaps this for a real broker;
+the pipeline code only sees this interface.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class LogRecord:
+    offset: int
+    doc_id: str
+    payload: Any
+
+
+class Partition:
+    def __init__(self) -> None:
+        self.records: list[LogRecord] = []
+
+    def append(self, doc_id: str, payload: Any) -> int:
+        off = len(self.records)
+        self.records.append(LogRecord(offset=off, doc_id=doc_id, payload=payload))
+        return off
+
+    def read(self, from_offset: int, max_records: int = 1 << 30) -> list[LogRecord]:
+        return self.records[from_offset : from_offset + max_records]
+
+    @property
+    def head(self) -> int:
+        return len(self.records)
+
+
+@dataclass
+class Topic:
+    """A named topic with a fixed partition count; records route by document
+    id hash (kafka partition-by-key, lambdas-driver routing)."""
+
+    name: str
+    n_partitions: int = 4
+    partitions: dict[int, Partition] = field(default_factory=dict)
+
+    def partition_for(self, doc_id: str) -> int:
+        return sum(doc_id.encode()) % self.n_partitions
+
+    def partition(self, idx: int) -> Partition:
+        if idx not in self.partitions:
+            self.partitions[idx] = Partition()
+        return self.partitions[idx]
+
+    def produce(self, doc_id: str, payload: Any) -> tuple[int, int]:
+        p = self.partition_for(doc_id)
+        return p, self.partition(p).append(doc_id, payload)
+
+    def lag(self, offsets: dict[int, int]) -> int:
+        """Unconsumed records across partitions given consumer offsets."""
+        return sum(
+            self.partition(i).head - offsets.get(i, 0)
+            for i in range(self.n_partitions)
+        )

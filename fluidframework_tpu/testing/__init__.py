@@ -1,0 +1,20 @@
+"""Test infrastructure (SURVEY §4): the DDS fuzz harness and stochastic
+utilities — the reference's @fluid-private/test-dds-utils +
+stochastic-test-utils, the central convergence-correctness tooling.
+"""
+
+from .fuzz import (
+    DDSFuzzModel,
+    FuzzClient,
+    FuzzFailure,
+    run_fuzz_seed,
+    run_fuzz_suite,
+)
+
+__all__ = [
+    "DDSFuzzModel",
+    "FuzzClient",
+    "FuzzFailure",
+    "run_fuzz_seed",
+    "run_fuzz_suite",
+]

@@ -1,0 +1,171 @@
+"""DDS fuzz suite over the generic harness: map, string, and tree models.
+
+Mirrors the reference's createDDSFuzzSuite usage per DDS (SURVEY §4.2);
+the harness itself (meta-ops, minification, replay) is exercised through
+these models plus a deliberately-broken model proving failures surface
+and minify.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.tree.changeset import (
+    make_insert,
+    make_remove,
+    make_set_value,
+)
+from fluidframework_tpu.dds.tree.schema import leaf
+from fluidframework_tpu.testing import DDSFuzzModel, FuzzFailure, run_fuzz_suite
+from fluidframework_tpu.testing.fuzz import minimize, run_fuzz_seed
+
+
+# --------------------------------------------------------------------------
+# models
+# --------------------------------------------------------------------------
+
+def map_generate(rng: random.Random, channel) -> dict:
+    kind = rng.choices(["set", "delete", "clear"], [8, 3, 1])[0]
+    if kind == "set":
+        return {"t": "set", "k": f"k{rng.randrange(6)}", "v": rng.randrange(100)}
+    if kind == "delete":
+        return {"t": "delete", "k": f"k{rng.randrange(6)}"}
+    return {"t": "clear"}
+
+
+def map_reduce(channel, op: dict) -> None:
+    if op["t"] == "set":
+        channel.set(op["k"], op["v"])
+    elif op["t"] == "delete":
+        channel.delete(op["k"])
+    else:
+        channel.clear()
+
+
+MAP_MODEL = DDSFuzzModel(name="sharedMap", channel_type="sharedMap",
+                         generate=map_generate, reduce=map_reduce)
+
+
+def string_generate(rng: random.Random, channel) -> dict | None:
+    n = len(channel.text)
+    kind = rng.choices(["insert", "remove", "annotate", "interval"], [8, 4, 2, 2])[0]
+    if kind == "insert":
+        return {"t": "insert", "pos": rng.randint(0, n),
+                "text": rng.choice("abcxyz") * rng.randint(1, 3)}
+    if n == 0:
+        return None
+    if kind == "remove":
+        p1 = rng.randrange(n)
+        return {"t": "remove", "p1": p1, "p2": rng.randint(p1 + 1, min(n, p1 + 4))}
+    if kind == "annotate":
+        p1 = rng.randrange(n)
+        return {"t": "annotate", "p1": p1, "p2": rng.randint(p1 + 1, n),
+                "prop": rng.randrange(3), "val": rng.randrange(10)}
+    p1 = rng.randrange(n)
+    return {"t": "interval", "p1": p1, "p2": rng.randint(p1, n - 1)}
+
+
+def string_reduce(channel, op: dict) -> None:
+    if op["t"] == "insert":
+        channel.insert_text(op["pos"], op["text"])
+    elif op["t"] == "remove":
+        channel.remove_range(op["p1"], op["p2"])
+    elif op["t"] == "annotate":
+        channel.annotate_range(op["p1"], op["p2"], op["prop"], op["val"])
+    else:
+        channel.get_interval_collection("f").add(op["p1"], op["p2"])
+
+
+def string_check(a, b) -> None:
+    assert a.text == b.text, f"text divergence: {a.text!r} != {b.text!r}"
+    assert a.summarize() == b.summarize()
+    ia = {iv.interval_id: (iv.start, iv.end) for iv in a.get_interval_collection("f")}
+    ib = {iv.interval_id: (iv.start, iv.end) for iv in b.get_interval_collection("f")}
+    assert ia == ib, f"interval divergence: {ia} != {ib}"
+
+
+STRING_MODEL = DDSFuzzModel(name="sharedString", channel_type="sharedString",
+                            generate=string_generate, reduce=string_reduce,
+                            check_consistent=string_check)
+
+
+def tree_generate(rng: random.Random, channel) -> dict | None:
+    n = len(channel.forest.root_field)
+    kind = rng.choices(["ins", "rm", "set"], [6, 3, 3])[0]
+    if kind == "ins" or n == 0:
+        return {"t": "ins", "i": rng.randint(0, n), "v": rng.randrange(1000)}
+    if kind == "rm":
+        i = rng.randrange(n)
+        return {"t": "rm", "i": i, "n": rng.randint(1, min(2, n - i))}
+    return {"t": "set", "i": rng.randrange(n), "v": rng.randrange(1000)}
+
+
+def tree_reduce(channel, op: dict) -> None:
+    if op["t"] == "ins":
+        channel.submit_change(make_insert([], "", op["i"], [leaf(op["v"])]))
+    elif op["t"] == "rm":
+        channel.submit_change(make_remove([], "", op["i"], op["n"]))
+    else:
+        channel.submit_change(make_set_value([("", op["i"])], op["v"]))
+
+
+def tree_check(a, b) -> None:
+    assert a.forest.to_json() == b.forest.to_json()
+
+
+TREE_MODEL = DDSFuzzModel(name="sharedTree", channel_type="sharedTree",
+                          generate=tree_generate, reduce=tree_reduce,
+                          check_consistent=tree_check)
+
+
+# --------------------------------------------------------------------------
+# suites
+# --------------------------------------------------------------------------
+
+def test_fuzz_shared_map():
+    run_fuzz_suite(MAP_MODEL, range(6), steps=100)
+
+
+def test_fuzz_shared_string():
+    run_fuzz_suite(STRING_MODEL, range(6), steps=100)
+
+
+def test_fuzz_shared_tree():
+    run_fuzz_suite(TREE_MODEL, range(6), steps=100)
+
+
+# --------------------------------------------------------------------------
+# harness machinery
+# --------------------------------------------------------------------------
+
+def test_broken_model_fails_and_minifies():
+    """A model whose reducer uses client-local randomness diverges; the
+    harness must catch it, and minification must shrink the trace while
+    still reproducing (ddsFuzzHarness minification contract)."""
+    import itertools
+
+    counter = itertools.count()
+
+    def broken_reduce(channel, op):
+        # Applies a DIFFERENT value than the op says (divergent local echo).
+        channel.set(op["k"], next(counter))
+
+    broken = DDSFuzzModel(
+        name="broken", channel_type="sharedMap",
+        generate=map_generate, reduce=broken_reduce,
+    )
+    with pytest.raises(FuzzFailure) as exc_info:
+        run_fuzz_seed(broken, seed=0, steps=40)
+    failure = exc_info.value
+    reduced = minimize(broken, failure)
+    assert 0 < len(reduced) <= len(failure.trace)
+
+
+def test_replay_is_deterministic():
+    """A recorded trace replays to the same end state (failure-file replay)."""
+    trace: list = []
+    run_fuzz_seed(STRING_MODEL, seed=3, steps=60, trace=trace)
+    # Re-running the recorded trace must succeed identically.
+    run_fuzz_seed(STRING_MODEL, seed=3, trace=list(trace), replay=True)

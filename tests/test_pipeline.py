@@ -1,0 +1,151 @@
+"""Ordering-pipeline tests: deli over the ordered log, scriptorium
+persistence, broadcaster fan-out, scribe acks, partition sharding, and
+deli checkpoint-restart.
+
+Mirrors the reference's routerlicious lambda unit tests (SURVEY §4.8) run
+against in-memory kafka/mongo/redis fakes."""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.protocol.messages import MessageType, UnsequencedMessage
+from fluidframework_tpu.runtime.summary import blob, tree
+from fluidframework_tpu.server.lambdas import DeliLambda, PipelineService
+from fluidframework_tpu.server.local_service import LocalService
+
+
+def op(cid: str, cseq: int, rseq: int, n: int) -> UnsequencedMessage:
+    return UnsequencedMessage(
+        client_id=cid, client_seq=cseq, ref_seq=rseq,
+        type=MessageType.OP, contents={"n": n},
+    )
+
+
+def test_pipeline_sequences_and_persists():
+    svc = PipelineService()
+    svc.join("docA", "alice")
+    svc.pump()
+    got = []
+    svc.subscribe("docA", lambda m: got.append(m))
+    for i in range(1, 6):
+        svc.submit_op("docA", op("alice", i, 1, i))
+    svc.pump()
+    # scriptorium persisted everything in order (join + 5 ops)
+    ops = svc.ops_of("docA")
+    assert [m.seq for m in ops] == list(range(1, 7))
+    # broadcaster delivered the ops produced after subscription
+    assert [m.contents["n"] for m in got if m.type == MessageType.OP] == [1, 2, 3, 4, 5]
+
+
+def test_pipeline_nacks_and_isolation_across_docs():
+    svc = PipelineService()
+    svc.join("docA", "alice")
+    svc.join("docB", "bob")
+    svc.pump()
+    svc.submit_op("docA", op("alice", 1, 1, 10))
+    svc.submit_op("docB", op("bob", 1, 1, 20))
+    svc.submit_op("docA", op("ghost", 1, 1, 0))  # unjoined -> nack
+    svc.pump()
+    assert [m.seq for m in svc.ops_of("docA")] == [1, 2]  # independent seq spaces
+    assert [m.seq for m in svc.ops_of("docB")] == [1, 2]
+    all_nacks = [n for lam in svc.deli for _, n in lam.nacks]
+    assert len(all_nacks) == 1 and all_nacks[0].reason == "client not joined"
+
+
+def test_pipeline_matches_local_service_sequencing():
+    """The pipeline's deli and the in-process LocalService sequencer must
+    assign identical (seq, minSeq) streams for identical inputs."""
+    pipeline = PipelineService()
+    local = LocalService()
+    doc = local.document("d")
+
+    pipeline.join("d", "a")
+    local_join_a = doc.sequencer.join("a")
+    pipeline.join("d", "b")
+    local_join_b = doc.sequencer.join("b")
+    pipeline.pump()
+    schedule = [("a", 1, 2, 1), ("b", 1, 2, 2), ("a", 2, 3, 3), ("b", 2, 4, 4)]
+    for cid, cseq, rseq, n in schedule:
+        pipeline.submit_op("d", op(cid, cseq, rseq, n))
+        doc.sequencer.ticket(op(cid, cseq, rseq, n))
+    pipeline.pump()
+    pipe_ops = [(m.seq, m.min_seq, m.client_id) for m in pipeline.ops_of("d")]
+    local_ops = [(m.seq, m.min_seq, m.client_id) for m in doc.sequencer.log]
+    assert pipe_ops == local_ops
+
+
+def test_partition_sharding_routes_consistently():
+    svc = PipelineService(n_partitions=3)
+    docs = [f"doc{i}" for i in range(12)]
+    for d in docs:
+        svc.join(d, "c")
+    svc.pump()
+    for d in docs:
+        svc.submit_op(d, op("c", 1, 1, 1))
+    svc.pump()
+    for d in docs:
+        assert [m.seq for m in svc.ops_of(d)] == [1, 2]
+    # every partition hosts a disjoint, stable doc subset
+    owners = {
+        d: [i for i, lam in enumerate(svc.deli) if d in lam.sequencers] for d in docs
+    }
+    assert all(len(v) == 1 for v in owners.values())
+
+
+def test_scribe_ack_roundtrip_through_pipeline():
+    svc = PipelineService()
+    svc.join("d", "a")
+    svc.pump()
+    h = svc.upload_summary(tree({"runtime": blob({"state": 1}), "protocol": blob({})}))
+    svc.submit_op(
+        "d",
+        UnsequencedMessage(
+            client_id="a", client_seq=1, ref_seq=1,
+            type=MessageType.SUMMARIZE, contents={"handle": h, "refSeq": 1},
+        ),
+    )
+    svc.pump()  # summarize sequences; scribe stores + acks; ack sequences
+    snaps = svc.snapshots_of("d")
+    assert snaps == [(1, {"runtime": {"state": 1}, "protocol": {}})]
+    acks = [m for m in svc.ops_of("d") if m.type == MessageType.SUMMARY_ACK]
+    assert len(acks) == 1 and acks[0].contents["handle"] == h
+    assert acks[0].client_id == "__service__"
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_deli_checkpoint_restart(use_native):
+    """Kill deli mid-stream, restore from its checkpoint, replay the rest of
+    the partition: output identical to an uninterrupted run (deli
+    checkpoint-restart on log offsets)."""
+    if use_native:
+        from fluidframework_tpu.native import native_available
+
+        if not native_available():
+            pytest.skip("native unavailable")
+
+    def feed(svc: PipelineService, upto: int):
+        svc.join("d", "a")
+        for i in range(1, upto + 1):
+            svc.submit_op("d", op("a", i, 1, i))
+
+    # Uninterrupted reference run.
+    ref = PipelineService(use_native_sequencer=use_native)
+    feed(ref, 10)
+    ref.pump()
+    want = [(m.seq, m.min_seq, m.type) for m in ref.ops_of("d")]
+
+    # Interrupted run: process 5, checkpoint, crash, restore, process rest.
+    svc = PipelineService(use_native_sequencer=use_native)
+    svc.join("d", "a")
+    for i in range(1, 6):
+        svc.submit_op("d", op("a", i, 1, i))
+    svc.pump()
+    p = svc.rawdeltas.partition_for("d")
+    state = svc.deli[p].checkpoint()
+    svc.deli[p] = DeliLambda.restore(state, svc.rawdeltas, svc.deltas, p)
+    for i in range(6, 11):
+        svc.submit_op("d", op("a", i, 1, i))
+    svc.pump()
+    got = [(m.seq, m.min_seq, m.type) for m in svc.ops_of("d")]
+    assert got == want
