@@ -412,11 +412,19 @@ SharedMapFactory = ChannelTypeFactory(SharedMapChannel)
 
 
 def default_registry() -> dict[str, Any]:
-    """Type string -> factory map (ref ISharedObjectRegistry)."""
+    """Type string -> factory map covering the full DDS family (ref
+    ISharedObjectRegistry + the fluid-framework re-export surface)."""
+    from .extras import EXTRA_DDS_FACTORIES
+    from .shared_matrix import SharedMatrixFactory
+    from .small import SMALL_DDS_FACTORIES
     from .tree import SharedTreeFactory
 
-    return {
+    out: dict[str, Any] = {
         SharedStringFactory.channel_type: SharedStringFactory,
         SharedMapFactory.channel_type: SharedMapFactory,
         SharedTreeFactory.channel_type: SharedTreeFactory,
     }
+    out.update(SMALL_DDS_FACTORIES)
+    out.update(EXTRA_DDS_FACTORIES)
+    out[SharedMatrixFactory.channel_type] = SharedMatrixFactory
+    return out

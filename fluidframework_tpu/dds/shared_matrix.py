@@ -316,3 +316,354 @@ class SharedMatrix:
         rows = self.rows.handles(ALL_ACKED, self.short_client)
         cols = self.cols.handles(ALL_ACKED, self.short_client)
         return [[self.cells.get((rh, ch)) for ch in cols] for rh in rows]
+
+
+# ---------------------------------------------------------------------------
+# Channel-boundary form
+# ---------------------------------------------------------------------------
+
+from ..runtime.channel import Channel, MessageCollection  # noqa: E402
+
+
+class SharedMatrixChannel(Channel):
+    """SharedMatrix over the channel boundary (ref SharedMatrixClass,
+    matrix/src/matrix.ts): two permutation-vector merge-trees (rows/cols)
+    plus a sparse consensus cell store with LWW or switchable FWW conflict
+    policy. Reconnect regenerates row/col ops through the permutation trees
+    (regeneratePendingOp) and re-anchors pending cell writes by handle.
+
+    Local metadata per pending op:
+      {"axis": "rows"|"cols", "localSeq": n}   for insert/remove ops
+      {"cell": [rh, ch]}                       for set ops
+    """
+
+    channel_type = "sharedMatrix"
+
+    def __init__(self, channel_id: str) -> None:
+        super().__init__(channel_id)
+        self.rows = _Perm()
+        self.cols = _Perm()
+        self.cells: dict[tuple[int, int], Any] = {}
+        self._last_write: dict[tuple[int, int], tuple[int, str]] = {}
+        self._fww = False
+        self._pending_cells: dict[tuple[int, int], list[Any]] = {}
+        self._local_seq = 0
+        # Metadata dicts minted for in-flight set ops: shared by reference
+        # with the PendingStateManager, remapped in place when provisional
+        # handles become real (insert ack).
+        self._minted_md: list[dict] = []
+
+    def _next_ls(self) -> int:
+        self._local_seq += 1
+        return self._local_seq
+
+    def _perm(self, axis: str) -> _Perm:
+        return self.rows if axis == "rows" else self.cols
+
+    # ------------------------------------------------------------ local edits
+    def switch_to_fww(self) -> None:
+        self._fww = True
+
+    def _insert(self, axis: str, pos: int, count: int) -> None:
+        assert count > 0
+        ls = self._next_ls()
+        perm = self._perm(axis)
+        perm.tree.apply_insert(
+            pos, perm.alloc_prov(count), encode_stamp(-1, ls),
+            perm.tree.local_client, ALL_ACKED,
+        )
+        op = "insertRows" if axis == "rows" else "insertCols"
+        self.submit_local_message(
+            {"type": op, "pos": pos, "count": count},
+            {"axis": axis, "localSeq": ls},
+        )
+
+    def _remove(self, axis: str, pos: int, count: int) -> None:
+        ls = self._next_ls()
+        perm = self._perm(axis)
+        perm.tree.apply_remove(
+            pos, pos + count, encode_stamp(-1, ls), perm.tree.local_client, ALL_ACKED
+        )
+        op = "removeRows" if axis == "rows" else "removeCols"
+        self.submit_local_message(
+            {"type": op, "pos": pos, "count": count},
+            {"axis": axis, "localSeq": ls},
+        )
+
+    def insert_rows(self, pos: int, count: int) -> None:
+        self._insert("rows", pos, count)
+
+    def insert_cols(self, pos: int, count: int) -> None:
+        self._insert("cols", pos, count)
+
+    def remove_rows(self, pos: int, count: int) -> None:
+        self._remove("rows", pos, count)
+
+    def remove_cols(self, pos: int, count: int) -> None:
+        self._remove("cols", pos, count)
+
+    def set_cell(self, row: int, col: int, value: Any) -> None:
+        rh = self.rows.handle_at(row, ALL_ACKED, self.rows.tree.local_client)
+        ch = self.cols.handle_at(col, ALL_ACKED, self.cols.tree.local_client)
+        self._pending_cells.setdefault((rh, ch), []).append(value)
+        md = {"cell": [rh, ch]}
+        self._minted_md.append(md)
+        self.submit_local_message(
+            {"type": "set", "row": row, "col": col, "value": value, "fwwMode": self._fww},
+            md,
+        )
+
+    # ---------------------------------------------------------------- inbound
+    def _remap_cells(self, mapping: dict[int, int], axis: int) -> None:
+        if not mapping:
+            return
+        for store in (self.cells, self._last_write, self._pending_cells):
+            for key in [k for k in store if k[axis] in mapping]:
+                nk = (mapping[key[0]], key[1]) if axis == 0 else (key[0], mapping[key[1]])
+                store[nk] = store.pop(key)
+
+    def _should_set(self, rh: int, ch: int, seq: int, ref_seq: int, client: str) -> bool:
+        if not self._fww:
+            return True  # LWW: sequence order decides
+        last = self._last_write.get((rh, ch))
+        return last is None or last[1] == client or ref_seq >= last[0]
+
+    def process_messages(self, collection: MessageCollection) -> None:
+        env = collection.envelope
+        for m in collection.messages:
+            c = m.contents
+            if c.get("fwwMode"):
+                self._fww = True  # one-way switch broadcast (matrix.ts:210)
+            if m.local:
+                self._ack(c, m.local_metadata, env)
+            else:
+                self._apply_remote(c, env)
+        for perm in (self.rows, self.cols):
+            perm.tree.update_min_seq(env.min_seq)
+
+    def _ack(self, c: dict, md: dict, env) -> None:
+        if "axis" in md:
+            perm = self._perm(md["axis"])
+            perm.tree.ack(md["localSeq"], env.seq)
+            if c["type"].startswith("insert"):
+                mapping = perm.remap_acked(env.seq)
+                self._remap_cells(mapping, 0 if md["axis"] == "rows" else 1)
+                # Re-key pending metadata is unnecessary: channel metadata
+                # holds handle VALUES only for cell ops, remapped above via
+                # _pending_cells; later acks look up by (rh, ch) post-remap.
+                self._md_remap(mapping, 0 if md["axis"] == "rows" else 1)
+        else:
+            rh, ch = md["cell"]
+            if md in self._minted_md:
+                self._minted_md.remove(md)
+            pending = self._pending_cells.get((rh, ch))
+            assert pending, "cell ack without pending write"
+            value = pending.pop(0)
+            if not pending:
+                del self._pending_cells[(rh, ch)]
+            if self._should_set(rh, ch, env.seq, env.ref_seq, env.client_id):
+                self.cells[(rh, ch)] = value
+                self._last_write[(rh, ch)] = (env.seq, env.client_id)
+
+    def _md_remap(self, mapping: dict[int, int], axis: int) -> None:
+        """In-flight set-op metadata references provisional handles; the
+        dicts are shared by reference with the PendingStateManager, so remap
+        them in place."""
+        for md in self._minted_md:
+            rh, ch = md["cell"]
+            if axis == 0 and rh in mapping:
+                md["cell"][0] = mapping[rh]
+            elif axis == 1 and ch in mapping:
+                md["cell"][1] = mapping[ch]
+
+    def _apply_remote(self, c: dict, env) -> None:
+        client = self._connection.short_id(env.client_id)
+        kind = c["type"]
+        key = env.seq
+        if kind == "insertRows":
+            self.rows.tree.apply_insert(
+                c["pos"], self.rows.alloc(c["count"]), key, client, env.ref_seq
+            )
+        elif kind == "insertCols":
+            self.cols.tree.apply_insert(
+                c["pos"], self.cols.alloc(c["count"]), key, client, env.ref_seq
+            )
+        elif kind == "removeRows":
+            self.rows.tree.apply_remove(
+                c["pos"], c["pos"] + c["count"], key, client, env.ref_seq
+            )
+        elif kind == "removeCols":
+            self.cols.tree.apply_remove(
+                c["pos"], c["pos"] + c["count"], key, client, env.ref_seq
+            )
+        elif kind == "set":
+            rh = self.rows.handle_at(c["row"], env.ref_seq, client)
+            ch = self.cols.handle_at(c["col"], env.ref_seq, client)
+            if self._should_set(rh, ch, env.seq, env.ref_seq, env.client_id):
+                self.cells[(rh, ch)] = c["value"]
+                self._last_write[(rh, ch)] = (env.seq, env.client_id)
+        else:
+            raise ValueError(f"unknown matrix op {kind!r}")
+
+    # ----------------------------------------------------- reconnect / stash
+    def resubmit(self, contents: Any, local_metadata: Any, squash: bool = False) -> None:
+        if "axis" in local_metadata:
+            axis = local_metadata["axis"]
+            perm = self._perm(axis)
+            regenerated = perm.tree.regenerate_pending(
+                local_metadata["localSeq"], self._next_ls, squash=squash
+            )
+            for fresh_ls, op in regenerated:
+                if op["type"] == 0:  # merge-tree insert -> matrix insert
+                    out = {
+                        "type": "insertRows" if axis == "rows" else "insertCols",
+                        "pos": op["pos1"],
+                        "count": len(op["seg"]),
+                    }
+                else:  # remove
+                    out = {
+                        "type": "removeRows" if axis == "rows" else "removeCols",
+                        "pos": op["pos1"],
+                        "count": op["pos2"] - op["pos1"],
+                    }
+                self.submit_local_message(out, {"axis": axis, "localSeq": fresh_ls})
+            return
+        # Cell set: re-anchor by handle in the current local view; a write
+        # into a removed row/col drops (reference setCell resubmit).
+        rh, ch = local_metadata["cell"]
+        rows = self.rows.handles(ALL_ACKED, self.rows.tree.local_client)
+        cols = self.cols.handles(ALL_ACKED, self.cols.tree.local_client)
+        if rh not in rows or ch not in cols:
+            pending = self._pending_cells.get((rh, ch))
+            if pending:
+                pending.pop(0)
+                if not pending:
+                    del self._pending_cells[(rh, ch)]
+            return
+        md = {"cell": [rh, ch]}
+        self._minted_md.append(md)
+        self.submit_local_message(
+            {
+                "type": "set",
+                "row": rows.index(rh),
+                "col": cols.index(ch),
+                "value": contents["value"],
+                "fwwMode": self._fww,
+            },
+            md,
+        )
+
+    def apply_stashed(self, contents: Any) -> Any:
+        c = contents
+        kind = c["type"]
+        if kind in ("insertRows", "insertCols", "removeRows", "removeCols"):
+            axis = "rows" if "Rows" in kind else "cols"
+            perm = self._perm(axis)
+            ls = self._next_ls()
+            if kind.startswith("insert"):
+                perm.tree.apply_insert(
+                    c["pos"], perm.alloc_prov(c["count"]),
+                    encode_stamp(-1, ls), perm.tree.local_client, ALL_ACKED,
+                )
+            else:
+                perm.tree.apply_remove(
+                    c["pos"], c["pos"] + c["count"],
+                    encode_stamp(-1, ls), perm.tree.local_client, ALL_ACKED,
+                )
+            return {"axis": axis, "localSeq": ls}
+        rh = self.rows.handle_at(c["row"], ALL_ACKED, self.rows.tree.local_client)
+        ch = self.cols.handle_at(c["col"], ALL_ACKED, self.cols.tree.local_client)
+        self._pending_cells.setdefault((rh, ch), []).append(c["value"])
+        md = {"cell": [rh, ch]}
+        self._minted_md.append(md)
+        return md
+
+    # ------------------------------------------------------------ checkpoint
+    def summarize(self) -> dict[str, Any]:
+        for perm in (self.rows, self.cols):
+            for seg in perm.tree.segments:
+                if not acked_key(seg.ins_key) or any(
+                    not acked_key(k) for k, _c in seg.removes
+                ):
+                    raise RuntimeError("summarize with pending matrix state")
+        if self._pending_cells:
+            raise RuntimeError("summarize with pending matrix cell writes")
+
+        def perm_summary(perm: _Perm) -> dict:
+            return {
+                "segments": [
+                    {
+                        "handles": [ord(c) for c in s.text],
+                        "ins": [s.ins_key, s.ins_client],
+                        "removes": [[k, c] for k, c in s.removes],
+                    }
+                    for s in perm.tree.segments
+                ],
+                "minSeq": perm.tree.min_seq,
+                "nextHandle": perm.next_handle,
+            }
+
+        return {
+            "rows": perm_summary(self.rows),
+            "cols": perm_summary(self.cols),
+            "cells": [[list(k), v] for k, v in self.cells.items()],
+            "lastWrite": [[list(k), list(v)] for k, v in self._last_write.items()],
+            "fww": self._fww,
+        }
+
+    def load(self, summary: dict[str, Any]) -> None:
+        from .mergetree_ref import Segment
+
+        def load_perm(perm: _Perm, data: dict) -> None:
+            perm.tree.min_seq = data["minSeq"]
+            perm.next_handle = data["nextHandle"]
+            perm.tree.segments = [
+                Segment(
+                    text="".join(chr(h) for h in e["handles"]),
+                    ins_key=e["ins"][0],
+                    ins_client=e["ins"][1],
+                    removes=[(k, c) for k, c in e["removes"]],
+                )
+                for e in data["segments"]
+            ]
+
+        load_perm(self.rows, summary["rows"])
+        load_perm(self.cols, summary["cols"])
+        self.cells = {tuple(k): v for k, v in summary["cells"]}
+        self._last_write = {tuple(k): tuple(v) for k, v in summary["lastWrite"]}
+        self._fww = summary["fww"]
+
+    # ------------------------------------------------------------------ views
+    @property
+    def row_count(self) -> int:
+        return len(self.rows.handles(ALL_ACKED, self.rows.tree.local_client))
+
+    @property
+    def col_count(self) -> int:
+        return len(self.cols.handles(ALL_ACKED, self.cols.tree.local_client))
+
+    def get_cell(self, row: int, col: int) -> Any:
+        rh = self.rows.handle_at(row, ALL_ACKED, self.rows.tree.local_client)
+        ch = self.cols.handle_at(col, ALL_ACKED, self.cols.tree.local_client)
+        pending = self._pending_cells.get((rh, ch))
+        if pending:
+            return pending[-1]
+        return self.cells.get((rh, ch))
+
+    def to_grid(self) -> list[list[Any]]:
+        rows = self.rows.handles(ALL_ACKED, self.rows.tree.local_client)
+        cols = self.cols.handles(ALL_ACKED, self.cols.tree.local_client)
+        return [[self.cells.get((rh, ch)) for ch in cols] for rh in rows]
+
+
+from ..protocol.stamps import acked as acked_key  # noqa: E402
+
+
+class _MatrixFactory:
+    channel_type = SharedMatrixChannel.channel_type
+
+    def create(self, channel_id: str) -> SharedMatrixChannel:
+        return SharedMatrixChannel(channel_id)
+
+
+SharedMatrixFactory = _MatrixFactory()

@@ -1,0 +1,301 @@
+"""SharedDirectory, Ink, SharedSummaryBlock, and SharedMatrixChannel tests:
+convergence, optimistic overlays, reconnect/stash, summaries — plus fuzz
+models through the generic harness."""
+
+from __future__ import annotations
+
+import random
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+from fluidframework_tpu.testing import DDSFuzzModel, run_fuzz_suite
+
+
+def make_container(doc, name, channels, stash=None):
+    c = ContainerRuntime(default_registry(), container_id=name)
+    ds = c.create_datastore("root")
+    for ctype, cid in channels:
+        ds.create_channel(ctype, cid)
+    c.connect(doc, name, stash=stash)
+    return c
+
+
+def pair(channels):
+    svc = LocalService()
+    doc = svc.document("d")
+    a = make_container(doc, "A", channels)
+    b = make_container(doc, "B", channels)
+    doc.process_all()
+    return doc, a, b
+
+
+def ch(c, cid="x"):
+    return c.datastore("root").get_channel(cid)
+
+
+# --------------------------------------------------------------------------
+# SharedDirectory
+# --------------------------------------------------------------------------
+
+def test_directory_nested_set_get_converge():
+    doc, a, b = pair([("sharedDirectory", "x")])
+    ch(a).set("", "top", 1)
+    ch(a).set("users/alice", "age", 30)
+    a.flush()
+    ch(b).set("users/bob", "age", 25)
+    b.flush()
+    doc.process_all()
+    for c in (a, b):
+        assert ch(c).get("", "top") == 1
+        assert ch(c).get("users/alice", "age") == 30
+        assert ch(c).get("users/bob", "age") == 25
+        assert ch(c).subdirectories("users") == {"alice", "bob"}
+
+
+def test_directory_delete_subdir_drops_subtree():
+    doc, a, b = pair([("sharedDirectory", "x")])
+    ch(a).set("s/deep/deeper", "k", 1)
+    a.flush()
+    doc.process_all()
+    ch(b).delete_subdirectory("s/deep")
+    b.flush()
+    # Concurrent write into the subtree being deleted: delete sequenced
+    # first wins; the set recreates the path (LWW by sequence order).
+    ch(a).set("s/deep", "k2", 2)
+    a.flush()
+    doc.process_all()
+    assert ch(a).root == ch(b).root
+    assert ch(a).get("s/deep", "k2") == 2
+    assert ch(a).get("s/deep/deeper", "k") is None
+
+
+def test_directory_optimistic_overlay_and_summary():
+    doc, a, b = pair([("sharedDirectory", "x")])
+    ch(a).set("p", "k", "pending")
+    assert ch(a).get("p", "k") == "pending"  # before sequencing
+    assert ch(b).get("p", "k") is None
+    a.flush()
+    doc.process_all()
+    s = ch(a).summarize()
+    from fluidframework_tpu.dds.extras import SharedDirectory
+
+    fresh = SharedDirectory("x")
+    fresh.load(s)
+    assert fresh.get("p", "k") == "pending"
+
+
+# --------------------------------------------------------------------------
+# Ink
+# --------------------------------------------------------------------------
+
+def test_ink_strokes_converge():
+    doc, a, b = pair([("ink", "x")])
+    sid = ch(a).create_stroke({"color": "red"})
+    ch(a).append_point(sid, 0.0, 0.0)
+    ch(a).append_point(sid, 1.0, 1.0)
+    a.flush()
+    doc.process_all()
+    sb = ch(b).get_stroke(sid)
+    assert sb["pen"] == {"color": "red"}
+    assert sb["points"] == [(0.0, 0.0, 0.0, 0.5), (1.0, 1.0, 0.0, 0.5)]
+    # Optimistic: local pending points visible immediately.
+    sid2 = ch(b).create_stroke()
+    ch(b).append_point(sid2, 5.0, 5.0)
+    assert len(ch(b).get_stroke(sid2)["points"]) == 1
+    b.flush()
+    doc.process_all()
+    assert ch(a).stroke_ids() == ch(b).stroke_ids() == {sid, sid2}
+    assert ch(a).summarize() == ch(b).summarize()
+
+
+# --------------------------------------------------------------------------
+# SharedSummaryBlock
+# --------------------------------------------------------------------------
+
+def test_summary_block_travels_only_via_summary():
+    doc, a, b = pair([("sharedSummaryBlock", "x")])
+    ch(a).set("note", "local only")
+    a.flush()
+    doc.process_all()
+    assert ch(b).get("note") is None  # no ops ever
+    from fluidframework_tpu.dds.extras import SharedSummaryBlock
+
+    fresh = SharedSummaryBlock("x")
+    fresh.load(ch(a).summarize())
+    assert fresh.get("note") == "local only"
+
+
+# --------------------------------------------------------------------------
+# SharedMatrixChannel
+# --------------------------------------------------------------------------
+
+def test_matrix_channel_converges():
+    doc, a, b = pair([("sharedMatrix", "x")])
+    ch(a).insert_rows(0, 2)
+    ch(a).insert_cols(0, 2)
+    a.flush()
+    doc.process_all()
+    ch(a).set_cell(0, 0, "a00")
+    a.flush()
+    ch(b).set_cell(1, 1, "b11")
+    ch(b).insert_rows(1, 1)  # concurrent structural edit
+    b.flush()
+    doc.process_all()
+    assert ch(a).to_grid() == ch(b).to_grid()
+    assert ch(a).row_count == 3 and ch(a).col_count == 2
+    assert ch(a).get_cell(0, 0) == "a00"
+
+
+def test_matrix_channel_lww_and_fww():
+    doc, a, b = pair([("sharedMatrix", "x")])
+    ch(a).insert_rows(0, 1)
+    ch(a).insert_cols(0, 1)
+    a.flush()
+    doc.process_all()
+    # LWW: later-sequenced wins.
+    ch(a).set_cell(0, 0, "first")
+    a.flush()
+    ch(b).set_cell(0, 0, "second")
+    b.flush()
+    doc.process_all()
+    assert ch(a).get_cell(0, 0) == ch(b).get_cell(0, 0) == "second"
+    # FWW switch: concurrent writes now keep the first.
+    ch(a).switch_to_fww()
+    ch(a).set_cell(0, 0, "fww-a")
+    a.flush()
+    ch(b).set_cell(0, 0, "fww-b")  # b hasn't seen a's write
+    b.flush()
+    doc.process_all()
+    assert ch(a).get_cell(0, 0) == ch(b).get_cell(0, 0) == "fww-a"
+
+
+def test_matrix_channel_reconnect_regenerates():
+    doc, a, b = pair([("sharedMatrix", "x")])
+    ch(a).insert_rows(0, 2)
+    ch(a).insert_cols(0, 1)
+    a.flush()
+    doc.process_all()
+    a.disconnect()
+    ch(a).insert_rows(1, 1)  # offline structural edit
+    ch(a).set_cell(0, 0, "offline")
+    ch(b).insert_rows(0, 1)  # concurrent remote edit
+    b.flush()
+    doc.process_all()
+    a.connect(doc, "A2")
+    doc.process_all()
+    assert ch(a).to_grid() == ch(b).to_grid()
+    assert ch(a).row_count == 4
+
+
+def test_matrix_channel_summary_roundtrip():
+    doc, a, b = pair([("sharedMatrix", "x")])
+    ch(a).insert_rows(0, 2)
+    ch(a).insert_cols(0, 2)
+    ch(a).set_cell(0, 1, 42)
+    a.flush()
+    doc.process_all()
+    from fluidframework_tpu.dds.shared_matrix import SharedMatrixChannel
+
+    fresh = SharedMatrixChannel("x")
+    fresh.load(ch(a).summarize())
+    assert fresh.to_grid() == ch(a).to_grid()
+
+
+# --------------------------------------------------------------------------
+# fuzz models
+# --------------------------------------------------------------------------
+
+def dir_generate(rng: random.Random, channel) -> dict:
+    paths = ["", "a", "a/b", "c"]
+    kind = rng.choices(["set", "delete", "subdir", "delSubdir"], [8, 2, 2, 1])[0]
+    p = rng.choice(paths)
+    if kind == "set":
+        return {"t": "set", "p": p, "k": f"k{rng.randrange(3)}", "v": rng.randrange(50)}
+    if kind == "delete":
+        return {"t": "delete", "p": p, "k": f"k{rng.randrange(3)}"}
+    if kind == "subdir":
+        return {"t": "subdir", "p": rng.choice(["a", "a/b", "c", "d"])}
+    return {"t": "delSubdir", "p": rng.choice(["a", "a/b", "c", "d"])}
+
+
+def dir_reduce(channel, op: dict) -> None:
+    if op["t"] == "set":
+        channel.set(op["p"], op["k"], op["v"])
+    elif op["t"] == "delete":
+        channel.delete(op["p"], op["k"])
+    elif op["t"] == "subdir":
+        channel.create_subdirectory(op["p"])
+    else:
+        channel.delete_subdirectory(op["p"])
+
+
+def test_fuzz_shared_directory():
+    run_fuzz_suite(
+        DDSFuzzModel(
+            name="sharedDirectory", channel_type="sharedDirectory",
+            generate=dir_generate, reduce=dir_reduce,
+        ),
+        range(5), steps=90,
+    )
+
+
+def matrix_generate(rng: random.Random, channel) -> dict | None:
+    r, c = channel.row_count, channel.col_count
+    kind = rng.choices(["insR", "insC", "rmR", "rmC", "set"], [3, 3, 1, 1, 6])[0]
+    if kind == "insR":
+        return {"t": "insR", "p": rng.randint(0, r), "n": rng.randint(1, 2)}
+    if kind == "insC":
+        return {"t": "insC", "p": rng.randint(0, c), "n": rng.randint(1, 2)}
+    if kind == "rmR" and r > 0:
+        p = rng.randrange(r)
+        return {"t": "rmR", "p": p, "n": rng.randint(1, min(2, r - p))}
+    if kind == "rmC" and c > 0:
+        p = rng.randrange(c)
+        return {"t": "rmC", "p": p, "n": rng.randint(1, min(2, c - p))}
+    if r > 0 and c > 0:
+        return {"t": "set", "r": rng.randrange(r), "c": rng.randrange(c),
+                "v": rng.randrange(100)}
+    return None
+
+
+def matrix_reduce(channel, op: dict) -> None:
+    if op["t"] == "insR":
+        channel.insert_rows(op["p"], op["n"])
+    elif op["t"] == "insC":
+        channel.insert_cols(op["p"], op["n"])
+    elif op["t"] == "rmR":
+        channel.remove_rows(op["p"], op["n"])
+    elif op["t"] == "rmC":
+        channel.remove_cols(op["p"], op["n"])
+    else:
+        channel.set_cell(op["r"], op["c"], op["v"])
+
+
+def matrix_check(a, b) -> None:
+    assert a.to_grid() == b.to_grid(), f"{a.to_grid()} != {b.to_grid()}"
+
+
+def test_fuzz_shared_matrix():
+    run_fuzz_suite(
+        DDSFuzzModel(
+            name="sharedMatrix", channel_type="sharedMatrix",
+            generate=matrix_generate, reduce=matrix_reduce,
+            check_consistent=matrix_check,
+        ),
+        range(5), steps=80,
+    )
+
+
+def test_matrix_offline_structural_plus_cell_resubmit():
+    """Reconnect replay of insert_rows + insert_cols + set_cell minted
+    offline: the resubmitted cell metadata must track handle remapping
+    (review regression: crashed with 'cell ack without pending write')."""
+    doc, a, b = pair([("sharedMatrix", "x")])
+    a.disconnect()
+    ch(a).insert_rows(0, 1)
+    ch(a).insert_cols(0, 1)
+    ch(a).set_cell(0, 0, "v")
+    a.connect(doc, "A2")
+    doc.process_all()
+    assert ch(a).to_grid() == ch(b).to_grid() == [["v"]]
