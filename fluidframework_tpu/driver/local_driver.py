@@ -32,6 +32,7 @@ class LocalDeltaConnection(DeltaConnection):
         listener: Callable[[SequencedMessage], None],
         nack_listener: Callable[[Nack], None] | None,
         signal_listener: Callable[[SignalMessage], None] | None,
+        token: str | None = None,
     ) -> None:
         self._doc = doc
         self.client_id = client_id
@@ -45,9 +46,14 @@ class LocalDeltaConnection(DeltaConnection):
             if nack_listener is not None:
                 nack_listener(nack)
 
-        self.join_msg, self.checkpoint_seq = doc.connect_stream(
-            client_id, listener, on_nack, mode=mode
-        )
+        from ..server.auth import AuthError
+
+        try:
+            self.join_msg, self.checkpoint_seq = doc.connect_stream(
+                client_id, listener, on_nack, mode=mode, token=token
+            )
+        except AuthError as e:
+            raise DriverError(f"connection rejected: {e}", can_retry=False) from e
         if signal_listener is not None:
             doc.subscribe_signals(client_id, signal_listener)
 
@@ -97,8 +103,9 @@ class LocalStorageService(StorageService):
 
 
 class LocalDocumentService(DocumentService):
-    def __init__(self, doc: LocalDocument) -> None:
+    def __init__(self, doc: LocalDocument, token_provider=None) -> None:
         self._doc = doc
+        self._token_provider = token_provider
 
     def connect_to_delta_stream(
         self,
@@ -108,8 +115,12 @@ class LocalDocumentService(DocumentService):
         signal_listener: Callable[[SignalMessage], None] | None = None,
         mode: str = "write",
     ) -> DeltaConnection:
+        token = None
+        if self._token_provider is not None:
+            token = self._token_provider(self._doc.doc_id, client_id)
         return LocalDeltaConnection(
-            self._doc, client_id, mode, listener, nack_listener, signal_listener
+            self._doc, client_id, mode, listener, nack_listener, signal_listener,
+            token=token,
         )
 
     def connect_to_delta_storage(self) -> DeltaStorageService:
@@ -120,8 +131,13 @@ class LocalDocumentService(DocumentService):
 
 
 class LocalDocumentServiceFactory(DocumentServiceFactory):
-    def __init__(self, service: LocalService) -> None:
+    def __init__(self, service: LocalService, token_provider=None) -> None:
+        """``token_provider(doc_id, client_id) -> token`` supplies tenant
+        credentials when the service enforces auth (riddler analog)."""
         self._service = service
+        self._token_provider = token_provider
 
     def create_document_service(self, doc_id: str) -> DocumentService:
-        return LocalDocumentService(self._service.document(doc_id))
+        return LocalDocumentService(
+            self._service.document(doc_id), self._token_provider
+        )

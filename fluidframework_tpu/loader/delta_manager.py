@@ -112,6 +112,29 @@ class DeltaManager:
         for msg in buffered:
             self._process_inbound(msg)
 
+    def process_slice(self, max_ops: int, max_seconds: float | None = None) -> int:
+        """Process up to ``max_ops`` buffered inbound ops (and stop early
+        when ``max_seconds`` of wall clock elapses) WITHOUT unpausing — the
+        DeltaScheduler's time-slicing primitive (ref deltaScheduler.ts:25:
+        inbound processing yields every 50 ms so the host stays responsive).
+        Returns the number processed; pending remainder stays buffered."""
+        import time as _time
+
+        assert self._paused, "process_slice requires a paused delta manager"
+        t0 = _time.perf_counter()
+        n = 0
+        while self._pause_buffer and n < max_ops:
+            if max_seconds is not None and _time.perf_counter() - t0 >= max_seconds:
+                break
+            self._process_inbound(self._pause_buffer.pop(0))
+            n += 1
+        return n
+
+    @property
+    def inbound_backlog(self) -> int:
+        return len(self._pause_buffer)
+
+
     # ---------------------------------------- document adapter (runtime side)
     def connect(
         self,
@@ -159,3 +182,31 @@ class DeltaManager:
         if conn is None or not conn.connected:
             raise RuntimeError("signal while disconnected")
         conn.submit_signal(content)
+
+class DeltaScheduler:
+    """Drives a paused DeltaManager in slices (ref DeltaScheduler's 50 ms
+    budget, deltaScheduler.ts:25-33): call ``run_slice()`` from the host
+    loop; processing yields control between slices so UI/host work
+    interleaves with catch-up storms."""
+
+    DEFAULT_BUDGET_S = 0.05  # the reference's 50 ms slice
+
+    def __init__(self, dm: "DeltaManager", ops_per_slice: int = 100,
+                 seconds_per_slice: float | None = DEFAULT_BUDGET_S) -> None:
+        self._dm = dm
+        self.ops_per_slice = ops_per_slice
+        self.seconds_per_slice = seconds_per_slice
+        dm.pause()
+
+    def run_slice(self) -> int:
+        return self._dm.process_slice(self.ops_per_slice, self.seconds_per_slice)
+
+    def drain(self) -> int:
+        n = 0
+        while self._dm.inbound_backlog:
+            n += self.run_slice()
+        return n
+
+    def stop(self) -> None:
+        """Return the delta manager to immediate (unsliced) processing."""
+        self._dm.resume()

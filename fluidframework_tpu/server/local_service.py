@@ -40,12 +40,16 @@ class LocalDocument:
         # uploads the ISummaryTree to storage, then the op carries a handle).
         self._uploads: dict[str, dict] = {}
         self._upload_counter = 0
+        # Optional riddler-analog token validation (server/auth.py); set via
+        # LocalService.enable_auth.
+        self.token_manager = None
 
     def connect(
         self,
         client_id: str,
         subscriber: Subscriber,
         on_nack: Callable[[Nack], None] | None = None,
+        token: str | None = None,
     ) -> SequencedMessage:
         """Join a client and subscribe it to the broadcast stream.
 
@@ -54,6 +58,10 @@ class LocalDocument:
         snapshot plus trailing ops — the trailing-ops path is what this is).
         Messages still queued for delivery arrive through the normal pump.
         """
+        if self.token_manager is not None:
+            # Admission control applies to EVERY write join, in-process
+            # connections included (riddler validates all fronts).
+            self.token_manager.validate(token, self.doc_id, client_id)
         already_delivered = len(self.sequencer.log) - len(self._pending)
         for msg in self.sequencer.log[:already_delivered]:
             subscriber(msg)
@@ -95,6 +103,7 @@ class LocalDocument:
         subscriber: Subscriber,
         on_nack: Callable[[Nack], None] | None = None,
         mode: str = "write",
+        token: str | None = None,
     ) -> tuple[SequencedMessage | None, int]:
         """Driver-style connect: subscribe WITHOUT catch-up replay.
 
@@ -107,6 +116,9 @@ class LocalDocument:
         highest seq already broadcast — everything above it will arrive
         through this subscription.
         """
+        if self.token_manager is not None:
+            # Front-end admission control (riddler token validation).
+            self.token_manager.validate(token, self.doc_id, client_id)
         delivered = len(self.sequencer.log) - len(self._pending)
         delivered_seq = self.sequencer.log[delivered - 1].seq if delivered else 0
         join = None
@@ -222,11 +234,19 @@ class LocalService:
 
     def __init__(self) -> None:
         self._docs: dict[str, LocalDocument] = {}
+        self._token_manager = None
 
     def document(self, doc_id: str) -> LocalDocument:
         if doc_id not in self._docs:
             self._docs[doc_id] = LocalDocument(doc_id)
+            self._docs[doc_id].token_manager = self._token_manager
         return self._docs[doc_id]
+
+    def enable_auth(self, token_manager) -> None:
+        """Require valid tenant tokens on every write connection (riddler)."""
+        self._token_manager = token_manager
+        for doc in self._docs.values():
+            doc.token_manager = token_manager
 
     def documents(self) -> list[LocalDocument]:
         return list(self._docs.values())

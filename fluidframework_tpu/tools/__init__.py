@@ -1,0 +1,5 @@
+"""Tooling (SURVEY §2 layer 10): replay tool over the replay driver."""
+
+from .replay_tool import ReplayTool
+
+__all__ = ["ReplayTool"]
