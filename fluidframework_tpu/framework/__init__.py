@@ -10,8 +10,11 @@ op stream), and the service-client façade (tinylicious-client analog).
 from .aqueduct import DataObject, DataObjectFactory
 from .attributor import OpStreamAttributor
 from .fluid_static import ContainerSchema, FluidContainer
+from .interceptions import InterceptedSharedMap, InterceptedSharedString
+from .oldest_client import OldestClientObserver
 from .presence import Presence
 from .service_client import LocalServiceClient
+from .tree_agent import TreeAgent, render_schema_prompt
 from .undo_redo import UndoRedoStackManager
 
 __all__ = [
@@ -19,8 +22,13 @@ __all__ = [
     "DataObject",
     "DataObjectFactory",
     "FluidContainer",
+    "InterceptedSharedMap",
+    "InterceptedSharedString",
     "LocalServiceClient",
+    "OldestClientObserver",
     "OpStreamAttributor",
     "Presence",
+    "TreeAgent",
     "UndoRedoStackManager",
+    "render_schema_prompt",
 ]
