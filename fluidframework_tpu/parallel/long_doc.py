@@ -1,0 +1,170 @@
+"""Segment-axis sharding: one huge document spread across the mesh.
+
+The reference's long-sequence machinery — the merge-tree B-tree with
+``PartialSequenceLengths`` giving O(log n) position resolution
+(merge-tree/src/partialLengths.ts:230, SURVEY §5 "long-context") — exists
+only to make prefix-length queries cheap on one CPU. The TPU-native form
+(SURVEY §7): the flat segment SoA is block-sharded over a ``segs`` mesh
+axis (order-preserving), per-shard partial lengths are combined with ICI
+collectives, and every position query becomes
+
+    global prefix  =  all_gather of shard totals (one tiny collective)
+    local resolve  =  masked prefix-sum inside the shard (vector ops)
+    combine        =  psum of per-shard one-hot results
+
+— the distributed analog of the B-tree walk: two collective hops regardless
+of document size. Range ops (remove/annotate) then apply as purely-local
+mask updates. This composes with the ``docs`` axis as a 2-D mesh
+(docs × segs): fleets of huge documents — documents across chips, segments
+across chips — sequence parallelism for collaborative text.
+
+Inserts migrate between shards only at rebalance points (the zamboni
+compaction pass already gathers live segments; a sharded rebalance
+re-blocks them), so the hot query path stays at the two hops above.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.mergetree_kernel import DocState
+from ..protocol.stamps import NO_REMOVE
+
+I32 = jnp.int32
+
+
+def shard_doc_state(state: DocState, mesh: Mesh, axis: str = "segs") -> DocState:
+    """Place a single-doc state with segment arrays block-sharded over
+    ``axis`` and scalars/text replicated. Block sharding preserves segment
+    order: shard k owns the k-th contiguous run."""
+    seg = NamedSharding(mesh, P(axis))
+    rep = NamedSharding(mesh, P())
+    specs = _specs_for(state, axis)
+    return jax.tree.map(
+        lambda x, sp: jax.device_put(x, seg if sp == P(axis) else rep),
+        state,
+        specs,
+    )
+
+
+def _specs_for(state: DocState, axis: str) -> DocState:
+    s, r = P(axis), P()
+    return DocState(
+        text=r, text_end=r, nseg=r,
+        seg_start=s, seg_len=s, ins_key=s, ins_client=s,
+        rem_keys=(s,) * len(state.rem_keys),
+        rem_clients=(s,) * len(state.rem_clients),
+        prop_keys=(s,) * len(state.prop_keys),
+        prop_vals=(s,) * len(state.prop_vals),
+        min_seq=r, error=r,
+    )
+
+
+def _local_vis_lens(s: DocState, ref_seq, client, axis: str) -> jnp.ndarray:
+    """Per-shard perspective-visible lengths, with GLOBAL aliveness (local
+    row k is global row my_shard * S_local + k against the replicated
+    nseg)."""
+    my = jax.lax.axis_index(axis)
+    n_local = s.seg_len.shape[0]
+    gidx = my * n_local + jnp.arange(n_local, dtype=I32)
+    alive = gidx < s.nseg
+    ins_occ = (s.ins_key <= ref_seq) | (s.ins_client == client)
+    rem_occ = jnp.zeros_like(alive)
+    for k, c in zip(s.rem_keys, s.rem_clients):
+        rem_occ = rem_occ | (k <= ref_seq) | (c == client)
+    vis = alive & ins_occ & ~rem_occ
+    return jnp.where(vis, s.seg_len, 0)
+
+
+def _global_prefix(lens: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Per-segment exclusive prefix in GLOBAL visible coordinates: local
+    cumsum shifted by the sum of earlier shards' totals (one all_gather)."""
+    totals = jax.lax.all_gather(jnp.sum(lens), axis)  # [n_shards]
+    my = jax.lax.axis_index(axis)
+    shard_prefix = jnp.sum(jnp.where(jnp.arange(totals.shape[0]) < my, totals, 0))
+    return jnp.cumsum(lens) - lens + shard_prefix
+
+
+def make_sharded_ops(mesh: Mesh, state: DocState, axis: str = "segs"):
+    """Build (visible_length, resolve_positions, mark_range) for one
+    document layout, each shard_map-jitted over the segment axis."""
+    specs = _specs_for(state, axis)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(specs, P(), P()), out_specs=P())
+    def _visible_length(s: DocState, ref_seq, client):
+        return jax.lax.psum(jnp.sum(_local_vis_lens(s, ref_seq, client, axis)), axis)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(specs, P(), P(), P()), out_specs=(P(), P()),
+    )
+    def _resolve(s: DocState, positions, ref_seq, client):
+        """positions[Q] (replicated, in perspective-visible coordinates) ->
+        (global segment index, offset within segment) per query."""
+        lens = _local_vis_lens(s, ref_seq, client, axis)
+        prefix = _global_prefix(lens, axis)
+        q = positions[:, None]  # [Q, 1]
+        inside = (q >= prefix[None, :]) & (q < (prefix + lens)[None, :])
+        n_local = lens.shape[0]
+        my = jax.lax.axis_index(axis)
+        local_idx = jnp.argmax(inside, axis=1)
+        hit = jnp.any(inside, axis=1)
+        global_idx = jnp.where(hit, my * n_local + local_idx, 0)
+        offset = jnp.where(hit, positions - prefix[local_idx], 0)
+        # Exactly one shard hits each in-range query; psum merges one-hots.
+        return (
+            jax.lax.psum(global_idx.astype(I32), axis),
+            jax.lax.psum(offset.astype(I32), axis),
+        )
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(specs, P(), P(), P(), P(), P(), P()),
+        out_specs=specs,
+    )
+    def _mark_range(s: DocState, p1, p2, op_key, op_client, ref_seq, client):
+        """Remove [p1, p2) under the op's perspective as a purely-local mask
+        update (whole segments in range; boundary splits are the single-
+        owner engine's job before a doc graduates to sharded layout — large
+        deletes over long documents mark thousands of whole segments)."""
+        lens = _local_vis_lens(s, ref_seq, client, axis)
+        prefix = _global_prefix(lens, axis)
+        vis = lens > 0
+        in_range = vis & (prefix >= p1) & ((prefix + lens) <= p2)
+        new_rem_keys = []
+        new_rem_clients = []
+        taken = jnp.zeros_like(in_range)
+        for rk, rc in zip(s.rem_keys, s.rem_clients):
+            free = (rk == NO_REMOVE) & in_range & ~taken
+            new_rem_keys.append(jnp.where(free, op_key, rk).astype(I32))
+            new_rem_clients.append(jnp.where(free, op_client, rc).astype(I32))
+            taken = taken | free
+        return s._replace(
+            rem_keys=tuple(new_rem_keys), rem_clients=tuple(new_rem_clients)
+        )
+
+    def visible_length(s, ref_seq, client):
+        return _visible_length(s, jnp.asarray(ref_seq, I32), jnp.asarray(client, I32))
+
+    def resolve_positions(s, positions, ref_seq, client):
+        return _resolve(
+            s, jnp.asarray(positions, I32),
+            jnp.asarray(ref_seq, I32), jnp.asarray(client, I32),
+        )
+
+    def mark_range(s, p1, p2, op_key, op_client, ref_seq, client):
+        return _mark_range(
+            s, jnp.asarray(p1, I32), jnp.asarray(p2, I32),
+            jnp.asarray(op_key, I32), jnp.asarray(op_client, I32),
+            jnp.asarray(ref_seq, I32), jnp.asarray(client, I32),
+        )
+
+    return (
+        jax.jit(visible_length),
+        jax.jit(resolve_positions),
+        jax.jit(mark_range),
+    )
