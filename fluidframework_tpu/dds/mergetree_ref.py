@@ -348,6 +348,57 @@ class RefMergeTree:
                 pos += len(seg.text)
         return out
 
+    def converged_to_local(self, pos: int) -> int:
+        """Translate a converged-coordinate position into the LOCAL view
+        (acked state plus own pending ops). Landing inside a segment the
+        local view cannot see (covered by a pending local remove) slides to
+        that segment's local start."""
+        from ..protocol.stamps import NON_COLLAB_CLIENT
+
+        conv = 0
+        loc = 0
+        for seg in self.segments:
+            c_vis = seg.visible(ALL_ACKED, NON_COLLAB_CLIENT)
+            l_vis = seg.visible(ALL_ACKED, self.local_client)
+            n = len(seg.text)
+            if c_vis and pos < conv + n:
+                return loc + (pos - conv) if l_vis else loc
+            if c_vis:
+                conv += n
+            if l_vis:
+                loc += n
+        return loc
+
+    def converged_spans_to_local(self, start: int, end: int) -> list[tuple[int, int]]:
+        """Map the converged range [start, end) into local-view sub-ranges,
+        ascending. Content invisible to the converged view (own pending
+        inserts inside the range) produces holes — the caller operating on
+        the local view leaves it untouched; content locally hidden by a
+        pending remove is skipped (already gone from the local view)."""
+        from ..protocol.stamps import NON_COLLAB_CLIENT
+
+        spans: list[list[int]] = []
+        conv = 0
+        loc = 0
+        for seg in self.segments:
+            c_vis = seg.visible(ALL_ACKED, NON_COLLAB_CLIENT)
+            l_vis = seg.visible(ALL_ACKED, self.local_client)
+            n = len(seg.text)
+            if c_vis:
+                o1 = max(start, conv)
+                o2 = min(end, conv + n)
+                if o1 < o2 and l_vis:
+                    s0 = loc + (o1 - conv)
+                    e0 = loc + (o2 - conv)
+                    if spans and spans[-1][1] == s0:
+                        spans[-1][1] = e0
+                    else:
+                        spans.append([s0, e0])
+                conv += n
+            if l_vis:
+                loc += n
+        return [(s, e) for s, e in spans]
+
     # --------------------------------------------------------------- reconnect
     def _squashed(self, seg: Segment) -> bool:
         """A pending insert later covered by a pending remove: under squash

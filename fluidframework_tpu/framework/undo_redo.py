@@ -107,12 +107,22 @@ class _StringInsertRevertible:
 
     def revert(self):
         self._range.release()
+        # Tracked ranges are converged-coordinate once synced; translate them
+        # into the local view (which may differ while unacked local edits are
+        # in flight) before touching the string. Pending local inserts inside
+        # a tracked range survive as holes in the mapped spans.
+        local_spans: list[tuple[int, int]] = []
+        for start, end in self._range.ranges:
+            if end <= start:
+                continue
+            if self._range._synced:
+                local_spans.extend(self._ch.backend.converged_spans_to_local(start, end))
+            else:
+                local_spans.append((start, end))
         # Remove every surviving fragment back-to-front; each removal hands
         # back its own re-insert revertible.
         inverses = []
-        for start, end in sorted(self._range.ranges, reverse=True):
-            if end <= start:
-                continue
+        for start, end in sorted(local_spans, reverse=True):
             removed = self._ch.text[start:end]
             ls = self._ch.remove_range(start, end)
             inverses.append(_StringRemoveRevertible(self._ch, ls, start, removed))
@@ -133,6 +143,8 @@ class _StringRemoveRevertible:
     def revert(self) -> "_StringInsertRevertible":
         self._range.release()
         pos = self._range.start
+        if self._range._synced:
+            pos = self._ch.backend.converged_to_local(pos)
         ls = self._ch.insert_text(pos, self._text)
         return _StringInsertRevertible(self._ch, ls, pos, len(self._text))
 

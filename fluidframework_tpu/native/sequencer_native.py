@@ -58,7 +58,11 @@ def _ensure_built() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
     ]
     lib.seq_leave.restype = ctypes.c_int32
-    lib.seq_leave.argtypes = lib.seq_join.argtypes
+    lib.seq_leave.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+    ]
     lib.seq_ticket.restype = ctypes.c_int32
     lib.seq_ticket.argtypes = [
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
@@ -142,21 +146,26 @@ class NativeSequencer:
     def leave(self, client_id: str) -> SequencedMessage:
         out_seq = ctypes.c_int64()
         out_min = ctypes.c_int64()
-        rc = _lib.seq_leave(self._h, client_id.encode(), ctypes.byref(out_seq), ctypes.byref(out_min))
-        if rc != 0:
+        out_cseq = ctypes.c_int64()
+        out_rseq = ctypes.c_int64()
+        short = _lib.seq_leave(
+            self._h, client_id.encode(), ctypes.byref(out_seq), ctypes.byref(out_min),
+            ctypes.byref(out_cseq), ctypes.byref(out_rseq),
+        )
+        if short < 0:
             raise ValueError(f"leave of unjoined client: {client_id}")
         self._members.pop(client_id, None)
         msg = SequencedMessage(
             client_id=client_id,
-            client_seq=0,
-            ref_seq=out_seq.value - 1,
+            client_seq=out_cseq.value,
+            ref_seq=out_rseq.value,
             seq=out_seq.value,
             min_seq=out_min.value,
             type=MessageType.LEAVE,
             contents={"clientId": client_id},
             metadata=None,
             timestamp=time.time(),
-            short_client=-1,
+            short_client=short,
         )
         self.log.append(msg)
         return msg
@@ -216,6 +225,8 @@ class NativeSequencer:
     def restore_bytes(data: bytes) -> "NativeSequencer":
         buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
         h = _lib.seq_restore(buf, len(data))
+        if not h:
+            raise ValueError("truncated or corrupt sequencer checkpoint")
         out = NativeSequencer(_handle=h)
         out._members = _parse_checkpoint_members(data)
         return out

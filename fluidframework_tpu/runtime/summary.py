@@ -158,9 +158,16 @@ class SummaryManager:
         )
         h = self._storage.upload_summary(root)
         self._inflight_handle = h
-        self._runtime.submit_protocol_message(
-            MessageType.SUMMARIZE, {"handle": h, "refSeq": self._runtime.ref_seq}
-        )
+        try:
+            self._runtime.submit_protocol_message(
+                MessageType.SUMMARIZE, {"handle": h, "refSeq": self._runtime.ref_seq}
+            )
+        except RuntimeError:
+            # Connection dropped during flush: the proposal never reached the
+            # stream, so no ack/nack will ever clear it — treat as a nack so
+            # the elected client can summarize again after reconnect.
+            self._inflight_handle = None
+            return False
         self.submitted += 1
         return True
 

@@ -17,6 +17,20 @@ class AuthError(Exception):
     pass
 
 
+def _scope_bytes(tenant_id: str, doc_id: str, client_id: str) -> bytes:
+    """Unambiguous scope encoding: length-prefixed components.
+
+    A raw f"{tenant}:{doc}:{client}" concatenation aliases scopes when ids
+    contain ':' (doc='a:b', client='c' vs doc='a', client='b:c'); prefixing
+    each UTF-8 component with its byte length removes the ambiguity."""
+    out = bytearray()
+    for part in (tenant_id, doc_id, client_id):
+        raw = part.encode()
+        out += len(raw).to_bytes(4, "big")
+        out += raw
+    return bytes(out)
+
+
 class TokenManager:
     """Tenant registry + token mint/validate."""
 
@@ -32,7 +46,7 @@ class TokenManager:
         key = self._tenants.get(tenant_id)
         if key is None:
             raise AuthError(f"unknown tenant {tenant_id!r}")
-        scope = f"{tenant_id}:{doc_id}:{client_id}".encode()
+        scope = _scope_bytes(tenant_id, doc_id, client_id)
         mac = hmac.new(key, scope, hashlib.sha256).hexdigest()
         return f"{tenant_id}:{mac}"
 
@@ -40,11 +54,11 @@ class TokenManager:
         """Returns the tenant id or raises AuthError."""
         if not token or ":" not in token:
             raise AuthError("missing or malformed token")
-        tenant_id, mac = token.split(":", 1)
+        tenant_id, mac = token.rsplit(":", 1)
         key = self._tenants.get(tenant_id)
         if key is None:
             raise AuthError(f"unknown tenant {tenant_id!r}")
-        scope = f"{tenant_id}:{doc_id}:{client_id}".encode()
+        scope = _scope_bytes(tenant_id, doc_id, client_id)
         want = hmac.new(key, scope, hashlib.sha256).hexdigest()
         if not hmac.compare_digest(mac, want):
             raise AuthError("invalid token signature")

@@ -104,17 +104,25 @@ int32_t seq_join(void* h, const char* client_id, int64_t* out_seq, int64_t* out_
 }
 
 // Leave: seq-stamps the leave, drops the client from MSN computation.
-// Returns 0 on success, -1 if unknown.
-int32_t seq_leave(void* h, const char* client_id, int64_t* out_seq, int64_t* out_min) {
+// Returns the leaver's short id on success, -1 if unknown. The leave
+// message is stamped exactly like the Python oracle's: clientSeq is the
+// client's next clientSeq (last accepted + 1) and refSeq is the client's
+// last observed refSeq — both reported via out params so the wrapper can
+// persist a bit-identical op log.
+int32_t seq_leave(void* h, const char* client_id, int64_t* out_seq, int64_t* out_min,
+                  int64_t* out_client_seq, int64_t* out_ref_seq) {
     auto* s = static_cast<SequencerState*>(h);
     auto it = s->clients.find(client_id);
     if (it == s->clients.end()) return -1;
+    int32_t short_id = it->second.short_id;
+    *out_client_seq = it->second.client_seq + 1;
+    *out_ref_seq = it->second.ref_seq;
     s->clients.erase(it);
     s->seq += 1;
     s->advance_msn();
     *out_seq = s->seq;
     *out_min = s->min_seq;
-    return 0;
+    return short_id;
 }
 
 // The hot loop: validate + stamp one op.
@@ -176,12 +184,21 @@ int64_t seq_checkpoint(void* h, uint8_t* buf, int64_t cap) {
 void* seq_restore(const uint8_t* buf, int64_t len) {
     auto* s = new SequencerState();
     int64_t off = 0;
-    auto get = [&](void* p, size_t n) { std::memcpy(p, buf + off, n); off += (int64_t)n; };
+    bool bad = false;
+    // Every read is validated against len so a truncated or corrupt
+    // checkpoint yields nullptr instead of out-of-bounds reads.
+    auto get = [&](void* p, size_t n) {
+        if (bad || off + (int64_t)n > len) { bad = true; return; }
+        std::memcpy(p, buf + off, n);
+        off += (int64_t)n;
+    };
     int32_t n = 0;
     get(&s->seq, 8); get(&s->min_seq, 8); get(&s->next_short, 4); get(&n, 4);
-    for (int32_t i = 0; i < n && off < len; i++) {
+    if (bad || n < 0) { delete s; return nullptr; }
+    for (int32_t i = 0; i < n; i++) {
         ClientEntry e; int32_t slen = 0;
         get(&e.short_id, 4); get(&e.client_seq, 8); get(&e.ref_seq, 8); get(&slen, 4);
+        if (bad || slen < 0 || off + (int64_t)slen > len) { delete s; return nullptr; }
         std::string name(reinterpret_cast<const char*>(buf + off), (size_t)slen);
         off += slen;
         s->clients[name] = e;

@@ -1,0 +1,168 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md).
+
+Each test pins one of the fixes: auth scope aliasing, native checkpoint
+bounds validation, native leave-stamp parity (covered in
+test_native_sequencer.py), summary inflight-handle leak on mid-flush
+disconnect, and undo-redo reverts with unacked local edits in flight."""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.framework import LocalServiceClient, UndoRedoStackManager
+from fluidframework_tpu.framework.fluid_static import ContainerSchema
+from fluidframework_tpu.server.auth import AuthError, TokenManager
+
+SCHEMA = ContainerSchema(initial_objects={"text": "sharedString"})
+
+
+# --------------------------------------------------------------------------
+# auth: scope encoding must be unambiguous for ids containing ':'
+# --------------------------------------------------------------------------
+
+def test_token_scope_no_aliasing_across_colon_boundaries():
+    tm = TokenManager()
+    tm.create_tenant("t")
+    token = tm.sign("t", "a:b", "c")
+    assert tm.validate(token, "a:b", "c") == "t"
+    # The concatenation-aliased scope must NOT validate.
+    with pytest.raises(AuthError):
+        tm.validate(token, "a", "b:c")
+    with pytest.raises(AuthError):
+        tm.validate(tm.sign("t", "a", "b:c"), "a:b", "c")
+
+
+def test_token_tenant_with_colon_roundtrips():
+    tm = TokenManager()
+    tm.create_tenant("org:unit")
+    token = tm.sign("org:unit", "doc", "client")
+    assert tm.validate(token, "doc", "client") == "org:unit"
+
+
+# --------------------------------------------------------------------------
+# native sequencer: corrupt/truncated checkpoints must be rejected
+# --------------------------------------------------------------------------
+
+def test_native_restore_rejects_truncated_checkpoint():
+    from fluidframework_tpu.native import NativeSequencer, native_available
+
+    if not native_available():
+        pytest.skip("native sequencer library unavailable")
+    nat = NativeSequencer()
+    nat.join("alice")
+    nat.join("bob")
+    data = nat.checkpoint_bytes()
+    # Every strict prefix is a truncation; none may produce a handle.
+    for cut in (0, 1, 8, 20, len(data) - 1):
+        with pytest.raises(ValueError):
+            NativeSequencer.restore_bytes(data[:cut])
+    # Corrupt client count (huge positive) must be rejected, not walked.
+    bad = bytearray(data)
+    bad[20:24] = (2**31 - 1).to_bytes(4, "little")
+    with pytest.raises(ValueError):
+        NativeSequencer.restore_bytes(bytes(bad))
+
+
+# --------------------------------------------------------------------------
+# summary manager: disconnect during the summarize flush must not wedge
+# --------------------------------------------------------------------------
+
+def test_summary_inflight_clears_when_submit_raises():
+    from fluidframework_tpu.dds.channels import default_registry
+    from fluidframework_tpu.driver import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.runtime.summary import SummaryConfig
+    from fluidframework_tpu.server import LocalService
+
+    svc = LocalService()
+    factory = LocalDocumentServiceFactory(svc)
+    d = Container.create_detached(default_registry(), container_id="creator")
+    ds = d.runtime.create_datastore("root")
+    ds.create_channel("sharedString", "text")
+    d.attach("doc", factory, "creator")
+    svc.process_all()
+    sm = d.make_summary_manager(SummaryConfig(max_ops=1))
+    assert sm.is_elected()
+    ds.get_channel("text").insert_text(0, "x")
+    d.runtime.flush()
+    svc.process_all()
+    # Sever the document so the summarize proposal's flush raises before the
+    # proposal reaches the stream: the handle must NOT stay in flight.
+    d.runtime._document = None
+    assert sm.tick() is False
+    assert sm._inflight_handle is None  # not wedged permanently
+
+
+# --------------------------------------------------------------------------
+# undo-redo: revert while unacked local edits are in flight
+# --------------------------------------------------------------------------
+
+def test_undo_remove_reinserts_with_pending_local_edit_before_range():
+    client = LocalServiceClient()
+    fc, _ = client.create_container(SCHEMA, "doc1")
+    client.service.process_all()
+    t = fc.initial_objects["text"]
+    t.insert_text(0, "hello world")
+    fc.flush()
+    client.service.process_all()
+    ur = UndoRedoStackManager()
+    ur.capture_string_remove(t, 5, 11)  # drop " world"
+    ur.close_current_operation()
+    fc.flush()
+    client.service.process_all()
+    assert t.text == "hello"
+    # An UNACKED local insert before the tracked point: local coords now
+    # differ from converged coords by 4.
+    t.insert_text(0, ">>> ")
+    assert t.text == ">>> hello"
+    ur.undo()
+    assert t.text == ">>> hello world"
+    fc.flush()
+    client.service.process_all()
+    assert t.text == ">>> hello world"
+
+
+def test_undo_insert_removes_right_range_with_pending_local_edit():
+    client = LocalServiceClient()
+    fc, _ = client.create_container(SCHEMA, "doc1")
+    client.service.process_all()
+    t = fc.initial_objects["text"]
+    t.insert_text(0, "base ")
+    fc.flush()
+    client.service.process_all()
+    ur = UndoRedoStackManager()
+    ur.capture_string_insert(t, 5, "WORD")
+    ur.close_current_operation()
+    fc.flush()
+    client.service.process_all()
+    assert t.text == "base WORD"
+    # Unacked local insert BEFORE the tracked range shifts local coords.
+    t.insert_text(0, "## ")
+    assert t.text == "## base WORD"
+    ur.undo()
+    assert t.text == "## base "
+    fc.flush()
+    client.service.process_all()
+    assert t.text == "## base "
+
+
+def test_undo_insert_preserves_pending_local_typing_inside_range():
+    """A pending local insert INSIDE the tracked range survives the undo as
+    a hole in the mapped removal spans."""
+    client = LocalServiceClient()
+    fc, _ = client.create_container(SCHEMA, "doc1")
+    client.service.process_all()
+    t = fc.initial_objects["text"]
+    ur = UndoRedoStackManager()
+    ur.capture_string_insert(t, 0, "abcdef")
+    ur.close_current_operation()
+    fc.flush()
+    client.service.process_all()
+    # Unacked local typing inside the tracked range.
+    t.insert_text(3, "XYZ")
+    assert t.text == "abcXYZdef"
+    ur.undo()
+    assert t.text == "XYZ"
+    fc.flush()
+    client.service.process_all()
+    assert t.text == "XYZ"

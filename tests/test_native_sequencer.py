@@ -20,6 +20,18 @@ pytestmark = pytest.mark.skipif(
 )
 
 
+def assert_stamped_identical(a, b, what: str) -> None:
+    """All stamped fields must match so py and native pipelines persist
+    bit-identical op logs (scriptorium/replay/file-driver consumers)."""
+    assert (
+        a.client_id, a.client_seq, a.ref_seq, a.seq, a.min_seq, a.type,
+        a.short_client,
+    ) == (
+        b.client_id, b.client_seq, b.ref_seq, b.seq, b.min_seq, b.type,
+        b.short_client,
+    ), f"{what} stamp mismatch"
+
+
 def drive_both(py: Sequencer, nat: NativeSequencer, actions) -> None:
     for act in actions:
         kind = act[0]
@@ -32,9 +44,8 @@ def drive_both(py: Sequencer, nat: NativeSequencer, actions) -> None:
                     nat.join(cid)
                 continue
             b = nat.join(cid)
-            assert (a.seq, a.min_seq, a.contents["short"]) == (
-                b.seq, b.min_seq, b.contents["short"]
-            ), f"join mismatch for {cid}"
+            assert_stamped_identical(a, b, f"join({cid})")
+            assert a.contents["short"] == b.contents["short"]
         elif kind == "leave":
             _, cid = act
             try:
@@ -44,7 +55,7 @@ def drive_both(py: Sequencer, nat: NativeSequencer, actions) -> None:
                     nat.leave(cid)
                 continue
             b = nat.leave(cid)
-            assert (a.seq, a.min_seq) == (b.seq, b.min_seq)
+            assert_stamped_identical(a, b, f"leave({cid})")
         elif kind == "ticket":
             _, cid, cseq, rseq = act
             msg = UnsequencedMessage(
@@ -58,9 +69,7 @@ def drive_both(py: Sequencer, nat: NativeSequencer, actions) -> None:
                 assert a.reason == b.reason
             else:
                 assert not isinstance(b, Nack), f"native nacked ({b.reason}), py ticketed"
-                assert (a.seq, a.min_seq, a.short_client) == (
-                    b.seq, b.min_seq, b.short_client
-                )
+                assert_stamped_identical(a, b, "ticket")
         elif kind == "mint":
             a = py.mint_service(MessageType.SUMMARY_ACK, {"x": 1})
             b = nat.mint_service(MessageType.SUMMARY_ACK, {"x": 1})
