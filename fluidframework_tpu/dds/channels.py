@@ -13,9 +13,22 @@ from __future__ import annotations
 from typing import Any
 
 from ..protocol.stamps import ALL_ACKED, acked, encode_stamp
-from .mergetree_ref import RefMergeTree, Segment
+from .mergetree_ref import SIDE_AFTER, SIDE_BEFORE, RefMergeTree, Segment
 from .sequence_intervals import IntervalCollection, StringOpLog
 from ..runtime.channel import Channel, MessageCollection
+
+
+def _decode_obliterate_places(c: dict) -> tuple[int, int, int, int]:
+    """Wire op -> (pos1, side1, pos2, side2) endpoint places.  The plain
+    OBLITERATE form {pos1, pos2} is the sided range (pos1, Before) ..
+    (pos2-1, After) (ref mergeTree.ts obliterateRange:2282)."""
+    if c["type"] == 4:
+        return c["pos1"], SIDE_BEFORE, c["pos2"] - 1, SIDE_AFTER
+    p1, p2 = c["pos1"], c["pos2"]
+    return (
+        p1["pos"], SIDE_BEFORE if p1["before"] else SIDE_AFTER,
+        p2["pos"], SIDE_BEFORE if p2["before"] else SIDE_AFTER,
+    )
 
 
 class SharedStringChannel(Channel):
@@ -65,6 +78,43 @@ class SharedStringChannel(Channel):
         )
         self.submit_local_message(
             {"type": 1, "pos1": pos1, "pos2": pos2}, {"localSeq": ls}
+        )
+        return ls
+
+    def obliterate_range(self, pos1: int, pos2: int) -> int:
+        """Slice-remove [pos1, pos2): also swallows concurrent inserts into
+        the range (ref client.ts applyObliterateRangeOp, ops.ts OBLITERATE)."""
+        assert pos1 < pos2
+        ls = self._next_local_seq()
+        self.backend.apply_obliterate(
+            pos1, SIDE_BEFORE, pos2 - 1, SIDE_AFTER,
+            encode_stamp(-1, ls), self.backend.local_client, ALL_ACKED,
+        )
+        self.submit_local_message(
+            {"type": 4, "pos1": pos1, "pos2": pos2}, {"localSeq": ls}
+        )
+        return ls
+
+    def obliterate_range_sided(
+        self, start: tuple[int, bool], end: tuple[int, bool]
+    ) -> int:
+        """Sided obliterate: endpoints are (char pos, before) places; an
+        After (before=False) start / Before end expands the range to swallow
+        concurrent inserts adjacent to the exclusive endpoint
+        (ref ops.ts OBLITERATE_SIDED, mergeTreeEnableSidedObliterate)."""
+        ls = self._next_local_seq()
+        self.backend.apply_obliterate(
+            start[0], SIDE_BEFORE if start[1] else SIDE_AFTER,
+            end[0], SIDE_BEFORE if end[1] else SIDE_AFTER,
+            encode_stamp(-1, ls), self.backend.local_client, ALL_ACKED,
+        )
+        self.submit_local_message(
+            {
+                "type": 5,
+                "pos1": {"pos": start[0], "before": start[1]},
+                "pos2": {"pos": end[0], "before": end[1]},
+            },
+            {"localSeq": ls},
         )
         return ls
 
@@ -159,6 +209,11 @@ class SharedStringChannel(Channel):
                     self.backend.apply_annotate(
                         c["pos1"], c["pos2"], int(prop), value, env.seq, sender, env.ref_seq
                     )
+            elif c["type"] in (4, 5):
+                p1, s1, p2, s2 = _decode_obliterate_places(c)
+                rem_segs = self.backend.apply_obliterate(
+                    p1, s1, p2, s2, env.seq, sender, env.ref_seq
+                )
             else:
                 raise ValueError(f"unsupported merge-tree op type {c['type']}")
             ls = m.local_metadata["localSeq"] if m.local else None
@@ -166,7 +221,7 @@ class SharedStringChannel(Channel):
                 self._record_converged_events(
                     "insert", self.backend.converged_insert_ranges(ins_segs), env.seq, ls
                 )
-            elif c["type"] == 1:
+            elif c["type"] in (1, 4, 5):
                 self._record_converged_events(
                     "remove",
                     self.backend.converged_removed_ranges(rem_segs, env.seq),
@@ -223,6 +278,9 @@ class SharedStringChannel(Channel):
                 self.backend.apply_annotate(
                     c["pos1"], c["pos2"], int(prop), value, key, short, ALL_ACKED
                 )
+        elif c["type"] in (4, 5):
+            p1, s1, p2, s2 = _decode_obliterate_places(c)
+            self.backend.apply_obliterate(p1, s1, p2, s2, key, short, ALL_ACKED)
         else:
             raise ValueError(f"unsupported merge-tree op type {c['type']}")
         return {"localSeq": ls}
@@ -245,8 +303,25 @@ class SharedStringChannel(Channel):
                     "props": {str(p): [v, k] for p, (v, k) in s.props.items()},
                 }
             )
+        seg_index = {id(s): i for i, s in enumerate(self.backend.segments)}
+        obs = []
+        for ob in self.backend.obliterates:
+            if not acked(ob.key):
+                raise RuntimeError("summarize with pending merge-tree state")
+            obs.append(
+                {
+                    "key": ob.key,
+                    "client": ob.client,
+                    "start": seg_index.get(id(ob.start_seg), -1),
+                    "startSide": ob.start_side,
+                    "end": seg_index.get(id(ob.end_seg), -1),
+                    "endSide": ob.end_side,
+                    "refSeq": ob.ref_seq,
+                }
+            )
         return {
             "segments": segs,
+            "obliterates": obs,
             "minSeq": self.backend.min_seq,
             # Lazily-materialized empty collections are omitted so replicas
             # that never touched a label summarize identically.
@@ -272,6 +347,21 @@ class SharedStringChannel(Channel):
                 props={int(p): (v, k) for p, (v, k) in e["props"].items()},
             )
             for e in summary["segments"]
+        ]
+        from .mergetree_ref import Obliterate
+
+        segs = self.backend.segments
+        self.backend.obliterates = [
+            Obliterate(
+                key=o["key"],
+                client=o["client"],
+                start_seg=segs[o["start"]] if o["start"] >= 0 else None,
+                start_side=o["startSide"],
+                end_seg=segs[o["end"]] if o["end"] >= 0 else None,
+                end_side=o["endSide"],
+                ref_seq=o["refSeq"],
+            )
+            for o in summary.get("obliterates", [])
         ]
 
     # ------------------------------------------------------------------ views

@@ -61,6 +61,30 @@ from ..protocol.stamps import (
     has_occurred,
 )
 
+# Endpoint sidedness for obliterate ranges (ref sequencePlace.ts Side).
+SIDE_BEFORE = 0
+SIDE_AFTER = 1
+
+
+@dataclass
+class Obliterate:
+    """One obliterate in the collab window (ref mergeTreeNodes.ts
+    ObliterateInfo): stamp + boundary anchors.  Anchors are the segments
+    CONTAINING the endpoint characters (the reference's StayOnRemove local
+    references, mergeTree.ts:2100-2126); on split an anchor follows the half
+    holding its character — first char for Before sides, last char for After
+    sides — which makes the reference's ordinal-window overlap test
+    (Obliterates.findOverlapping, mergeTree.ts:566) a plain index-window
+    test over the flat segment list."""
+
+    key: int          # stamp key (acked seq, or LOCAL_BASE+localSeq pending)
+    client: int
+    start_seg: "Segment | None"   # None = boundary past the end of content
+    start_side: int
+    end_seg: "Segment | None"
+    end_side: int
+    ref_seq: int
+
 
 @dataclass
 class Segment:
@@ -71,9 +95,16 @@ class Segment:
     ins_client: int
     # Overlapping remove stamps as (key, client), sorted by key; the first
     # entry is the winning (earliest) remove — reference seg.removes[0].
+    # Obliterate stamps live in the same list (visibility is identical);
+    # which stamps are slice-removes is recoverable from the Obliterates set.
     removes: list[tuple[int, int]] = field(default_factory=list)
     # prop id -> (value, stamp key of the write that set it)
     props: dict[int, tuple[int, int]] = field(default_factory=dict)
+    # Newest concurrent obliterate overlapping this segment's insertion point
+    # at insert time (ref ISegmentInsideObliterateInfo
+    # .obliteratePrecedingInsertion) — drives the last-obliterater-wins
+    # tiebreak when later obliterates consider marking this segment.
+    ob_preceding: "Obliterate | None" = None
 
     @property
     def rem_key(self) -> int:
@@ -95,6 +126,8 @@ class RefMergeTree:
         self.segments: list[Segment] = []
         self.local_client = local_client
         self.min_seq = 0
+        # Obliterates inside the collab window (ref MergeTree.obliterates).
+        self.obliterates: list[Obliterate] = []
         # Stamp keys minted by regenerate_pending during a reconnect replay.
         # When regenerating a LATER pending op, segments carrying these keys
         # must count as "will be sequenced before it" even though the fresh
@@ -136,6 +169,14 @@ class RefMergeTree:
             seg, text=seg.text[offset:], removes=list(seg.removes), props=dict(seg.props)
         )
         self.segments[i : i + 1] = [left, right]
+        # Obliterate anchors follow the half holding their endpoint char:
+        # Before sides sit on the segment's first char (left half), After
+        # sides on its last char (right half).
+        for ob in self.obliterates:
+            if ob.start_seg is seg:
+                ob.start_seg = left if ob.start_side == SIDE_BEFORE else right
+            if ob.end_seg is seg:
+                ob.end_seg = left if ob.end_side == SIDE_BEFORE else right
 
     def _tiebreak(self, seg: Segment, op_key: int) -> bool:
         """mergeTree.ts breakTie leaf case (pos == 0, invisible segment)."""
@@ -217,8 +258,137 @@ class RefMergeTree:
     ) -> Segment:
         idx = self._find_insert_index(pos, op_key, ref_seq, op_client)
         seg = Segment(text=text, ins_key=op_key, ins_client=op_client)
+        if self.obliterates:
+            self._obliterate_on_insert(seg, idx, op_key, op_client, ref_seq)
         self.segments.insert(idx, seg)
         return seg
+
+    def _obliterate_on_insert(
+        self, seg: Segment, idx: int, op_key: int, op_client: int, ref_seq: int
+    ) -> None:
+        """Mark a just-placed segment removed when it lands inside an
+        obliterated range the inserter had not seen (ref mergeTree.ts
+        blockInsert obliterate handling, :1647-1745, incl. the
+        last-obliterater-gets-to-insert tiebreak)."""
+        index_of = {id(s): i for i, s in enumerate(self.segments)}
+        concurrent: list[Obliterate] = []
+        for ob in self.obliterates:
+            if ob.start_seg is None or ob.end_seg is None:
+                continue
+            s_i = index_of[id(ob.start_seg)]
+            e_i = index_of[id(ob.end_seg)]
+            # New segment will sit at idx: inside the anchor window iff it
+            # lands strictly after the start anchor and at/before the end
+            # anchor (ordinal test, findOverlapping).
+            if s_i < idx <= e_i and ob.key > ref_seq:
+                concurrent.append(ob)
+        if not concurrent:
+            return
+        newest = max(concurrent, key=lambda o: o.key)
+        seg.ob_preceding = newest
+        others = [o for o in concurrent if o.client != op_client]
+        if not others or newest.client == op_client:
+            # Inserter performed (or wins with) the newest overlapping
+            # obliterate: their insert survives.
+            return
+        acked_concurrent = [o for o in concurrent if acked(o.key)]
+        newest_acked = max(acked_concurrent, key=lambda o: o.key, default=None)
+        removes: list[tuple[int, int]] = []
+        if newest_acked is None or newest_acked is newest or newest_acked.client != op_client:
+            removes = [(o.key, o.client) for o in others if acked(o.key)]
+        unacked = [o for o in concurrent if not acked(o.key)]
+        if unacked:
+            oldest_unacked = min(unacked, key=lambda o: o.key)
+            removes.append((oldest_unacked.key, oldest_unacked.client))
+        seg.removes = sorted(removes)
+
+    def _split_at(self, pos: int, ref_seq: int, view_client: int) -> None:
+        """Split so perspective-position ``pos`` falls on a segment boundary
+        (ref ensureIntervalBoundary)."""
+        covered = 0
+        for i, seg in enumerate(self.segments):
+            if not seg.visible(ref_seq, view_client):
+                continue
+            seg_end = covered + len(seg.text)
+            if covered < pos < seg_end:
+                self._split(i, pos - covered)
+                return
+            if seg_end >= pos:
+                return
+            covered = seg_end
+
+    def _seg_containing(self, p: int, ref_seq: int, view_client: int) -> Segment | None:
+        """The perspective-visible segment containing char position ``p``."""
+        covered = 0
+        for seg in self.segments:
+            if not seg.visible(ref_seq, view_client):
+                continue
+            if covered <= p < covered + len(seg.text):
+                return seg
+            covered += len(seg.text)
+        return None
+
+    def apply_obliterate(
+        self,
+        pos1: int,
+        side1: int,
+        pos2: int,
+        side2: int,
+        op_key: int,
+        op_client: int,
+        ref_seq: int,
+    ) -> list[Segment]:
+        """Obliterate the sided range — a slice-remove that also swallows
+        concurrent inserts (ref mergeTree.ts obliterateRange:2262 /
+        obliterateRangeSided:2083).  ``(pos1, side1)``/``(pos2, side2)`` name
+        endpoint CHARACTERS in the op's perspective; the non-sided wire op
+        {pos1, pos2} maps to (pos1, Before) .. (pos2-1, After).
+
+        Returns the segments marked removed by this op (for channel events).
+        Already-obliterated/removed segments are not re-marked (the marking
+        perspective is "everything inserted, nothing removed" — the
+        RemoteObliteratePerspective of the reference's design doc)."""
+        vis_len = self.visible_length(ref_seq, op_client)
+        start_pos = pos1 + (1 if side1 == SIDE_AFTER else 0)
+        end_pos = pos2 + (1 if side2 == SIDE_AFTER else 0)
+        if not (0 <= pos1 <= pos2 < vis_len and start_pos <= end_pos):
+            raise ValueError(
+                f"obliterate places ({pos1},{side1})..({pos2},{side2}) invalid "
+                f"for visible length {vis_len}"
+            )
+        self._split_at(start_pos, ref_seq, op_client)
+        self._split_at(end_pos, ref_seq, op_client)
+        start_seg = self._seg_containing(pos1, ref_seq, op_client)
+        end_seg = self._seg_containing(pos2, ref_seq, op_client)
+        assert start_seg is not None and end_seg is not None
+        ob = Obliterate(
+            key=op_key, client=op_client,
+            start_seg=start_seg, start_side=side1,
+            end_seg=end_seg, end_side=side2,
+            ref_seq=ref_seq,
+        )
+        index_of = {id(s): i for i, s in enumerate(self.segments)}
+        lo = index_of[id(start_seg)] + (1 if side1 == SIDE_AFTER else 0)
+        hi = index_of[id(end_seg)] - (1 if side2 == SIDE_BEFORE else 0)
+        marked: list[Segment] = []
+        for i in range(lo, hi + 1):
+            seg = self.segments[i]
+            if seg.removes:
+                continue  # already dead to the remote-obliterate perspective
+            if (
+                not acked(seg.ins_key)
+                and seg.ob_preceding is not None
+                and not acked(seg.ob_preceding.key)
+                and acked(op_key)
+            ):
+                # A local pending obliterate is newer than this incoming
+                # acked one: last-obliterater-wins lets our insert live.
+                continue
+            seg.removes.append((op_key, op_client))
+            seg.removes.sort()
+            marked.append(seg)
+        self.obliterates.append(ob)
+        return marked
 
     def apply_remove(
         self, pos1: int, pos2: int, op_key: int, op_client: int, ref_seq: int
@@ -278,6 +448,14 @@ class RefMergeTree:
             for prop, (value, key) in list(seg.props.items()):
                 if key == local_key:
                     seg.props[prop] = (value, seq)
+        for ob in self.obliterates:
+            if ob.key == local_key:
+                # In-place stamp rewrite keeps every seg.ob_preceding
+                # reference consistent (the reference mutates ObliterateInfo
+                # .stamp the same way on ack).
+                ob.key = seq
+                if client is not None:
+                    ob.client = client
         return inserted, removed
 
     # ----------------------------------------------------- converged queries
@@ -450,6 +628,9 @@ class RefMergeTree:
         client.ts:1452).
         """
         key = encode_stamp(-1, local_seq)
+        ob = next((o for o in self.obliterates if o.key == key), None)
+        if ob is not None:
+            return self._regenerate_obliterate(ob, key, new_local_seq, squash, new_client)
         # (kind, pos1, pos2, payload, [segments]) collected before re-stamping
         # so position math sees unmodified stamps throughout.
         plans: list[tuple[int, int, int, object, list[Segment]]] = []
@@ -508,9 +689,14 @@ class RefMergeTree:
         flush_remove()
         flush_annotate()
 
-        # Squashed segments are dead: never resubmitted, never acked. Drop.
+        # Squashed segments are dead: never resubmitted, never acked. Drop
+        # (keeping obliterate anchors resident; invisible everywhere anyway).
         if squash:
-            self.segments = [s for s in self.segments if not self._squashed(s)]
+            anchored = self._anchored_ids()
+            self.segments = [
+                s for s in self.segments
+                if id(s) in anchored or not self._squashed(s)
+            ]
 
         out: list[tuple[int, dict]] = []
         for kind, pos1, pos2, payload, segs in plans:
@@ -543,16 +729,113 @@ class RefMergeTree:
                 )
         return out
 
+    def _regenerate_obliterate(
+        self, ob: Obliterate, key: int, new_local_seq, squash: bool, new_client: int | None
+    ) -> list[tuple[int, dict]]:
+        """Re-mint a pending obliterate against current state: recompute the
+        sided endpoint places in the prefix-visible space the resubmitted op
+        will be interpreted in, and re-stamp every segment it marked.  The
+        regenerated op is always emitted in sided form (type 5), which
+        subsumes the plain form.  Reference analog: the experimental
+        mergeTreeEnableObliterateReconnect path (client.ts
+        regeneratePendingOp + obliterate range fixup)."""
+        index_of = {id(s): i for i, s in enumerate(self.segments)}
+        s_i = index_of.get(id(ob.start_seg), len(self.segments))
+        e_i = index_of.get(id(ob.end_seg), len(self.segments))
+        b_s = b_e = total = 0
+        for i, seg in enumerate(self.segments):
+            if not self._visible_at_prefix(seg, key, exclude_key=key, squash=squash):
+                continue
+            n = len(seg.text)
+            if i < s_i or (i == s_i and ob.start_side == SIDE_AFTER):
+                b_s += n
+            if i < e_i or (i == e_i and ob.end_side == SIDE_AFTER):
+                b_e += n
+            total += n
+
+        # Express the surviving boundaries as sided places; a boundary whose
+        # anchor char vanished from the prefix view degrades to the nearest
+        # expressible place (slide semantics).
+        if ob.start_side == SIDE_AFTER and b_s > 0:
+            start = {"pos": b_s - 1, "before": False}
+        else:
+            start = {"pos": b_s, "before": True}
+        if ob.end_side == SIDE_BEFORE and b_e < total:
+            end = {"pos": b_e, "before": True}
+        elif b_e > 0:
+            end = {"pos": b_e - 1, "before": False}
+        else:
+            end = None
+
+        start_char = start["pos"]
+        end_char = end["pos"] if end is not None else -1
+        start_bound = start["pos"] + (0 if start["before"] else 1)
+        end_bound = (end["pos"] + (0 if end["before"] else 1)) if end is not None else -1
+        if (
+            end is None
+            or not (0 <= start_char <= end_char < total)
+            or start_bound > end_bound
+        ):
+            # The whole range (and any place to re-anchor it) is gone from
+            # the prefix view: the op is never resubmitted, so retire the
+            # obliterate — strip its (never-to-ack) stamps and drop the
+            # record so it stops swallowing future concurrent inserts.
+            for seg in self.segments:
+                if any(k == key for k, _c in seg.removes):
+                    seg.removes = [(k, c) for k, c in seg.removes if k != key]
+            self.obliterates.remove(ob)
+            return []
+
+        # Re-stamp the marked segments and the obliterate record itself so
+        # the re-minted op acks independently.
+        fresh = new_local_seq()
+        fresh_key = encode_stamp(-1, fresh)
+        self._regenerated_keys.add(fresh_key)
+        for seg in self.segments:
+            if any(k == key for k, _c in seg.removes):
+                seg.removes = sorted(
+                    (fresh_key if k == key else k,
+                     new_client if new_client is not None and k == key else c)
+                    for k, c in seg.removes
+                )
+        ob.key = fresh_key
+        if new_client is not None:
+            ob.client = new_client
+        return [(fresh, {"type": 5, "pos1": start, "pos2": end})]
+
     # --------------------------------------------------------------- lifetime
     def update_min_seq(self, min_seq: int) -> None:
         if min_seq > self.min_seq:
             self.min_seq = min_seq
+            # Obliterates below the window floor can never affect another
+            # legal op (every refSeq >= minSeq sees them); release their
+            # anchors first (ref Obliterates.setMinSeq).
+            self.obliterates = [
+                ob for ob in self.obliterates
+                if not (acked(ob.key) and ob.key <= min_seq)
+            ]
             self.zamboni()
 
+    def _anchored_ids(self) -> set[int]:
+        out: set[int] = set()
+        for ob in self.obliterates:
+            if ob.start_seg is not None:
+                out.add(id(ob.start_seg))
+            if ob.end_seg is not None:
+                out.add(id(ob.end_seg))
+        return out
+
     def zamboni(self) -> None:
-        """Evict segments unreferenceable from any legal perspective."""
+        """Evict segments unreferenceable from any legal perspective.
+
+        Segments anchoring a live obliterate are retained even when evictable
+        (the anchor defines the obliterate's index window for concurrent
+        inserts); they fall out once the obliterate leaves the collab window.
+        """
+        anchored = self._anchored_ids()
         self.segments = [
             s
             for s in self.segments
-            if not (s.removes and acked(s.removes[0][0]) and s.removes[0][0] <= self.min_seq)
+            if id(s) in anchored
+            or not (s.removes and acked(s.removes[0][0]) and s.removes[0][0] <= self.min_seq)
         ]

@@ -55,6 +55,11 @@ ERR_SEG_OVERFLOW = 1
 ERR_TEXT_OVERFLOW = 2
 ERR_REM_OVERFLOW = 4
 ERR_POS_RANGE = 8
+ERR_OB_OVERFLOW = 16
+
+# Obliterate endpoint sides (ref sequencePlace.ts Side; mergetree_ref.py).
+SIDE_BEFORE = 0
+SIDE_AFTER = 1
 
 
 class OpKind:
@@ -63,12 +68,14 @@ class OpKind:
     REMOVE = 2
     ANNOTATE = 3
     ACK = 4
+    OBLITERATE = 5  # always sided: plain {pos1,pos2} encodes as (pos1,B)..(pos2-1,A)
 
 
 # Op row layout (int32[OP_FIELDS]):
 #   0 kind | 1 key | 2 client | 3 ref_seq | 4 pos1 | 5 pos2 | 6 a | 7 b
 # a/b meaning per kind: INSERT a=text_len, REMOVE -, ANNOTATE a=prop_slot
-# b=value, ACK a=local_seq b=seq.
+# b=value, ACK a=local_seq b=seq, OBLITERATE a=side1 b=side2 (pos1/pos2 are
+# the endpoint CHARACTER positions, already in sided form).
 OP_FIELDS = 8
 
 
@@ -82,10 +89,21 @@ class DocState(NamedTuple):
     seg_len: jnp.ndarray      # int32[S]
     ins_key: jnp.ndarray      # int32[S] insert stamp key
     ins_client: jnp.ndarray   # int32[S] insert short client id
+    seg_uid: jnp.ndarray      # int32[S] stable identity (obliterate anchors)
+    seg_obpre: jnp.ndarray    # int32[S] newest concurrent ob key at insert (-1)
     rem_keys: tuple           # R x int32[S] remove stamp keys (NO_REMOVE empty)
     rem_clients: tuple        # R x int32[S]
     prop_keys: tuple          # P x int32[S] LWW stamp key per prop (-1 unset)
     prop_vals: tuple          # P x int32[S]
+    uid_next: jnp.ndarray     # int32 scalar
+    # Obliterate window table (ref MergeTree.obliterates): OB slots, key=-1
+    # free.  Anchors reference segments by uid; sides follow mergetree_ref.
+    ob_key: jnp.ndarray       # int32[OB]
+    ob_client: jnp.ndarray    # int32[OB]
+    ob_start_uid: jnp.ndarray  # int32[OB]
+    ob_end_uid: jnp.ndarray    # int32[OB]
+    ob_start_side: jnp.ndarray  # int32[OB]
+    ob_end_side: jnp.ndarray    # int32[OB]
     min_seq: jnp.ndarray      # int32 scalar (collab-window floor)
     error: jnp.ndarray        # int32 scalar bitmask
 
@@ -95,8 +113,9 @@ def init_state(
     remove_slots: int = 4,
     prop_slots: int = 4,
     text_capacity: int = 8192,
+    ob_slots: int = 8,
 ) -> DocState:
-    S, R, P, T = max_segments, remove_slots, prop_slots, text_capacity
+    S, R, P, T, OB = max_segments, remove_slots, prop_slots, text_capacity, ob_slots
     return DocState(
         text=jnp.zeros((T,), I32),
         text_end=jnp.zeros((), I32),
@@ -105,10 +124,19 @@ def init_state(
         seg_len=jnp.zeros((S,), I32),
         ins_key=jnp.zeros((S,), I32),
         ins_client=jnp.full((S,), -1, I32),
+        seg_uid=jnp.full((S,), -1, I32),
+        seg_obpre=jnp.full((S,), -1, I32),
         rem_keys=tuple(jnp.full((S,), NO_REMOVE, I32) for _ in range(R)),
         rem_clients=tuple(jnp.full((S,), -1, I32) for _ in range(R)),
         prop_keys=tuple(jnp.full((S,), -1, I32) for _ in range(P)),
         prop_vals=tuple(jnp.zeros((S,), I32) for _ in range(P)),
+        uid_next=jnp.zeros((), I32),
+        ob_key=jnp.full((OB,), -1, I32),
+        ob_client=jnp.full((OB,), -1, I32),
+        ob_start_uid=jnp.full((OB,), -1, I32),
+        ob_end_uid=jnp.full((OB,), -1, I32),
+        ob_start_side=jnp.zeros((OB,), I32),
+        ob_end_side=jnp.zeros((OB,), I32),
         min_seq=jnp.zeros((), I32),
         error=jnp.zeros((), I32),
     )
@@ -145,6 +173,23 @@ def encode_insert(
         )
         out.append((op, payload))
     return out
+
+
+def encode_obliterate(
+    pos1: int,
+    side1: int,
+    pos2: int,
+    side2: int,
+    op_key: int,
+    op_client: int,
+    ref_seq: int,
+) -> np.ndarray:
+    """Encode a sided obliterate op row.  The plain wire form {pos1, pos2}
+    encodes as ``encode_obliterate(pos1, SIDE_BEFORE, pos2-1, SIDE_AFTER)``."""
+    return np.array(
+        [OpKind.OBLITERATE, op_key, op_client, ref_seq, pos1, pos2, side1, side2],
+        np.int32,
+    )
 
 
 def _any_tree(masks) -> jnp.ndarray:
@@ -195,6 +240,8 @@ class _NewSeg(NamedTuple):
     seg_len: jnp.ndarray
     ins_key: jnp.ndarray
     ins_client: jnp.ndarray
+    seg_uid: jnp.ndarray
+    seg_obpre: jnp.ndarray
     rem_keys: tuple
     rem_clients: tuple
     prop_keys: tuple
@@ -216,6 +263,8 @@ def _open_slot(s: DocState, k, do: jnp.ndarray, new: _NewSeg) -> DocState:
         seg_len=sh(s.seg_len, new.seg_len),
         ins_key=sh(s.ins_key, new.ins_key),
         ins_client=sh(s.ins_client, new.ins_client),
+        seg_uid=sh(s.seg_uid, new.seg_uid),
+        seg_obpre=sh(s.seg_obpre, new.seg_obpre),
         rem_keys=tuple(sh(a, v) for a, v in zip(s.rem_keys, new.rem_keys)),
         rem_clients=tuple(sh(a, v) for a, v in zip(s.rem_clients, new.rem_clients)),
         prop_keys=tuple(sh(a, v) for a, v in zip(s.prop_keys, new.prop_keys)),
@@ -230,7 +279,9 @@ def _ensure_boundary(s: DocState, pos, ref_seq, client) -> DocState:
 
     Mirrors the reference's split-on-walk (ensureIntervalBoundary /
     insertingWalk split path): after this, ``pos`` falls on a segment
-    boundary of the perspective-visible sequence.
+    boundary of the perspective-visible sequence.  Obliterate anchors on the
+    split segment follow the half holding their endpoint char: Before sides
+    keep the left half's uid, After sides move to the right half.
     """
     vis = _visible(s, ref_seq, client)
     vlen, excl = _vis_lengths(s, vis)
@@ -238,11 +289,15 @@ def _ensure_boundary(s: DocState, pos, ref_seq, client) -> DocState:
     k = _first_true(mid, jnp.asarray(0, I32))  # default unused when ~do
     do = jnp.any(mid)
     off = pos - excl[k]
+    old_uid = s.seg_uid[k]
+    right_uid = s.uid_next
     right = _NewSeg(
         seg_start=s.seg_start[k] + off,
         seg_len=s.seg_len[k] - off,
         ins_key=s.ins_key[k],
         ins_client=s.ins_client[k],
+        seg_uid=right_uid,
+        seg_obpre=s.seg_obpre[k],
         rem_keys=tuple(a[k] for a in s.rem_keys),
         rem_clients=tuple(a[k] for a in s.rem_clients),
         prop_keys=tuple(a[k] for a in s.prop_keys),
@@ -251,7 +306,14 @@ def _ensure_boundary(s: DocState, pos, ref_seq, client) -> DocState:
     s2 = _open_slot(s, k + 1, do, right)
     # Trim the left half (only when the split actually happened).
     new_len = jnp.where(do, off, s2.seg_len[k])
-    return s2._replace(seg_len=s2.seg_len.at[k].set(new_len))
+    moved_start = do & (s2.ob_start_uid == old_uid) & (s2.ob_start_side == SIDE_AFTER)
+    moved_end = do & (s2.ob_end_uid == old_uid) & (s2.ob_end_side == SIDE_AFTER)
+    return s2._replace(
+        seg_len=s2.seg_len.at[k].set(new_len),
+        uid_next=s2.uid_next + do.astype(I32),
+        ob_start_uid=jnp.where(moved_start, right_uid, s2.ob_start_uid),
+        ob_end_uid=jnp.where(moved_end, right_uid, s2.ob_end_uid),
+    )
 
 
 # --------------------------------------------------------------------------
@@ -263,6 +325,68 @@ def _tiebreak(s: DocState, op_key) -> jnp.ndarray:
     rem0 = _min_tree(s.rem_keys)  # removes[0] = earliest remove stamp
     rem_clause = (rem0 < LOCAL_BASE) & (rem0 > op_key)
     return (op_key > s.ins_key) | rem_clause
+
+
+def _ob_anchor_indices(s: DocState) -> tuple[jnp.ndarray, ...]:
+    """Per obliterate slot: segment indices of its start/end anchor uids
+    ([OB] each) plus found masks.  OB is small (<=8), so the [OB, S]
+    comparison matrix is cheap."""
+    alive = _alive(s)
+    m_start = (s.ob_start_uid[:, None] == s.seg_uid[None, :]) & alive[None, :]
+    m_end = (s.ob_end_uid[:, None] == s.seg_uid[None, :]) & alive[None, :]
+    s_idx = jnp.argmax(m_start, axis=1).astype(I32)
+    e_idx = jnp.argmax(m_end, axis=1).astype(I32)
+    return s_idx, m_start.any(axis=1), e_idx, m_end.any(axis=1)
+
+
+def _obliterate_new_segment(s: DocState, k, key, client, ref_seq):
+    """The insert-time obliterate rule (ref mergeTree.ts blockInsert
+    :1647-1745): decide whether the segment about to land at index ``k`` is
+    swallowed by concurrent obliterates, and with which remove stamps.
+
+    Returns (rem_keys, rem_clients, obpre, overflow): the new segment's
+    remove slots (sorted ascending, NO_REMOVE padded), its
+    obliteratePrecedingInsertion stamp key (-1 none), and whether the
+    candidate stamps overflowed the R slots."""
+    R = len(s.rem_keys)
+    OB = s.ob_key.shape[0]
+    used = s.ob_key >= 0
+    s_idx, s_found, e_idx, e_found = _ob_anchor_indices(s)
+    # New segment lands at k: inside the anchor window iff strictly after
+    # the start anchor and at/before the end anchor (pre-insert indices).
+    inside = used & s_found & e_found & (s_idx < k) & (e_idx >= k)
+    concurrent = inside & (s.ob_key > ref_seq)
+    others = concurrent & (s.ob_client != client)
+    any_conc = jnp.any(concurrent)
+    conc_keys = jnp.where(concurrent, s.ob_key, -1)
+    newest_i = jnp.argmax(conc_keys)
+    newest_key = conc_keys[newest_i]
+    newest_client = s.ob_client[newest_i]
+    acked_conc = concurrent & (s.ob_key < LOCAL_BASE)
+    any_acked = jnp.any(acked_conc)
+    na_keys = jnp.where(acked_conc, s.ob_key, -1)
+    na_i = jnp.argmax(na_keys)
+    na_key = na_keys[na_i]
+    na_client = s.ob_client[na_i]
+    unacked_conc = concurrent & (s.ob_key >= LOCAL_BASE)
+    ou_keys = jnp.where(unacked_conc, s.ob_key, NO_REMOVE)
+    ou_i = jnp.argmin(ou_keys)
+    mark = jnp.any(others) & any_conc & (newest_client != client)
+    include_acked = ~any_acked | (na_key == newest_key) | (na_client != client)
+    is_oldest_unacked = unacked_conc & (jnp.arange(OB, dtype=I32) == ou_i)
+    cand = mark & ((others & acked_conc & include_acked) | is_oldest_unacked)
+    # Extract the R smallest candidate stamps into sorted remove slots.
+    ckeys = jnp.where(cand, s.ob_key, NO_REMOVE)
+    rem_k, rem_c = [], []
+    for _ in range(R):
+        i = jnp.argmin(ckeys)
+        kk = ckeys[i]
+        rem_k.append(kk)
+        rem_c.append(jnp.where(kk < NO_REMOVE, s.ob_client[i], -1))
+        ckeys = ckeys.at[i].set(NO_REMOVE)
+    overflow = jnp.any(ckeys < NO_REMOVE)
+    obpre = jnp.where(any_conc, newest_key, -1)
+    return tuple(rem_k), tuple(rem_c), obpre, overflow
 
 
 def _do_insert(s: DocState, op, payload) -> DocState:
@@ -284,7 +408,9 @@ def _do_insert(s: DocState, op, payload) -> DocState:
     dst = jnp.where((tpos < text_len) & ~text_over, s.text_end + tpos, T)
     text = s.text.at[dst].set(payload, mode="drop")
 
-    R = len(s.rem_keys)
+    new_rem_k, new_rem_c, obpre, rem_over = _obliterate_new_segment(
+        s, k, key, client, ref_seq
+    )
     P = len(s.prop_keys)
     zero = jnp.zeros((), I32)
     new = _NewSeg(
@@ -292,8 +418,10 @@ def _do_insert(s: DocState, op, payload) -> DocState:
         seg_len=text_len,
         ins_key=key,
         ins_client=client,
-        rem_keys=tuple(jnp.full((), NO_REMOVE, I32) for _ in range(R)),
-        rem_clients=tuple(jnp.full((), -1, I32) for _ in range(R)),
+        seg_uid=s.uid_next,
+        seg_obpre=obpre,
+        rem_keys=new_rem_k,
+        rem_clients=new_rem_c,
         prop_keys=tuple(jnp.full((), -1, I32) for _ in range(P)),
         prop_vals=tuple(zero for _ in range(P)),
     )
@@ -302,9 +430,11 @@ def _do_insert(s: DocState, op, payload) -> DocState:
     return s._replace(
         text=jnp.where(text_over, s.text, text),
         text_end=s.text_end + jnp.where(ok, text_len, 0),
+        uid_next=s.uid_next + ok.astype(I32),
         error=s.error
         | jnp.where(text_over, ERR_TEXT_OVERFLOW, 0)
-        | jnp.where(pos > total, ERR_POS_RANGE, 0),
+        | jnp.where(pos > total, ERR_POS_RANGE, 0)
+        | jnp.where(ok & rem_over, ERR_REM_OVERFLOW, 0),
     )
 
 
@@ -354,6 +484,68 @@ def _do_annotate(s: DocState, op, payload) -> DocState:
     return s._replace(prop_keys=tuple(prop_keys), prop_vals=tuple(prop_vals))
 
 
+def _do_obliterate(s: DocState, op, payload) -> DocState:
+    """Sided obliterate (ref mergeTree.ts obliterateRangeSided:2083): mark
+    every not-yet-removed segment in the anchor window — concurrent inserts
+    included — and record the obliterate for insert-time swallowing.
+
+    pos1/pos2 are the endpoint CHARACTER positions in the op's perspective;
+    op[6]/op[7] carry the sides (plain {pos1,pos2} ops encode as
+    (pos1, Before) .. (pos2-1, After))."""
+    key, client, ref_seq = op[1], op[2], op[3]
+    pos1, pos2, side1, side2 = op[4], op[5], op[6], op[7]
+    start_pos = pos1 + side1
+    end_pos = pos2 + side2
+    vis = _visible(s, ref_seq, client)
+    vlen, _excl = _vis_lengths(s, vis)
+    total = jnp.sum(vlen)
+    valid = (0 <= pos1) & (pos1 <= pos2) & (pos2 < total) & (start_pos <= end_pos)
+    s = _ensure_boundary(s, jnp.where(valid, start_pos, 0), ref_seq, client)
+    s = _ensure_boundary(s, jnp.where(valid, end_pos, 0), ref_seq, client)
+    vis = _visible(s, ref_seq, client)
+    vlen, excl = _vis_lengths(s, vis)
+    # Anchor segments: the visible segments containing the endpoint chars.
+    cont_s = vis & (excl <= pos1) & (pos1 < excl + vlen)
+    cont_e = vis & (excl <= pos2) & (pos2 < excl + vlen)
+    s_idx = _first_true(cont_s, s.nseg)
+    e_idx = _first_true(cont_e, s.nseg)
+    lo = s_idx + (side1 == SIDE_AFTER).astype(I32)
+    hi = e_idx - (side2 == SIDE_BEFORE).astype(I32)
+    idx = jnp.arange(s.seg_len.shape[0], dtype=I32)
+    # Remote-obliterate perspective: everything inserted and not already
+    # removed (acked or local-pending) is alive for marking.
+    no_rem = ~_any_tree([k != NO_REMOVE for k in s.rem_keys])
+    # Last-obliterater-wins: never mark a local pending insert whose newest
+    # preceding obliterate is an (even newer) local pending one.
+    skip = (s.ins_key >= LOCAL_BASE) & (s.seg_obpre >= LOCAL_BASE) & (key < LOCAL_BASE)
+    mark = valid & _alive(s) & (idx >= lo) & (idx <= hi) & no_rem & ~skip
+    # Marked segments have no removes yet: slot 0 is free by construction.
+    rem_keys = (jnp.where(mark, key, s.rem_keys[0]),) + s.rem_keys[1:]
+    rem_clients = (jnp.where(mark, client, s.rem_clients[0]),) + s.rem_clients[1:]
+    # Record in the obliterate window table.
+    free = s.ob_key < 0
+    slot = _first_true(free, jnp.asarray(0, I32))
+    has_free = jnp.any(free)
+    rec = valid & has_free
+
+    def put(arr, val):
+        return arr.at[slot].set(jnp.where(rec, val, arr[slot]))
+
+    return s._replace(
+        rem_keys=rem_keys,
+        rem_clients=rem_clients,
+        ob_key=put(s.ob_key, key),
+        ob_client=put(s.ob_client, client),
+        ob_start_uid=put(s.ob_start_uid, s.seg_uid[s_idx]),
+        ob_end_uid=put(s.ob_end_uid, s.seg_uid[e_idx]),
+        ob_start_side=put(s.ob_start_side, side1),
+        ob_end_side=put(s.ob_end_side, side2),
+        error=s.error
+        | jnp.where(~valid, ERR_POS_RANGE, 0)
+        | jnp.where(valid & ~has_free, ERR_OB_OVERFLOW, 0),
+    )
+
+
 def _do_ack(s: DocState, op, payload) -> DocState:
     local_seq, seq = op[6], op[7]
     local_key = LOCAL_BASE + local_seq
@@ -361,6 +553,8 @@ def _do_ack(s: DocState, op, payload) -> DocState:
         ins_key=jnp.where(s.ins_key == local_key, seq, s.ins_key),
         rem_keys=tuple(jnp.where(a == local_key, seq, a) for a in s.rem_keys),
         prop_keys=tuple(jnp.where(a == local_key, seq, a) for a in s.prop_keys),
+        ob_key=jnp.where(s.ob_key == local_key, seq, s.ob_key),
+        seg_obpre=jnp.where(s.seg_obpre == local_key, seq, s.seg_obpre),
     )
 
 
@@ -373,6 +567,7 @@ def apply_op(s: DocState, op: jnp.ndarray, payload: jnp.ndarray) -> DocState:
         _do_remove,
         _do_annotate,
         _do_ack,
+        _do_obliterate,
     ]
     s = jax.lax.switch(kind, branches, s, op, payload)
     return s
@@ -408,7 +603,17 @@ def compact(s: DocState) -> DocState:
     alive = _alive(s)
     rem0 = _min_tree(s.rem_keys)
     dead = alive & (rem0 < LOCAL_BASE) & (rem0 <= s.min_seq)
-    keep = alive & ~dead
+    # Segments anchoring a live obliterate stay resident (their index
+    # position defines the obliterate's window for concurrent inserts).
+    used = s.ob_key >= 0
+    anchored = (
+        (
+            (s.seg_uid[None, :] == s.ob_start_uid[:, None])
+            | (s.seg_uid[None, :] == s.ob_end_uid[:, None])
+        )
+        & used[:, None]
+    ).any(axis=0)
+    keep = alive & ~(dead & ~anchored)
     # Stable order: kept segments first, in original order.
     order = jnp.argsort(~keep, stable=True)
     n_keep = jnp.sum(keep).astype(I32)
@@ -422,6 +627,8 @@ def compact(s: DocState) -> DocState:
         seg_len=g(s.seg_len, 0),
         ins_key=g(s.ins_key, 0),
         ins_client=g(s.ins_client, -1),
+        seg_uid=g(s.seg_uid, -1),
+        seg_obpre=g(s.seg_obpre, -1),
         rem_keys=tuple(g(a, NO_REMOVE) for a in s.rem_keys),
         rem_clients=tuple(g(a, -1) for a in s.rem_clients),
         prop_keys=tuple(g(a, -1) for a in s.prop_keys),
@@ -431,7 +638,14 @@ def compact(s: DocState) -> DocState:
 
 
 def set_min_seq(s: DocState, min_seq) -> DocState:
-    return s._replace(min_seq=jnp.maximum(s.min_seq, jnp.asarray(min_seq, I32)))
+    """Advance the collab-window floor and release obliterates below it
+    (ref Obliterates.setMinSeq)."""
+    new_min = jnp.maximum(s.min_seq, jnp.asarray(min_seq, I32))
+    expired = (s.ob_key >= 0) & (s.ob_key < LOCAL_BASE) & (s.ob_key <= new_min)
+    return s._replace(
+        min_seq=new_min,
+        ob_key=jnp.where(expired, -1, s.ob_key),
+    )
 
 
 # --------------------------------------------------------------------------

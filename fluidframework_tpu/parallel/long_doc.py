@@ -56,10 +56,14 @@ def _specs_for(state: DocState, axis: str) -> DocState:
     return DocState(
         text=r, text_end=r, nseg=r,
         seg_start=s, seg_len=s, ins_key=s, ins_client=s,
+        seg_uid=s, seg_obpre=s,
         rem_keys=(s,) * len(state.rem_keys),
         rem_clients=(s,) * len(state.rem_clients),
         prop_keys=(s,) * len(state.prop_keys),
         prop_vals=(s,) * len(state.prop_vals),
+        # The obliterate window table is tiny: replicate it like scalars.
+        uid_next=r, ob_key=r, ob_client=r, ob_start_uid=r, ob_end_uid=r,
+        ob_start_side=r, ob_end_side=r,
         min_seq=r, error=r,
     )
 
