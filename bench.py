@@ -93,8 +93,12 @@ def main() -> None:
     state = jax.tree.map(lambda x: jnp.broadcast_to(x, (D,) + x.shape), proto)
 
     # ops arrive as [B, F, D] per step (doc axis minor): vmap over axis 2.
-    apply_batch = jax.vmap(mk.apply_ops, in_axes=(0, 2, 2))
-    compact_batch = jax.vmap(lambda s, m: mk.compact(mk.set_min_seq(s, m)))
+    # The ob_flag is a SCALAR computed over the whole batch so the obliterate
+    # machinery stays a real cond branch under vmap (mk.apply_op docstring).
+    apply_batch = jax.vmap(mk.apply_ops, in_axes=(0, 2, 2, None))
+    compact_batch = jax.vmap(
+        lambda s, m, f: mk.compact(mk.set_min_seq(s, m), f), in_axes=(0, 0, None)
+    )
 
     ce = args.compact_every
 
@@ -102,10 +106,13 @@ def main() -> None:
         def body(carry, xs):
             s, i = carry
             ops, payloads, min_seqs = xs
-            s = apply_batch(s, ops, payloads)
+            flag = jnp.any(s.ob_key >= 0) | jnp.any(
+                ops[:, 0, :] == mk.OpKind.OBLITERATE
+            )
+            s = apply_batch(s, ops, payloads, flag)
             s = jax.lax.cond(
                 (i + 1) % ce == 0,
-                lambda s: compact_batch(s, min_seqs),
+                lambda s: compact_batch(s, min_seqs, jnp.any(s.ob_key >= 0)),
                 lambda s: s,
                 s,
             )

@@ -15,20 +15,8 @@ from typing import Any
 from ..protocol.stamps import ALL_ACKED, acked, encode_stamp
 from .mergetree_ref import SIDE_AFTER, SIDE_BEFORE, RefMergeTree, Segment
 from .sequence_intervals import IntervalCollection, StringOpLog
+from .shared_string import decode_obliterate_places as _decode_obliterate_places
 from ..runtime.channel import Channel, MessageCollection
-
-
-def _decode_obliterate_places(c: dict) -> tuple[int, int, int, int]:
-    """Wire op -> (pos1, side1, pos2, side2) endpoint places.  The plain
-    OBLITERATE form {pos1, pos2} is the sided range (pos1, Before) ..
-    (pos2-1, After) (ref mergeTree.ts obliterateRange:2282)."""
-    if c["type"] == 4:
-        return c["pos1"], SIDE_BEFORE, c["pos2"] - 1, SIDE_AFTER
-    p1, p2 = c["pos1"], c["pos2"]
-    return (
-        p1["pos"], SIDE_BEFORE if p1["before"] else SIDE_AFTER,
-        p2["pos"], SIDE_BEFORE if p2["before"] else SIDE_AFTER,
-    )
 
 
 class SharedStringChannel(Channel):
@@ -102,10 +90,14 @@ class SharedStringChannel(Channel):
         After (before=False) start / Before end expands the range to swallow
         concurrent inserts adjacent to the exclusive endpoint
         (ref ops.ts OBLITERATE_SIDED, mergeTreeEnableSidedObliterate)."""
+        from .shared_string import validate_obliterate_places
+
+        s1 = SIDE_BEFORE if start[1] else SIDE_AFTER
+        s2 = SIDE_BEFORE if end[1] else SIDE_AFTER
+        validate_obliterate_places(start[0], s1, end[0], s2, len(self.text))
         ls = self._next_local_seq()
         self.backend.apply_obliterate(
-            start[0], SIDE_BEFORE if start[1] else SIDE_AFTER,
-            end[0], SIDE_BEFORE if end[1] else SIDE_AFTER,
+            start[0], s1, end[0], s2,
             encode_stamp(-1, ls), self.backend.local_client, ALL_ACKED,
         )
         self.submit_local_message(
@@ -192,7 +184,8 @@ class SharedStringChannel(Channel):
             rem_segs: list = []
             if m.local:
                 ins_segs, rem_segs = self.backend.ack(
-                    m.local_metadata["localSeq"], env.seq, sender
+                    m.local_metadata["localSeq"], env.seq, sender,
+                    ref_seq=env.ref_seq,
                 )
             elif c["type"] == 0:
                 ins_segs = [
@@ -305,7 +298,9 @@ class SharedStringChannel(Channel):
             )
         seg_index = {id(s): i for i, s in enumerate(self.backend.segments)}
         obs = []
-        for ob in self.backend.obliterates:
+        # Issuers append their own obliterate at issuance, remotes at apply:
+        # stamp-key order is the replica-independent canonical order.
+        for ob in sorted(self.backend.obliterates, key=lambda o: o.key):
             if not acked(ob.key):
                 raise RuntimeError("summarize with pending merge-tree state")
             obs.append(

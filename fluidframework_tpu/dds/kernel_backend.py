@@ -39,9 +39,10 @@ class KernelMergeTree:
         prop_slots: int = 4,
         text_capacity: int = 8192,
         max_insert_len: int = 64,
+        ob_slots: int = 8,
     ) -> None:
         self.state = mk.init_state(
-            max_segments, remove_slots, prop_slots, text_capacity
+            max_segments, remove_slots, prop_slots, text_capacity, ob_slots
         )
         self.max_insert_len = max_insert_len
         self._empty_payload = np.zeros((max_insert_len,), np.int32)
@@ -82,6 +83,11 @@ class KernelMergeTree:
                 mk.OpKind.REMOVE, key=op_key, client=op_client, ref_seq=ref_seq,
                 pos1=pos1, pos2=pos2,
             )
+        )
+
+    def apply_obliterate(self, pos1, side1, pos2, side2, op_key, op_client, ref_seq) -> None:
+        self._step(
+            mk.encode_obliterate(pos1, side1, pos2, side2, op_key, op_client, ref_seq)
         )
 
     def apply_annotate(self, pos1, pos2, prop, value, op_key, op_client, ref_seq) -> None:

@@ -179,8 +179,13 @@ class RefMergeTree:
                 ob.end_seg = left if ob.end_side == SIDE_BEFORE else right
 
     def _tiebreak(self, seg: Segment, op_key: int) -> bool:
-        """mergeTree.ts breakTie leaf case (pos == 0, invisible segment)."""
-        if op_key > seg.ins_key:
+        """mergeTree.ts breakTie leaf case (pos == 0, invisible segment).
+
+        Equal keys (>=) win the tie: they arise only from ops grouped in one
+        batch, where the issuer already placed the later op's segment in
+        front under its (strictly larger) localSeq stamp — remotes must
+        agree after ack collapses the batch onto one sequence number."""
+        if op_key >= seg.ins_key:
             return True
         return (
             bool(seg.removes)
@@ -373,8 +378,44 @@ class RefMergeTree:
         marked: list[Segment] = []
         for i in range(lo, hi + 1):
             seg = self.segments[i]
-            if seg.removes:
-                continue  # already dead to the remote-obliterate perspective
+            # Marking visit rule (ref nodeMap mergeTree.ts:2990-3001 +
+            # markRemoved:2144, walking RemoteObliteratePerspective for
+            # remote ops, perspective.ts:201): a REMOTE obliterate visits —
+            # and splices its stamp into — every window segment EXCEPT those
+            # dead in both views: hidden by an acked remove AND not visible
+            # at the op's refSeq AND not a local pending insert.  So it
+            # still stamps (a) segments covered only by unacked local
+            # removes, (b) segments whose acked removes are concurrent with
+            # the obliterate (visible at its refSeq), and (c) local pending
+            # inserts; skipping any of those diverges the replicas' remove
+            # sets.  A LOCAL obliterate walks the local perspective: any
+            # remove present locally hides the segment.
+            has_acked_rem = any(acked(k) for k, _c in seg.removes)
+            if acked(op_key):
+                # A concurrent-inserted segment (insert not visible at the
+                # op's perspective) is spliced even when acked-removed: the
+                # obliterater's replica swallowed it at INSERT time (it held
+                # the pending obliterate when the insert arrived, ref
+                # blockInsert oldestUnacked, mergeTree.ts:1730-1740), so the
+                # walk on every other replica must add the same stamp — the
+                # exception being a pre-existing remove stamp from the same
+                # client (then the issuer's insert-time rule added only that
+                # older one, and the extra stamp would be unobservable).
+                ins_concurrent = not has_occurred(
+                    seg.ins_key, seg.ins_client, ref_seq, op_client
+                )
+                same_client_stamp = any(
+                    c == op_client and k < op_key for k, c in seg.removes
+                )
+                if (
+                    has_acked_rem
+                    and not seg.visible(ref_seq, op_client)
+                    and acked(seg.ins_key)
+                    and not (ins_concurrent and not same_client_stamp)
+                ):
+                    continue
+            elif seg.removes:
+                continue
             if (
                 not acked(seg.ins_key)
                 and seg.ob_preceding is not None
@@ -386,7 +427,10 @@ class RefMergeTree:
                 continue
             seg.removes.append((op_key, op_client))
             seg.removes.sort()
-            marked.append(seg)
+            # Event list: only segments this op removes from the ACKED view
+            # (ref removedSegments vs the splice path, mergeTree.ts:2177).
+            if not has_acked_rem:
+                marked.append(seg)
         self.obliterates.append(ob)
         return marked
 
@@ -416,17 +460,30 @@ class RefMergeTree:
             seg = self.segments[i]
             prev = seg.props.get(prop)
             # LWW by stamp order; pending local writes outrank acked remotes.
-            if prev is None or op_key > prev[1]:
+            # Ties (>=) go to the later-APPLIED op: ops grouped in one batch
+            # share a sequence number, and the issuer resolved them by
+            # localSeq order before ack — remotes must agree.
+            if prev is None or op_key >= prev[1]:
                 seg.props[prop] = (value, op_key)
 
     # -------------------------------------------------------------------- ack
-    def ack(self, local_seq: int, seq: int, client: int | None = None) -> None:
+    def ack(
+        self,
+        local_seq: int,
+        seq: int,
+        client: int | None = None,
+        ref_seq: int | None = None,
+    ) -> None:
         """Convert pending stamps with this localSeq to the acked seq.
 
         ``client`` (when given) re-stamps the client id to the identity the
         op was sequenced under — channel-hosted replicas stamp local pending
         ops with ``local_client`` and learn their short id only at ack, which
         keeps views stable across reconnection identity changes.
+        ``ref_seq`` (when given) rewrites an acked obliterate's recorded
+        refSeq to the wire value every remote replica stored (the issuer
+        created the record under the ALL_ACKED sentinel; summaries must be
+        replica-identical).
         """
         local_key = encode_stamp(-1, local_seq)
         self._regenerated_keys.discard(local_key)
@@ -456,6 +513,8 @@ class RefMergeTree:
                 ob.key = seq
                 if client is not None:
                     ob.client = client
+                if ref_seq is not None:
+                    ob.ref_seq = ref_seq
         return inserted, removed
 
     # ----------------------------------------------------- converged queries

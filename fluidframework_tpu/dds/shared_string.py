@@ -28,7 +28,35 @@ from ..protocol.messages import (
     UnsequencedMessage,
 )
 from ..protocol.stamps import ALL_ACKED, encode_stamp
-from .mergetree_ref import RefMergeTree
+from .mergetree_ref import SIDE_AFTER, SIDE_BEFORE, RefMergeTree
+
+
+def decode_obliterate_places(c: dict) -> tuple[int, int, int, int]:
+    """Wire op -> (pos1, side1, pos2, side2) endpoint places.  The plain
+    OBLITERATE form {pos1, pos2} is the sided range (pos1, Before) ..
+    (pos2-1, After) (ref mergeTree.ts obliterateRange:2282)."""
+    if c["type"] == int(DeltaType.OBLITERATE):
+        return c["pos1"], SIDE_BEFORE, c["pos2"] - 1, SIDE_AFTER
+    p1, p2 = c["pos1"], c["pos2"]
+    return (
+        p1["pos"], SIDE_BEFORE if p1["before"] else SIDE_AFTER,
+        p2["pos"], SIDE_BEFORE if p2["before"] else SIDE_AFTER,
+    )
+
+
+def validate_obliterate_places(
+    pos1: int, side1: int, pos2: int, side2: int, vis_len: int
+) -> None:
+    """Reject invalid sided places BEFORE submission: a backend that only
+    latches error flags (the kernel) must not broadcast an op that would
+    make every oracle-backed remote raise."""
+    start = pos1 + (1 if side1 == SIDE_AFTER else 0)
+    end = pos2 + (1 if side2 == SIDE_AFTER else 0)
+    if not (0 <= pos1 <= pos2 < vis_len and start <= end):
+        raise ValueError(
+            f"obliterate places ({pos1},{side1})..({pos2},{side2}) invalid "
+            f"for visible length {vis_len}"
+        )
 
 
 class MergeTreeBackend(Protocol):
@@ -37,6 +65,7 @@ class MergeTreeBackend(Protocol):
     def apply_insert(self, pos: int, text: str, op_key: int, op_client: int, ref_seq: int) -> None: ...
     def apply_remove(self, pos1: int, pos2: int, op_key: int, op_client: int, ref_seq: int) -> None: ...
     def apply_annotate(self, pos1: int, pos2: int, prop: int, value: int, op_key: int, op_client: int, ref_seq: int) -> None: ...
+    def apply_obliterate(self, pos1: int, side1: int, pos2: int, side2: int, op_key: int, op_client: int, ref_seq: int) -> None: ...
     def ack(self, local_seq: int, seq: int) -> None: ...
     def update_min_seq(self, min_seq: int) -> None: ...
     def visible_text(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> str: ...
@@ -94,6 +123,42 @@ class SharedString:
             pos1, pos2, encode_stamp(-1, self._local_seq), self.short_client, ALL_ACKED
         )
         self._submit({"type": int(DeltaType.REMOVE), "pos1": pos1, "pos2": pos2})
+
+    def obliterate_range(self, pos1: int, pos2: int) -> None:
+        """Slice-remove [pos1, pos2): also swallows concurrent inserts into
+        the range (ref client.ts applyObliterateRangeOp:558)."""
+        assert pos1 < pos2
+        self._require_joined()
+        self._local_seq += 1
+        self.backend.apply_obliterate(
+            pos1, SIDE_BEFORE, pos2 - 1, SIDE_AFTER,
+            encode_stamp(-1, self._local_seq), self.short_client, ALL_ACKED,
+        )
+        self._submit(
+            {"type": int(DeltaType.OBLITERATE), "pos1": pos1, "pos2": pos2}
+        )
+
+    def obliterate_range_sided(
+        self, start: tuple[int, bool], end: tuple[int, bool]
+    ) -> None:
+        """Sided obliterate: endpoints are (char pos, before) places
+        (ref ops.ts OBLITERATE_SIDED, client.ts:568)."""
+        self._require_joined()
+        s1 = SIDE_BEFORE if start[1] else SIDE_AFTER
+        s2 = SIDE_BEFORE if end[1] else SIDE_AFTER
+        validate_obliterate_places(start[0], s1, end[0], s2, len(self.text))
+        self._local_seq += 1
+        self.backend.apply_obliterate(
+            start[0], s1, end[0], s2,
+            encode_stamp(-1, self._local_seq), self.short_client, ALL_ACKED,
+        )
+        self._submit(
+            {
+                "type": int(DeltaType.OBLITERATE_SIDED),
+                "pos1": {"pos": start[0], "before": start[1]},
+                "pos2": {"pos": end[0], "before": end[1]},
+            }
+        )
 
     def annotate_range(self, pos1: int, pos2: int, prop: int, value: int) -> None:
         assert pos1 < pos2
@@ -180,6 +245,9 @@ class SharedString:
                 self.backend.apply_annotate(
                     c["pos1"], c["pos2"], int(prop), value, key, client, ref_seq
                 )
+        elif kind in (DeltaType.OBLITERATE, DeltaType.OBLITERATE_SIDED):
+            p1, s1, p2, s2 = decode_obliterate_places(c)
+            self.backend.apply_obliterate(p1, s1, p2, s2, key, client, ref_seq)
         else:
             raise ValueError(f"unsupported merge-tree op type {kind}")
 

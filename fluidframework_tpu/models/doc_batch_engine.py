@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..dds.shared_string import SharedString  # re-exported convenience
+from ..dds.shared_string import SharedString, decode_obliterate_places
 from ..ops import mergetree_kernel as mk
 from ..parallel.mesh import doc_mesh, shard_docs
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
@@ -86,15 +86,20 @@ class DocBatchEngine:
                 lambda x: jax.device_put(x, docs_sharding), self.state
             )
 
-        batched = jax.vmap(mk.apply_ops)
+        batched = jax.vmap(mk.apply_ops, in_axes=(0, 0, 0, None))
 
         def _step(state, ops, payloads):
-            new = batched(state, ops, payloads)
-            return new
+            # Scalar (unbatched) obliterate gate: keeps the ob machinery a
+            # real lax.cond branch under vmap (see mk.apply_op docstring).
+            flag = jnp.any(state.ob_key >= 0) | jnp.any(
+                ops[..., 0] == mk.OpKind.OBLITERATE
+            )
+            return batched(state, ops, payloads, flag)
 
         def _compact(state, min_seqs):
             state = jax.vmap(mk.set_min_seq)(state, min_seqs)
-            return jax.vmap(mk.compact)(state)
+            flag = jnp.any(state.ob_key >= 0)
+            return jax.vmap(mk.compact, in_axes=(0, None))(state, flag)
 
         self._step = jax.jit(_step, donate_argnums=(0,))
         self._compact = jax.jit(_compact, donate_argnums=(0,))
@@ -145,6 +150,12 @@ class DocBatchEngine:
                     )
                 )
                 h.payloads.append(np.zeros((self.max_insert_len,), np.int32))
+        elif kind in (DeltaType.OBLITERATE, DeltaType.OBLITERATE_SIDED):
+            p1, s1, p2, s2 = decode_obliterate_places(c)
+            h.queue.append(
+                mk.encode_obliterate(p1, s1, p2, s2, msg.seq, client, msg.ref_seq)
+            )
+            h.payloads.append(np.zeros((self.max_insert_len,), np.int32))
         else:
             raise ValueError(f"unsupported op type {kind}")
         h.min_seq = max(h.min_seq, msg.min_seq)

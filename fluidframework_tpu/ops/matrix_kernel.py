@@ -104,7 +104,8 @@ def _perm_insert(perm: mk.DocState, next_handle, op):
         [jnp.asarray(mk.OpKind.INSERT, I32), op[1], op[2], op[3], op[4],
          jnp.zeros((), I32), count, jnp.zeros((), I32)]
     )
-    new_perm = mk._do_insert(perm, ins_op, payload)
+    # Permutation vectors never carry obliterates: ob machinery stays off.
+    new_perm = mk._do_insert(perm, ins_op, payload, jnp.zeros((), bool))
     return new_perm, next_handle + count
 
 

@@ -156,19 +156,21 @@ def encode_insert(
 ) -> list[tuple[np.ndarray, np.ndarray]]:
     """Encode one insert as (op_row, payload) pairs, chunking long text.
 
-    Chunks share the op's stamp and insert left-to-right at pos+i: since the
-    boundary walk treats same-stamp segments identically, chunks always land
-    adjacently — equivalent to the reference's single unbounded segment.
-    This is THE insert encoding; every ingest path must use it so chunk
-    placement can never diverge between host adapters.
+    Chunks share the op's stamp and are emitted BACK-TO-FRONT, all at
+    ``pos``: with the >=-tiebreak each later-emitted chunk lands immediately
+    before the previously placed one, whether that one is alive or was
+    swallowed by a concurrent obliterate — so the final order is the
+    original text order, equivalent to the reference's single unbounded
+    segment.  This is THE insert encoding; every ingest path must use it so
+    chunk placement can never diverge between host adapters.
     """
     out: list[tuple[np.ndarray, np.ndarray]] = []
-    for i in range(0, len(text), max_insert_len):
+    for i in reversed(range(0, len(text), max_insert_len)):
         chunk = text[i : i + max_insert_len]
         payload = np.zeros((max_insert_len,), np.int32)
         payload[: len(chunk)] = [ord(ch) for ch in chunk]
         op = np.array(
-            [OpKind.INSERT, op_key, op_client, ref_seq, pos + i, 0, len(chunk), 0],
+            [OpKind.INSERT, op_key, op_client, ref_seq, pos, 0, len(chunk), 0],
             np.int32,
         )
         out.append((op, payload))
@@ -321,10 +323,15 @@ def _ensure_boundary(s: DocState, pos, ref_seq, client) -> DocState:
 # --------------------------------------------------------------------------
 
 def _tiebreak(s: DocState, op_key) -> jnp.ndarray:
-    """Reference breakTie (mergeTree.ts:1811) as a per-segment mask."""
+    """Reference breakTie (mergeTree.ts:1811) as a per-segment mask.
+
+    Equal keys (>=) win the tie — grouped-batch ops share a sequence number
+    and the issuer placed the later op's segment in front by localSeq (see
+    mergetree_ref._tiebreak); same-stamp insert CHUNKS rely on this too
+    (encode_insert emits them back-to-front at one position)."""
     rem0 = _min_tree(s.rem_keys)  # removes[0] = earliest remove stamp
     rem_clause = (rem0 < LOCAL_BASE) & (rem0 > op_key)
-    return (op_key > s.ins_key) | rem_clause
+    return (op_key >= s.ins_key) | rem_clause
 
 
 def _ob_anchor_indices(s: DocState) -> tuple[jnp.ndarray, ...]:
@@ -389,7 +396,21 @@ def _obliterate_new_segment(s: DocState, k, key, client, ref_seq):
     return tuple(rem_k), tuple(rem_c), obpre, overflow
 
 
-def _do_insert(s: DocState, op, payload) -> DocState:
+def _no_obliterate_swallow(s: DocState):
+    """Cheap branch of the insert-time obliterate rule: empty ob table means
+    the new segment is never swallowed."""
+    R = len(s.rem_keys)
+    no = jnp.full((), NO_REMOVE, I32)
+    neg = jnp.full((), -1, I32)
+    return (
+        tuple(no for _ in range(R)),
+        tuple(neg for _ in range(R)),
+        neg,
+        jnp.zeros((), bool),
+    )
+
+
+def _do_insert(s: DocState, op, payload, ob_flag) -> DocState:
     pos, key, client, ref_seq = op[4], op[1], op[2], op[3]
     text_len = op[6]
     s = _ensure_boundary(s, pos, ref_seq, client)
@@ -408,8 +429,14 @@ def _do_insert(s: DocState, op, payload) -> DocState:
     dst = jnp.where((tpos < text_len) & ~text_over, s.text_end + tpos, T)
     text = s.text.at[dst].set(payload, mode="drop")
 
-    new_rem_k, new_rem_c, obpre, rem_over = _obliterate_new_segment(
-        s, k, key, client, ref_seq
+    # The [OB,S] swallow analysis only runs when an obliterate can exist
+    # (``ob_flag`` is a SCALAR so this stays a real branch under vmap —
+    # batched predicates would degrade cond to select-of-both-branches).
+    new_rem_k, new_rem_c, obpre, rem_over = jax.lax.cond(
+        ob_flag,
+        lambda s: _obliterate_new_segment(s, k, key, client, ref_seq),
+        _no_obliterate_swallow,
+        s,
     )
     P = len(s.prop_keys)
     zero = jnp.zeros((), I32)
@@ -451,23 +478,28 @@ def _mark_range(s: DocState, op) -> tuple[DocState, jnp.ndarray]:
     return s, mark
 
 
-def _do_remove(s: DocState, op, payload) -> DocState:
-    key, client = op[1], op[2]
-    s, mark = _mark_range(s, op)
-    # First free slot per segment, cascading over the R slot arrays.
+def _splice_remove_stamp(s: DocState, mark, key, client):
+    """Place a remove stamp into the first free slot of every marked
+    segment; returns (rem_keys, rem_clients, overflow)."""
     rem_keys = list(s.rem_keys)
     rem_clients = list(s.rem_clients)
     placed = jnp.zeros_like(mark)
     for r in range(len(rem_keys)):
-        free = rem_keys[r] == NO_REMOVE
-        sel = mark & free & ~placed
+        sel = mark & (rem_keys[r] == NO_REMOVE) & ~placed
         rem_keys[r] = jnp.where(sel, key, rem_keys[r])
         rem_clients[r] = jnp.where(sel, client, rem_clients[r])
         placed = placed | sel
+    return tuple(rem_keys), tuple(rem_clients), jnp.any(mark & ~placed)
+
+
+def _do_remove(s: DocState, op, payload) -> DocState:
+    key, client = op[1], op[2]
+    s, mark = _mark_range(s, op)
+    rem_keys, rem_clients, overflow = _splice_remove_stamp(s, mark, key, client)
     return s._replace(
-        rem_keys=tuple(rem_keys),
-        rem_clients=tuple(rem_clients),
-        error=s.error | jnp.where(jnp.any(mark & ~placed), ERR_REM_OVERFLOW, 0),
+        rem_keys=rem_keys,
+        rem_clients=rem_clients,
+        error=s.error | jnp.where(overflow, ERR_REM_OVERFLOW, 0),
     )
 
 
@@ -478,7 +510,8 @@ def _do_annotate(s: DocState, op, payload) -> DocState:
     prop_vals = list(s.prop_vals)
     for p in range(len(prop_keys)):
         # LWW by stamp key: pending local writes outrank acked remotes.
-        win = (prop_slot == p) & mark & (key > prop_keys[p])
+        # Ties (>=) go to the later-applied op (grouped-batch shared seqs).
+        win = (prop_slot == p) & mark & (key >= prop_keys[p])
         prop_keys[p] = jnp.where(win, key, prop_keys[p])
         prop_vals[p] = jnp.where(win, value, prop_vals[p])
     return s._replace(prop_keys=tuple(prop_keys), prop_vals=tuple(prop_vals))
@@ -512,16 +545,35 @@ def _do_obliterate(s: DocState, op, payload) -> DocState:
     lo = s_idx + (side1 == SIDE_AFTER).astype(I32)
     hi = e_idx - (side2 == SIDE_BEFORE).astype(I32)
     idx = jnp.arange(s.seg_len.shape[0], dtype=I32)
-    # Remote-obliterate perspective: everything inserted and not already
-    # removed (acked or local-pending) is alive for marking.
-    no_rem = ~_any_tree([k != NO_REMOVE for k in s.rem_keys])
+    # Marking visit rule (ref nodeMap mergeTree.ts:2990-3001 + markRemoved
+    # splice, walking RemoteObliteratePerspective for remote ops): a REMOTE
+    # obliterate visits — and splices into — every window segment except
+    # those dead in both views: acked-removed AND invisible at the op's
+    # refSeq AND not a local pending insert.  A LOCAL obliterate marks
+    # exactly the segments visible to the op's (local) perspective.
+    rem_min = _min_tree(s.rem_keys)
+    has_acked_rem = rem_min < LOCAL_BASE
+    is_local_ins = s.ins_key >= LOCAL_BASE
+    # Concurrent-inserted segments are spliced even when acked-removed (the
+    # obliterater's replica swallowed them at insert time), unless an older
+    # remove stamp from the same client already covers them (then the extra
+    # stamp would be unobservable and the issuer never added it).
+    ins_conc = ~((s.ins_key <= ref_seq) | (s.ins_client == client))
+    same_client_stamp = _any_tree(
+        [(c == client) & (k < key) for k, c in zip(s.rem_keys, s.rem_clients)]
+    )
+    visit = jnp.where(
+        key >= LOCAL_BASE,
+        vis,
+        ~has_acked_rem | vis | is_local_ins | (ins_conc & ~same_client_stamp),
+    )
     # Last-obliterater-wins: never mark a local pending insert whose newest
     # preceding obliterate is an (even newer) local pending one.
     skip = (s.ins_key >= LOCAL_BASE) & (s.seg_obpre >= LOCAL_BASE) & (key < LOCAL_BASE)
-    mark = valid & _alive(s) & (idx >= lo) & (idx <= hi) & no_rem & ~skip
-    # Marked segments have no removes yet: slot 0 is free by construction.
-    rem_keys = (jnp.where(mark, key, s.rem_keys[0]),) + s.rem_keys[1:]
-    rem_clients = (jnp.where(mark, client, s.rem_clients[0]),) + s.rem_clients[1:]
+    mark = valid & _alive(s) & (idx >= lo) & (idx <= hi) & visit & ~skip
+    # Splice the stamp into the first free remove slot (segments covered by
+    # earlier removes already occupy lower slots).
+    rem_keys, rem_clients, rem_over = _splice_remove_stamp(s, mark, key, client)
     # Record in the obliterate window table.
     free = s.ob_key < 0
     slot = _first_true(free, jnp.asarray(0, I32))
@@ -542,7 +594,8 @@ def _do_obliterate(s: DocState, op, payload) -> DocState:
         ob_end_side=put(s.ob_end_side, side2),
         error=s.error
         | jnp.where(~valid, ERR_POS_RANGE, 0)
-        | jnp.where(valid & ~has_free, ERR_OB_OVERFLOW, 0),
+        | jnp.where(valid & ~has_free, ERR_OB_OVERFLOW, 0)
+        | jnp.where(rem_over, ERR_REM_OVERFLOW, 0),
     )
 
 
@@ -558,32 +611,51 @@ def _do_ack(s: DocState, op, payload) -> DocState:
     )
 
 
-def apply_op(s: DocState, op: jnp.ndarray, payload: jnp.ndarray) -> DocState:
-    """Apply one op row (+ its text payload row) to one document."""
+def apply_op(
+    s: DocState, op: jnp.ndarray, payload: jnp.ndarray, ob_flag=None
+) -> DocState:
+    """Apply one op row (+ its text payload row) to one document.
+
+    ``ob_flag`` gates the obliterate machinery off the hot path: it must be
+    True whenever the ob table may be nonempty or this op may be an
+    OBLITERATE (default: computed per doc).  Batched callers MUST pass a
+    scalar flag computed OUTSIDE vmap (any doc's table nonempty | any op in
+    the batch is OBLITERATE): an unbatched predicate keeps lax.cond a real
+    branch under vmap, a batched one degrades it to select-of-both-branches.
+    """
+    if ob_flag is None:
+        ob_flag = jnp.any(s.ob_key >= 0) | (op[0] == OpKind.OBLITERATE)
     kind = op[0]
     branches = [
         lambda s, op, p: s,  # NOOP
-        _do_insert,
+        lambda s, op, p: _do_insert(s, op, p, ob_flag),
         _do_remove,
         _do_annotate,
         _do_ack,
-        _do_obliterate,
+        lambda s, op, p: jax.lax.cond(
+            ob_flag, lambda st: _do_obliterate(st, op, p), lambda st: st, s
+        ),
     ]
     s = jax.lax.switch(kind, branches, s, op, payload)
     return s
 
 
-def apply_ops(s: DocState, ops: jnp.ndarray, payloads: jnp.ndarray) -> DocState:
+def apply_ops(
+    s: DocState, ops: jnp.ndarray, payloads: jnp.ndarray, ob_flag=None
+) -> DocState:
     """Apply a batch of ops to one document, in order (lax.scan).
 
     ops: int32[B, OP_FIELDS]; payloads: int32[B, MAX_INSERT_LEN].
     This is the per-document sequential spine; parallelism comes from
-    `jax.vmap(apply_ops)` over a leading document axis.
+    `jax.vmap(apply_ops)` over a leading document axis (pass ``ob_flag``
+    with in_axes=None — see apply_op).
     """
+    if ob_flag is None:
+        ob_flag = jnp.any(s.ob_key >= 0) | jnp.any(ops[:, 0] == OpKind.OBLITERATE)
 
     def step(carry, xs):
         op, payload = xs
-        return apply_op(carry, op, payload), None
+        return apply_op(carry, op, payload, ob_flag), None
 
     out, _ = jax.lax.scan(step, s, (ops, payloads))
     return out
@@ -593,26 +665,35 @@ def apply_ops(s: DocState, ops: jnp.ndarray, payloads: jnp.ndarray) -> DocState:
 # Compaction (zamboni)
 # --------------------------------------------------------------------------
 
-def compact(s: DocState) -> DocState:
+def compact(s: DocState, ob_flag=None) -> DocState:
     """Evict segments whose winning remove is acked at or below min_seq.
 
     Reference zamboni.ts:33 — such segments are invisible to every legal
     perspective (refSeq >= minSeq), so dropping them is unobservable.
-    Stable-compacts the arrays with an argsort gather.
+    Stable-compacts the arrays with an argsort gather.  ``ob_flag`` gates
+    the [OB,S] anchor-retention matrix (scalar; see apply_op).
     """
+    if ob_flag is None:
+        ob_flag = jnp.any(s.ob_key >= 0)
     alive = _alive(s)
     rem0 = _min_tree(s.rem_keys)
     dead = alive & (rem0 < LOCAL_BASE) & (rem0 <= s.min_seq)
+
     # Segments anchoring a live obliterate stay resident (their index
     # position defines the obliterate's window for concurrent inserts).
-    used = s.ob_key >= 0
-    anchored = (
-        (
-            (s.seg_uid[None, :] == s.ob_start_uid[:, None])
-            | (s.seg_uid[None, :] == s.ob_end_uid[:, None])
-        )
-        & used[:, None]
-    ).any(axis=0)
+    def _anchored(s):
+        used = s.ob_key >= 0
+        return (
+            (
+                (s.seg_uid[None, :] == s.ob_start_uid[:, None])
+                | (s.seg_uid[None, :] == s.ob_end_uid[:, None])
+            )
+            & used[:, None]
+        ).any(axis=0)
+
+    anchored = jax.lax.cond(
+        ob_flag, _anchored, lambda s: jnp.zeros_like(alive), s
+    )
     keep = alive & ~(dead & ~anchored)
     # Stable order: kept segments first, in original order.
     order = jnp.argsort(~keep, stable=True)

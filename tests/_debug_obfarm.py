@@ -1,0 +1,113 @@
+"""Shrinker for the obliterate farm: record the full schedule (ops, flushes,
+partial deliveries) for a failing seed, then greedily drop events while the
+failure (divergence or exception) reproduces.
+
+Usage: python tests/_debug_obfarm.py <seed>   (seed as in test_obliterate)
+"""
+
+import pathlib
+import random
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+from fluidframework_tpu.dds.shared_string import SharedString
+from fluidframework_tpu.server.local_service import LocalDocument
+
+from test_mergetree_oracle import draw_op, issue_op, pump
+
+
+def record(seed):
+    """Run the farm schedule for ``seed``, recording every event."""
+    rng = random.Random(7000 + seed)
+    doc = LocalDocument("d")
+    n = rng.randint(2, 4)
+    clients = [SharedString(client_id=f"c{i}") for i in range(n)]
+    for c in clients:
+        doc.connect(c.client_id, c.process)
+    doc.process_all()
+    events = []
+    try:
+        for _round in range(rng.randint(4, 10)):
+            for i, c in enumerate(clients):
+                for _ in range(rng.randint(0, 3)):
+                    events.append(("op", i, draw_op(rng, len(c.text))))
+                    issue_op(c, events[-1][2])
+                if rng.random() < 0.7:
+                    events.append(("flush", i))
+                    for m in c.take_outbox():
+                        doc.submit(m)
+            k = rng.randint(0, doc.pending_count)
+            events.append(("deliver", k))
+            doc.process_some(k)
+    except Exception as e:  # noqa: BLE001
+        print(f"(record aborted at event {len(events)}: {e!r})")
+    return n, events
+
+
+def replay(n, events):
+    """Replay an event list; returns None on success or a failure string."""
+    doc = LocalDocument("d")
+    clients = [SharedString(client_id=f"c{i}") for i in range(n)]
+    for c in clients:
+        doc.connect(c.client_id, c.process)
+    doc.process_all()
+    try:
+        for ev in events:
+            if ev[0] == "op":
+                c = clients[ev[1]]
+                op = ev[2]
+                # Re-validate against the replica's current view; skip ops
+                # that no longer fit (shrinking changed preceding state).
+                m = len(c.text)
+                if op[0] == "insert":
+                    if op[1] > m:
+                        continue
+                elif op[0] == "obliterate_sided":
+                    if op[1][0] >= m or op[2][0] >= m:
+                        continue
+                elif op[2] > m or op[1] >= m:
+                    continue
+                issue_op(c, op)
+            elif ev[0] == "flush":
+                for msg in clients[ev[1]].take_outbox():
+                    doc.submit(msg)
+            else:
+                doc.process_some(min(ev[1], doc.pending_count))
+        pump(doc, clients)
+    except Exception as e:  # noqa: BLE001
+        return f"exception: {e!r}"
+    texts = [c.text for c in clients]
+    if len(set(texts)) != 1:
+        return f"diverged: {texts}"
+    return None
+
+
+def shrink(n, events):
+    fail = replay(n, events)
+    assert fail, "full replay does not fail"
+    changed = True
+    while changed:
+        changed = False
+        i = 0
+        while i < len(events):
+            cand = events[:i] + events[i + 1 :]
+            if replay(n, cand):
+                events = cand
+                changed = True
+            else:
+                i += 1
+    return events, replay(n, events)
+
+
+if __name__ == "__main__":
+    seed = int(sys.argv[1])
+    n, events = record(seed)
+    fail = replay(n, events)
+    print(f"seed {seed} ({n} clients): {fail or 'converged (no repro)'}")
+    if fail:
+        small, f2 = shrink(n, events)
+        print(f"minimal ({len(small)} events): {f2}")
+        for ev in small:
+            print("  ", ev)

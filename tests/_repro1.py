@@ -1,0 +1,43 @@
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+from fluidframework_tpu.dds.shared_string import SharedString
+from fluidframework_tpu.server.local_service import LocalDocument
+from test_mergetree_oracle import issue_op, pump
+
+EVENTS = [
+    ("op", 3, ("insert", 0, "gf")),
+    ("flush", 3),
+    ("op", 0, ("insert", 0, "bd")),
+    ("deliver", 5),
+    ("op", 0, ("obliterate", 2, 3)),
+    ("flush", 0),
+    ("op", 3, ("insert", 1, "gf")),
+    ("op", 3, ("insert", 4, "aghg")),
+    ("deliver", 1),
+    ("op", 3, ("obliterate", 2, 6)),
+    ("op", 3, ("remove", 0, 2)),
+    ("op", 3, ("remove", 1, 2)),
+]
+
+doc = LocalDocument("d")
+clients = [SharedString(client_id=f"c{i}") for i in range(4)]
+for c in clients:
+    doc.connect(c.client_id, c.process)
+doc.process_all()
+for ev in EVENTS:
+    if ev[0] == "op":
+        issue_op(clients[ev[1]], ev[2])
+    elif ev[0] == "flush":
+        for m in clients[ev[1]].take_outbox():
+            doc.submit(m)
+    else:
+        doc.process_some(min(ev[1], doc.pending_count))
+pump(doc, clients)
+for c in clients:
+    print(c.client_id, repr(c.text))
+    for s in c.backend.segments:
+        print(f"   {s.text!r:10} ins=({s.ins_key},{s.ins_client}) rem={s.removes} obpre={None if s.ob_preceding is None else s.ob_preceding.key}")

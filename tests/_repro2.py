@@ -1,0 +1,41 @@
+import pathlib, sys
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+from fluidframework_tpu.dds.shared_string import SharedString
+from fluidframework_tpu.server.local_service import LocalDocument
+from test_mergetree_oracle import issue_op, pump
+EVENTS = [
+    ("op", 2, ("insert", 0, "hdhc")),
+    ("op", 2, ("insert", 3, "ggbf")),
+    ("op", 2, ("insert", 2, "bda")),
+    ("op", 0, ("insert", 0, "ae")),
+    ("op", 0, ("insert", 1, "hffa")),
+    ("op", 2, ("insert", 9, "afg")),
+    ("flush", 2),
+    ("deliver", 2),
+    ("op", 0, ("obliterate_sided", (0, True), (4, False))),
+    ("flush", 0),
+    ("op", 2, ("obliterate", 1, 6)),
+    ("flush", 2),
+    ("op", 0, ("obliterate_sided", (1, True), (5, False))),
+    ("deliver", 5),
+    ("op", 0, ("insert", 3, "ed")),
+]
+doc = LocalDocument("d")
+clients = [SharedString(client_id=f"c{i}") for i in range(3)]
+for c in clients:
+    doc.connect(c.client_id, c.process)
+doc.process_all()
+for ev in EVENTS:
+    if ev[0] == "op":
+        issue_op(clients[ev[1]], ev[2])
+    elif ev[0] == "flush":
+        for m in clients[ev[1]].take_outbox():
+            doc.submit(m)
+    else:
+        doc.process_some(min(ev[1], doc.pending_count))
+pump(doc, clients)
+for c in clients[:2]:
+    print(c.client_id, repr(c.text))
+    for s in c.backend.segments:
+        print(f"   {s.text!r:8} ins=({s.ins_key},{s.ins_client}) rem={s.removes} obpre={None if s.ob_preceding is None else s.ob_preceding.key}")

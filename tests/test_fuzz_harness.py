@@ -50,7 +50,10 @@ MAP_MODEL = DDSFuzzModel(name="sharedMap", channel_type="sharedMap",
 
 def string_generate(rng: random.Random, channel) -> dict | None:
     n = len(channel.text)
-    kind = rng.choices(["insert", "remove", "annotate", "interval"], [8, 4, 2, 2])[0]
+    kind = rng.choices(
+        ["insert", "remove", "annotate", "interval", "obliterate", "obliterate_sided"],
+        [8, 4, 2, 2, 2, 1],
+    )[0]
     if kind == "insert":
         return {"t": "insert", "pos": rng.randint(0, n),
                 "text": rng.choice("abcxyz") * rng.randint(1, 3)}
@@ -59,6 +62,17 @@ def string_generate(rng: random.Random, channel) -> dict | None:
     if kind == "remove":
         p1 = rng.randrange(n)
         return {"t": "remove", "p1": p1, "p2": rng.randint(p1 + 1, min(n, p1 + 4))}
+    if kind == "obliterate":
+        p1 = rng.randrange(n)
+        return {"t": "obliterate", "p1": p1, "p2": rng.randint(p1 + 1, min(n, p1 + 4))}
+    if kind == "obliterate_sided":
+        c1 = rng.randrange(n)
+        c2 = rng.randint(c1, n - 1)
+        s1 = rng.random() < 0.5
+        s2 = rng.random() < 0.5
+        if c1 == c2 and not s1 and s2:
+            s1 = True
+        return {"t": "obliterate_sided", "p1": [c1, s1], "p2": [c2, s2]}
     if kind == "annotate":
         p1 = rng.randrange(n)
         return {"t": "annotate", "p1": p1, "p2": rng.randint(p1 + 1, n),
@@ -72,6 +86,10 @@ def string_reduce(channel, op: dict) -> None:
         channel.insert_text(op["pos"], op["text"])
     elif op["t"] == "remove":
         channel.remove_range(op["p1"], op["p2"])
+    elif op["t"] == "obliterate":
+        channel.obliterate_range(op["p1"], op["p2"])
+    elif op["t"] == "obliterate_sided":
+        channel.obliterate_range_sided(tuple(op["p1"]), tuple(op["p2"]))
     elif op["t"] == "annotate":
         channel.annotate_range(op["p1"], op["p2"], op["prop"], op["val"])
     else:

@@ -149,7 +149,10 @@ class TestDirectedSemantics:
             assert all(len(s.text) > 0 for s in backend.segments)
 
 
-OPS = ("insert", "insert", "insert", "remove", "annotate")
+OPS = (
+    "insert", "insert", "insert", "remove", "annotate",
+    "obliterate", "obliterate_sided",
+)
 
 
 def draw_op(rng: random.Random, n: int, alphabet: str = "abcdefgh") -> tuple:
@@ -167,6 +170,18 @@ def draw_op(rng: random.Random, n: int, alphabet: str = "abcdefgh") -> tuple:
     p2 = rng.randint(p1 + 1, n)
     if kind == "remove":
         return ("remove", p1, p2)
+    if kind == "obliterate":
+        return ("obliterate", p1, p2)
+    if kind == "obliterate_sided":
+        # Sided endpoint CHARACTERS c1 <= c2 with sides such that the range
+        # boundary is non-inverted (start_bound <= end_bound).
+        c1 = rng.randint(0, n - 1)
+        c2 = rng.randint(c1, n - 1)
+        s1 = rng.random() < 0.5  # before?
+        s2 = rng.random() < 0.5
+        if c1 == c2 and not s1 and s2:
+            s1 = True  # (c,After)..(c,Before) would invert; degrade
+        return ("obliterate_sided", (c1, s1), (c2, s2))
     return ("annotate", p1, p2, rng.randint(0, 3), rng.randint(0, 1000))
 
 
@@ -175,6 +190,10 @@ def issue_op(c: SharedString, op: tuple) -> None:
         c.insert_text(op[1], op[2])
     elif op[0] == "remove":
         c.remove_range(op[1], op[2])
+    elif op[0] == "obliterate":
+        c.obliterate_range(op[1], op[2])
+    elif op[0] == "obliterate_sided":
+        c.obliterate_range_sided(op[1], op[2])
     else:
         c.annotate_range(op[1], op[2], op[3], op[4])
 

@@ -1,0 +1,295 @@
+"""Obliterate semantics: directed edge cases + obliterate-heavy farms.
+
+Reference analog: merge-tree client.obliterateFarm.spec.ts plus the directed
+obliterate suites (obliterate.spec.ts, obliterateSided tests).  Every
+directed test runs on BOTH backends (Python oracle and TPU kernel); the farm
+runs kernel-backed clients against an oracle observer replica.
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.kernel_backend import KernelMergeTree
+from fluidframework_tpu.dds.shared_string import SharedString
+from fluidframework_tpu.protocol.stamps import ALL_ACKED, acked
+from fluidframework_tpu.server.local_service import LocalDocument
+
+from test_mergetree_oracle import canon_annotations, draw_op, issue_op, pump
+
+
+def make_backend(which: str):
+    if which == "oracle":
+        return None  # SharedString defaults to RefMergeTree
+    return KernelMergeTree(max_insert_len=8, ob_slots=16)
+
+
+def make_doc(which: str, n: int):
+    doc = LocalDocument("d")
+    clients = [
+        SharedString(client_id=f"c{i}", backend=make_backend(which))
+        for i in range(n)
+    ]
+    for c in clients:
+        doc.connect(c.client_id, c.process)
+    doc.process_all()
+    return doc, clients
+
+
+BACKENDS = ("oracle", "kernel")
+
+
+@pytest.mark.parametrize("which", BACKENDS)
+class TestDirectedObliterate:
+    def test_basic_obliterate(self, which):
+        doc, (a, b) = make_doc(which, 2)
+        a.insert_text(0, "hello world")
+        pump(doc, [a, b])
+        a.obliterate_range(5, 11)
+        pump(doc, [a, b])
+        assert a.text == b.text == "hello"
+
+    def test_concurrent_insert_into_obliterated_range_is_swallowed(self, which):
+        """The defining obliterate behavior (vs set-remove): an insert
+        concurrent with an obliterate covering its position is swallowed
+        (ref mergeTree.ts blockInsert obliterate handling)."""
+        doc, (a, b) = make_doc(which, 2)
+        a.insert_text(0, "abcd")
+        pump(doc, [a, b])
+        a.obliterate_range(0, 4)
+        b.insert_text(2, "X")  # concurrent: lands inside the obliterated range
+        pump(doc, [a, b])
+        assert a.text == b.text == ""
+
+    def test_obliterater_own_insert_survives(self, which):
+        """The obliterating client's own insert into the range survives
+        (last-obliterater-gets-to-insert)."""
+        doc, (a, b) = make_doc(which, 2)
+        a.insert_text(0, "abcd")
+        pump(doc, [a, b])
+        a.obliterate_range(0, 4)
+        a.insert_text(0, "Y")  # a's view: text already empty locally
+        pump(doc, [a, b])
+        assert a.text == b.text == "Y"
+
+    def test_remote_obliterate_splices_over_local_pending_remove(self, which):
+        """ADVICE round-2 high: a remote obliterate must still stamp segments
+        covered only by an UNACKED LOCAL remove (RemoteObliteratePerspective,
+        perspective.ts:201 — local remove stamps have not 'occurred').  If it
+        skips them, replicas disagree on the remove set once the local remove
+        acks, and any op with refSeq in [ob.seq, removeAck.seq) resolves
+        positions differently."""
+        doc, (a, b, c) = make_doc(which, 3)
+        a.insert_text(0, "abcdefgh")
+        pump(doc, [a, b, c])
+        a.remove_range(1, 4)       # local pending remove of 'bcd' (not flushed)
+        b.obliterate_range(1, 5)   # concurrent obliterate of 'bcde'
+        for m in b.take_outbox():
+            doc.submit(m)
+        doc.process_all()          # ob sequenced; a's remove still pending
+        for m in a.take_outbox():  # a's remove sequenced next
+            doc.submit(m)
+        # c op with refSeq = ob.seq (c has not seen a's remove): intends 'fg'
+        # of its view "afgh".
+        c.remove_range(1, 3)
+        for m in c.take_outbox():
+            doc.submit(m)
+        doc.process_all()
+        pump(doc, [a, b, c])
+        assert a.text == b.text == c.text == "ah"
+
+    def test_overlapping_remove_and_obliterate_converge(self, which):
+        doc, (a, b) = make_doc(which, 2)
+        a.insert_text(0, "abcdef")
+        pump(doc, [a, b])
+        a.remove_range(1, 4)
+        b.obliterate_range(2, 6)
+        pump(doc, [a, b])
+        assert a.text == b.text == "a"
+
+    def test_last_obliterater_wins_insert(self, which):
+        """Two concurrent obliterates over one range; the LATER-sequenced
+        obliterater's concurrent insert into the range survives (ref
+        obliteratePrecedingInsertion last-obliterater-wins)."""
+        doc, (a, b) = make_doc(which, 2)
+        a.insert_text(0, "abcd")
+        pump(doc, [a, b])
+        a.obliterate_range(0, 4)
+        for m in a.take_outbox():
+            doc.submit(m)
+        b.obliterate_range(0, 4)   # sequenced after a's
+        b.insert_text(0, "Z")      # b: the newest obliterater inserts
+        pump(doc, [a, b])
+        assert a.text == b.text == "Z"
+
+    def test_earlier_obliterater_front_insert_escapes_later_obliterate(self, which):
+        """a obliterates, inserts Y at the front (protected by its own ob),
+        then b's concurrent obliterate of the same chars is sequenced later.
+        Y landed BEFORE b's start anchor char (tie-break front placement), so
+        it is outside b's window and survives (ref nodeMap: a zero-length-at-
+        refSeq segment at the walk start satisfies start >= nextPos and is
+        skipped; insert-time findOverlapping likewise has idx <= start)."""
+        doc, (a, b) = make_doc(which, 2)
+        a.insert_text(0, "abcd")
+        pump(doc, [a, b])
+        a.obliterate_range(0, 4)
+        a.insert_text(0, "Y")
+        for m in a.take_outbox():
+            doc.submit(m)
+        b.obliterate_range(0, 4)   # sequenced last; b had not seen a's ops
+        pump(doc, [a, b])
+        assert a.text == b.text == "Y"
+
+    def test_sided_obliterate_expand_after_start(self, which):
+        """(c, After) start excludes c itself but swallows concurrent inserts
+        landing right after it."""
+        doc, (a, b) = make_doc(which, 2)
+        a.insert_text(0, "abcd")
+        pump(doc, [a, b])
+        # Obliterate (0, After)..(3, After): keeps 'a', removes 'bcd'.
+        a.obliterate_range_sided((0, False), (3, False))
+        b.insert_text(1, "X")  # concurrent insert right after 'a': swallowed
+        pump(doc, [a, b])
+        assert a.text == b.text == "a"
+
+    def test_sided_obliterate_before_end_swallows_adjacent_insert(self, which):
+        """A (c, Before) end excludes char c from removal, but the endpoint
+        sticks to c: a concurrent insert landing just before c is still
+        inside the window and is swallowed (the sided-expansion behavior the
+        plain form (c-1, After) would NOT have)."""
+        doc, (a, b) = make_doc(which, 2)
+        a.insert_text(0, "abcd")
+        pump(doc, [a, b])
+        # Obliterate (1, Before)..(3, Before): removes 'bc', keeps 'a','d'.
+        a.obliterate_range_sided((1, True), (3, True))
+        b.insert_text(3, "X")  # boundary before 'd': inside the sided window
+        pump(doc, [a, b])
+        assert a.text == b.text == "ad"
+
+    def test_obliterate_then_msn_expiry_allows_reuse(self, which):
+        """Obliterates below the MSN leave the window; later inserts at the
+        same spot are unaffected."""
+        doc, (a, b) = make_doc(which, 2)
+        a.insert_text(0, "abcdef")
+        pump(doc, [a, b])
+        a.obliterate_range(1, 5)
+        pump(doc, [a, b])
+        # Both clients op again so MSN advances past the obliterate.
+        a.insert_text(0, "x")
+        pump(doc, [a, b])
+        b.insert_text(0, "y")
+        pump(doc, [a, b])
+        a.insert_text(2, "Q")
+        pump(doc, [a, b])
+        assert a.text == b.text
+
+    def test_obliterate_survives_segment_splits(self, which):
+        """Anchors must follow splits: insert inside the obliterated window
+        after boundary segments were split by unrelated edits."""
+        doc, (a, b) = make_doc(which, 2)
+        a.insert_text(0, "aabbccdd")
+        pump(doc, [a, b])
+        a.obliterate_range(2, 6)   # 'bbcc'
+        b.remove_range(0, 1)       # concurrent edit splits position space
+        b.insert_text(3, "M")      # concurrent insert inside the ob window
+        pump(doc, [a, b])
+        assert a.text == b.text == "add"
+
+
+@pytest.mark.parametrize("seed", range(30))
+def test_obliterate_farm_oracle(seed):
+    """Obliterate-weighted multi-client farm on the oracle backend
+    (ref client.obliterateFarm.spec.ts)."""
+    rng = random.Random(7000 + seed)
+    doc = LocalDocument("d")
+    n = rng.randint(2, 4)
+    clients = [SharedString(client_id=f"c{i}") for i in range(n)]
+    for c in clients:
+        doc.connect(c.client_id, c.process)
+    doc.process_all()
+
+    for _round in range(rng.randint(4, 10)):
+        for c in clients:
+            for _ in range(rng.randint(0, 3)):
+                issue_op(c, draw_op(rng, len(c.text)))
+            if rng.random() < 0.7:
+                for m in c.take_outbox():
+                    doc.submit(m)
+        doc.process_some(rng.randint(0, doc.pending_count))
+
+    pump(doc, clients)
+    texts = {c.text for c in clients}
+    assert len(texts) == 1, f"divergent texts: {texts}"
+    anns = {canon_annotations(c) for c in clients}
+    assert len(anns) == 1, "divergent annotations"
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_obliterate_differential_farm(seed):
+    """Obliterate-weighted differential farm: kernel-backed clients against
+    an oracle observer; texts and annotations must match exactly (the
+    oracle-vs-kernel equivalence gate for obliterate)."""
+    rng = random.Random(8000 + seed)
+    doc = LocalDocument("d")
+    n = rng.randint(2, 3)
+    clients = [
+        SharedString(
+            client_id=f"c{i}",
+            backend=KernelMergeTree(max_insert_len=8, ob_slots=16),
+        )
+        for i in range(n)
+    ]
+    oracle = SharedString(client_id="oracle")
+    for c in clients:
+        doc.connect(c.client_id, c.process)
+    doc.connect(oracle.client_id, oracle.process)
+    doc.process_all()
+
+    for _round in range(rng.randint(4, 8)):
+        for c in clients:
+            for _ in range(rng.randint(0, 2)):
+                issue_op(c, draw_op(rng, len(c.text)))
+            if rng.random() < 0.7:
+                for m in c.take_outbox():
+                    doc.submit(m)
+        doc.process_some(rng.randint(0, doc.pending_count))
+
+    pump(doc, clients + [oracle])
+    expected = oracle.text
+    for c in clients:
+        assert c.backend.check_errors() == 0, f"kernel error flags (seed {seed})"
+        assert c.text == expected, f"kernel diverged from oracle (seed {seed})"
+    anns = {canon_annotations(c) for c in clients}
+    anns.add(canon_annotations(oracle))
+    assert len(anns) == 1, "annotation divergence"
+
+
+def test_remove_set_after_splice_matches_between_replicas():
+    """After the splice fix, every replica holds the SAME remove-stamp set
+    on overlap segments (the state-level assertion behind the regression)."""
+    doc = LocalDocument("d")
+    a, b = [SharedString(client_id=f"c{i}") for i in range(2)]
+    for c in (a, b):
+        doc.connect(c.client_id, c.process)
+    doc.process_all()
+    a.insert_text(0, "abcdef")
+    pump(doc, [a, b])
+    a.remove_range(1, 4)       # pending local remove
+    b.obliterate_range(0, 6)
+    for m in b.take_outbox():
+        doc.submit(m)
+    doc.process_all()          # remote obliterate splices over a's pending remove
+    pump(doc, [a, b])          # a's remove acks
+    assert a.text == b.text == ""
+
+    def stamp_sets(client):
+        return sorted(
+            tuple(sorted((k, cl) for k, cl in s.removes))
+            for s in client.backend.segments
+            if s.removes and acked(s.ins_key)
+        )
+
+    assert stamp_sets(a) == stamp_sets(b)
+    # The overlap segment carries BOTH stamps on both replicas.
+    overlap = [s for s in a.backend.segments if len(s.removes) >= 2]
+    assert overlap, "expected an overlap segment with both remove stamps"
