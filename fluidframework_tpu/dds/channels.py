@@ -12,11 +12,24 @@ from __future__ import annotations
 
 from typing import Any
 
-from ..protocol.stamps import ALL_ACKED, acked, encode_stamp
-from .mergetree_ref import SIDE_AFTER, SIDE_BEFORE, RefMergeTree, Segment
+from ..protocol.stamps import ALL_ACKED, encode_stamp
+from .mergetree_ref import SIDE_AFTER, SIDE_BEFORE, RefMergeTree
 from .sequence_intervals import IntervalCollection, StringOpLog
 from .shared_string import decode_obliterate_places as _decode_obliterate_places
 from ..runtime.channel import Channel, MessageCollection
+
+# Default merge-tree backend for channel-hosted SharedStrings: None -> the
+# Python oracle.  Tests swap in the TPU kernel backend here to run the whole
+# channel/container suite differentially (the IChannelFactory plugin
+# boundary the north star gates on, channel.ts:294).
+_STRING_BACKEND_FACTORY = None
+
+
+def set_string_backend_factory(factory) -> None:
+    """Install a zero-arg factory for SharedStringChannel backends (None
+    restores the oracle default)."""
+    global _STRING_BACKEND_FACTORY
+    _STRING_BACKEND_FACTORY = factory
 
 
 class SharedStringChannel(Channel):
@@ -31,7 +44,11 @@ class SharedStringChannel(Channel):
 
     def __init__(self, channel_id: str, backend: RefMergeTree | None = None) -> None:
         super().__init__(channel_id)
-        self.backend = backend if backend is not None else RefMergeTree()
+        if backend is None:
+            backend = (
+                _STRING_BACKEND_FACTORY() if _STRING_BACKEND_FACTORY else RefMergeTree()
+            )
+        self.backend = backend
         self._local_seq = 0
         # Interval collections (ref sequence/src/intervalCollection.ts):
         # named range sets anchored into this string; endpoints transform
@@ -280,84 +297,24 @@ class SharedStringChannel(Channel):
 
     # ------------------------------------------------------------ checkpoint
     def summarize(self) -> dict[str, Any]:
-        """Merge-tree snapshot: the acked segment array with full stamps
-        (ref snapshotV1.ts:42 — header + segment chunks; we keep one chunk;
-        stamps above minSeq are required so concurrent in-flight remote ops
-        rebase correctly against the loaded state)."""
-        segs = []
-        for s in self.backend.segments:
-            if not acked(s.ins_key) or any(not acked(k) for k, _c in s.removes):
-                raise RuntimeError("summarize with pending merge-tree state")
-            segs.append(
-                {
-                    "text": s.text,
-                    "ins": [s.ins_key, s.ins_client],
-                    "removes": [[k, c] for k, c in s.removes],
-                    "props": {str(p): [v, k] for p, (v, k) in s.props.items()},
-                }
-            )
-        seg_index = {id(s): i for i, s in enumerate(self.backend.segments)}
-        obs = []
-        # Issuers append their own obliterate at issuance, remotes at apply:
-        # stamp-key order is the replica-independent canonical order.
-        for ob in sorted(self.backend.obliterates, key=lambda o: o.key):
-            if not acked(ob.key):
-                raise RuntimeError("summarize with pending merge-tree state")
-            obs.append(
-                {
-                    "key": ob.key,
-                    "client": ob.client,
-                    "start": seg_index.get(id(ob.start_seg), -1),
-                    "startSide": ob.start_side,
-                    "end": seg_index.get(id(ob.end_seg), -1),
-                    "endSide": ob.end_side,
-                    "refSeq": ob.ref_seq,
-                }
-            )
-        return {
-            "segments": segs,
-            "obliterates": obs,
-            "minSeq": self.backend.min_seq,
-            # Lazily-materialized empty collections are omitted so replicas
-            # that never touched a label summarize identically.
-            "intervals": {
-                label: coll.summarize()
-                for label, coll in self._collections.items()
-                if coll.sequenced or coll._pending
-            },
-            "opLog": self._op_log.to_json(),
+        """Merge-tree snapshot (backend-owned; ref snapshotV1.ts:42) plus
+        the channel's interval collections and converged op log."""
+        out = self.backend.export_summary()
+        # Lazily-materialized empty collections are omitted so replicas
+        # that never touched a label summarize identically.
+        out["intervals"] = {
+            label: coll.summarize()
+            for label, coll in self._collections.items()
+            if coll.sequenced or coll._pending
         }
+        out["opLog"] = self._op_log.to_json()
+        return out
 
     def load(self, summary: dict[str, Any]) -> None:
         for label, data in summary.get("intervals", {}).items():
             self.get_interval_collection(label).load(data)
         self._op_log.load_json(summary.get("opLog", []))
-        self.backend.min_seq = summary["minSeq"]
-        self.backend.segments = [
-            Segment(
-                text=e["text"],
-                ins_key=e["ins"][0],
-                ins_client=e["ins"][1],
-                removes=[(k, c) for k, c in e["removes"]],
-                props={int(p): (v, k) for p, (v, k) in e["props"].items()},
-            )
-            for e in summary["segments"]
-        ]
-        from .mergetree_ref import Obliterate
-
-        segs = self.backend.segments
-        self.backend.obliterates = [
-            Obliterate(
-                key=o["key"],
-                client=o["client"],
-                start_seg=segs[o["start"]] if o["start"] >= 0 else None,
-                start_side=o["startSide"],
-                end_seg=segs[o["end"]] if o["end"] >= 0 else None,
-                end_side=o["endSide"],
-                ref_seq=o["refSeq"],
-            )
-            for o in summary.get("obliterates", [])
-        ]
+        self.backend.import_summary(summary)
 
     # ------------------------------------------------------------------ views
     @property

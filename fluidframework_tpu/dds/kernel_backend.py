@@ -1,22 +1,40 @@
 """Host adapter: run a SharedString client on the TPU merge-tree kernel.
 
-Implements the ``MergeTreeBackend`` protocol (the channel-boundary analog)
-over a single-document ``DocState``, so the exact same client/service test
-harness drives either the Python oracle or the JAX kernel — the differential
+Implements the FULL merge-tree backend protocol (the channel-boundary
+analog, ref datastore-definitions/src/channel.ts:294) over a single-document
+``DocState``, so the exact same channel/container test harness drives either
+the Python oracle (``RefMergeTree``) or the JAX kernel — the differential
 oracle setup the reference achieves with its fuzz suites.
 
-This adapter is the *correctness* path (one jitted call per op).  The
-*throughput* path batches ops across documents first — see
-``models/doc_batch_engine.py``.
+Split of responsibilities:
+
+- **Op application** (insert/remove/annotate/obliterate/ack) runs on device
+  through the columnar kernel — one jitted call per op (the correctness
+  path; the throughput path batches ops across documents first, see
+  ``models/doc_batch_engine.py``).
+- **Queries** (visible text, converged-coordinate translation for interval
+  collections and undo, summaries) are host-side walks over a pulled
+  snapshot of the columnar state — control-plane reads, mirroring
+  ``mergetree_ref`` line for line.
+- **Reconnect regeneration** splits host/device: the host PLANS the
+  re-minted wire ops from a snapshot (ref client.ts regeneratePendingOp
+  :1452), then re-stamps exactly the affected segments on device with
+  ``mergetree_kernel.restamp`` (plus ``drop_squashed`` / ``strip_stamp``).
 """
 
 from __future__ import annotations
+
+from dataclasses import dataclass, field
 
 import numpy as np
 import jax
 
 from ..ops import mergetree_kernel as mk
-from ..protocol.stamps import ALL_ACKED
+from ..protocol.stamps import ALL_ACKED, LOCAL_BASE, NO_REMOVE, NON_COLLAB_CLIENT
+
+
+def _acked(key: int) -> bool:
+    return key < LOCAL_BASE
 
 
 @jax.jit
@@ -27,6 +45,41 @@ def _apply_one(state: mk.DocState, op, payload) -> mk.DocState:
 @jax.jit
 def _compact(state: mk.DocState) -> mk.DocState:
     return mk.compact(state)
+
+
+@dataclass
+class _Seg:
+    """Host mirror of one device segment (decoded columnar row)."""
+
+    uid: int
+    length: int
+    ins_key: int
+    ins_client: int
+    obpre: int
+    removes: list[tuple[int, int]]            # sorted (key, client)
+    props: dict[int, tuple[int, int]] = field(default_factory=dict)  # slot -> (val, key)
+    text: str | None = None
+
+    def visible(self, ref_seq: int, view_client: int) -> bool:
+        if not (self.ins_key <= ref_seq or self.ins_client == view_client):
+            return False
+        return not any(
+            k <= ref_seq or c == view_client for k, c in self.removes
+        )
+
+
+@dataclass
+class _Ob:
+    """Host mirror of one obliterate-table record."""
+
+    slot: int
+    key: int
+    client: int
+    start_uid: int
+    start_side: int
+    end_uid: int
+    end_side: int
+    ref_seq: int
 
 
 class KernelMergeTree:
@@ -40,14 +93,19 @@ class KernelMergeTree:
         text_capacity: int = 8192,
         max_insert_len: int = 64,
         ob_slots: int = 8,
+        local_client: int = -3,
     ) -> None:
         self.state = mk.init_state(
             max_segments, remove_slots, prop_slots, text_capacity, ob_slots
         )
         self.max_insert_len = max_insert_len
+        self.local_client = local_client
         self._empty_payload = np.zeros((max_insert_len,), np.int32)
         # Host-interned property ids -> kernel prop slots.
         self._prop_slot: dict[int, int] = {}
+        # Stamp keys minted by regenerate_pending during a reconnect replay
+        # (see mergetree_ref.RefMergeTree._regenerated_keys).
+        self._regenerated_keys: set[int] = set()
 
     # ------------------------------------------------------------------ utils
     def _op(self, kind, key=0, client=-1, ref_seq=0, pos1=0, pos2=0, a=0, b=0):
@@ -70,25 +128,123 @@ class KernelMergeTree:
             self._prop_slot[prop] = slot
         return self._prop_slot[prop]
 
+    # --------------------------------------------------------------- snapshot
+    def _segs(self, with_text: bool = False) -> list[_Seg]:
+        """Pull the live segment rows off device as host records."""
+        s = self.state
+        nseg = int(s.nseg)
+        seg_uid = np.asarray(s.seg_uid)[:nseg]
+        seg_len = np.asarray(s.seg_len)[:nseg]
+        ins_key = np.asarray(s.ins_key)[:nseg]
+        ins_client = np.asarray(s.ins_client)[:nseg]
+        obpre = np.asarray(s.seg_obpre)[:nseg]
+        rem_k = np.stack([np.asarray(a)[:nseg] for a in s.rem_keys]) if nseg else None
+        rem_c = np.stack([np.asarray(a)[:nseg] for a in s.rem_clients]) if nseg else None
+        prop_k = np.stack([np.asarray(a)[:nseg] for a in s.prop_keys]) if nseg else None
+        prop_v = np.stack([np.asarray(a)[:nseg] for a in s.prop_vals]) if nseg else None
+        texts: list[str | None] = [None] * nseg
+        if with_text and nseg:
+            pool = np.asarray(s.text)
+            start = np.asarray(s.seg_start)[:nseg]
+            texts = [
+                "".join(chr(c) for c in pool[start[i] : start[i] + seg_len[i]])
+                for i in range(nseg)
+            ]
+        out: list[_Seg] = []
+        for i in range(nseg):
+            removes = sorted(
+                (int(rem_k[r, i]), int(rem_c[r, i]))
+                for r in range(rem_k.shape[0])
+                if rem_k[r, i] != NO_REMOVE
+            )
+            props = {
+                p: (int(prop_v[p, i]), int(prop_k[p, i]))
+                for p in range(prop_k.shape[0])
+                if prop_k[p, i] >= 0
+            }
+            out.append(
+                _Seg(
+                    uid=int(seg_uid[i]),
+                    length=int(seg_len[i]),
+                    ins_key=int(ins_key[i]),
+                    ins_client=int(ins_client[i]),
+                    obpre=int(obpre[i]),
+                    removes=removes,
+                    props=props,
+                    text=texts[i],
+                )
+            )
+        return out
+
+    def _obs(self) -> list[_Ob]:
+        s = self.state
+        keys = np.asarray(s.ob_key)
+        out = []
+        for i in range(keys.shape[0]):
+            if keys[i] >= 0:
+                out.append(
+                    _Ob(
+                        slot=i,
+                        key=int(keys[i]),
+                        client=int(np.asarray(s.ob_client)[i]),
+                        start_uid=int(np.asarray(s.ob_start_uid)[i]),
+                        start_side=int(np.asarray(s.ob_start_side)[i]),
+                        end_uid=int(np.asarray(s.ob_end_uid)[i]),
+                        end_side=int(np.asarray(s.ob_end_side)[i]),
+                        ref_seq=int(np.asarray(s.ob_ref_seq)[i]),
+                    )
+                )
+        return out
+
+    def _stamp_uids(self, op_key: int, op_client: int) -> dict[int, int]:
+        """uid -> number of remove slots carrying exactly (op_key, op_client)."""
+        s = self.state
+        nseg = int(s.nseg)
+        if nseg == 0:
+            return {}
+        uid = np.asarray(s.seg_uid)[:nseg]
+        counts = np.zeros((nseg,), np.int64)
+        for k, c in zip(s.rem_keys, s.rem_clients):
+            counts += (np.asarray(k)[:nseg] == op_key) & (
+                np.asarray(c)[:nseg] == op_client
+            )
+        return {int(uid[i]): int(counts[i]) for i in range(nseg) if counts[i]}
+
     # ---------------------------------------------------------------- backend
-    def apply_insert(self, pos, text, op_key, op_client, ref_seq) -> None:
+    def apply_insert(self, pos, text, op_key, op_client, ref_seq) -> list[int]:
+        """Apply an insert; returns the uids of the created segments (the
+        channel's converged-event handles)."""
+        uids: list[int] = []
         for op, payload in mk.encode_insert(
             pos, text, op_key, op_client, ref_seq, self.max_insert_len
         ):
+            err_before = int(self.state.error)
             self._step(op, payload)
+            if int(self.state.error) == err_before:
+                # The new segment's uid is always the last allocation of the
+                # chunk's apply (_do_insert allocates the boundary-split uid
+                # first, the new segment's uid last).
+                uids.append(int(self.state.uid_next) - 1)
+        return uids
 
-    def apply_remove(self, pos1, pos2, op_key, op_client, ref_seq) -> None:
+    def apply_remove(self, pos1, pos2, op_key, op_client, ref_seq) -> list[int]:
+        before = self._stamp_uids(op_key, op_client)
         self._step(
             self._op(
                 mk.OpKind.REMOVE, key=op_key, client=op_client, ref_seq=ref_seq,
                 pos1=pos1, pos2=pos2,
             )
         )
+        after = self._stamp_uids(op_key, op_client)
+        return [u for u, n in after.items() if n > before.get(u, 0)]
 
-    def apply_obliterate(self, pos1, side1, pos2, side2, op_key, op_client, ref_seq) -> None:
+    def apply_obliterate(self, pos1, side1, pos2, side2, op_key, op_client, ref_seq) -> list[int]:
+        before = self._stamp_uids(op_key, op_client)
         self._step(
             mk.encode_obliterate(pos1, side1, pos2, side2, op_key, op_client, ref_seq)
         )
+        after = self._stamp_uids(op_key, op_client)
+        return [u for u, n in after.items() if n > before.get(u, 0)]
 
     def apply_annotate(self, pos1, pos2, prop, value, op_key, op_client, ref_seq) -> None:
         self._step(
@@ -98,8 +254,34 @@ class KernelMergeTree:
             )
         )
 
-    def ack(self, local_seq, seq) -> None:
-        self._step(self._op(mk.OpKind.ACK, a=local_seq, b=seq))
+    def ack(self, local_seq, seq, client=None, ref_seq=None):
+        """Convert pending stamps with this localSeq to the acked seq
+        (re-stamping client id / obliterate refSeq when given — see
+        mergetree_ref.RefMergeTree.ack).  Returns (inserted_uids,
+        removed_uids) for the channel's converged events."""
+        local_key = LOCAL_BASE + local_seq
+        self._regenerated_keys.discard(local_key)
+        s = self.state
+        nseg = int(s.nseg)
+        ins_uids: list[int] = []
+        rem_uids: list[int] = []
+        if nseg:
+            uid = np.asarray(s.seg_uid)[:nseg]
+            ins_hit = np.asarray(s.ins_key)[:nseg] == local_key
+            rem_hit = np.zeros((nseg,), bool)
+            for k in s.rem_keys:
+                rem_hit |= np.asarray(k)[:nseg] == local_key
+            ins_uids = [int(u) for u in uid[ins_hit]]
+            rem_uids = [int(u) for u in uid[rem_hit]]
+        self._step(
+            self._op(
+                mk.OpKind.ACK,
+                client=-1 if client is None else client,
+                ref_seq=-1 if ref_seq is None else ref_seq,
+                a=local_seq, b=seq,
+            )
+        )
+        return ins_uids, rem_uids
 
     def update_min_seq(self, min_seq) -> None:
         prev = int(self.state.min_seq)
@@ -107,12 +289,442 @@ class KernelMergeTree:
             self.state = mk.set_min_seq(self.state, min_seq)
             self.state = _compact(self.state)
 
+    # ------------------------------------------------------------------ views
     def visible_text(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> str:
-        vc = -3 if view_client is None else view_client
+        vc = self.local_client if view_client is None else view_client
         return mk.visible_text(self.state, ref_seq, vc)
 
     def annotations(self, ref_seq: int = ALL_ACKED, view_client: int | None = None):
-        vc = -3 if view_client is None else view_client
+        vc = self.local_client if view_client is None else view_client
         raw = mk.annotations(self.state, ref_seq, vc)
         inv = {v: k for k, v in self._prop_slot.items()}
         return [{inv[p]: v for p, v in d.items()} for d in raw]
+
+    # ----------------------------------------------------- converged queries
+    # Host-side ports of mergetree_ref's converged-coordinate walks (the
+    # coordinates interval collections and undo ranges live in).
+
+    @staticmethod
+    def _flatten_uids(segs) -> set[int]:
+        out: set[int] = set()
+        for x in segs:
+            if isinstance(x, (list, tuple, set)):
+                out.update(int(u) for u in x)
+            else:
+                out.add(int(x))
+        return out
+
+    def converged_position(self, pos: int, ref_seq: int, view_client: int) -> int:
+        rem = pos
+        conv = 0
+        for seg in self._segs():
+            p_len = seg.length if seg.visible(ref_seq, view_client) else 0
+            c_vis = seg.visible(ALL_ACKED, NON_COLLAB_CLIENT)
+            if rem < p_len:
+                return conv + (rem if c_vis else 0)
+            rem -= p_len
+            if c_vis:
+                conv += seg.length
+        if rem == 0:
+            return conv
+        raise ValueError(f"position {pos} beyond perspective-visible length")
+
+    def converged_insert_ranges(self, segs) -> list[tuple[int, int]]:
+        wanted = self._flatten_uids(segs)
+        out: list[tuple[int, int]] = []
+        pos = 0
+        for seg in self._segs():
+            if seg.visible(ALL_ACKED, NON_COLLAB_CLIENT):
+                if seg.uid in wanted:
+                    out.append((pos, seg.length))
+                pos += seg.length
+        return out
+
+    def converged_removed_ranges(self, segs, op_key: int) -> list[tuple[int, int]]:
+        wanted = self._flatten_uids(segs)
+        out: list[tuple[int, int]] = []
+        pos = 0
+        for seg in self._segs():
+            if not _acked(seg.ins_key):
+                continue
+            acked_removes = [k for k, _c in seg.removes if _acked(k)]
+            newly = seg.uid in wanted and all(k == op_key for k in acked_removes)
+            alive = not acked_removes
+            if newly:
+                out.append((pos, seg.length))
+            if newly or alive:
+                pos += seg.length
+        return out
+
+    def converged_to_local(self, pos: int) -> int:
+        conv = 0
+        loc = 0
+        for seg in self._segs():
+            c_vis = seg.visible(ALL_ACKED, NON_COLLAB_CLIENT)
+            l_vis = seg.visible(ALL_ACKED, self.local_client)
+            n = seg.length
+            if c_vis and pos < conv + n:
+                return loc + (pos - conv) if l_vis else loc
+            if c_vis:
+                conv += n
+            if l_vis:
+                loc += n
+        return loc
+
+    def converged_spans_to_local(self, start: int, end: int) -> list[tuple[int, int]]:
+        spans: list[list[int]] = []
+        conv = 0
+        loc = 0
+        for seg in self._segs():
+            c_vis = seg.visible(ALL_ACKED, NON_COLLAB_CLIENT)
+            l_vis = seg.visible(ALL_ACKED, self.local_client)
+            n = seg.length
+            if c_vis:
+                o1 = max(start, conv)
+                o2 = min(end, conv + n)
+                if o1 < o2 and l_vis:
+                    s0 = loc + (o1 - conv)
+                    e0 = loc + (o2 - conv)
+                    if spans and spans[-1][1] == s0:
+                        spans[-1][1] = e0
+                    else:
+                        spans.append([s0, e0])
+                conv += n
+            if l_vis:
+                loc += n
+        return [(s, e) for s, e in spans]
+
+    # --------------------------------------------------------------- reconnect
+    def _squashed(self, seg: _Seg) -> bool:
+        return not _acked(seg.ins_key) and any(
+            not _acked(k) for k, _c in seg.removes
+        )
+
+    def _occurred_before(self, key: int, max_key: int) -> bool:
+        return _acked(key) or key < max_key or key in self._regenerated_keys
+
+    def _visible_at_prefix(
+        self, seg: _Seg, max_key: int, exclude_key: int, squash: bool = False
+    ) -> bool:
+        if squash and self._squashed(seg):
+            return False
+        if not self._occurred_before(seg.ins_key, max_key):
+            return False
+        return not any(
+            self._occurred_before(key, max_key) and key != exclude_key
+            for key, _client in seg.removes
+        )
+
+    def _restamp(
+        self, uids: set[int] | None, old_key: int, fresh_key: int,
+        new_client: int | None, cls: str,
+    ) -> None:
+        """Device-side selective re-stamp of one plan's segments."""
+        s = self.state
+        S = s.seg_len.shape[0]
+        if uids is None:
+            mask = np.ones((S,), bool)
+        else:
+            nseg = int(s.nseg)
+            uid = np.asarray(s.seg_uid)
+            mask = np.zeros((S,), bool)
+            for i in range(nseg):
+                if int(uid[i]) in uids:
+                    mask[i] = True
+        self.state = mk.restamp(
+            s,
+            jax.numpy.asarray(mask),
+            old_key,
+            fresh_key,
+            -1 if new_client is None else new_client,
+            cls == "ins",
+            cls in ("rem", "ob"),
+            cls == "prop",
+            cls == "ob",
+        )
+
+    def regenerate_pending(
+        self,
+        local_seq: int,
+        new_local_seq,
+        squash: bool = False,
+        new_client: int | None = None,
+    ) -> list[tuple[int, dict]]:
+        """Re-mint the pending op with this localSeq against current state
+        (ref client.ts regeneratePendingOp:1452; the host plan mirrors
+        mergetree_ref.RefMergeTree.regenerate_pending step for step, the
+        re-stamping runs on device)."""
+        key = LOCAL_BASE + local_seq
+        ob = next((o for o in self._obs() if o.key == key), None)
+        if ob is not None:
+            return self._regenerate_obliterate(ob, key, new_local_seq, squash, new_client)
+
+        segs = self._segs(with_text=True)
+        inv_prop = {v: k for k, v in self._prop_slot.items()}
+        # (kind, pos1, pos2, payload, {uids}) collected before re-stamping.
+        plans: list[tuple[int, int, int, object, set[int]]] = []
+
+        # Pending insert: contiguous run of segments carrying this ins stamp.
+        ins_segs: list[_Seg] = []
+        pos = 0
+        ins_pos = -1
+        for seg in segs:
+            if seg.ins_key == key and not (squash and self._squashed(seg)):
+                if ins_pos < 0:
+                    ins_pos = pos
+                ins_segs.append(seg)
+            if self._visible_at_prefix(seg, key, exclude_key=-1, squash=squash):
+                pos += seg.length
+        if ins_pos >= 0:
+            plans.append(
+                (0, ins_pos, -1, "".join(s.text for s in ins_segs),
+                 {s.uid for s in ins_segs})
+            )
+
+        # Pending remove / annotate: maximal visible runs carrying the stamp.
+        pos = 0
+        rem_run: tuple[int, int, set[int]] | None = None
+        ann_run: tuple[int, int, dict, set[int]] | None = None
+
+        def flush_remove() -> None:
+            nonlocal rem_run
+            if rem_run is not None:
+                plans.append((1, rem_run[0], rem_run[1], None, rem_run[2]))
+            rem_run = None
+
+        def flush_annotate() -> None:
+            nonlocal ann_run
+            if ann_run is not None:
+                plans.append((2, ann_run[0], ann_run[1], ann_run[2], ann_run[3]))
+            ann_run = None
+
+        for seg in segs:
+            if not self._visible_at_prefix(seg, key, exclude_key=key, squash=squash):
+                continue  # invisible: breaks neither runs nor position space
+            if any(k == key for k, _c in seg.removes):
+                if rem_run is None:
+                    rem_run = (pos, pos + seg.length, {seg.uid})
+                else:
+                    rem_run = (rem_run[0], pos + seg.length, rem_run[2] | {seg.uid})
+            else:
+                flush_remove()
+            props = {
+                str(inv_prop[p]): v for p, (v, k) in seg.props.items() if k == key
+            }
+            if props:
+                if ann_run is None or props != ann_run[2]:
+                    flush_annotate()
+                    ann_run = (pos, pos + seg.length, props, {seg.uid})
+                else:
+                    ann_run = (ann_run[0], pos + seg.length, props, ann_run[3] | {seg.uid})
+            else:
+                flush_annotate()
+            pos += seg.length
+        flush_remove()
+        flush_annotate()
+
+        if squash:
+            self.state = mk.drop_squashed(self.state)
+
+        out: list[tuple[int, dict]] = []
+        for kind, pos1, pos2, payload, uids in plans:
+            fresh = new_local_seq()
+            fresh_key = LOCAL_BASE + fresh
+            self._regenerated_keys.add(fresh_key)
+            if kind == 0:
+                self._restamp(uids, key, fresh_key, new_client, "ins")
+                out.append((fresh, {"type": 0, "pos1": pos1, "seg": payload}))
+            elif kind == 1:
+                self._restamp(uids, key, fresh_key, new_client, "rem")
+                out.append((fresh, {"type": 1, "pos1": pos1, "pos2": pos2}))
+            else:
+                self._restamp(uids, key, fresh_key, None, "prop")
+                out.append(
+                    (fresh, {"type": 2, "pos1": pos1, "pos2": pos2, "props": payload})
+                )
+        return out
+
+    def _regenerate_obliterate(
+        self, ob: _Ob, key: int, new_local_seq, squash: bool, new_client: int | None
+    ) -> list[tuple[int, dict]]:
+        """Port of mergetree_ref._regenerate_obliterate over the snapshot."""
+        segs = self._segs()
+        index_of = {seg.uid: i for i, seg in enumerate(segs)}
+        s_i = index_of.get(ob.start_uid, len(segs))
+        e_i = index_of.get(ob.end_uid, len(segs))
+        b_s = b_e = total = 0
+        for i, seg in enumerate(segs):
+            if not self._visible_at_prefix(seg, key, exclude_key=key, squash=squash):
+                continue
+            n = seg.length
+            if i < s_i or (i == s_i and ob.start_side == mk.SIDE_AFTER):
+                b_s += n
+            if i < e_i or (i == e_i and ob.end_side == mk.SIDE_AFTER):
+                b_e += n
+            total += n
+
+        if ob.start_side == mk.SIDE_AFTER and b_s > 0:
+            start = {"pos": b_s - 1, "before": False}
+        else:
+            start = {"pos": b_s, "before": True}
+        if ob.end_side == mk.SIDE_BEFORE and b_e < total:
+            end = {"pos": b_e, "before": True}
+        elif b_e > 0:
+            end = {"pos": b_e - 1, "before": False}
+        else:
+            end = None
+
+        start_char = start["pos"]
+        end_char = end["pos"] if end is not None else -1
+        start_bound = start["pos"] + (0 if start["before"] else 1)
+        end_bound = (end["pos"] + (0 if end["before"] else 1)) if end is not None else -1
+        if (
+            end is None
+            or not (0 <= start_char <= end_char < total)
+            or start_bound > end_bound
+        ):
+            # Range gone from the prefix view: retire the obliterate (strip
+            # its never-to-ack stamps, free its record slot).
+            self.state = mk.strip_stamp(self.state, key)
+            return []
+
+        fresh = new_local_seq()
+        fresh_key = LOCAL_BASE + fresh
+        self._regenerated_keys.add(fresh_key)
+        self._restamp(None, key, fresh_key, new_client, "ob")
+        return [(fresh, {"type": 5, "pos1": start, "pos2": end})]
+
+    # ------------------------------------------------------------ checkpoint
+    def export_summary(self) -> dict:
+        """Merge-tree snapshot in the shared summary JSON (identical schema
+        to RefMergeTree.export_summary; ref snapshotV1.ts:42)."""
+        segs = self._segs(with_text=True)
+        inv_prop = {v: k for k, v in self._prop_slot.items()}
+        out_segs = []
+        for seg in segs:
+            if not _acked(seg.ins_key) or any(not _acked(k) for k, _c in seg.removes):
+                raise RuntimeError("summarize with pending merge-tree state")
+            out_segs.append(
+                {
+                    "text": seg.text,
+                    "ins": [seg.ins_key, seg.ins_client],
+                    "removes": [[k, c] for k, c in seg.removes],
+                    "props": {
+                        str(inv_prop[p]): [v, k]
+                        for p, (v, k) in sorted(seg.props.items())
+                    },
+                }
+            )
+        uid_index = {seg.uid: i for i, seg in enumerate(segs)}
+        obs = []
+        for ob in sorted(self._obs(), key=lambda o: o.key):
+            if not _acked(ob.key):
+                raise RuntimeError("summarize with pending merge-tree state")
+            obs.append(
+                {
+                    "key": ob.key,
+                    "client": ob.client,
+                    "start": uid_index.get(ob.start_uid, -1),
+                    "startSide": ob.start_side,
+                    "end": uid_index.get(ob.end_uid, -1),
+                    "endSide": ob.end_side,
+                    "refSeq": ob.ref_seq,
+                }
+            )
+        return {
+            "segments": out_segs,
+            "obliterates": obs,
+            "minSeq": int(self.state.min_seq),
+        }
+
+    def import_summary(self, summary: dict) -> None:
+        """Rebuild device state from summary JSON (fresh text pool, uids =
+        segment indices, obliterate anchors resolved by index)."""
+        import jax.numpy as jnp
+
+        s = self.state
+        S = s.seg_len.shape[0]
+        T = s.text.shape[0]
+        R = len(s.rem_keys)
+        P = len(s.prop_keys)
+        OB = s.ob_key.shape[0]
+        entries = summary["segments"]
+        obs = summary.get("obliterates", [])
+        if len(entries) > S:
+            raise ValueError(f"summary has {len(entries)} segments > capacity {S}")
+        if len(obs) > OB:
+            raise ValueError(f"summary has {len(obs)} obliterates > capacity {OB}")
+
+        text_pool = np.zeros((T,), np.int32)
+        seg_start = np.zeros((S,), np.int32)
+        seg_len = np.zeros((S,), np.int32)
+        ins_key = np.zeros((S,), np.int32)
+        ins_client = np.full((S,), -1, np.int32)
+        seg_uid = np.full((S,), -1, np.int32)
+        rem_keys = np.full((R, S), NO_REMOVE, np.int32)
+        rem_clients = np.full((R, S), -1, np.int32)
+        prop_keys = np.full((P, S), -1, np.int32)
+        prop_vals = np.zeros((P, S), np.int32)
+        end = 0
+        for i, e in enumerate(entries):
+            txt = e["text"]
+            if end + len(txt) > T:
+                raise ValueError("summary text exceeds pool capacity")
+            text_pool[end : end + len(txt)] = [ord(ch) for ch in txt]
+            seg_start[i] = end
+            seg_len[i] = len(txt)
+            end += len(txt)
+            ins_key[i] = e["ins"][0]
+            ins_client[i] = e["ins"][1]
+            seg_uid[i] = i
+            if len(e["removes"]) > R:
+                raise ValueError("summary removes exceed remove slots")
+            for r, (k, c) in enumerate(e["removes"]):
+                rem_keys[r, i] = k
+                rem_clients[r, i] = c
+            for p_str, (v, k) in e["props"].items():
+                slot = self._slot_for(int(p_str))
+                prop_keys[slot, i] = k
+                prop_vals[slot, i] = v
+
+        ob_key = np.full((OB,), -1, np.int32)
+        ob_client = np.full((OB,), -1, np.int32)
+        ob_start_uid = np.full((OB,), -1, np.int32)
+        ob_end_uid = np.full((OB,), -1, np.int32)
+        ob_start_side = np.zeros((OB,), np.int32)
+        ob_end_side = np.zeros((OB,), np.int32)
+        ob_ref_seq = np.full((OB,), -1, np.int32)
+        for j, o in enumerate(obs):
+            ob_key[j] = o["key"]
+            ob_client[j] = o["client"]
+            ob_start_uid[j] = o["start"]
+            ob_end_uid[j] = o["end"]
+            ob_start_side[j] = o["startSide"]
+            ob_end_side[j] = o["endSide"]
+            ob_ref_seq[j] = o["refSeq"]
+
+        self.state = mk.DocState(
+            text=jnp.asarray(text_pool),
+            text_end=jnp.asarray(end, jnp.int32),
+            nseg=jnp.asarray(len(entries), jnp.int32),
+            seg_start=jnp.asarray(seg_start),
+            seg_len=jnp.asarray(seg_len),
+            ins_key=jnp.asarray(ins_key),
+            ins_client=jnp.asarray(ins_client),
+            seg_uid=jnp.asarray(seg_uid),
+            seg_obpre=jnp.full((S,), -1, jnp.int32),
+            rem_keys=tuple(jnp.asarray(rem_keys[r]) for r in range(R)),
+            rem_clients=tuple(jnp.asarray(rem_clients[r]) for r in range(R)),
+            prop_keys=tuple(jnp.asarray(prop_keys[p]) for p in range(P)),
+            prop_vals=tuple(jnp.asarray(prop_vals[p]) for p in range(P)),
+            uid_next=jnp.asarray(len(entries), jnp.int32),
+            ob_key=jnp.asarray(ob_key),
+            ob_client=jnp.asarray(ob_client),
+            ob_start_uid=jnp.asarray(ob_start_uid),
+            ob_end_uid=jnp.asarray(ob_end_uid),
+            ob_start_side=jnp.asarray(ob_start_side),
+            ob_end_side=jnp.asarray(ob_end_side),
+            ob_ref_seq=jnp.asarray(ob_ref_seq),
+            min_seq=jnp.asarray(summary["minSeq"], jnp.int32),
+            error=jnp.zeros((), jnp.int32),
+        )

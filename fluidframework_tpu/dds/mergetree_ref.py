@@ -862,6 +862,70 @@ class RefMergeTree:
             ob.client = new_client
         return [(fresh, {"type": 5, "pos1": start, "pos2": end})]
 
+    # ------------------------------------------------------------ checkpoint
+    def export_summary(self) -> dict:
+        """Merge-tree snapshot: the acked segment array with full stamps
+        (ref snapshotV1.ts:42 — header + segment chunks; we keep one chunk;
+        stamps above minSeq are required so concurrent in-flight remote ops
+        rebase correctly against the loaded state)."""
+        segs = []
+        for s in self.segments:
+            if not acked(s.ins_key) or any(not acked(k) for k, _c in s.removes):
+                raise RuntimeError("summarize with pending merge-tree state")
+            segs.append(
+                {
+                    "text": s.text,
+                    "ins": [s.ins_key, s.ins_client],
+                    "removes": [[k, c] for k, c in s.removes],
+                    "props": {str(p): [v, k] for p, (v, k) in sorted(s.props.items())},
+                }
+            )
+        seg_index = {id(s): i for i, s in enumerate(self.segments)}
+        obs = []
+        # Issuers append their own obliterate at issuance, remotes at apply:
+        # stamp-key order is the replica-independent canonical order.
+        for ob in sorted(self.obliterates, key=lambda o: o.key):
+            if not acked(ob.key):
+                raise RuntimeError("summarize with pending merge-tree state")
+            obs.append(
+                {
+                    "key": ob.key,
+                    "client": ob.client,
+                    "start": seg_index.get(id(ob.start_seg), -1),
+                    "startSide": ob.start_side,
+                    "end": seg_index.get(id(ob.end_seg), -1),
+                    "endSide": ob.end_side,
+                    "refSeq": ob.ref_seq,
+                }
+            )
+        return {"segments": segs, "obliterates": obs, "minSeq": self.min_seq}
+
+    def import_summary(self, summary: dict) -> None:
+        self.min_seq = summary["minSeq"]
+        self.segments = [
+            Segment(
+                text=e["text"],
+                ins_key=e["ins"][0],
+                ins_client=e["ins"][1],
+                removes=[(k, c) for k, c in e["removes"]],
+                props={int(p): (v, k) for p, (v, k) in e["props"].items()},
+            )
+            for e in summary["segments"]
+        ]
+        segs = self.segments
+        self.obliterates = [
+            Obliterate(
+                key=o["key"],
+                client=o["client"],
+                start_seg=segs[o["start"]] if o["start"] >= 0 else None,
+                start_side=o["startSide"],
+                end_seg=segs[o["end"]] if o["end"] >= 0 else None,
+                end_side=o["endSide"],
+                ref_seq=o["refSeq"],
+            )
+            for o in summary.get("obliterates", [])
+        ]
+
     # --------------------------------------------------------------- lifetime
     def update_min_seq(self, min_seq: int) -> None:
         if min_seq > self.min_seq:

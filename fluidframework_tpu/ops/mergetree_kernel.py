@@ -104,6 +104,7 @@ class DocState(NamedTuple):
     ob_end_uid: jnp.ndarray    # int32[OB]
     ob_start_side: jnp.ndarray  # int32[OB]
     ob_end_side: jnp.ndarray    # int32[OB]
+    ob_ref_seq: jnp.ndarray     # int32[OB] refSeq the obliterate was issued at
     min_seq: jnp.ndarray      # int32 scalar (collab-window floor)
     error: jnp.ndarray        # int32 scalar bitmask
 
@@ -137,6 +138,7 @@ def init_state(
         ob_end_uid=jnp.full((OB,), -1, I32),
         ob_start_side=jnp.zeros((OB,), I32),
         ob_end_side=jnp.zeros((OB,), I32),
+        ob_ref_seq=jnp.full((OB,), -1, I32),
         min_seq=jnp.zeros((), I32),
         error=jnp.zeros((), I32),
     )
@@ -592,6 +594,7 @@ def _do_obliterate(s: DocState, op, payload) -> DocState:
         ob_end_uid=put(s.ob_end_uid, s.seg_uid[e_idx]),
         ob_start_side=put(s.ob_start_side, side1),
         ob_end_side=put(s.ob_end_side, side2),
+        ob_ref_seq=put(s.ob_ref_seq, ref_seq),
         error=s.error
         | jnp.where(~valid, ERR_POS_RANGE, 0)
         | jnp.where(valid & ~has_free, ERR_OB_OVERFLOW, 0)
@@ -600,13 +603,29 @@ def _do_obliterate(s: DocState, op, payload) -> DocState:
 
 
 def _do_ack(s: DocState, op, payload) -> DocState:
+    """Convert pending stamps (localSeq) to the acked seq; optionally
+    re-stamp the client id (op[2] >= 0) and the obliterate's recorded refSeq
+    (op[3] >= 0) — channel-hosted replicas stamp local pending ops with a
+    sentinel client and learn their short id / wire refSeq only at ack
+    (mirrors mergetree_ref.RefMergeTree.ack)."""
     local_seq, seq = op[6], op[7]
+    new_client, new_ref = op[2], op[3]
     local_key = LOCAL_BASE + local_seq
+    ins_hit = s.ins_key == local_key
+    ob_hit = s.ob_key == local_key
+    rw_c = new_client >= 0
     return s._replace(
-        ins_key=jnp.where(s.ins_key == local_key, seq, s.ins_key),
+        ins_key=jnp.where(ins_hit, seq, s.ins_key),
+        ins_client=jnp.where(ins_hit & rw_c, new_client, s.ins_client),
         rem_keys=tuple(jnp.where(a == local_key, seq, a) for a in s.rem_keys),
+        rem_clients=tuple(
+            jnp.where((k == local_key) & rw_c, new_client, c)
+            for k, c in zip(s.rem_keys, s.rem_clients)
+        ),
         prop_keys=tuple(jnp.where(a == local_key, seq, a) for a in s.prop_keys),
-        ob_key=jnp.where(s.ob_key == local_key, seq, s.ob_key),
+        ob_key=jnp.where(ob_hit, seq, s.ob_key),
+        ob_client=jnp.where(ob_hit & rw_c, new_client, s.ob_client),
+        ob_ref_seq=jnp.where(ob_hit & (new_ref >= 0), new_ref, s.ob_ref_seq),
         seg_obpre=jnp.where(s.seg_obpre == local_key, seq, s.seg_obpre),
     )
 
@@ -665,37 +684,20 @@ def apply_ops(
 # Compaction (zamboni)
 # --------------------------------------------------------------------------
 
-def compact(s: DocState, ob_flag=None) -> DocState:
-    """Evict segments whose winning remove is acked at or below min_seq.
+def _anchored_mask(s: DocState) -> jnp.ndarray:
+    """Segments anchoring a live obliterate ([OB,S] uid match)."""
+    used = s.ob_key >= 0
+    return (
+        (
+            (s.seg_uid[None, :] == s.ob_start_uid[:, None])
+            | (s.seg_uid[None, :] == s.ob_end_uid[:, None])
+        )
+        & used[:, None]
+    ).any(axis=0)
 
-    Reference zamboni.ts:33 — such segments are invisible to every legal
-    perspective (refSeq >= minSeq), so dropping them is unobservable.
-    Stable-compacts the arrays with an argsort gather.  ``ob_flag`` gates
-    the [OB,S] anchor-retention matrix (scalar; see apply_op).
-    """
-    if ob_flag is None:
-        ob_flag = jnp.any(s.ob_key >= 0)
-    alive = _alive(s)
-    rem0 = _min_tree(s.rem_keys)
-    dead = alive & (rem0 < LOCAL_BASE) & (rem0 <= s.min_seq)
 
-    # Segments anchoring a live obliterate stay resident (their index
-    # position defines the obliterate's window for concurrent inserts).
-    def _anchored(s):
-        used = s.ob_key >= 0
-        return (
-            (
-                (s.seg_uid[None, :] == s.ob_start_uid[:, None])
-                | (s.seg_uid[None, :] == s.ob_end_uid[:, None])
-            )
-            & used[:, None]
-        ).any(axis=0)
-
-    anchored = jax.lax.cond(
-        ob_flag, _anchored, lambda s: jnp.zeros_like(alive), s
-    )
-    keep = alive & ~(dead & ~anchored)
-    # Stable order: kept segments first, in original order.
+def _gather_keep(s: DocState, keep: jnp.ndarray) -> DocState:
+    """Stable-compact the per-segment arrays down to the kept ones."""
     order = jnp.argsort(~keep, stable=True)
     n_keep = jnp.sum(keep).astype(I32)
     idx = jnp.arange(keep.shape[0], dtype=I32)
@@ -715,6 +717,107 @@ def compact(s: DocState, ob_flag=None) -> DocState:
         prop_keys=tuple(g(a, -1) for a in s.prop_keys),
         prop_vals=tuple(g(a, 0) for a in s.prop_vals),
         nseg=n_keep,
+    )
+
+
+def compact(s: DocState, ob_flag=None) -> DocState:
+    """Evict segments whose winning remove is acked at or below min_seq.
+
+    Reference zamboni.ts:33 — such segments are invisible to every legal
+    perspective (refSeq >= minSeq), so dropping them is unobservable.
+    Segments anchoring a live obliterate stay resident (their index position
+    defines the obliterate's window for concurrent inserts).  ``ob_flag``
+    gates the [OB,S] anchor-retention matrix (scalar; see apply_op).
+    """
+    if ob_flag is None:
+        ob_flag = jnp.any(s.ob_key >= 0)
+    alive = _alive(s)
+    rem0 = _min_tree(s.rem_keys)
+    dead = alive & (rem0 < LOCAL_BASE) & (rem0 <= s.min_seq)
+    anchored = jax.lax.cond(
+        ob_flag, _anchored_mask, lambda s: jnp.zeros_like(alive), s
+    )
+    return _gather_keep(s, alive & ~(dead & ~anchored))
+
+
+@jax.jit
+def drop_squashed(s: DocState) -> DocState:
+    """Drop squashed segments: pending insert later covered by a pending
+    remove — under squash resubmission the pair cancels and the segment
+    never materializes remotely (ref reSubmitCore(squash), channel.ts:160;
+    mergetree_ref.RefMergeTree._squashed).  Obliterate anchors stay."""
+    alive = _alive(s)
+    pend_ins = s.ins_key >= LOCAL_BASE
+    pend_rem = _any_tree(
+        [(k >= LOCAL_BASE) & (k < NO_REMOVE) for k in s.rem_keys]
+    )
+    squashed = alive & pend_ins & pend_rem
+    return _gather_keep(s, alive & ~(squashed & ~_anchored_mask(s)))
+
+
+@jax.jit
+def strip_stamp(s: DocState, key) -> DocState:
+    """Erase every trace of the stamp ``key``: remove-slot stamps revert to
+    NO_REMOVE and the matching obliterate record (if any) is freed.  Used
+    when a pending op is retired without resubmission (its target content
+    vanished during reconnect regeneration)."""
+    hits = [k == key for k in s.rem_keys]
+    ob_hit = s.ob_key == key
+    return s._replace(
+        rem_keys=tuple(
+            jnp.where(h, NO_REMOVE, k) for h, k in zip(hits, s.rem_keys)
+        ),
+        rem_clients=tuple(
+            jnp.where(h, -1, c) for h, c in zip(hits, s.rem_clients)
+        ),
+        ob_key=jnp.where(ob_hit, -1, s.ob_key),
+    )
+
+
+@jax.jit
+def restamp(
+    s: DocState,
+    mask: jnp.ndarray,
+    old_key,
+    new_key,
+    new_client,
+    do_ins,
+    do_rem,
+    do_prop,
+    do_ob,
+) -> DocState:
+    """Selectively rewrite stamp keys ``old_key`` -> ``new_key`` on the
+    segments selected by ``mask`` ([S] bool), per stamp class (insert /
+    remove / prop / obliterate-record).  ``new_client`` < 0 keeps clients.
+    This is the device half of reconnect regeneration: the host plans the
+    re-minted ops (kernel_backend.regenerate_pending) and re-stamps exactly
+    the segments of each plan so every re-minted op acks independently
+    (ref client.ts regeneratePendingOp mints new segment groups)."""
+    rw_c = new_client >= 0
+    ins_hit = do_ins & mask & (s.ins_key == old_key)
+    rem_hits = [do_rem & mask & (k == old_key) for k in s.rem_keys]
+    ob_hit = do_ob & (s.ob_key == old_key)
+    return s._replace(
+        ins_key=jnp.where(ins_hit, new_key, s.ins_key),
+        ins_client=jnp.where(ins_hit & rw_c, new_client, s.ins_client),
+        rem_keys=tuple(
+            jnp.where(h, new_key, k) for h, k in zip(rem_hits, s.rem_keys)
+        ),
+        rem_clients=tuple(
+            jnp.where(h & rw_c, new_client, c)
+            for h, c in zip(rem_hits, s.rem_clients)
+        ),
+        prop_keys=tuple(
+            jnp.where(do_prop & mask & (k == old_key), new_key, k)
+            for k in s.prop_keys
+        ),
+        ob_key=jnp.where(ob_hit, new_key, s.ob_key),
+        ob_client=jnp.where(ob_hit & rw_c, new_client, s.ob_client),
+        # ob_preceding references follow the record's stamp rewrite (the
+        # oracle mutates the shared Obliterate object in place).
+        seg_obpre=jnp.where(
+            do_ob & (s.seg_obpre == old_key), new_key, s.seg_obpre
+        ),
     )
 
 

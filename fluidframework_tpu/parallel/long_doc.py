@@ -63,7 +63,7 @@ def _specs_for(state: DocState, axis: str) -> DocState:
         prop_vals=(s,) * len(state.prop_vals),
         # The obliterate window table is tiny: replicate it like scalars.
         uid_next=r, ob_key=r, ob_client=r, ob_start_uid=r, ob_end_uid=r,
-        ob_start_side=r, ob_end_side=r,
+        ob_start_side=r, ob_end_side=r, ob_ref_seq=r,
         min_seq=r, error=r,
     )
 

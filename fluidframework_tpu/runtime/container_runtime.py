@@ -378,6 +378,13 @@ class ContainerRuntime:
         if not inbound:
             return  # partial chunk
         batch_id = inbound[0].batch_id
+        # "Our own op" matching is by submitting identity: stashed entries
+        # carry the identity they were flushed under, so a batch sequenced
+        # under the PREVIOUS identity before the stash was taken acks the
+        # stashed ops on rehydrate (ref pendingStateManager.ts matches
+        # savedOps by clientId/clientSequenceNumber), while the same batch
+        # id arriving under a DIFFERENT identity is a rehydrated twin's
+        # replay — a fork.
         local = (
             self._psm.has_pending and self._psm.head_client_id == msg.client_id
         )
@@ -543,9 +550,13 @@ class ContainerRuntime:
                 # Stashed attach op: re-create the structure locally, then
                 # let the pending replay resubmit it verbatim.
                 self._apply_runtime_op(contents["contents"], self.ref_seq)
-                self._psm.add_stashed(contents, None, entry["batchId"])
+                self._psm.add_stashed(
+                    contents, None, entry["batchId"], entry.get("clientId", "")
+                )
                 continue
             md = self._datastores[contents["address"]].apply_stashed(
                 contents["contents"]
             )
-            self._psm.add_stashed(contents, md, entry["batchId"])
+            self._psm.add_stashed(
+                contents, md, entry["batchId"], entry.get("clientId", "")
+            )

@@ -51,6 +51,10 @@ class PendingStateManager:
     def head_client_id(self) -> str | None:
         return self._pending[0].client_id if self._pending else None
 
+    @property
+    def head_batch_id(self) -> str | None:
+        return self._pending[0].batch_id if self._pending else None
+
     def pending_batch_ids(self) -> set[str]:
         return {p.batch_id for p in self._pending}
 
@@ -96,8 +100,16 @@ class PendingStateManager:
         return groups
 
     # ------------------------------------------------------------------ stash
-    def add_stashed(self, contents: dict[str, Any], local_metadata: Any, batch_id: str) -> None:
-        self._pending.append(PendingMessage(contents, local_metadata, batch_id, ""))
+    def add_stashed(
+        self,
+        contents: dict[str, Any],
+        local_metadata: Any,
+        batch_id: str,
+        client_id: str = "",
+    ) -> None:
+        self._pending.append(
+            PendingMessage(contents, local_metadata, batch_id, client_id)
+        )
 
     def get_local_state(self, ref_seq: int) -> str:
         """Serialize pending messages for offline stash. Metadata is dropped:
@@ -105,12 +117,19 @@ class PendingStateManager:
         regenerates it (the reference's applyStashedOp contract). ``ref_seq``
         records the sequence number the pending state is relative to, so
         rehydration can apply the stash at the exact same point in the
-        op stream (ref applyStashedOpsAt)."""
+        op stream (ref applyStashedOpsAt). ``clientId`` records the identity
+        each entry was flushed under ("" = never sent): rehydration uses it
+        to recognize stashed ops that were ALREADY sequenced before the
+        stash was taken (ref savedOps matching in pendingStateManager.ts)."""
         return json.dumps(
             {
                 "refSeq": ref_seq,
                 "pending": [
-                    {"contents": p.contents, "batchId": p.batch_id}
+                    {
+                        "contents": p.contents,
+                        "batchId": p.batch_id,
+                        "clientId": p.client_id,
+                    }
                     for p in self._pending
                 ],
             }

@@ -21,3 +21,31 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(params=["oracle", "kernel"])
+def string_backend(request):
+    """Run a test once on the Python oracle and once with the TPU kernel
+    behind the channel boundary (the north star's plugin gate,
+    ref datastore-definitions/src/channel.ts:294).  Modules opt in with
+    ``pytestmark = pytest.mark.usefixtures("string_backend")``."""
+    if request.param == "kernel":
+        from fluidframework_tpu.dds import channels
+        from fluidframework_tpu.dds.kernel_backend import KernelMergeTree
+
+        channels.set_string_backend_factory(
+            lambda: KernelMergeTree(
+                max_segments=1024,
+                remove_slots=6,
+                prop_slots=4,
+                text_capacity=16384,
+                max_insert_len=16,
+                ob_slots=16,
+            )
+        )
+        yield "kernel"
+        channels.set_string_backend_factory(None)
+    else:
+        yield "oracle"

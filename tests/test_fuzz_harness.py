@@ -21,6 +21,9 @@ from fluidframework_tpu.dds.tree.schema import leaf
 from fluidframework_tpu.testing import DDSFuzzModel, FuzzFailure, run_fuzz_suite
 from fluidframework_tpu.testing.fuzz import minimize, run_fuzz_seed
 
+pytestmark = pytest.mark.usefixtures("string_backend")
+
+
 
 # --------------------------------------------------------------------------
 # models

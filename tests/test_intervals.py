@@ -9,9 +9,14 @@ from __future__ import annotations
 
 import random
 
+import pytest
+
 from fluidframework_tpu.dds.channels import default_registry
 from fluidframework_tpu.runtime import ContainerRuntime
 from fluidframework_tpu.server.local_service import LocalService
+
+pytestmark = pytest.mark.usefixtures("string_backend")
+
 
 
 def make_container(doc, name: str, stash: str | None = None) -> ContainerRuntime:

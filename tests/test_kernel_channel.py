@@ -1,0 +1,194 @@
+"""Differential proof that the TPU kernel is a drop-in channel backend.
+
+The strongest form of the channel-boundary gate (ref
+datastore-definitions/src/channel.ts:294): a MIXED fleet — some replicas on
+the Python oracle, some on the JAX kernel — collaborating on one document
+must converge to identical text/annotations/intervals through every channel
+code path (flush, synchronize, reconnect regeneration, offline stash,
+summaries for late joiners).  Any semantic drift between the two
+implementations surfaces as divergence here.
+
+The single-backend forms of these paths run across the whole channel suite
+via the ``string_backend`` conftest fixture; this module adds the
+cross-backend fleet plus directed reconnect/stash cases on the kernel.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import pytest
+
+from fluidframework_tpu.dds import channels
+from fluidframework_tpu.dds.kernel_backend import KernelMergeTree
+from fluidframework_tpu.dds.mergetree_ref import RefMergeTree
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+from fluidframework_tpu.testing import DDSFuzzModel, run_fuzz_suite
+
+from test_fuzz_harness import string_generate, string_reduce
+
+
+def _kernel() -> KernelMergeTree:
+    return KernelMergeTree(
+        max_segments=1024,
+        remove_slots=6,
+        text_capacity=16384,
+        max_insert_len=8,
+        ob_slots=16,
+    )
+
+
+@pytest.fixture
+def mixed_fleet():
+    """Alternate kernel/oracle backends across channel creations."""
+    counter = itertools.count()
+
+    def factory():
+        return _kernel() if next(counter) % 2 == 0 else RefMergeTree()
+
+    channels.set_string_backend_factory(factory)
+    yield
+    channels.set_string_backend_factory(None)
+
+
+def mixed_check(a, b) -> None:
+    assert a.text == b.text, f"text divergence: {a.text!r} != {b.text!r}"
+    ann_a = a.backend.annotations(view_client=a.backend.local_client)
+    ann_b = b.backend.annotations(view_client=b.backend.local_client)
+    assert ann_a == ann_b, f"annotation divergence: {ann_a} != {ann_b}"
+    ia = {iv.interval_id: (iv.start, iv.end) for iv in a.get_interval_collection("f")}
+    ib = {iv.interval_id: (iv.start, iv.end) for iv in b.get_interval_collection("f")}
+    assert ia == ib, f"interval divergence: {ia} != {ib}"
+
+
+MIXED_MODEL = DDSFuzzModel(
+    name="mixedBackends",
+    channel_type="sharedString",
+    generate=string_generate,
+    reduce=string_reduce,
+    check_consistent=mixed_check,
+    # Boost the reconnect/stash meta-ops: regeneration is where backend
+    # drift would hide (ref client.ts regeneratePendingOp:1452).
+    weights={
+        "edit": 12.0,
+        "flush": 4.0,
+        "synchronize": 2.0,
+        "reconnect": 2.0,
+        "stash": 1.0,
+        "add_client": 0.5,
+        "rollback": 0.25,
+    },
+)
+
+
+def test_mixed_backend_fleet_fuzz(mixed_fleet):
+    run_fuzz_suite(MIXED_MODEL, range(8), steps=80)
+
+
+# --------------------------------------------------------------------------
+# Directed kernel reconnect / stash cases
+# --------------------------------------------------------------------------
+
+
+def _fleet(n=2, backend_for=lambda i: None):
+    svc = LocalService()
+    doc = svc.document("d")
+    containers = []
+    for i in range(n):
+        be = backend_for(i)
+        channels.set_string_backend_factory((lambda b: lambda: b)(be) if be else None)
+        try:
+            rt = ContainerRuntime(channels.default_registry(), container_id=f"c{i}")
+            ds = rt.create_datastore("root")
+            ds.create_channel("sharedString", "t")
+            rt.connect(doc, f"c{i}")
+        finally:
+            channels.set_string_backend_factory(None)
+        containers.append(rt)
+    doc.process_all()
+    return svc, doc, containers
+
+
+def _ch(rt):
+    return rt.datastore("root").get_channel("t")
+
+
+def test_kernel_reconnect_regenerates_pending(mixed_fleet):
+    """Pending insert+remove+annotate+obliterate survive a reconnect on the
+    kernel backend and converge with an oracle peer."""
+    svc, doc, (a, b) = _fleet(2, backend_for=lambda i: _kernel() if i == 0 else None)
+    assert isinstance(_ch(a).backend, KernelMergeTree)
+    _ch(a).insert_text(0, "hello world")
+    a.flush()
+    doc.process_all()
+
+    # Pending ops of every kind, then drop the connection before they land.
+    _ch(a).insert_text(5, "XY")
+    _ch(a).remove_range(0, 2)
+    _ch(a).annotate_range(3, 8, prop=1, value=7)
+    _ch(a).obliterate_range(8, 10)
+    a.flush()
+    # Concurrent remote edit the regenerated ops must rebase over.
+    _ch(b).insert_text(0, "zz")
+    b.flush()
+    a.disconnect()
+    doc.process_all()  # b's edit + a's ops are lost (disconnected before send? no: flushed)
+    a.connect(doc, "c0.r1")
+    doc.process_all()
+    assert _ch(a).text == _ch(b).text
+    assert _ch(a).backend.check_errors() == 0
+
+
+def test_kernel_stash_rehydrate(mixed_fleet):
+    """Offline stash on a kernel-backed container rehydrates and converges."""
+    svc, doc, (a, b) = _fleet(2, backend_for=lambda i: _kernel() if i == 0 else None)
+    _ch(a).insert_text(0, "abcdef")
+    a.flush()
+    doc.process_all()
+    _ch(a).insert_text(3, "QQ")
+    _ch(a).remove_range(0, 1)
+    a.disconnect()
+    stash = a.get_pending_local_state()
+    a.close()
+
+    _ch(b).insert_text(0, "pp")
+    b.flush()
+    doc.process_all()
+
+    channels.set_string_backend_factory(_kernel)
+    try:
+        a2 = ContainerRuntime(channels.default_registry(), container_id="c0s")
+        ds = a2.create_datastore("root")
+        ds.create_channel("sharedString", "t")
+        a2.connect(doc, "c0.s", stash=stash)
+    finally:
+        channels.set_string_backend_factory(None)
+    doc.process_all()
+    assert _ch(a2).text == _ch(b).text
+    assert _ch(a2).backend.check_errors() == 0
+
+
+def test_kernel_summary_round_trip(mixed_fleet):
+    """Kernel summaries load back into both kernel and oracle backends."""
+    svc, doc, (a, b) = _fleet(2, backend_for=lambda i: _kernel() if i == 0 else None)
+    _ch(a).insert_text(0, "summary me")
+    _ch(a).annotate_range(0, 4, prop=2, value=9)
+    a.flush()
+    doc.process_all()
+    _ch(b).obliterate_range(2, 5)
+    b.flush()
+    doc.process_all()
+
+    summary = _ch(a).summarize()
+    # Round-trip into a fresh kernel backend.
+    fresh_k = _kernel()
+    fresh_k.import_summary(summary)
+    assert fresh_k.visible_text() == _ch(a).text
+    assert fresh_k.export_summary() == {
+        k: summary[k] for k in ("segments", "obliterates", "minSeq")
+    }
+    # And into the oracle.
+    fresh_o = RefMergeTree()
+    fresh_o.import_summary(summary)
+    assert fresh_o.visible_text() == _ch(a).text

@@ -13,6 +13,9 @@ from fluidframework_tpu.driver import LocalDocumentServiceFactory
 from fluidframework_tpu.loader import Container
 from fluidframework_tpu.server import LocalService
 
+pytestmark = pytest.mark.usefixtures("string_backend")
+
+
 
 @pytest.fixture
 def env():

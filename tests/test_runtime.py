@@ -20,6 +20,9 @@ from fluidframework_tpu.runtime import (
 from fluidframework_tpu.runtime.container_runtime import ContainerForkError
 from fluidframework_tpu.server.local_service import LocalService
 
+pytestmark = pytest.mark.usefixtures("string_backend")
+
+
 
 # --------------------------------------------------------------------------
 # op lifecycle unit tests
