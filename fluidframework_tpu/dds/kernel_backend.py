@@ -30,11 +30,13 @@ import numpy as np
 import jax
 
 from ..ops import mergetree_kernel as mk
-from ..protocol.stamps import ALL_ACKED, LOCAL_BASE, NO_REMOVE, NON_COLLAB_CLIENT
-
-
-def _acked(key: int) -> bool:
-    return key < LOCAL_BASE
+from ..protocol.stamps import (
+    ALL_ACKED,
+    LOCAL_BASE,
+    NO_REMOVE,
+    NON_COLLAB_CLIENT,
+    acked as _acked,
+)
 
 
 @jax.jit
@@ -214,13 +216,16 @@ class KernelMergeTree:
     def apply_insert(self, pos, text, op_key, op_client, ref_seq) -> list[int]:
         """Apply an insert; returns the uids of the created segments (the
         channel's converged-event handles)."""
+        # An insert chunk fails iff one of these latches (ERR_REM_OVERFLOW
+        # can accompany a SUCCESSFUL insert — swallow-candidate overflow);
+        # once any is latched the state is unreliable, so stop attributing.
+        fail_bits = mk.ERR_SEG_OVERFLOW | mk.ERR_TEXT_OVERFLOW | mk.ERR_POS_RANGE
         uids: list[int] = []
         for op, payload in mk.encode_insert(
             pos, text, op_key, op_client, ref_seq, self.max_insert_len
         ):
-            err_before = int(self.state.error)
             self._step(op, payload)
-            if int(self.state.error) == err_before:
+            if int(self.state.error) & fail_bits == 0:
                 # The new segment's uid is always the last allocation of the
                 # chunk's apply (_do_insert allocates the boundary-split uid
                 # first, the new segment's uid last).

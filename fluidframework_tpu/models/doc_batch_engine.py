@@ -18,6 +18,24 @@ contiguous ops are bunched before DDS apply; here the bunch becomes a
 This engine is the pure-replica path (no local pending ops): every op is a
 remote sequenced apply, exactly the scenario of a server-side/materialized
 replica fleet.  Client-side engines with pending/ack live in dds/.
+
+Capacity overflow recovery (the kernel latches ERR_* bits instead of
+trapping — mergetree_kernel.py): after every ``step`` the engine inspects
+the fleet's error vector and recovers any flagged document, so no error bit
+ever survives a run.  Recovery policy:
+
+- ``"grow"`` (default): re-provision the document in an *overflow lane* — a
+  single-doc DocState with the implicated capacity axes doubled — and
+  replay its retained wire log from scratch (deterministic: the log is the
+  total order).  Repeated overflows double again up to ``max_growths``,
+  then fall through to the oracle.  Lanes keep applying on device (jit per
+  geometry, cached), they just leave the lockstep batch.
+- ``"oracle"``: replay the log through the host RefMergeTree and route all
+  future ops there (the reference analog of a document leaving the fast
+  path; SURVEY §7 capacity-management risk).
+
+ERR_POS_RANGE is not recoverable by capacity: a malformed sequenced op
+would corrupt every conforming replica, so the engine raises.
 """
 
 from __future__ import annotations
@@ -28,7 +46,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..dds.shared_string import SharedString, decode_obliterate_places
+from ..dds.mergetree_ref import RefMergeTree
+from ..dds.shared_string import decode_obliterate_places
 from ..ops import mergetree_kernel as mk
 from ..parallel.mesh import doc_mesh, shard_docs
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
@@ -44,6 +63,20 @@ class _DocHost:
     min_seq: int = 0
     # Property id -> kernel prop slot (interned per document).
     prop_slot: dict[int, int] = field(default_factory=dict)
+    # Retained wire log (every OP message, in sequence order): the replay
+    # source for overflow recovery.
+    log: list[SequencedMessage] = field(default_factory=list)
+
+
+@dataclass
+class _OverflowLane:
+    """A document that outgrew the lockstep batch: own DocState, own queue."""
+
+    state: mk.DocState
+    geometry: dict[str, int]
+    growths: int
+    queue: list[np.ndarray] = field(default_factory=list)
+    payloads: list[np.ndarray] = field(default_factory=list)
 
 
 class DocBatchEngine:
@@ -58,13 +91,29 @@ class DocBatchEngine:
         text_capacity: int = 16384,
         max_insert_len: int = 64,
         ops_per_step: int = 16,
+        ob_slots: int = 8,
         mesh=None,
         use_mesh: bool = True,
+        recovery: str = "grow",
+        max_growths: int = 4,
     ) -> None:
+        assert recovery in ("grow", "oracle", "off")
         self.n_docs = n_docs
         self.max_insert_len = max_insert_len
         self.ops_per_step = ops_per_step
+        self.recovery = recovery
+        self.max_growths = max_growths
         self.hosts = [_DocHost() for _ in range(n_docs)]
+        self.geometry = {
+            "max_segments": max_segments,
+            "remove_slots": remove_slots,
+            "prop_slots": prop_slots,
+            "text_capacity": text_capacity,
+            "ob_slots": ob_slots,
+        }
+        # Recovery lanes (doc_idx -> lane / oracle replica).
+        self.overflow: dict[int, _OverflowLane] = {}
+        self.oracles: dict[int, RefMergeTree] = {}
 
         if use_mesh:
             self.mesh = mesh if mesh is not None else doc_mesh()
@@ -76,7 +125,9 @@ class DocBatchEngine:
         # inert: their queues stay empty so they only ever apply noops).
         self.capacity = -(-n_docs // n_shards) * n_shards
 
-        proto = mk.init_state(max_segments, remove_slots, prop_slots, text_capacity)
+        proto = mk.init_state(
+            max_segments, remove_slots, prop_slots, text_capacity, ob_slots
+        )
         self.state = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (self.capacity,) + x.shape), proto
         )
@@ -103,6 +154,11 @@ class DocBatchEngine:
 
         self._step = jax.jit(_step, donate_argnums=(0,))
         self._compact = jax.jit(_compact, donate_argnums=(0,))
+        # Lane programs: jit caches one executable per lane geometry.
+        self._lane_apply = jax.jit(mk.apply_ops)
+        self._lane_compact = jax.jit(
+            lambda s, m: mk.compact(mk.set_min_seq(s, m))
+        )
 
     # ------------------------------------------------------------------ ingest
     def ingest(self, doc_idx: int, msg: SequencedMessage) -> None:
@@ -120,53 +176,101 @@ class DocBatchEngine:
         if msg.type != MessageType.OP:
             h.min_seq = max(h.min_seq, msg.min_seq)
             return
+        h.min_seq = max(h.min_seq, msg.min_seq)
+        if doc_idx in self.oracles:
+            # Oracle-routed docs apply immediately and can never need
+            # another replay — no point retaining their log further.
+            self._oracle_apply(self.oracles[doc_idx], h, msg)
+            return
+        if self.recovery != "off":
+            # Replay source for overflow recovery.  Unbounded by design for
+            # now: bounding it needs DDS-level checkpoints to replay from
+            # (summary + suffix), which this pure-replica engine does not
+            # carry yet.
+            h.log.append(msg)
+        if doc_idx in self.overflow:
+            lane = self.overflow[doc_idx]
+            for op, payload in self._encode(h, msg):
+                lane.queue.append(op)
+                lane.payloads.append(payload)
+            return
+        for op, payload in self._encode(h, msg):
+            h.queue.append(op)
+            h.payloads.append(payload)
+
+    def _encode(
+        self, h: _DocHost, msg: SequencedMessage
+    ) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Wire message -> kernel op rows (+payloads)."""
+        c = msg.contents
+        kind = c["type"]
+        client = h.quorum[msg.client_id]
+        empty = np.zeros((self.max_insert_len,), np.int32)
+        if kind == DeltaType.INSERT:
+            return mk.encode_insert(
+                c["pos1"], c["seg"], msg.seq, client, msg.ref_seq,
+                self.max_insert_len,
+            )
+        if kind == DeltaType.REMOVE:
+            op = np.array(
+                [mk.OpKind.REMOVE, msg.seq, client, msg.ref_seq,
+                 c["pos1"], c["pos2"], 0, 0],
+                np.int32,
+            )
+            return [(op, empty)]
+        if kind == DeltaType.ANNOTATE:
+            out = []
+            for prop, value in c["props"].items():
+                slot = self._prop_slot_for(h, int(prop))
+                out.append(
+                    (
+                        np.array(
+                            [mk.OpKind.ANNOTATE, msg.seq, client, msg.ref_seq,
+                             c["pos1"], c["pos2"], slot, value],
+                            np.int32,
+                        ),
+                        empty,
+                    )
+                )
+            return out
+        if kind in (DeltaType.OBLITERATE, DeltaType.OBLITERATE_SIDED):
+            p1, s1, p2, s2 = decode_obliterate_places(c)
+            return [
+                (mk.encode_obliterate(p1, s1, p2, s2, msg.seq, client, msg.ref_seq),
+                 empty)
+            ]
+        raise ValueError(f"unsupported op type {kind}")
+
+    @staticmethod
+    def _oracle_apply(tree: RefMergeTree, h: _DocHost, msg: SequencedMessage) -> None:
+        """Apply one wire OP message to a host oracle replica (the pure
+        remote path of SharedString._apply_remote)."""
         c = msg.contents
         kind = c["type"]
         client = h.quorum[msg.client_id]
         if kind == DeltaType.INSERT:
-            for op, payload in mk.encode_insert(
-                c["pos1"], c["seg"], msg.seq, client, msg.ref_seq,
-                self.max_insert_len,
-            ):
-                h.queue.append(op)
-                h.payloads.append(payload)
+            tree.apply_insert(c["pos1"], c["seg"], msg.seq, client, msg.ref_seq)
         elif kind == DeltaType.REMOVE:
-            h.queue.append(
-                np.array(
-                    [mk.OpKind.REMOVE, msg.seq, client, msg.ref_seq,
-                     c["pos1"], c["pos2"], 0, 0],
-                    np.int32,
-                )
-            )
-            h.payloads.append(np.zeros((self.max_insert_len,), np.int32))
+            tree.apply_remove(c["pos1"], c["pos2"], msg.seq, client, msg.ref_seq)
         elif kind == DeltaType.ANNOTATE:
             for prop, value in c["props"].items():
-                slot = self._prop_slot_for(h, int(prop))
-                h.queue.append(
-                    np.array(
-                        [mk.OpKind.ANNOTATE, msg.seq, client, msg.ref_seq,
-                         c["pos1"], c["pos2"], slot, value],
-                        np.int32,
-                    )
+                tree.apply_annotate(
+                    c["pos1"], c["pos2"], int(prop), value,
+                    msg.seq, client, msg.ref_seq,
                 )
-                h.payloads.append(np.zeros((self.max_insert_len,), np.int32))
         elif kind in (DeltaType.OBLITERATE, DeltaType.OBLITERATE_SIDED):
             p1, s1, p2, s2 = decode_obliterate_places(c)
-            h.queue.append(
-                mk.encode_obliterate(p1, s1, p2, s2, msg.seq, client, msg.ref_seq)
-            )
-            h.payloads.append(np.zeros((self.max_insert_len,), np.int32))
+            tree.apply_obliterate(p1, s1, p2, s2, msg.seq, client, msg.ref_seq)
         else:
             raise ValueError(f"unsupported op type {kind}")
-        h.min_seq = max(h.min_seq, msg.min_seq)
 
     def _prop_slot_for(self, h: _DocHost, prop: int) -> int:
         """Intern a property id to a kernel prop slot (range-checked)."""
         if prop not in h.prop_slot:
             slot = len(h.prop_slot)
-            if slot >= len(self.state.prop_keys):
+            if slot >= self.geometry["prop_slots"]:
                 raise ValueError(
-                    f"document exhausted its {len(self.state.prop_keys)} prop "
+                    f"document exhausted its {self.geometry['prop_slots']} prop "
                     f"slots; raise prop_slots to accommodate prop id {prop}"
                 )
             h.prop_slot[prop] = slot
@@ -174,12 +278,14 @@ class DocBatchEngine:
 
     # ------------------------------------------------------------------- step
     def pending_ops(self) -> int:
-        return sum(len(h.queue) for h in self.hosts)
+        return sum(len(h.queue) for h in self.hosts) + sum(
+            len(l.queue) for l in self.overflow.values()
+        )
 
     def build_step_batch(self) -> tuple[np.ndarray, np.ndarray] | None:
         """Dequeue up to ops_per_step ops per doc into padded [D,B] arrays."""
         B = self.ops_per_step
-        if self.pending_ops() == 0:
+        if not any(h.queue for h in self.hosts):
             return None
         ops = np.zeros((self.capacity, B, mk.OP_FIELDS), np.int32)
         payloads = np.zeros((self.capacity, B, self.max_insert_len), np.int32)
@@ -193,33 +299,172 @@ class DocBatchEngine:
         return ops, payloads
 
     def step(self) -> int:
-        """Run device steps until all staged ops are applied; returns steps."""
+        """Run device steps until all staged ops are applied; returns the
+        number of batched steps.  Afterwards, any latched overflow bits are
+        recovered (grow-and-replay or oracle routing), so ``errors()`` is
+        all-zero on return unless recovery is off."""
         steps = 0
         while True:
             batch = self.build_step_batch()
             if batch is None:
-                return steps
+                break
             ops, payloads = batch
             self.state = self._step(self.state, jnp.asarray(ops), jnp.asarray(payloads))
             steps += 1
+        self._step_lanes()
+        if self.recovery != "off":
+            self.recover()
+        return steps
+
+    def _step_lanes(self) -> None:
+        B = self.ops_per_step
+        for lane in self.overflow.values():
+            while lane.queue:
+                take = min(B, len(lane.queue))
+                ops = np.zeros((B, mk.OP_FIELDS), np.int32)
+                payloads = np.zeros((B, self.max_insert_len), np.int32)
+                for j in range(take):
+                    ops[j] = lane.queue[j]
+                    payloads[j] = lane.payloads[j]
+                del lane.queue[:take]
+                del lane.payloads[:take]
+                lane.state = self._lane_apply(
+                    lane.state, jnp.asarray(ops), jnp.asarray(payloads)
+                )
 
     def compact(self) -> None:
         """Advance MSNs and run zamboni eviction across the fleet."""
         mins = [h.min_seq for h in self.hosts]
         mins += [0] * (self.capacity - self.n_docs)
         self.state = self._compact(self.state, jnp.asarray(mins, jnp.int32))
+        for d, lane in self.overflow.items():
+            lane.state = self._lane_compact(
+                lane.state, jnp.asarray(self.hosts[d].min_seq, jnp.int32)
+            )
+        for d, tree in self.oracles.items():
+            tree.update_min_seq(self.hosts[d].min_seq)
+
+    # --------------------------------------------------------------- recovery
+    def recover(self) -> list[int]:
+        """Inspect every error vector and recover flagged docs; returns the
+        doc indices recovered this call."""
+        recovered: list[int] = []
+        err = np.asarray(self.state.error)
+        for d in range(self.n_docs):
+            if d not in self.overflow and d not in self.oracles and err[d]:
+                self._recover_doc(d, int(err[d]), growths=0)
+                # Retire the batch slot: clear the latched bits so the slot
+                # never re-triggers (its queue is empty and future ops route
+                # to the lane).
+                self.state = self.state._replace(
+                    error=self.state.error.at[d].set(0)
+                )
+                recovered.append(d)
+        for d, lane in list(self.overflow.items()):
+            bits = int(lane.state.error)
+            if bits:
+                self._recover_doc(d, bits, growths=lane.growths)
+                recovered.append(d)
+        return recovered
+
+    def _recover_doc(self, d: int, bits: int, growths: int) -> None:
+        if bits == mk.ERR_POS_RANGE:
+            # POS_RANGE alone (no capacity bit) means the op stream itself is
+            # malformed.  Alongside a capacity bit it is usually a CASCADE —
+            # an op referencing content a capacity overflow dropped — which
+            # the replay at grown capacity resolves, so fall through.
+            raise RuntimeError(
+                f"doc {d}: sequenced op out of range (error bits {bits:#x}) — "
+                "not a capacity problem; the op stream is malformed"
+            )
+        h = self.hosts[d]
+        geom = dict(
+            self.overflow[d].geometry if d in self.overflow else self.geometry
+        )
+        while self.recovery == "grow" and growths < self.max_growths:
+            growths += 1
+            geom = self._grown_geometry(geom, bits)
+            state = self._replay(h, geom)
+            new_bits = int(state.error)
+            if new_bits == 0:
+                self.overflow[d] = _OverflowLane(
+                    state=state, geometry=geom, growths=growths
+                )
+                return
+            bits = new_bits
+            if bits == mk.ERR_POS_RANGE:
+                raise RuntimeError(
+                    f"doc {d}: sequenced op out of range during replay at "
+                    f"capacity {geom} — the op stream is malformed"
+                )
+        # Growth exhausted (or policy is oracle): host replica takes over.
+        self.overflow.pop(d, None)
+        tree = RefMergeTree()
+        for msg in h.log:
+            self._oracle_apply(tree, h, msg)
+        tree.update_min_seq(h.min_seq)
+        self.oracles[d] = tree
+
+    @staticmethod
+    def _grown_geometry(base: dict[str, int], bits: int) -> dict[str, int]:
+        geom = dict(base)
+        if bits & mk.ERR_SEG_OVERFLOW:
+            geom["max_segments"] *= 2
+        if bits & mk.ERR_TEXT_OVERFLOW:
+            geom["text_capacity"] *= 2
+        if bits & mk.ERR_REM_OVERFLOW:
+            geom["remove_slots"] *= 2
+        if bits & mk.ERR_OB_OVERFLOW:
+            geom["ob_slots"] *= 2
+        return geom
+
+    def _replay(self, h: _DocHost, geom: dict[str, int]) -> mk.DocState:
+        """Re-apply the retained wire log on a fresh state with ``geom``."""
+        state = mk.init_state(
+            geom["max_segments"], geom["remove_slots"], geom["prop_slots"],
+            geom["text_capacity"], geom["ob_slots"],
+        )
+        B = self.ops_per_step
+        rows: list[tuple[np.ndarray, np.ndarray]] = []
+        for msg in h.log:
+            rows.extend(self._encode(h, msg))
+        for i in range(0, len(rows), B):
+            chunk = rows[i : i + B]
+            ops = np.zeros((B, mk.OP_FIELDS), np.int32)
+            payloads = np.zeros((B, self.max_insert_len), np.int32)
+            for j, (op, payload) in enumerate(chunk):
+                ops[j] = op
+                payloads[j] = payload
+            state = self._lane_apply(
+                state, jnp.asarray(ops), jnp.asarray(payloads)
+            )
+        return state
 
     # ------------------------------------------------------------------ views
     def doc_state(self, doc_idx: int) -> mk.DocState:
+        if doc_idx in self.overflow:
+            return self.overflow[doc_idx].state
         return jax.tree.map(lambda x: x[doc_idx], self.state)
 
     def text(self, doc_idx: int) -> str:
+        if doc_idx in self.oracles:
+            return self.oracles[doc_idx].visible_text()
         return mk.visible_text(self.doc_state(doc_idx))
 
     def annotations(self, doc_idx: int) -> list[dict[int, int]]:
+        if doc_idx in self.oracles:
+            return self.oracles[doc_idx].annotations()
         raw = mk.annotations(self.doc_state(doc_idx))
         inv = {v: k for k, v in self.hosts[doc_idx].prop_slot.items()}
         return [{inv[p]: v for p, v in d.items()} for d in raw]
 
     def errors(self) -> np.ndarray:
-        return np.asarray(self.state.error)
+        """Combined per-doc error vector across batch, lanes, and oracles."""
+        err = np.asarray(self.state.error).copy()
+        for d in range(self.n_docs, self.capacity):
+            err[d] = 0  # padding slots
+        for d, lane in self.overflow.items():
+            err[d] = int(lane.state.error)
+        for d in self.oracles:
+            err[d] = 0
+        return err

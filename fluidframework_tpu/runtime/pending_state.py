@@ -51,10 +51,6 @@ class PendingStateManager:
     def head_client_id(self) -> str | None:
         return self._pending[0].client_id if self._pending else None
 
-    @property
-    def head_batch_id(self) -> str | None:
-        return self._pending[0].batch_id if self._pending else None
-
     def pending_batch_ids(self) -> set[str]:
         return {p.batch_id for p in self._pending}
 

@@ -1,0 +1,199 @@
+"""Overflow recovery: no latched kernel error bit survives a fleet run.
+
+Each test forces one of the four capacity error classes
+(ERR_SEG/TEXT/REM/OB_OVERFLOW, ops/mergetree_kernel.py) on a deliberately
+under-provisioned DocBatchEngine and asserts the engine recovers — grow +
+re-replay into an overflow lane, or routing to the host oracle — and that
+the recovered document converges with an independently-driven oracle fleet.
+A healthy sibling doc shares the batch throughout to prove recovery is
+per-document.  (Round-2 verdict #4: errors() must stop being expose-only.)
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.shared_string import SharedString
+from fluidframework_tpu.models.doc_batch_engine import DocBatchEngine
+from fluidframework_tpu.ops import mergetree_kernel as mk
+from fluidframework_tpu.server.local_service import LocalService
+
+
+def _session(edits):
+    """Drive one two-client document; returns (log, expected_text)."""
+    svc = LocalService()
+    doc = svc.document("d")
+    a = SharedString(client_id="a")
+    b = SharedString(client_id="b")
+    doc.connect(a.client_id, a.process)
+    doc.connect(b.client_id, b.process)
+    doc.process_all()
+    edits(a, b, doc)
+    for c in (a, b):
+        for m in c.take_outbox():
+            doc.submit(m)
+    doc.process_all()
+    assert a.text == b.text
+    return list(doc.sequencer.log), a.text
+
+
+def _healthy_session():
+    def edits(a, b, doc):
+        a.insert_text(0, "healthy")
+        for m in a.take_outbox():
+            doc.submit(m)
+        doc.process_all()
+        b.insert_text(7, "!")
+
+    return _session(edits)
+
+
+def _run_engine(log, recovery, **geom):
+    """Feed two docs — the overflow scenario and a healthy one — through an
+    engine; return it after step (recovery runs inside step)."""
+    h_log, h_text = _healthy_session()
+    eng = DocBatchEngine(
+        2, max_insert_len=8, ops_per_step=4, use_mesh=False,
+        recovery=recovery, **geom,
+    )
+    for msg in log:
+        eng.ingest(0, msg)
+    for msg in h_log:
+        eng.ingest(1, msg)
+    eng.step()
+    return eng, h_text
+
+
+def _check(eng, expected, h_text, want_lane=None):
+    assert not eng.errors().any(), "error bits survived the run"
+    assert eng.text(0) == expected
+    assert eng.text(1) == h_text
+    assert 1 not in eng.overflow and 1 not in eng.oracles
+    if want_lane == "overflow":
+        assert 0 in eng.overflow and 0 not in eng.oracles
+    elif want_lane == "oracle":
+        assert 0 in eng.oracles
+
+
+# ---------------------------------------------------------------- scenarios
+
+def _seg_overflow_session():
+    def edits(a, b, doc):
+        # Alternating-position inserts create one segment each: 10 > 4 slots.
+        for i in range(10):
+            a.insert_text(0, "ab")
+
+    return _session(edits)
+
+
+def _text_overflow_session():
+    def edits(a, b, doc):
+        a.insert_text(0, "x" * 100)  # > 64-char pool
+
+    return _session(edits)
+
+
+def _rem_overflow_session():
+    def edits(a, b, doc):
+        a.insert_text(0, "abcdef")
+        for m in a.take_outbox():
+            doc.submit(m)
+        doc.process_all()
+        # Concurrent overlapping removes from both clients: two remove
+        # stamps on one segment > 1 slot.
+        a.remove_range(1, 4)
+        b.remove_range(2, 5)
+
+    return _session(edits)
+
+
+def _ob_overflow_session():
+    def edits(a, b, doc):
+        a.insert_text(0, "abcdefgh")
+        for m in a.take_outbox():
+            doc.submit(m)
+        doc.process_all()
+        # Two obliterates in the collab window: second overflows 1 slot.
+        a.obliterate_range(0, 2)
+        b.obliterate_range(4, 6)
+
+    return _session(edits)
+
+
+CASES = [
+    ("seg", _seg_overflow_session, {"max_segments": 4}, mk.ERR_SEG_OVERFLOW),
+    ("text", _text_overflow_session, {"text_capacity": 64}, mk.ERR_TEXT_OVERFLOW),
+    ("rem", _rem_overflow_session, {"remove_slots": 1}, mk.ERR_REM_OVERFLOW),
+    ("ob", _ob_overflow_session, {"ob_slots": 1}, mk.ERR_OB_OVERFLOW),
+]
+
+
+@pytest.mark.parametrize("name,session,geom,bit", CASES, ids=[c[0] for c in CASES])
+def test_grow_recovers(name, session, geom, bit):
+    log, expected = session()
+    # First prove the bit actually trips with recovery off.
+    eng_off, _ = _run_engine(log, "off", **geom)
+    assert eng_off.errors()[0] & bit, f"scenario did not trip {name} overflow"
+    # Then that grow-and-replay clears it.
+    eng, h_text = _run_engine(log, "grow", **geom)
+    _check(eng, expected, h_text, want_lane="overflow")
+
+
+@pytest.mark.parametrize("name,session,geom,bit", CASES, ids=[c[0] for c in CASES])
+def test_oracle_route_recovers(name, session, geom, bit):
+    log, expected = session()
+    eng, h_text = _run_engine(log, "oracle", **geom)
+    _check(eng, expected, h_text, want_lane="oracle")
+
+
+def test_growth_exhaustion_falls_back_to_oracle():
+    log, expected = _seg_overflow_session()
+    h_log, h_text = _healthy_session()
+    eng = DocBatchEngine(
+        2, max_segments=4, max_insert_len=8, ops_per_step=4, use_mesh=False,
+        recovery="grow", max_growths=0,
+    )
+    for msg in log:
+        eng.ingest(0, msg)
+    for msg in h_log:
+        eng.ingest(1, msg)
+    eng.step()
+    _check(eng, expected, h_text, want_lane="oracle")
+
+
+def test_lane_keeps_serving_and_compacting():
+    """Ops arriving after recovery flow to the lane; compaction covers it."""
+    svc = LocalService()
+    doc = svc.document("d")
+    a = SharedString(client_id="a")
+    doc.connect(a.client_id, a.process)
+    doc.process_all()
+    for _ in range(10):
+        a.insert_text(0, "ab")
+    for m in a.take_outbox():
+        doc.submit(m)
+    doc.process_all()
+
+    eng = DocBatchEngine(
+        1, max_segments=4, max_insert_len=8, ops_per_step=4, use_mesh=False,
+    )
+    consumed = 0
+    for msg in doc.sequencer.log:
+        eng.ingest(0, msg)
+    consumed = len(doc.sequencer.log)
+    eng.step()
+    assert 0 in eng.overflow
+
+    # Continue editing: removes and inserts land on the lane.
+    a.remove_range(0, 4)
+    a.insert_text(2, "zz")
+    for m in a.take_outbox():
+        doc.submit(m)
+    doc.process_all()
+    for msg in doc.sequencer.log[consumed:]:
+        eng.ingest(0, msg)
+    eng.step()
+    assert not eng.errors().any()
+    assert eng.text(0) == a.text
+    eng.compact()
+    assert eng.text(0) == a.text
