@@ -71,7 +71,33 @@ class Modify:
     change: "NodeChange"
 
 
-Mark = Skip | Insert | Remove | Modify
+@dataclass
+class MoveOut:
+    """Detach ``count`` nodes into the move register ``id`` (consumes N,
+    produces 0).  ``offset`` is the first node's index within the ORIGINAL
+    move — rebasing can split one move into discontiguous pieces, and the
+    register must keep the move's original internal order regardless of
+    where the pieces ended up (ref sequence-field moveOut/moveIn pair with
+    cell ids)."""
+
+    count: int
+    id: int
+    offset: int = 0
+
+
+@dataclass
+class MoveIn:
+    """Attach nodes of move register ``id`` here (consumes 0, produces
+    ``count``).  ``offset`` selects which original-move offsets to attach
+    (None = the whole register, sorted by offset) — needed when inverting a
+    split move, whose inverse returns each piece to its own origin."""
+
+    id: int
+    count: int
+    offset: int | None = None
+
+
+Mark = Skip | Insert | Remove | Modify | MoveOut | MoveIn
 
 
 @dataclass
@@ -105,6 +131,10 @@ def marks_to_json(marks: list[Mark]) -> list:
                 if m.detached is None
                 else ["r", m.count, [n.to_json() for n in m.detached]]
             )
+        elif isinstance(m, MoveOut):
+            out.append(["mo", m.count, m.id, m.offset])
+        elif isinstance(m, MoveIn):
+            out.append(["mi", m.id, m.count, m.offset])
         else:
             out.append(["m", change_to_json(m.change)])
     return out
@@ -122,6 +152,10 @@ def marks_from_json(data: list) -> list[Mark]:
             out.append(
                 Remove(e[1], [Node.from_json(n) for n in e[2]] if len(e) > 2 else None)
             )
+        elif kind == "mo":
+            out.append(MoveOut(e[1], e[2], e[3] if len(e) > 3 else 0))
+        elif kind == "mi":
+            out.append(MoveIn(e[1], e[2], e[3] if len(e) > 3 else None))
         else:
             out.append(Modify(change_from_json(e[1])))
     return out
@@ -153,58 +187,18 @@ def clone_change(change: NodeChange) -> NodeChange:
 
 
 def _consumes(m: Mark) -> int:
-    if isinstance(m, (Skip, Remove)):
+    if isinstance(m, (Skip, Remove, MoveOut)):
         return m.count
     if isinstance(m, Modify):
         return 1
     return 0
 
 
-def _split(m: Mark, n: int) -> tuple[Mark, Mark | None]:
-    """Split a consuming mark into a prefix consuming n and the remainder."""
-    c = _consumes(m)
-    assert 0 < n <= c
-    if n == c:
-        return m, None
-    if isinstance(m, Skip):
-        return Skip(n), Skip(c - n)
-    if isinstance(m, Remove):
-        det = m.detached
-        return (
-            Remove(n, det[:n] if det is not None else None),
-            Remove(c - n, det[n:] if det is not None else None),
-        )
-    raise AssertionError("Modify cannot be split")
-
-
-class _MarkStream:
-    """Cursor over a mark list with implicit infinite trailing Skip."""
-
-    def __init__(self, marks: list[Mark]) -> None:
-        self._marks = [m for m in marks if _consumes(m) > 0 or isinstance(m, Insert)]
-        self._i = 0
-
-    def peek(self) -> Mark | None:
-        return self._marks[self._i] if self._i < len(self._marks) else None
-
-    def pop(self) -> Mark:
-        m = self._marks[self._i]
-        self._i += 1
-        return m
-
-    def push_front(self, m: Mark) -> None:
-        self._i -= 1
-        self._marks[self._i] = m
-
-    def exhausted(self) -> bool:
-        return self._i >= len(self._marks)
-
-
 def _emit(out: list[Mark], m: Mark) -> None:
-    """Append a mark, coalescing adjacent same-kind Skip/Remove runs."""
-    if isinstance(m, Skip) and m.count == 0:
+    """Append a mark, coalescing adjacent same-kind runs."""
+    if isinstance(m, (Skip, Remove, MoveOut)) and m.count == 0:
         return
-    if isinstance(m, Remove) and m.count == 0:
+    if isinstance(m, MoveIn) and m.count == 0:
         return
     if out:
         last = out[-1]
@@ -224,7 +218,134 @@ def _emit(out: list[Mark], m: Mark) -> None:
         if isinstance(last, Insert) and isinstance(m, Insert):
             out[-1] = Insert(last.content + m.content)
             return
+        if (
+            isinstance(last, MoveOut)
+            and isinstance(m, MoveOut)
+            and last.id == m.id
+            and last.offset + last.count == m.offset
+        ):
+            out[-1] = MoveOut(last.count + m.count, last.id, last.offset)
+            return
     out.append(m)
+
+
+class _Fates:
+    """Per-input-node fates and boundary maps of one mark list ``b``.
+
+    For every input position of b's context: whether the node survives into
+    b's output, where it lands (moves followed to their destination), and
+    any nested change b applied to it.  For every input boundary: the output
+    boundary before/after b's productions there — the sided tie-break
+    coordinates for rebasing boundary marks (Insert/MoveIn)."""
+
+    GONE = ("gone", None, None)
+
+    def __init__(self, b: list[Mark]) -> None:
+        # fate[i] = ("keep", out_pos, nested_change|None) | ("gone",..)
+        #         | ("moved", (move_id, offset), nested)
+        self.fate: list[tuple] = []
+        # MoveIn sites in mark order: (id, slice offset|None, count, out base)
+        self._move_ins: list[tuple[int, int | None, int, int]] = []
+        self._move_offsets: dict[int, list[int]] = {}  # id -> piece offsets
+        self._offset_dest: dict[tuple[int, int], int] | None = None
+        in_pos = 0
+        out_pos = 0
+        b_start = {}  # out position when each input boundary is reached
+        prods = {}    # outputs b produces AT each input boundary
+        for m in b:
+            if in_pos not in b_start:
+                b_start[in_pos] = out_pos
+            if isinstance(m, Skip):
+                for _ in range(m.count):
+                    self.fate.append(("keep", out_pos, None))
+                    out_pos += 1
+                    in_pos += 1
+                    b_start.setdefault(in_pos, out_pos)
+            elif isinstance(m, Modify):
+                self.fate.append(("keep", out_pos, m.change))
+                out_pos += 1
+                in_pos += 1
+                b_start.setdefault(in_pos, out_pos)
+            elif isinstance(m, Remove):
+                for _ in range(m.count):
+                    self.fate.append(self.GONE)
+                    in_pos += 1
+                    b_start.setdefault(in_pos, out_pos)
+            elif isinstance(m, MoveOut):
+                for off in range(m.count):
+                    self.fate.append(("moved", (m.id, m.offset + off), None))
+                    self._move_offsets.setdefault(m.id, []).append(
+                        m.offset + off
+                    )
+                    in_pos += 1
+                    b_start.setdefault(in_pos, out_pos)
+            elif isinstance(m, Insert):
+                prods[in_pos] = prods.get(in_pos, 0) + len(m.content)
+                out_pos += len(m.content)
+            elif isinstance(m, MoveIn):
+                self._move_ins.append((m.id, m.offset, m.count, out_pos))
+                prods[in_pos] = prods.get(in_pos, 0) + m.count
+                out_pos += m.count
+        self._tail_in = in_pos
+        self._tail_out = out_pos
+        self._b_start = b_start
+        self._prods = prods
+
+    def _dest_of(self, mid: int, off: int) -> int | None:
+        """Output position of the moved node with original offset ``off`` —
+        resolved by replaying apply_marks' register pop policy over b's
+        MoveIn sites (slice MoveIns of one id each take their own nodes)."""
+        if self._offset_dest is None:
+            self._offset_dest = {}
+            remaining = {
+                k: sorted(v) for k, v in self._move_offsets.items()
+            }
+            for in_id, in_off, count, base in self._move_ins:
+                pool = remaining.get(in_id, [])
+                if in_off is None:
+                    picked = pool[:]
+                else:
+                    picked = [o for o in pool if o >= in_off][:count]
+                for i, o in enumerate(picked):
+                    self._offset_dest[(in_id, o)] = base + i
+                remaining[in_id] = [o for o in pool if o not in picked]
+        return self._offset_dest.get((mid, off))
+
+    def node(self, i: int) -> tuple[str, int | None, "NodeChange | None"]:
+        """(kind, out_pos, nested) for input node i — moves resolved per
+        piece offset (split moves keep original internal order; slice
+        MoveIns each own their offsets)."""
+        if i < len(self.fate):
+            kind, payload, nested = self.fate[i]
+            if kind == "moved":
+                mid, off = payload
+                dest = self._dest_of(mid, off)
+                if dest is None:
+                    return ("gone", None, nested)  # dangling move register
+                return ("keep", dest, nested)
+            return (kind, payload, nested)
+        # Beyond b's marks: implicit trailing Skip.
+        return ("keep", self._tail_out + (i - self._tail_in), None)
+
+    def out_boundary(self, p: int, after_productions: bool) -> int:
+        """Output boundary for input boundary p.  ``after_productions``
+        implements the tie-break: True puts the rebased boundary mark AFTER
+        b's own Insert/MoveIn content at p (a is the later-sequenced side),
+        False before it.  A boundary inside a b-removed run slides to the
+        run's start (both sided forms collapse there)."""
+        if p in self._b_start:
+            before = self._b_start[p]
+        else:
+            # Beyond b's marks: implicit trailing Skip (every interior
+            # boundary is recorded during the walk).
+            assert p >= self._tail_in, f"unrecorded interior boundary {p}"
+            return self._tail_out + (p - self._tail_in)
+        if not after_productions:
+            return before
+        # Only productions AT THIS input boundary count: content b produced
+        # at later (possibly output-adjacent) boundaries stays to the right
+        # of a mark anchored at p.
+        return before + self._prods.get(p, 0)
 
 
 def rebase_marks(a: list[Mark], b: list[Mark], a_after: bool = True) -> list[Mark]:
@@ -235,46 +356,94 @@ def rebase_marks(a: list[Mark], b: list[Mark], a_after: bool = True) -> list[Mar
     later-sequenced change (its inserts land after b's at a shared position);
     False when a is the earlier-sequenced/trunk change being carried over a
     local pending one (its inserts stay left). The two sides are exact
-    mirrors, which is what makes the convergence square commute."""
-    sa, sb = _MarkStream(a), _MarkStream(b)
-    out: list[Mark] = []
-    while not (sa.exhausted() and sb.exhausted()):
-        ma, mb = sa.peek(), sb.peek()
-        a_ins = ma is not None and isinstance(ma, Insert)
-        b_ins = mb is not None and isinstance(mb, Insert)
-        # Tie at one boundary: the winner's (earlier-sequenced) content lands
-        # left; skipping b's content keeps a's ranges from swallowing it.
-        if b_ins and (a_after or not a_ins):
-            sb.pop()
-            _emit(out, Skip(len(mb.content)))
-            continue
-        if a_ins:
-            sa.pop()
-            _emit(out, ma)
-            continue
-        if ma is None:
-            # a is done; the rest of b only affects positions a never touches.
-            break
-        if mb is None:
-            sa.pop()
-            _emit(out, ma)
-            continue
-        # Both consume input: advance over min(count) positions together.
-        n = min(_consumes(ma), _consumes(mb))
-        a_part, a_rest = _split(sa.pop(), n) if not isinstance(ma, Modify) else (sa.pop(), None)
-        b_part, b_rest = _split(sb.pop(), n) if not isinstance(mb, Modify) else (sb.pop(), None)
-        if a_rest is not None:
-            sa.push_front(a_rest)
-        if b_rest is not None:
-            sb.push_front(b_rest)
-        if isinstance(b_part, Remove):
-            # Those positions are gone: a's skip/remove/modify there drops.
-            continue
-        if isinstance(a_part, Modify) and isinstance(b_part, Modify):
-            _emit(out, Modify(rebase_node_change(a_part.change, b_part.change, a_after)))
+    mirrors, which is what makes the convergence square commute.
+
+    Algorithm (fate map, two phases): phase 1 computes every b-context
+    node's fate — surviving output position (moves followed to their
+    destination, ref sequence-field move effects), removal, or nested
+    change — plus sided output coordinates for every input boundary.
+    Phase 2 re-places each of a's marks by fate (per-node marks follow
+    their node; boundary marks map through the sided boundary), sorts by
+    output position, and emits with Skip gaps.  Unlike a stream merge this
+    handles marks whose target moved LEFT of the cursor, which is what
+    makes Move a first-class mark."""
+    fates = _Fates(b)
+    # Placements: (out_pos, kind_order, seq, mark) — kind_order 0 for
+    # boundary marks (land before the node at that position), 1 for node
+    # marks; seq preserves a's original order among equals.
+    placements: list[tuple[int, int, int, Mark]] = []
+    move_alive: dict[int, set[int]] = {}  # a's move id -> surviving offsets
+    pending_movein: list[tuple[int, int, int, MoveIn]] = []
+    in_pos = 0
+    seq = 0
+    for m in a:
+        seq += 1
+        if isinstance(m, Skip):
+            in_pos += m.count
+        elif isinstance(m, Insert):
+            bp = fates.out_boundary(in_pos, after_productions=a_after)
+            placements.append((bp, 0, seq, Insert(m.content)))
+        elif isinstance(m, MoveIn):
+            bp = fates.out_boundary(in_pos, after_productions=a_after)
+            pending_movein.append((bp, 0, seq, MoveIn(m.id, m.count, m.offset)))
+        elif isinstance(m, Modify):
+            kind, pos, nested = fates.node(in_pos)
+            if kind == "keep":
+                change = (
+                    rebase_node_change(m.change, nested, a_after)
+                    if nested is not None
+                    else m.change
+                )
+                placements.append((pos, 1, seq, Modify(change)))
+            in_pos += 1
+        elif isinstance(m, Remove):
+            for off in range(m.count):
+                kind, pos, _nested = fates.node(in_pos)
+                if kind == "keep":
+                    det = (
+                        [m.detached[off]] if m.detached is not None else None
+                    )
+                    placements.append((pos, 1, seq, Remove(1, det)))
+                in_pos += 1
+        elif isinstance(m, MoveOut):
+            alive = move_alive.setdefault(m.id, set())
+            for off in range(m.count):
+                # Move-vs-move conflict: when b ALSO moved this node, the
+                # later-sequenced move owns it — the earlier side's MoveOut
+                # drops (ref sequence-field move-effect competition).
+                b_moved = (
+                    in_pos < len(fates.fate)
+                    and fates.fate[in_pos][0] == "moved"
+                )
+                kind, pos, _nested = fates.node(in_pos)
+                if kind == "keep" and not (b_moved and not a_after):
+                    placements.append(
+                        (pos, 1, seq, MoveOut(1, m.id, m.offset + off))
+                    )
+                    alive.add(m.offset + off)
+                in_pos += 1
+    # MoveIn counts shrink to the surviving MoveOut offsets of their slice;
+    # fully-emptied moves drop.
+    for bp, ko, sq, mi in pending_movein:
+        alive = move_alive.get(mi.id, set())
+        if mi.offset is None:
+            n_alive = len(alive)
         else:
-            # b Skip or b Modify leave a's mark structurally intact.
-            _emit(out, a_part)
+            n_alive = sum(
+                1 for o in alive if mi.offset <= o < mi.offset + mi.count
+            )
+        if n_alive > 0:
+            placements.append((bp, ko, sq, MoveIn(mi.id, n_alive, mi.offset)))
+
+    placements.sort(key=lambda t: (t[0], t[1], t[2]))
+    out: list[Mark] = []
+    cursor = 0
+    for pos, _ko, _sq, mark in placements:
+        if pos > cursor:
+            _emit(out, Skip(pos - cursor))
+            cursor = pos
+        _emit(out, mark)
+        cursor += _consumes(mark)
     return out
 
 
@@ -301,6 +470,15 @@ def rebase_node_change(a: NodeChange, b: NodeChange, a_after: bool = True) -> No
 
 
 def invert_marks(marks: list[Mark]) -> list[Mark]:
+    # Per-id original offsets of this changeset's MoveOut pieces: inverting
+    # a MoveIn that received a SPLIT move must hand each node back under its
+    # original offset (the destination block's order is sorted-offsets).
+    offsets_by_id: dict[int, list[int]] = {}
+    for m in marks:
+        if isinstance(m, MoveOut):
+            offsets_by_id.setdefault(m.id, []).extend(
+                range(m.offset, m.offset + m.count)
+            )
     out: list[Mark] = []
     for m in marks:
         if isinstance(m, Skip):
@@ -310,6 +488,18 @@ def invert_marks(marks: list[Mark]) -> list[Mark]:
         elif isinstance(m, Remove):
             assert m.detached is not None, "invert of unapplied remove"
             _emit(out, Insert([n.clone() for n in m.detached]))
+        elif isinstance(m, MoveOut):
+            # The inverse moves this piece back to its own origin.
+            _emit(out, MoveIn(m.id, m.count, m.offset))
+        elif isinstance(m, MoveIn):
+            if m.offset is not None:
+                _emit(out, MoveOut(m.count, m.id, m.offset))
+            else:
+                # The destination block holds the surviving pieces in
+                # sorted-original-offset order: move each back out under its
+                # own offset so the returning MoveIn pieces find it.
+                for off in sorted(offsets_by_id.get(m.id, range(m.count))):
+                    _emit(out, MoveOut(1, m.id, off))
         else:
             _emit(out, Modify(invert_node_change(m.change)))
     return out
@@ -331,22 +521,65 @@ def invert_node_change(change: NodeChange) -> NodeChange:
 # ---------------------------------------------------------------------------
 
 
+class _MoveRegister:
+    """Placeholder emitted where a MoveIn lands before its MoveOut has been
+    walked (moves can point either direction); resolved in a second pass."""
+
+    def __init__(self, move_id: int, count: int, offset: int | None) -> None:
+        self.move_id = move_id
+        self.count = count
+        self.offset = offset
+
+
 def apply_marks(nodes: list[Node], marks: list[Mark]) -> None:
+    """Single-pass rebuild: consume the input node list per mark, emitting
+    the output; MoveIn emits a register placeholder patched once every
+    MoveOut of the list has detached its nodes (a move may land left OR
+    right of its source)."""
+    out: list = []
+    registers: dict[int, dict[int, Node]] = {}  # id -> {original offset: node}
     pos = 0
     for m in marks:
         if isinstance(m, Skip):
+            out.extend(nodes[pos : pos + m.count])
             pos += m.count
         elif isinstance(m, Insert):
-            nodes[pos:pos] = [n.clone() for n in m.content]
-            pos += len(m.content)
+            out.extend(n.clone() for n in m.content)
         elif isinstance(m, Remove):
             assert pos + m.count <= len(nodes), "remove past end of field"
             m.detached = [n for n in nodes[pos : pos + m.count]]
-            del nodes[pos : pos + m.count]
+            pos += m.count
+        elif isinstance(m, MoveOut):
+            assert pos + m.count <= len(nodes), "move-out past end of field"
+            reg = registers.setdefault(m.id, {})
+            for off in range(m.count):
+                reg[m.offset + off] = nodes[pos + off]
+            pos += m.count
+        elif isinstance(m, MoveIn):
+            out.append(_MoveRegister(m.id, m.count, m.offset))
         else:
             apply_node_change(nodes[pos], m.change)
+            out.append(nodes[pos])
             pos += 1
     assert pos <= len(nodes), "marks walk past end of field"
+    out.extend(nodes[pos:])
+    resolved: list[Node] = []
+    for item in out:
+        if isinstance(item, _MoveRegister):
+            reg = registers.get(item.move_id, {})
+            if item.offset is None:
+                picked = sorted(reg)
+            else:
+                # A slice MoveIn (inverse of a split move): its own offsets.
+                picked = sorted(o for o in reg if o >= item.offset)[: item.count]
+            assert len(picked) == item.count, (
+                f"move register {item.move_id}: {len(picked)} nodes for a "
+                f"MoveIn of {item.count}"
+            )
+            resolved.extend(reg.pop(o) for o in picked)
+        else:
+            resolved.append(item)
+    nodes[:] = resolved
 
 
 def apply_node_change(node: Node, change: NodeChange) -> None:
@@ -356,6 +589,61 @@ def apply_node_change(node: Node, change: NodeChange) -> None:
         node.value = new
     for key, marks in change.fields.items():
         apply_marks(node.fields.setdefault(key, []), marks)
+
+
+# ---------------------------------------------------------------------------
+# Commits: atomic sequences of changesets (transactions)
+# ---------------------------------------------------------------------------
+# A commit is a list of NodeChanges applied in order as ONE sequenced unit —
+# the wire/trunk form of a transaction (ref shared-tree Transactor squashes
+# into one commit; here the sequence itself is the unit, so no separate
+# compose algebra is needed: rebase/invert/apply fold over the elements).
+
+
+Commit = list  # list[NodeChange]
+
+
+def rebase_commit_over_change(
+    a: "Commit", x: NodeChange, a_after: bool = True
+) -> "Commit":
+    """Rebase the commit a = [c1..cn] over one change x sharing c1's input
+    context: each element rebases over x carried through its predecessors."""
+    out = []
+    for c in a:
+        out.append(rebase_node_change(c, x, a_after))
+        x = rebase_node_change(x, c, not a_after)
+    return out
+
+
+def rebase_commit(a: "Commit", b: "Commit", a_after: bool = True) -> "Commit":
+    """Rebase commit a over commit b (same input context)."""
+    for x in b:
+        a = rebase_commit_over_change(a, x, a_after)
+        # Carrying x forward happens inside the helper per element; for the
+        # next b element we need a's ORIGINAL context advanced by x, which
+        # is exactly what successive iteration provides.
+    return a
+
+
+def invert_commit(cs: "Commit") -> "Commit":
+    return [invert_node_change(c) for c in reversed(cs)]
+
+
+def apply_commit(root: Node, cs: "Commit") -> None:
+    for c in cs:
+        apply_node_change(root, c)
+
+
+def clone_commit(cs: "Commit") -> "Commit":
+    return [clone_change(c) for c in cs]
+
+
+def commit_to_json(cs: "Commit") -> list:
+    return [change_to_json(c) for c in cs]
+
+
+def commit_from_json(data: list) -> "Commit":
+    return [change_from_json(c) for c in data]
 
 
 # ---------------------------------------------------------------------------
@@ -395,4 +683,45 @@ def make_remove(
 ) -> NodeChange:
     marks: list[Mark] = [Skip(index)] if index else []
     marks.append(Remove(count))
+    return _wrap(path, NodeChange(fields={field_key: marks}))
+
+
+_move_counter = 0
+
+
+def make_move(
+    path: list[tuple[str, int]],
+    field_key: str,
+    src_index: int,
+    count: int,
+    dst_index: int,
+) -> NodeChange:
+    """Move ``count`` nodes from ``src_index`` to the boundary ``dst_index``
+    of the same field, both in PRE-move coordinates (ref sequence-field
+    moveOut/moveIn pair).  A destination inside the moved range is the
+    identity move."""
+    global _move_counter
+    _move_counter += 1
+    mid = _move_counter
+    marks: list[Mark] = []
+    if dst_index <= src_index:
+        if dst_index:
+            marks.append(Skip(dst_index))
+        marks.append(MoveIn(mid, count))
+        if src_index > dst_index:
+            marks.append(Skip(src_index - dst_index))
+        marks.append(MoveOut(count, mid))
+    elif dst_index >= src_index + count:
+        if src_index:
+            marks.append(Skip(src_index))
+        marks.append(MoveOut(count, mid))
+        gap = dst_index - src_index - count
+        if gap:
+            marks.append(Skip(gap))
+        marks.append(MoveIn(mid, count))
+    else:  # destination inside the moved range: identity
+        if src_index:
+            marks.append(Skip(src_index))
+        marks.append(MoveOut(count, mid))
+        marks.append(MoveIn(mid, count))
     return _wrap(path, NodeChange(fields={field_key: marks}))

@@ -1,4 +1,4 @@
-"""EditManager: deterministic trunk construction from sequenced changesets.
+"""EditManager: deterministic trunk construction from sequenced commits.
 
 Reference parity: tree/src/shared-tree-core/editManager.ts:73 — a trunk of
 sequenced commits plus per-peer branches that cache each peer's in-flight
@@ -8,10 +8,14 @@ advanceMinimumSequenceNumber :247).
 Design (derived, not ported): for every peer P we simulate P's local branch
 — ``base`` is the highest trunk sequence number P has integrated (its last
 refSeq) and ``inflight`` holds P's submitted-but-not-yet-base-advanced
-changes in P-local coordinates. Because every replica runs this exact
+commits in P-local coordinates. Because every replica runs this exact
 deterministic procedure over the same sequenced stream, every replica
 computes the identical trunk version of every commit — convergence by
 construction, independent of OT transform properties.
+
+A commit is a LIST of changesets applied atomically (a single edit is a
+1-element commit; a transaction is longer — changeset.Commit), so the whole
+rebase machinery folds over commit elements.
 
 Integration of a commit c from P (refSeq r, seq s):
 1. advance P's branch base to r: walk trunk commits in (base, r]; P's own
@@ -23,19 +27,23 @@ Integration of a commit c from P (refSeq r, seq s):
    drains exactly when c's turn comes.
 3. append the original-coordinates c to P's inflight and the trunk-coords
    version to the trunk.
+
+Revisions are opaque, replica-local hashable tags (the channel layer mints
+them through the id-compressor); summaries serialize them through the
+``encode_rev``/``decode_rev`` codec so the summary is replica-independent.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, Callable
 
 from .changeset import (
-    NodeChange,
-    change_from_json,
-    change_to_json,
-    clone_change,
-    rebase_node_change,
+    Commit,
+    clone_commit,
+    commit_from_json,
+    commit_to_json,
+    rebase_commit,
 )
 
 
@@ -43,42 +51,48 @@ from .changeset import (
 class TrunkCommit:
     seq: int
     client_id: str
-    revision: str
-    change: NodeChange  # trunk coordinates (context = previous trunk commit)
+    revision: Any
+    change: Commit  # trunk coordinates (context = previous trunk commit)
 
 
 @dataclass
 class PeerBranch:
     base: int  # trunk seq this peer has integrated (its max refSeq seen)
-    inflight: list[tuple[str, NodeChange]] = field(default_factory=list)
+    inflight: list[tuple[Any, Commit]] = field(default_factory=list)
 
 
-def bridge(inflight: list[tuple[str, NodeChange]], incoming: NodeChange) -> tuple[
-    list[tuple[str, NodeChange]], NodeChange
+def bridge(inflight: list[tuple[Any, Commit]], incoming: Commit) -> tuple[
+    list[tuple[Any, Commit]], Commit
 ]:
-    """Transform an incoming change through a branch's in-flight list: returns
+    """Transform an incoming commit through a branch's in-flight list: returns
     (inflight rebased over incoming, incoming rebased past the inflight) —
     the standard OT bridge both the EditManager and the local branch use.
 
-    Sides: ``incoming`` is sequenced (earlier) and the in-flight changes are
+    Sides: ``incoming`` is sequenced (earlier) and the in-flight commits are
     not (later), so the in-flight rebases with a_after=True and the incoming
     carries over them with a_after=False — the mirrored pair that makes both
     orders of application converge."""
     x = incoming
     out = []
     for rev, f in inflight:
-        out.append((rev, rebase_node_change(f, x, a_after=True)))
-        x = rebase_node_change(x, f, a_after=False)
+        out.append((rev, rebase_commit(f, x, a_after=True)))
+        x = rebase_commit(x, f, a_after=False)
     return out, x
 
 
 class EditManager:
     """Trunk + peer branches for one SharedTree instance."""
 
-    def __init__(self) -> None:
+    def __init__(
+        self,
+        encode_rev: Callable[[Any], Any] | None = None,
+        decode_rev: Callable[[Any], Any] | None = None,
+    ) -> None:
         self.trunk: list[TrunkCommit] = []
         self.trunk_base = 0  # all commits with seq <= trunk_base are evicted
         self.peers: dict[str, PeerBranch] = {}
+        self._encode_rev = encode_rev or (lambda r: r)
+        self._decode_rev = decode_rev or (lambda r: r)
 
     # ------------------------------------------------------------------ query
     def _trunk_range(self, lo: int, hi: int) -> list[TrunkCommit]:
@@ -92,12 +106,12 @@ class EditManager:
     def add_sequenced(
         self,
         client_id: str,
-        revision: str,
-        change: NodeChange,
+        revision: Any,
+        change: Commit,
         ref_seq: int,
         seq: int,
-    ) -> NodeChange:
-        """Integrate one sequenced changeset; returns its trunk-coordinates
+    ) -> Commit:
+        """Integrate one sequenced commit; returns its trunk-coordinates
         version (what a caller applies to trunk-tip state)."""
         br = self.peers.get(client_id)
         if br is None:
@@ -108,17 +122,17 @@ class EditManager:
         # Range is (ref_seq, seq] over the EXISTING trunk: grouped batches
         # give several commits one sequence number, and earlier same-seq
         # commits from this client are part of this commit's context.
-        scratch = [(rev, clone_change(ch)) for rev, ch in br.inflight]
-        c = clone_change(change)
+        scratch = [(rev, clone_commit(ch)) for rev, ch in br.inflight]
+        c = clone_commit(change)
         for t in self._trunk_range(ref_seq, seq):
             if t.client_id == client_id:
                 assert scratch and scratch[0][0] == t.revision, "peer FIFO skew"
                 scratch.pop(0)
             else:
                 scratch, x = bridge(scratch, t.change)
-                c = rebase_node_change(c, x)
+                c = rebase_commit(c, x)
         assert not scratch, "peer had unsequenced ops ahead of this commit"
-        br.inflight.append((revision, clone_change(change)))
+        br.inflight.append((revision, clone_commit(change)))
         self.trunk.append(TrunkCommit(seq=seq, client_id=client_id, revision=revision, change=c))
         return c
 
@@ -157,8 +171,8 @@ class EditManager:
                 {
                     "seq": t.seq,
                     "client": t.client_id,
-                    "rev": t.revision,
-                    "change": change_to_json(t.change),
+                    "rev": self._encode_rev(t.revision),
+                    "change": commit_to_json(t.change),
                 }
                 for t in self.trunk
             ],
@@ -166,7 +180,8 @@ class EditManager:
                 cid: {
                     "base": br.base,
                     "inflight": [
-                        [rev, change_to_json(ch)] for rev, ch in br.inflight
+                        [self._encode_rev(rev), commit_to_json(ch)]
+                        for rev, ch in br.inflight
                     ],
                 }
                 for cid, br in self.peers.items()
@@ -179,15 +194,18 @@ class EditManager:
             TrunkCommit(
                 seq=t["seq"],
                 client_id=t["client"],
-                revision=t["rev"],
-                change=change_from_json(t["change"]),
+                revision=self._decode_rev(t["rev"]),
+                change=commit_from_json(t["change"]),
             )
             for t in data["trunk"]
         ]
         self.peers = {
             cid: PeerBranch(
                 base=p["base"],
-                inflight=[(rev, change_from_json(ch)) for rev, ch in p["inflight"]],
+                inflight=[
+                    (self._decode_rev(rev), commit_from_json(ch))
+                    for rev, ch in p["inflight"]
+                ],
             )
             for cid, p in data["peers"].items()
         }

@@ -7,23 +7,38 @@ the local branch, resubmit rebases pending edits onto the current trunk
 (defaultResubmitMachine.ts), and summaries carry forest + EditManager state
 (editManagerSummarizer.ts, forest-summary).
 
+Transactions (ref shared-tree Transactor / branch.ts): edits inside
+``with tree.transaction():`` apply optimistically as they are made and ship
+as ONE atomic commit on exit; abort rolls the forest back with the
+enriched inverses.
+
+Revision ids are compressed (ref id-compressor/src/idCompressor.ts op-space
+discipline): each replica mints session-space ids, ships the op-space form
+plus its id-creation range on the wire, and every replica finalizes ranges
+in total order — so revision tags cost an int on the wire instead of a
+UUID, and summaries decompress them to stable UUIDs.
+
 Wire op formats:
-  {"type": "edit", "rev": str, "change": <changeset json>}
+  {"type": "edit", "rev": op-space id, "sid": session uuid,
+   "idRange": [first, last] | None, "changes": [<changeset json>...]}
   {"type": "schema", "schema": <schema json>}   (LWW by sequence order)
 """
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Any, Callable
 
 from ...runtime.channel import Channel, MessageCollection
+from ...utils.id_compressor import IdCompressor, IdCreationRange
 from .changeset import (
+    Commit,
     NodeChange,
-    apply_node_change,
-    change_from_json,
-    change_to_json,
-    clone_change,
-    invert_node_change,
+    apply_commit,
+    clone_commit,
+    commit_from_json,
+    commit_to_json,
+    invert_commit,
 )
 from .editmanager import EditManager, bridge
 from .forest import Forest, Node, decode_field_chunked, encode_field_chunked, ROOT_FIELD
@@ -38,37 +53,92 @@ class SharedTreeChannel(Channel):
     def __init__(self, channel_id: str) -> None:
         super().__init__(channel_id)
         self.forest = Forest()  # trunk-tip state + local pending overlay
-        self.em = EditManager()
+        self.idc = IdCompressor()
+        self.em = EditManager(
+            encode_rev=self._rev_to_stable, decode_rev=self._rev_from_stable
+        )
         self.schema = SchemaRegistry()
-        # Local branch: pending edits in trunk-tip coordinates, continuously
+        # Local branch: pending commits in trunk-tip coordinates, continuously
         # rebased as remote commits land (the sandwich).
-        self._local_pending: list[tuple[str, NodeChange]] = []
-        self._rev_counter = 0
+        self._local_pending: list[tuple[Any, Commit]] = []
+        self._txn: list[NodeChange] | None = None
         self.on_change: Callable[[], None] | None = None  # view invalidation
         # Every change applied to the forest, in application order (local
         # edits and bridged remote commits alike) — the coordinate trail
         # undo-redo revertibles rebase their inverses over.
         self.applied_log: list[NodeChange] = []
 
-    # ------------------------------------------------------------ local edits
-    def _mint_revision(self) -> str:
-        self._rev_counter += 1
-        owner = self._connection.client_id() if self._connection else "detached"
-        return f"{owner}:{self._rev_counter}"
+    # ------------------------------------------------------------- revisions
+    # A revision tag is the WIRE pair (session uuid, op-space id): identical
+    # on every replica, hashable, and comparable without any normalization
+    # ordering concerns (the op-space discipline of idCompressor.ts:400).
+    # Summaries re-encode tags as stable UUIDs so they stay meaningful after
+    # the minting session's clusters are the only thing a loader knows.
 
+    def _rev_to_stable(self, rev: tuple[str, int]) -> str:
+        return self.idc.decompress(
+            self.idc.normalize_to_session_space(rev[1], rev[0])
+        )
+
+    def _rev_from_stable(self, stable: str) -> tuple[str, int]:
+        return ("", self.idc.recompress(stable))
+
+    # ------------------------------------------------------------ local edits
     def submit_change(self, change: NodeChange) -> None:
-        """Apply a local edit optimistically and stage it for sequencing.
-        The forest apply enriches the change (repair data), and the enriched
-        form is what goes on the wire so every replica integrates the exact
-        same changeset object."""
-        rev = self._mint_revision()
-        apply_node_change(self.forest.root, change)
+        """Apply a local edit optimistically; ships immediately, or as part
+        of the enclosing transaction's atomic commit.  The forest apply
+        enriches the change (repair data), and the enriched form is what
+        goes on the wire so every replica integrates the exact same
+        changeset object."""
+        apply_commit(self.forest.root, [change])
         self.applied_log.append(change)
-        self._local_pending.append((rev, change))
+        if self._txn is not None:
+            self._txn.append(change)
+            self._notify()
+            return
+        self._ship_commit([change])
+        self._notify()
+
+    def _ship_commit(self, commit: Commit) -> None:
+        raw = self.idc.generate_compressed_id()
+        rng = self.idc.take_next_creation_range()
+        rev = (self.idc.session_id, self.idc.normalize_to_op_space(raw))
+        self._local_pending.append((rev, commit))
         self.submit_local_message(
-            {"type": "edit", "rev": rev, "change": change_to_json(change)},
+            {
+                "type": "edit",
+                "rev": rev[1],
+                "sid": rev[0],
+                "idRange": (
+                    [rng.first_gen_count, rng.last_gen_count] if rng else None
+                ),
+                "changes": commit_to_json(commit),
+            },
             {"rev": rev},
         )
+
+    # ------------------------------------------------------------ transactions
+    @contextmanager
+    def transaction(self):
+        """Atomic edit scope: everything submitted inside lands as one
+        commit (one sequence number, all-or-nothing against concurrency);
+        an exception rolls the forest back and ships nothing."""
+        if self._txn is not None:
+            raise RuntimeError("transactions do not nest")
+        self._txn = []
+        try:
+            yield self
+        except BaseException:
+            staged, self._txn = self._txn, None
+            for change in reversed(staged):
+                inverse_commit = invert_commit([change])
+                apply_commit(self.forest.root, inverse_commit)
+                self.applied_log.extend(inverse_commit)
+            self._notify()
+            raise
+        staged, self._txn = self._txn, None
+        if staged:
+            self._ship_commit(staged)
         self._notify()
 
     def set_schema(self, registry: SchemaRegistry) -> None:
@@ -86,6 +156,22 @@ class SharedTreeChannel(Channel):
             self.on_change()
 
     # ---------------------------------------------------------------- inbound
+    def _finalize_ids(self, c: dict) -> None:
+        if c.get("idRange"):
+            self.idc.finalize_creation_range(
+                IdCreationRange(
+                    session_id=c["sid"],
+                    first_gen_count=c["idRange"][0],
+                    last_gen_count=c["idRange"][1],
+                )
+            )
+
+    @staticmethod
+    def _wire_revision(c: dict) -> tuple[str, int]:
+        """Revision tags ARE the wire pair — identical on every replica and
+        equal by value across submit/ack/trunk with no normalization races."""
+        return (c["sid"], c["rev"])
+
     def process_messages(self, collection: MessageCollection) -> None:
         env = collection.envelope
         for m in collection.messages:
@@ -93,26 +179,30 @@ class SharedTreeChannel(Channel):
             if c["type"] == "schema":
                 self.schema = SchemaRegistry.from_json(c["schema"])
                 continue
-            change = change_from_json(c["change"])
+            self._finalize_ids(c)
+            rev = self._wire_revision(c)
+            change = commit_from_json(c["changes"])
             trunk_change = self.em.add_sequenced(
                 client_id=env.client_id,
-                revision=c["rev"],
+                revision=rev,
                 change=change,
                 ref_seq=env.ref_seq,
                 seq=env.seq,
             )
             if m.local:
                 # Our own edit reached the trunk: the forest already shows it.
-                assert self._local_pending and self._local_pending[0][0] == c["rev"], (
+                assert self._local_pending and self._local_pending[0][0] == rev, (
                     "local branch FIFO skew"
                 )
                 self._local_pending.pop(0)
             else:
                 # Sandwich: rebase the local branch over the new trunk commit
                 # and apply its bridged form to the optimistic forest.
-                self._local_pending, x = bridge(self._local_pending, clone_change(trunk_change))
-                apply_node_change(self.forest.root, x)
-                self.applied_log.append(x)
+                self._local_pending, x = bridge(
+                    self._local_pending, clone_commit(trunk_change)
+                )
+                apply_commit(self.forest.root, x)
+                self.applied_log.extend(x)
         self.em.advance_min_seq(env.min_seq)
         self._notify()
 
@@ -124,16 +214,22 @@ class SharedTreeChannel(Channel):
 
     # ----------------------------------------------------- reconnect / stash
     def resubmit(self, contents: Any, local_metadata: Any, squash: bool = False) -> None:
-        """Resubmit the CURRENT (trunk-tip rebased) form of the pending edit
-        — merge-tree regeneratePendingOp's analog for tree changesets."""
+        """Resubmit the CURRENT (trunk-tip rebased) form of the pending
+        commit — merge-tree regeneratePendingOp's analog for tree edits."""
         if contents["type"] == "schema":
             self.submit_local_message(contents, {"rev": None})
             return
         rev = local_metadata["rev"]
-        for r, change in self._local_pending:
+        for r, commit in self._local_pending:
             if r == rev:
                 self.submit_local_message(
-                    {"type": "edit", "rev": rev, "change": change_to_json(change)},
+                    {
+                        "type": "edit",
+                        "rev": contents["rev"],
+                        "sid": contents["sid"],
+                        "idRange": contents.get("idRange"),
+                        "changes": commit_to_json(commit),
+                    },
                     {"rev": rev},
                 )
                 return
@@ -143,11 +239,13 @@ class SharedTreeChannel(Channel):
         if contents["type"] == "schema":
             self.schema = SchemaRegistry.from_json(contents["schema"])
             return {"rev": None}
-        change = change_from_json(contents["change"])
-        rev = contents["rev"]
-        apply_node_change(self.forest.root, change)
-        self.applied_log.append(change)
-        self._local_pending.append((rev, change))
+        commit = commit_from_json(contents["changes"])
+        # The stash rides the ORIGINAL session's ids; keep them as the
+        # pending key (sid, op-space id) — stable without finalization.
+        rev = (contents["sid"], contents["rev"])
+        apply_commit(self.forest.root, commit)
+        self.applied_log.extend(commit)
+        self._local_pending.append((rev, commit))
         self._notify()
         return {"rev": rev}
 
@@ -156,10 +254,15 @@ class SharedTreeChannel(Channel):
         assert self._local_pending and self._local_pending[-1][0] == rev, (
             "rollback must undo the latest local edit first"
         )
-        _, change = self._local_pending.pop()
-        inverse = invert_node_change(change)
-        apply_node_change(self.forest.root, inverse)
-        self.applied_log.append(inverse)
+        _, commit = self._local_pending.pop()
+        inverse = invert_commit(commit)
+        apply_commit(self.forest.root, inverse)
+        self.applied_log.extend(inverse)
+        # The rolled-back op never ships, so its id range must return to the
+        # unshipped pool or the NEXT op's range would leave a finalization
+        # gap on every replica (LIFO: this was the newest take).
+        if contents.get("idRange"):
+            self.idc.untake_creation_range(contents["idRange"][0])
         self._notify()
 
     # ------------------------------------------------------------ checkpoint
@@ -170,11 +273,17 @@ class SharedTreeChannel(Channel):
             "forest": encode_field_chunked(self.forest.root_field),
             "editManager": self.em.summarize(),
             "schema": self.schema.to_json(),
+            "idCompressor": self.idc.serialize(with_session=False),
         }
 
     def load(self, summary: dict[str, Any]) -> None:
         self.forest.root = Node(type="__root__")
         self.forest.root.fields[ROOT_FIELD] = decode_field_chunked(summary["forest"])
+        if "idCompressor" in summary:
+            self.idc = IdCompressor.deserialize(summary["idCompressor"])
+        self.em = EditManager(
+            encode_rev=self._rev_to_stable, decode_rev=self._rev_from_stable
+        )
         self.em.load(summary["editManager"])
         self.schema = SchemaRegistry.from_json(summary["schema"])
         self._notify()

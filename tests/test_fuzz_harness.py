@@ -113,23 +113,57 @@ STRING_MODEL = DDSFuzzModel(name="sharedString", channel_type="sharedString",
 
 
 def tree_generate(rng: random.Random, channel) -> dict | None:
-    n = len(channel.forest.root_field)
-    kind = rng.choices(["ins", "rm", "set"], [6, 3, 3])[0]
-    if kind == "ins" or n == 0:
-        return {"t": "ins", "i": rng.randint(0, n), "v": rng.randrange(1000)}
-    if kind == "rm":
-        i = rng.randrange(n)
-        return {"t": "rm", "i": i, "n": rng.randint(1, min(2, n - i))}
-    return {"t": "set", "i": rng.randrange(n), "v": rng.randrange(1000)}
+    def one(n, allow_txn=True):
+        kinds = ["ins", "rm", "set", "move"] + (["txn"] if allow_txn else [])
+        kind = rng.choices(kinds, [6, 3, 3, 2] + ([1] if allow_txn else []))[0]
+        if kind == "txn":
+            # 2-3 sub-edits applied atomically; sizes evolve inside, so
+            # sub-edits are generated against a running length estimate.
+            subs, m = [], n
+            for _ in range(rng.randint(2, 3)):
+                sub = one(m, allow_txn=False)
+                if sub is None:
+                    continue
+                if sub["t"] == "ins":
+                    m += 1
+                elif sub["t"] == "rm":
+                    m -= sub["n"]
+                subs.append(sub)
+            return {"t": "txn", "subs": subs} if subs else None
+        if kind == "ins" or n == 0:
+            return {"t": "ins", "i": rng.randint(0, n), "v": rng.randrange(1000)}
+        if kind == "rm":
+            i = rng.randrange(n)
+            return {"t": "rm", "i": i, "n": rng.randint(1, min(2, n - i))}
+        if kind == "move":
+            src = rng.randrange(n)
+            cnt = rng.randint(1, min(2, n - src))
+            return {"t": "move", "s": src, "n": cnt, "d": rng.randint(0, n)}
+        return {"t": "set", "i": rng.randrange(n), "v": rng.randrange(1000)}
+
+    return one(len(channel.forest.root_field))
 
 
-def tree_reduce(channel, op: dict) -> None:
+def _tree_edit(channel, op: dict) -> None:
+    from fluidframework_tpu.dds.tree.changeset import make_move
+
     if op["t"] == "ins":
         channel.submit_change(make_insert([], "", op["i"], [leaf(op["v"])]))
     elif op["t"] == "rm":
         channel.submit_change(make_remove([], "", op["i"], op["n"]))
+    elif op["t"] == "move":
+        channel.submit_change(make_move([], "", op["s"], op["n"], op["d"]))
     else:
         channel.submit_change(make_set_value([("", op["i"])], op["v"]))
+
+
+def tree_reduce(channel, op: dict) -> None:
+    if op["t"] == "txn":
+        with channel.transaction():
+            for sub in op["subs"]:
+                _tree_edit(channel, sub)
+        return
+    _tree_edit(channel, op)
 
 
 def tree_check(a, b) -> None:

@@ -265,13 +265,13 @@ def test_editmanager_peer_branch_fifo_pop():
     f = Forest()
     c1 = make_insert([], "", 0, [leaf(1)])
     c2 = make_insert([], "", 1, [leaf(2)])
-    t1 = em.add_sequenced("P", "P:1", clone_change(c1), ref_seq=0, seq=1)
-    t2 = em.add_sequenced("P", "P:2", clone_change(c2), ref_seq=0, seq=2)
-    apply_node_change(f.root, t1)
-    apply_node_change(f.root, t2)
+    t1 = em.add_sequenced("P", "P:1", [clone_change(c1)], ref_seq=0, seq=1)
+    t2 = em.add_sequenced("P", "P:2", [clone_change(c2)], ref_seq=0, seq=2)
+    apply_node_change(f.root, t1[0])
+    apply_node_change(f.root, t2[0])
     assert [n.value for n in f.root_field] == [1, 2]
     # Branch base advance pops P's own commits in FIFO order.
-    em.add_sequenced("P", "P:3", make_insert([], "", 2, [leaf(3)]), ref_seq=2, seq=3)
+    em.add_sequenced("P", "P:3", [make_insert([], "", 2, [leaf(3)])], ref_seq=2, seq=3)
     assert [rev for rev, _ in em.peers["P"].inflight] == ["P:3"]
 
 
@@ -281,11 +281,11 @@ def test_editmanager_cross_peer_interleave():
     em = EditManager()
     f = Forest()
     base = make_insert([], "", 0, [leaf(0), leaf(1), leaf(2)])
-    apply_node_change(f.root, em.add_sequenced("S", "S:1", base, ref_seq=0, seq=1))
+    apply_node_change(f.root, em.add_sequenced("S", "S:1", [base], ref_seq=0, seq=1)[0])
     p = make_insert([], "", 1, [leaf(10)])
     q = make_remove([], "", 1, 1)
-    apply_node_change(f.root, em.add_sequenced("P", "P:1", p, ref_seq=1, seq=2))
-    apply_node_change(f.root, em.add_sequenced("Q", "Q:1", q, ref_seq=1, seq=3))
+    apply_node_change(f.root, em.add_sequenced("P", "P:1", [p], ref_seq=1, seq=2)[0])
+    apply_node_change(f.root, em.add_sequenced("Q", "Q:1", [q], ref_seq=1, seq=3)[0])
     # P inserted before node 1; Q removed old node 1 (value 1): [0, 10, 2]
     assert [n.value for n in f.root_field] == [0, 10, 2]
 

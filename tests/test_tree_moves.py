@@ -1,0 +1,406 @@
+"""SharedTree moves, transactions, and compressed revision ids.
+
+Move marks (ref feature-libraries/sequence-field moveOut/moveIn): apply in
+both directions, invert round-trip, codec, and the rebase laws — including
+the follow-the-move rule (a concurrent Modify/Remove targets the node at
+its move destination) and the sided convergence square fuzz with moves in
+the mix.
+
+Transactions (ref shared-tree Transactor): all-or-nothing commits over the
+channel stack.  Id-compression (ref id-compressor op-space discipline):
+edits ship op-space revision ids plus creation ranges; replicas finalize in
+total order; summaries carry stable UUIDs.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.dds.tree.changeset import (
+    Insert,
+    Modify,
+    MoveIn,
+    MoveOut,
+    NodeChange,
+    Remove,
+    Skip,
+    apply_node_change,
+    change_from_json,
+    change_to_json,
+    clone_change,
+    invert_node_change,
+    make_insert,
+    make_move,
+    make_remove,
+    make_set_value,
+    rebase_node_change,
+)
+from fluidframework_tpu.dds.tree.forest import Node
+from fluidframework_tpu.dds.tree.schema import leaf
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+
+def num_array(*vals):
+    root = Node(type="__root__")
+    root.fields[""] = [leaf(v) for v in vals]
+    return root
+
+
+def values(root):
+    return [n.value for n in root.fields[""]]
+
+
+def apply_root(root, change):
+    apply_node_change(root, change)
+
+
+def converge(start_vals, a, b):
+    f1 = num_array(*start_vals)
+    apply_root(f1, clone_change(a))
+    apply_root(f1, rebase_node_change(clone_change(b), a, a_after=True))
+    f2 = num_array(*start_vals)
+    apply_root(f2, clone_change(b))
+    apply_root(f2, rebase_node_change(clone_change(a), b, a_after=False))
+    return values(f1), values(f2)
+
+
+# ---------------------------------------------------------------- apply
+
+def test_move_right_and_left():
+    f = num_array(0, 1, 2, 3, 4)
+    apply_root(f, make_move([], "", 0, 2, 4))  # [0,1] to boundary 4
+    assert values(f) == [2, 3, 0, 1, 4]
+    f = num_array(0, 1, 2, 3, 4)
+    apply_root(f, make_move([], "", 3, 2, 1))  # [3,4] to boundary 1
+    assert values(f) == [0, 3, 4, 1, 2]
+
+
+def test_move_identity_and_invert():
+    f = num_array(0, 1, 2)
+    mv = make_move([], "", 1, 1, 1)
+    apply_root(f, mv)
+    assert values(f) == [0, 1, 2]
+
+    f = num_array(0, 1, 2, 3)
+    mv = make_move([], "", 0, 2, 3)
+    applied = clone_change(mv)
+    apply_root(f, applied)
+    assert values(f) == [2, 0, 1, 3]
+    apply_root(f, invert_node_change(applied))
+    assert values(f) == [0, 1, 2, 3]
+
+
+def test_move_codec_roundtrip():
+    mv = make_move([], "", 1, 2, 5)
+    assert change_to_json(change_from_json(change_to_json(mv))) == change_to_json(mv)
+
+
+# ---------------------------------------------------------------- rebase
+
+def test_modify_follows_move():
+    """b moves the node a modifies: a's modify lands at the destination."""
+    a = make_set_value([("", 0)], 99)
+    b = make_move([], "", 0, 1, 3)
+    v1, v2 = converge([0, 1, 2], a, b)
+    assert v1 == v2 == [1, 2, 99]
+
+
+def test_remove_follows_move():
+    a = make_remove([], "", 0, 1)
+    b = make_move([], "", 0, 2, 3)
+    v1, v2 = converge([0, 1, 2], a, b)
+    assert v1 == v2 == [2, 1]
+
+
+def test_move_of_concurrently_removed_nodes_shrinks():
+    """b removes part of the range a moves: only survivors move."""
+    a = make_move([], "", 0, 3, 4)
+    b = make_remove([], "", 1, 1)
+    v1, v2 = converge([0, 1, 2, 3], a, b)
+    assert v1 == v2 == [3, 0, 2]
+
+
+def test_insert_at_moved_gap_stays_at_source():
+    """a inserts at a boundary inside the range b moved away: the insert
+    lands in the gap left at the source (deterministic contract)."""
+    a = make_insert([], "", 1, [leaf(99)])
+    b = make_move([], "", 0, 2, 4)
+    v1, v2 = converge([0, 1, 2, 3], a, b)
+    assert v1 == v2
+
+
+def test_move_vs_move_square():
+    a = make_move([], "", 0, 1, 3)
+    b = make_move([], "", 2, 1, 0)
+    v1, v2 = converge([0, 1, 2], a, b)
+    assert v1 == v2
+
+
+def test_rebase_square_fuzz_with_moves():
+    """The sided convergence square with moves in the random mix — the
+    multimark fuzz of test_tree_changeset extended with MoveOut/MoveIn."""
+
+    def rand_marks(rng: random.Random, n: int, tag: int) -> list:
+        marks, pos, v = [], 0, 0
+        mid = tag * 1000
+        while pos < n:
+            r = rng.random()
+            if r < 0.25:
+                k = rng.randint(1, n - pos)
+                marks.append(Skip(k)); pos += k
+            elif r < 0.4:
+                k = rng.randint(1, n - pos)
+                marks.append(Remove(k)); pos += k
+            elif r < 0.55:
+                v += 1
+                marks.append(Insert([leaf(tag * 100 + v)]))
+            elif r < 0.7:
+                marks.append(Modify(NodeChange(value=(tag * 1000 + pos,)))); pos += 1
+            elif r < 0.85:
+                # A move pair: out here, in at a random later boundary.
+                k = rng.randint(1, n - pos)
+                mid += 1
+                marks.append(MoveOut(k, mid))
+                pos += k
+                gap = rng.randint(0, n - pos)
+                if gap:
+                    marks.append(Skip(gap))
+                    pos += gap
+                marks.append(MoveIn(mid, k))
+            else:
+                break
+        return marks
+
+    for seed in range(3000):
+        rng = random.Random(seed)
+        n = rng.randint(0, 6)
+        a = NodeChange(fields={"": rand_marks(rng, n, 1)})
+        b = NodeChange(fields={"": rand_marks(rng, n, 2)})
+        v1, v2 = converge(list(range(n)), a, b)
+        assert v1 == v2, (
+            f"seed {seed}: {change_to_json(a)} vs {change_to_json(b)}: "
+            f"{v1} != {v2}"
+        )
+
+
+def test_split_move_invert_roundtrip():
+    """b removes the middle of the range a moves: rebased a carries split
+    pieces (discontiguous original offsets); applying it and its inverse
+    must restore the post-b state exactly."""
+    a = make_move([], "", 0, 3, 4)
+    b = make_remove([], "", 1, 1)
+    f = num_array(0, 1, 2, 3)
+    apply_root(f, clone_change(b))
+    after_b = values(f)
+    a2 = rebase_node_change(clone_change(a), b, a_after=True)
+    applied = clone_change(a2)
+    apply_root(f, applied)
+    assert values(f) == [3, 0, 2]
+    apply_root(f, invert_node_change(applied))
+    assert values(f) == after_b
+
+
+def test_move_invert_roundtrip_fuzz():
+    for seed in range(200):
+        rng = random.Random(seed)
+        n = rng.randint(1, 6)
+        src = rng.randint(0, n - 1)
+        cnt = rng.randint(1, n - src)
+        dst = rng.randint(0, n)
+        f = num_array(*range(n))
+        before = values(f)
+        mv = make_move([], "", src, cnt, dst)
+        applied = clone_change(mv)
+        apply_root(f, applied)
+        apply_root(f, invert_node_change(applied))
+        assert values(f) == before, f"seed {seed}"
+
+
+# ------------------------------------------------------------- channel stack
+
+def _fleet(n=2):
+    svc = LocalService()
+    doc = svc.document("d")
+    rts = []
+    for i in range(n):
+        rt = ContainerRuntime(default_registry(), container_id=f"c{i}")
+        rt.create_datastore("root").create_channel("sharedTree", "t")
+        rt.connect(doc, f"c{i}")
+        rts.append(rt)
+    doc.process_all()
+    return doc, rts
+
+
+def _tree(rt):
+    return rt.datastore("root").get_channel("t")
+
+
+def _sync(doc, rts):
+    for rt in rts:
+        rt.flush()
+    doc.process_all()
+
+
+def test_transaction_atomic_commit():
+    doc, (a, b) = _fleet()
+    ta, tb = _tree(a), _tree(b)
+    for i in range(3):
+        ta.submit_change(make_insert([], "", i, [leaf(i)]))
+    _sync(doc, (a, b))
+
+    with ta.transaction():
+        ta.submit_change(make_insert([], "", 3, [leaf(30)]))
+        ta.submit_change(make_set_value([("", 0)], 100))
+        ta.submit_change(make_remove([], "", 1, 1))
+    # Concurrent edit on b before it sees the transaction.
+    tb.submit_change(make_insert([], "", 0, [leaf(7)]))
+    _sync(doc, (a, b))
+    assert ta.forest.to_json() == tb.forest.to_json()
+    vals = [n.value for n in ta.forest.root_field]
+    assert 30 in vals and 100 in vals and 1 not in vals and 7 in vals
+
+
+def test_transaction_abort_rolls_back():
+    doc, (a, b) = _fleet()
+    ta = _tree(a)
+    ta.submit_change(make_insert([], "", 0, [leaf(1)]))
+    _sync(doc, (a, b))
+    before = ta.forest.to_json()
+    with pytest.raises(ValueError):
+        with ta.transaction():
+            ta.submit_change(make_insert([], "", 1, [leaf(2)]))
+            ta.submit_change(make_set_value([("", 0)], 9))
+            raise ValueError("abort")
+    assert ta.forest.to_json() == before
+    _sync(doc, (a, b))
+    assert ta.forest.to_json() == _tree(b).forest.to_json() == before
+
+
+def test_transaction_with_moves_converges():
+    doc, (a, b) = _fleet()
+    ta, tb = _tree(a), _tree(b)
+    for i in range(5):
+        ta.submit_change(make_insert([], "", i, [leaf(i)]))
+    _sync(doc, (a, b))
+    with ta.transaction():
+        ta.submit_change(make_move([], "", 0, 2, 5))
+        ta.submit_change(make_set_value([("", 4)], 77))
+    tb.submit_change(make_move([], "", 2, 1, 0))
+    _sync(doc, (a, b))
+    assert ta.forest.to_json() == tb.forest.to_json()
+
+
+def test_revision_ids_are_compressed_and_summaries_stable():
+    doc, (a, b) = _fleet()
+    ta, tb = _tree(a), _tree(b)
+    ta.submit_change(make_insert([], "", 0, [leaf(1)]))
+    tb.submit_change(make_insert([], "", 0, [leaf(2)]))
+    _sync(doc, (a, b))
+    # Wire revisions are ints (op-space), not UUID strings.
+    assert all(isinstance(t.revision[1], int) for t in ta.em.trunk)
+    # Both replicas finalized both sessions' ranges in the same total
+    # order: decompressed stable ids agree.
+    sa = ta.summarize()
+    sb = tb.summarize()
+    assert sa["editManager"] == sb["editManager"]
+    for t in sa["editManager"]["trunk"]:
+        assert isinstance(t["rev"], str) and len(t["rev"]) == 36  # stable uuid
+
+    # A fresh replica loads the summary and keeps collaborating.
+    rt = ContainerRuntime(default_registry(), container_id="late")
+    rt.create_datastore("root").create_channel("sharedTree", "t")
+    rt.connect(doc, "late")
+    doc.process_all()
+    tc = _tree(rt)
+    assert tc.forest.to_json() == ta.forest.to_json()
+    tc.submit_change(make_insert([], "", 0, [leaf(3)]))
+    _sync(doc, (a, b, rt))
+    assert tc.forest.to_json() == ta.forest.to_json() == tb.forest.to_json()
+
+
+def test_slice_movein_rebase_keeps_offsets():
+    """A changeset with multiple slice MoveIns of one id (the inverse of a
+    split move — what redo revertibles hold) must survive rebase: each
+    slice keeps its own offset/count instead of collapsing to the full
+    register (review regression)."""
+    # Build the inverse-of-split-move shape directly: nodes [X, Y] sit at
+    # positions 0,1 (the moved block); the change returns X to offset 0
+    # (position 3) and Y to offset 2 (position 4) of the original layout.
+    change = NodeChange(
+        fields={
+            "": [
+                MoveOut(1, 7, 0),
+                MoveOut(1, 7, 2),
+                Skip(1),
+                MoveIn(7, 1, 0),
+                MoveIn(7, 1, 2),
+            ]
+        }
+    )
+    f = num_array(10, 20, 30)
+    apply_root(f, clone_change(change))
+    assert values(f) == [30, 10, 20]
+    # Rebase over an unrelated insert at the front: slices must persist.
+    b = make_insert([], "", 0, [leaf(99)])
+    rebased = rebase_node_change(clone_change(change), b, a_after=True)
+    f = num_array(10, 20, 30)
+    apply_root(f, b)
+    apply_root(f, rebased)
+    assert values(f) == [99, 30, 10, 20]
+
+
+def test_move_farm_converges():
+    """Randomized 3-client farm over the full container stack with moves in
+    the mix: partial delivery, pending bridges, and EditManager chains —
+    the schedule shapes the pairwise square fuzz cannot reach (this is what
+    caught the split-move register-order bug)."""
+    for seed in range(60):
+        rng = random.Random(seed)
+        doc, rts = _fleet(3)
+        trees = [_tree(rt) for rt in rts]
+        for _step in range(40):
+            ci = rng.randrange(3)
+            t = trees[ci]
+            n = len(t.forest.root_field)
+            kind = rng.choices(["ins", "rm", "move", "set"], [5, 3, 4, 2])[0]
+            if kind == "ins" or n == 0:
+                t.submit_change(
+                    make_insert([], "", rng.randint(0, n), [leaf(rng.randrange(100))])
+                )
+            elif kind == "rm":
+                i = rng.randrange(n)
+                t.submit_change(make_remove([], "", i, rng.randint(1, min(2, n - i))))
+            elif kind == "move":
+                s = rng.randrange(n)
+                c = rng.randint(1, min(2, n - s))
+                t.submit_change(make_move([], "", s, c, rng.randint(0, n)))
+            else:
+                t.submit_change(
+                    make_set_value([("", rng.randrange(n))], rng.randrange(100))
+                )
+            if rng.random() < 0.4:
+                rts[ci].flush()
+            if rng.random() < 0.3:
+                doc.process_some(rng.randint(0, doc.pending_count))
+        _sync(doc, rts)
+        jsons = [t.forest.to_json() for t in trees]
+        assert all(j == jsons[0] for j in jsons), f"seed {seed} diverged"
+
+
+def test_rollback_returns_id_range():
+    doc, (a, b) = _fleet()
+    ta = _tree(a)
+    ta.submit_change(make_insert([], "", 0, [leaf(1)]))
+    _sync(doc, (a, b))
+    # Stage an edit and roll it back before flushing; then ship another
+    # edit — its id range must still finalize cleanly everywhere.
+    ta.submit_change(make_insert([], "", 1, [leaf(2)]))
+    a.rollback_staged()
+    ta.submit_change(make_insert([], "", 1, [leaf(3)]))
+    _sync(doc, (a, b))
+    assert ta.forest.to_json() == _tree(b).forest.to_json()
+    assert [n.value for n in ta.forest.root_field] == [1, 3]
