@@ -173,6 +173,17 @@ class SharedTreeChannel(Channel):
         return (c["sid"], c["rev"])
 
     def process_messages(self, collection: MessageCollection) -> None:
+        if self._txn is not None:
+            # The reference's Transactor is synchronous within one JS turn,
+            # so sequenced ops can never interleave an open transaction.
+            # Enforce the same discipline: the staged edits are not part of
+            # _local_pending yet, so bridging an incoming commit here would
+            # apply it at coordinates that ignore them (and abort could not
+            # restore converged state).
+            raise RuntimeError(
+                "sequenced ops arrived inside an open transaction — finish "
+                "or abort the transaction before pumping the delta stream"
+            )
         env = collection.envelope
         for m in collection.messages:
             c = m.contents

@@ -1,0 +1,285 @@
+"""TreeBatchEngine: batched sequenced tree-edit application across documents.
+
+The SharedTree analog of ``doc_batch_engine``: D tree documents, each with
+its own totally-ordered edit stream, stepped in lockstep device batches.
+
+Host/device split (the seam SURVEY §7 step 7 names):
+
+- host: per-doc EditManager runs the deterministic trunk translation
+  (dds/tree/editmanager.py) — rebase is control-plane work over tiny mark
+  lists; the result is a TRUNK-COORDINATE commit every replica agrees on.
+- device: the forest state — a uniform-chunk value column per document
+  (ref chunked-forest/uniformChunk.ts:42) — applies the trunk commits as
+  batched index-map gathers (ops/tree_kernel.py ForestState).
+
+The device path covers the uniform-chunk shape: a flat root field of leaf
+values with insert/remove/set-value/contiguous-move edits.  Documents whose
+commits leave that shape (nested fields, non-leaf content, split moves)
+fall back to a host Forest replica — the same route-to-oracle policy as the
+string engine, keeping every document correct while the common case stays
+on device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..dds.tree.changeset import (
+    Insert,
+    Modify,
+    MoveIn,
+    MoveOut,
+    Remove,
+    Skip,
+    apply_commit,
+    commit_from_json,
+)
+from ..dds.tree.editmanager import EditManager
+from ..dds.tree.forest import Forest, Node
+from ..ops import tree_kernel as tk
+from ..protocol.messages import MessageType, SequencedMessage
+
+
+@dataclass
+class _TreeHost:
+    em: EditManager = field(default_factory=EditManager)
+    queue: list[np.ndarray] = field(default_factory=list)
+    payloads: list[np.ndarray] = field(default_factory=list)
+    # Full trunk-coordinate commit log (replay source for fallback routing).
+    trunk_log: list[list] = field(default_factory=list)
+
+
+class UnsupportedShape(Exception):
+    """A commit the columnar path cannot express."""
+
+
+class TreeBatchEngine:
+    """A fleet of tree replicas: host EditManagers + device value columns."""
+
+    def __init__(
+        self,
+        n_docs: int,
+        capacity: int = 1024,
+        ops_per_step: int = 16,
+        max_insert_len: int = 16,
+        mesh=None,
+    ) -> None:
+        self.n_docs = n_docs
+        self.capacity = capacity
+        self.ops_per_step = ops_per_step
+        self.max_insert_len = max_insert_len
+        self.hosts = [_TreeHost() for _ in range(n_docs)]
+        self.fallbacks: dict[int, Forest] = {}
+        self.mesh = mesh
+        if mesh is not None:
+            n_shards = mesh.devices.size
+            assert n_docs % n_shards == 0, "pad n_docs to a mesh multiple"
+        proto = tk.init_forest(capacity)
+        self.state = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (n_docs,) + x.shape), proto
+        )
+        if mesh is not None:
+            from ..parallel.mesh import shard_docs
+
+            self.state = jax.tree.map(
+                lambda x: jax.device_put(x, shard_docs(mesh)), self.state
+            )
+        self._step = jax.jit(
+            jax.vmap(tk.apply_forest_ops), donate_argnums=(0,)
+        )
+
+    # ------------------------------------------------------------------ ingest
+    @staticmethod
+    def _unwrap(contents: dict):
+        """Yield the tree edit ops inside a wire message: handles grouped
+        batches and the runtime's address envelopes (containerRuntime ->
+        datastore -> channel), so the engine ingests the same streams a
+        container fleet produces."""
+        if not isinstance(contents, dict):
+            return
+        if contents.get("type") == "groupedBatch":
+            for inner in contents.get("contents", []):
+                yield from TreeBatchEngine._unwrap(inner)
+            return
+        if contents.get("type") == "edit":
+            yield contents
+            return
+        if "address" in contents and "contents" in contents:
+            yield from TreeBatchEngine._unwrap(contents["contents"])
+
+    def ingest(self, doc_idx: int, msg: SequencedMessage) -> None:
+        """Integrate one sequenced message: EditManager translation on the
+        host, op-row staging for the device (or fallback apply)."""
+        if msg.type != MessageType.OP:
+            return
+        for edit in self._unwrap(msg.contents):
+            self._ingest_edit(doc_idx, msg, edit)
+
+    def _ingest_edit(self, doc_idx: int, msg: SequencedMessage, c: dict) -> None:
+        h = self.hosts[doc_idx]
+        commit = commit_from_json(c["changes"])
+        trunk = h.em.add_sequenced(
+            client_id=msg.client_id,
+            revision=(c["sid"], c["rev"]),
+            change=commit,
+            ref_seq=msg.ref_seq,
+            seq=msg.seq,
+        )
+        h.em.advance_min_seq(msg.min_seq)
+        if doc_idx in self.fallbacks:
+            # Fallback docs apply directly; their trunk log is dead weight
+            # (they can never be re-replayed onto the device path).
+            apply_commit(self.fallbacks[doc_idx].root, trunk)
+            return
+        h.trunk_log.append(trunk)
+        try:
+            rows = self._flatten(trunk, msg.seq)
+        except UnsupportedShape:
+            self._route_to_fallback(doc_idx)
+            return
+        h.queue.extend(r for r, _p in rows)
+        h.payloads.extend(p for _r, p in rows)
+
+    def _flatten(self, trunk_commit, seq: int) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Trunk commit -> forest op rows.  Raises UnsupportedShape for
+        anything beyond the uniform-chunk edit grammar."""
+        rows: list[tuple[np.ndarray, np.ndarray]] = []
+        empty = np.zeros((self.max_insert_len,), np.int32)
+
+        def row(kind, pos=0, count=0, dst=0, value=0, payload=None):
+            op = np.array(
+                [kind, seq, pos, count, dst, value, 0, 0], np.int32
+            )
+            rows.append((op, empty if payload is None else payload))
+
+        for change in trunk_commit:
+            if change.value is not None:
+                raise UnsupportedShape("value change on the virtual root")
+            for key, marks in change.fields.items():
+                if key != "":
+                    raise UnsupportedShape(f"non-root field {key!r}")
+                self._flatten_marks(marks, row)
+        return rows
+
+    def _flatten_marks(self, marks, row) -> None:
+        """Mark list (simultaneous, input coordinates) -> sequential op rows.
+
+        All positions stay in INPUT coordinates and the ops are emitted
+        back-to-front (descending position): an op never shifts the
+        coordinates of ops below it, so sequential application reproduces
+        the simultaneous mark semantics exactly.  Moves flatten to one
+        contiguous (src, count, dst) op; split moves or moves mixed with
+        other structural marks fall back to the host."""
+        move_out: dict[int, tuple[int, int]] = {}
+        move_in: dict[int, int] = {}
+        in_pos = 0
+        pending: list[tuple] = []
+        for m in marks:
+            if isinstance(m, Skip):
+                in_pos += m.count
+            elif isinstance(m, Insert):
+                vals = []
+                for node in m.content:
+                    if node.fields or not isinstance(node.value, int):
+                        raise UnsupportedShape("non-leaf insert content")
+                    vals.append(node.value)
+                if len(vals) > self.max_insert_len:
+                    raise UnsupportedShape("insert wider than payload row")
+                pending.append(("ins", in_pos, vals))
+            elif isinstance(m, Remove):
+                pending.append(("rm", in_pos, m.count))
+                in_pos += m.count
+            elif isinstance(m, Modify):
+                ch = m.change
+                if ch.fields or ch.value is None:
+                    raise UnsupportedShape("nested modify")
+                if not isinstance(ch.value[0], int):
+                    raise UnsupportedShape("non-int value")
+                pending.append(("set", in_pos, ch.value[0]))
+                in_pos += 1
+            elif isinstance(m, MoveOut):
+                if m.id in move_out:
+                    raise UnsupportedShape("split move")
+                move_out[m.id] = (in_pos, m.count)
+                in_pos += m.count
+            elif isinstance(m, MoveIn):
+                if m.id in move_in:
+                    raise UnsupportedShape("split move")
+                move_in[m.id] = in_pos
+            else:
+                raise UnsupportedShape(type(m).__name__)
+        if move_out or move_in:
+            if len(move_out) != 1 or set(move_out) != set(move_in) or pending:
+                raise UnsupportedShape("mixed structural marks with move")
+            (mid, (src, count)), = move_out.items()
+            row(tk.ForestOpKind.MOVE, pos=src, count=count, dst=move_in[mid])
+            return
+        for kind, pos, arg in reversed(pending):
+            if kind == "ins":
+                payload = np.zeros((self.max_insert_len,), np.int32)
+                payload[: len(arg)] = arg
+                row(tk.ForestOpKind.INSERT, pos=pos, count=len(arg), payload=payload)
+            elif kind == "rm":
+                row(tk.ForestOpKind.REMOVE, pos=pos, count=arg)
+            else:
+                row(tk.ForestOpKind.SET, pos=pos, value=arg)
+
+    # ---------------------------------------------------------------- routing
+    def _route_to_fallback(self, doc_idx: int) -> None:
+        """Rebuild the document as a host Forest from its trunk log; all
+        future commits apply there (route-to-oracle, like the string
+        engine's recovery lanes)."""
+        f = Forest()
+        h = self.hosts[doc_idx]
+        for trunk in h.trunk_log:
+            apply_commit(f.root, trunk)
+        self.fallbacks[doc_idx] = f
+        h.trunk_log.clear()  # never replayed again
+        h.queue.clear()
+        h.payloads.clear()
+
+    # ------------------------------------------------------------------- step
+    def pending_ops(self) -> int:
+        return sum(len(h.queue) for h in self.hosts)
+
+    def step(self) -> int:
+        steps = 0
+        B = self.ops_per_step
+        while any(h.queue for h in self.hosts):
+            ops = np.zeros((self.n_docs, B, tk.FOREST_OP_FIELDS), np.int32)
+            payloads = np.zeros((self.n_docs, B, self.max_insert_len), np.int32)
+            for d, h in enumerate(self.hosts):
+                take = min(B, len(h.queue))
+                for j in range(take):
+                    ops[d, j] = h.queue[j]
+                    payloads[d, j] = h.payloads[j]
+                del h.queue[:take]
+                del h.payloads[:take]
+            self.state = self._step(
+                self.state, jnp.asarray(ops), jnp.asarray(payloads)
+            )
+            steps += 1
+        err = np.asarray(self.state.error)
+        for d in range(self.n_docs):
+            if err[d] and d not in self.fallbacks:
+                # Capacity/range overflow on device: replay on the host.
+                self._route_to_fallback(d)
+                self.state = self.state._replace(
+                    error=self.state.error.at[d].set(0)
+                )
+        return steps
+
+    # ------------------------------------------------------------------ views
+    def values(self, doc_idx: int) -> list[int]:
+        """The document's root-field leaf values."""
+        if doc_idx in self.fallbacks:
+            return [n.value for n in self.fallbacks[doc_idx].root_field]
+        st = jax.tree.map(lambda x: x[doc_idx], self.state)
+        return [int(v) for v in tk.forest_values(st)]
+
+    def errors(self) -> np.ndarray:
+        return np.asarray(self.state.error)

@@ -174,3 +174,156 @@ def batched_value_engine(n_docs: int):
     the tree analog of the merge-tree doc-batch engine (document sharding is
     the primary parallel axis, SURVEY §2.6.2)."""
     return jax.jit(jax.vmap(apply_value_sets))
+
+
+# ---------------------------------------------------------------------------
+# Columnar forest: a uniform chunk as mutable device state
+# ---------------------------------------------------------------------------
+# The reference's UniformChunk (chunked-forest/uniformChunk.ts:42) stores a
+# shape-uniform subtree as columnar value arrays.  ForestState is that idea
+# as REPLICA STATE: one document's root field of uniform leaf nodes, living
+# on device, mutated by sequenced trunk-coordinate changesets.  Structural
+# edits are index-map gathers (no data-dependent loops); a batch of D docs
+# is vmap over the leading axis (models/tree_batch_engine.py).
+
+# Forest op row layout (int32[8]):
+#   0 kind | 1 seq | 2 pos | 3 count | 4 dst | 5 value | 6..7 unused
+FOREST_OP_FIELDS = 8
+
+ERR_NODE_OVERFLOW = 1
+ERR_FOREST_RANGE = 2
+
+
+class ForestOpKind:
+    NOOP = 0
+    INSERT = 1   # count nodes at pos, values from the payload row
+    REMOVE = 2   # count nodes at pos
+    SET = 3      # value at pos
+    MOVE = 4     # count nodes from pos to boundary dst (pre-move coords)
+
+
+class ForestState(NamedTuple):
+    values: jnp.ndarray   # int32[N] leaf value column
+    val_seq: jnp.ndarray  # int32[N] seq of last write (attribution)
+    nnode: jnp.ndarray    # int32 scalar live node count
+    error: jnp.ndarray    # int32 scalar bitmask
+
+
+def init_forest(capacity: int = 1024) -> ForestState:
+    return ForestState(
+        values=jnp.zeros((capacity,), I32),
+        val_seq=jnp.zeros((capacity,), I32),
+        nnode=jnp.zeros((), I32),
+        error=jnp.zeros((), I32),
+    )
+
+
+def _forest_gather(s: ForestState, src: jnp.ndarray, n_new) -> ForestState:
+    """Rebuild the columns through a source-index map (-1 = fresh slot,
+    filled by the caller afterwards)."""
+    safe = jnp.clip(src, 0, s.values.shape[0] - 1)
+    take = src >= 0
+    return s._replace(
+        values=jnp.where(take, s.values[safe], 0),
+        val_seq=jnp.where(take, s.val_seq[safe], 0),
+        nnode=n_new,
+    )
+
+
+def apply_forest_op(s: ForestState, op: jnp.ndarray, payload: jnp.ndarray) -> ForestState:
+    """Apply one trunk-coordinate structural/value op to one document."""
+    kind, seq, pos, count, dst, value = op[0], op[1], op[2], op[3], op[4], op[5]
+    N = s.values.shape[0]
+    idx = jnp.arange(N, dtype=I32)
+    n = s.nnode
+
+    def do_noop(s):
+        return s
+
+    def do_insert(s):
+        over = n + count > N
+        bad = pos > n
+        ok = ~(over | bad)
+        src = jnp.where(idx < pos, idx, jnp.where(idx < pos + count, -1, idx - count))
+        out = _forest_gather(s, src, n + count)
+        fresh = (idx >= pos) & (idx < pos + count)
+        pay = payload[jnp.clip(idx - pos, 0, payload.shape[0] - 1)]
+        return jax.lax.cond(
+            ok,
+            lambda _: out._replace(
+                values=jnp.where(fresh, pay, out.values),
+                val_seq=jnp.where(fresh, seq, out.val_seq),
+            ),
+            lambda _: s._replace(
+                error=s.error
+                | jnp.where(over, ERR_NODE_OVERFLOW, 0)
+                | jnp.where(bad, ERR_FOREST_RANGE, 0)
+            ),
+            None,
+        )
+
+    def do_remove(s):
+        bad = pos + count > n
+        src = jnp.where(idx < pos, idx, idx + count)
+        out = _forest_gather(s, src, n - count)
+        return jax.lax.cond(
+            bad,
+            lambda _: s._replace(error=s.error | ERR_FOREST_RANGE),
+            lambda _: out,
+            None,
+        )
+
+    def do_set(s):
+        bad = pos >= n
+        return jax.lax.cond(
+            bad,
+            lambda _: s._replace(error=s.error | ERR_FOREST_RANGE),
+            lambda _: s._replace(
+                values=s.values.at[pos].set(value),
+                val_seq=s.val_seq.at[pos].set(seq),
+            ),
+            None,
+        )
+
+    def do_move(s):
+        # Move [pos, pos+count) to pre-move boundary dst: compose the
+        # remove map with the insert map (dst' = post-remove boundary).
+        bad = (pos + count > n) | (dst > n)
+        dstp = jnp.where(dst > pos + count, dst - count, jnp.minimum(dst, pos))
+        # For each output slot: inside the landed block -> moved source;
+        # else the surviving nodes in order (skip the moved range).
+        in_block = (idx >= dstp) & (idx < dstp + count)
+        u = jnp.where(idx < dstp, idx, idx - count)      # rank among survivors
+        surv = jnp.where(u < pos, u, u + count)          # survivor rank -> old idx
+        src = jnp.where(in_block, pos + (idx - dstp), surv)
+        out = _forest_gather(s, src, n)
+        return jax.lax.cond(
+            bad,
+            lambda _: s._replace(error=s.error | ERR_FOREST_RANGE),
+            lambda _: out,
+            None,
+        )
+
+    return jax.lax.switch(
+        kind, [do_noop, do_insert, do_remove, do_set, do_move], s
+    )
+
+
+def apply_forest_ops(
+    s: ForestState, ops: jnp.ndarray, payloads: jnp.ndarray
+) -> ForestState:
+    """Apply a [B]-op batch to one document in order (lax.scan); batch over
+    documents with vmap (the doc axis is the parallel one)."""
+
+    def step(carry, xs):
+        op, payload = xs
+        return apply_forest_op(carry, op, payload), None
+
+    out, _ = jax.lax.scan(step, s, (ops, payloads))
+    return out
+
+
+def forest_values(s: ForestState) -> np.ndarray:
+    """Host view of the live value column."""
+    n = int(s.nnode)
+    return np.asarray(s.values)[:n]

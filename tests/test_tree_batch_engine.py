@@ -1,0 +1,155 @@
+"""TreeBatchEngine: batched device tree application matches the host stack.
+
+Differential contract: N documents driven through full SharedTreeChannel
+fleets (host Forest + EditManager) while the identical sequenced stream
+feeds the TreeBatchEngine; every document's root-field values must match —
+docs that stay on the device value-column path and docs that routed to the
+host fallback alike.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.dds.tree.changeset import (
+    make_insert,
+    make_move,
+    make_remove,
+    make_set_value,
+)
+from fluidframework_tpu.dds.tree.schema import leaf
+from fluidframework_tpu.models.tree_batch_engine import TreeBatchEngine
+from fluidframework_tpu.ops import tree_kernel as tk
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+
+def drive_tree_docs(n_docs, seed, steps=30, clients_per_doc=2, nested_prob=0.0):
+    """Concurrent multi-client tree sessions; returns (service, expected)."""
+    rng = random.Random(seed)
+    svc = LocalService()
+    fleets = {}
+    for d in range(n_docs):
+        doc = svc.document(f"doc{d}")
+        rts = []
+        for i in range(clients_per_doc):
+            rt = ContainerRuntime(default_registry(), container_id=f"d{d}c{i}")
+            rt.create_datastore("root").create_channel("sharedTree", "t")
+            rt.connect(doc, f"d{d}c{i}")
+            rts.append(rt)
+        doc.process_all()
+        fleets[d] = rts
+    tree = lambda rt: rt.datastore("root").get_channel("t")
+    for _step in range(steps):
+        for d in range(n_docs):
+            doc = svc.document(f"doc{d}")
+            rt = fleets[d][rng.randrange(clients_per_doc)]
+            t = tree(rt)
+            n = len(t.forest.root_field)
+            kind = rng.choices(
+                ["ins", "rm", "set", "move", "txn", "nested"],
+                [5, 3, 3, 3, 1, nested_prob],
+            )[0]
+            if kind == "ins" or n == 0:
+                t.submit_change(
+                    make_insert([], "", rng.randint(0, n), [leaf(rng.randrange(1000))])
+                )
+            elif kind == "rm":
+                i = rng.randrange(n)
+                t.submit_change(make_remove([], "", i, rng.randint(1, min(2, n - i))))
+            elif kind == "set":
+                t.submit_change(
+                    make_set_value([("", rng.randrange(n))], rng.randrange(1000))
+                )
+            elif kind == "move":
+                s = rng.randrange(n)
+                c = rng.randint(1, min(2, n - s))
+                t.submit_change(make_move([], "", s, c, rng.randint(0, n)))
+            elif kind == "txn":
+                with t.transaction():
+                    t.submit_change(make_insert([], "", 0, [leaf(rng.randrange(1000))]))
+                    t.submit_change(make_set_value([("", 0)], rng.randrange(1000)))
+            else:
+                # Nested-field edit: unsupported by the columnar path, must
+                # route the doc to the host fallback.
+                t.submit_change(
+                    make_insert([("", rng.randrange(n))], "sub", 0, [leaf(7)])
+                )
+            if rng.random() < 0.5:
+                rt.flush()
+            if rng.random() < 0.4:
+                doc.process_some(rng.randint(0, doc.pending_count))
+    for d in range(n_docs):
+        for rt in fleets[d]:
+            rt.flush()
+        svc.document(f"doc{d}").process_all()
+    expected = {
+        d: [n.value for n in tree(fleets[d][0]).forest.root_field]
+        for d in range(n_docs)
+    }
+    for d in range(n_docs):
+        for rt in fleets[d][1:]:
+            assert [n.value for n in tree(rt).forest.root_field] == expected[d]
+    return svc, expected
+
+
+def _feed(svc, n_docs, **kw):
+    eng = TreeBatchEngine(n_docs, **kw)
+    for d in range(n_docs):
+        for msg in svc.document(f"doc{d}").sequencer.log:
+            eng.ingest(d, msg)
+    eng.step()
+    return eng
+
+
+def test_engine_matches_host_fleet():
+    svc, expected = drive_tree_docs(6, seed=0)
+    eng = _feed(svc, 6)
+    assert not eng.errors().any()
+    for d in range(6):
+        assert eng.values(d) == expected[d], f"doc {d} diverged"
+
+
+def test_engine_matches_with_more_seeds():
+    for seed in range(1, 5):
+        svc, expected = drive_tree_docs(4, seed=seed)
+        eng = _feed(svc, 4)
+        for d in range(4):
+            assert eng.values(d) == expected[d], f"seed {seed} doc {d}"
+
+
+def test_nested_edits_route_to_fallback_and_stay_correct():
+    svc, expected = drive_tree_docs(4, seed=7, nested_prob=2.0)
+    eng = _feed(svc, 4)
+    assert eng.fallbacks, "nested edits should have routed docs to the host"
+    for d in range(4):
+        assert eng.values(d) == expected[d], f"doc {d} diverged"
+
+
+def test_capacity_overflow_routes_to_fallback():
+    svc, expected = drive_tree_docs(2, seed=3, steps=25)
+    eng = _feed(svc, 2, capacity=8)
+    assert not eng.errors().any()
+    for d in range(2):
+        assert eng.values(d) == expected[d]
+
+
+def test_forest_kernel_move_directions():
+    import jax.numpy as jnp
+
+    s = tk.init_forest(16)
+    pay = np.zeros((8,), np.int32)
+    pay[:5] = [10, 11, 12, 13, 14]
+    op = np.array([tk.ForestOpKind.INSERT, 1, 0, 5, 0, 0, 0, 0], np.int32)
+    s = tk.apply_forest_op(s, jnp.asarray(op), jnp.asarray(pay))
+    # Move [0,1] to boundary 4 (right) then [3,4] back to 1 (left).
+    mv = np.array([tk.ForestOpKind.MOVE, 2, 0, 2, 4, 0, 0, 0], np.int32)
+    s = tk.apply_forest_op(s, jnp.asarray(mv), jnp.asarray(pay))
+    assert list(tk.forest_values(s)) == [12, 13, 10, 11, 14]
+    mv2 = np.array([tk.ForestOpKind.MOVE, 3, 3, 2, 1, 0, 0, 0], np.int32)
+    s = tk.apply_forest_op(s, jnp.asarray(mv2), jnp.asarray(pay))
+    assert list(tk.forest_values(s)) == [12, 11, 14, 13, 10]
+    assert int(s.error) == 0
