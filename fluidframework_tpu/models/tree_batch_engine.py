@@ -44,13 +44,20 @@ from ..ops import tree_kernel as tk
 from ..protocol.messages import MessageType, SequencedMessage
 
 
+def _int32(v) -> bool:
+    return isinstance(v, int) and -(1 << 31) <= v < (1 << 31)
+
+
 @dataclass
 class _TreeHost:
     em: EditManager = field(default_factory=EditManager)
     queue: list[np.ndarray] = field(default_factory=list)
     payloads: list[np.ndarray] = field(default_factory=list)
-    # Full trunk-coordinate commit log (replay source for fallback routing).
+    # Trunk-coordinate commit suffix since ``checkpoint`` (replay source for
+    # fallback routing); folded into the checkpoint forest every
+    # CHECKPOINT_EVERY commits so host memory stays bounded.
     trunk_log: list[list] = field(default_factory=list)
+    checkpoint: Forest = field(default_factory=Forest)
 
 
 class UnsupportedShape(Exception):
@@ -59,6 +66,8 @@ class UnsupportedShape(Exception):
 
 class TreeBatchEngine:
     """A fleet of tree replicas: host EditManagers + device value columns."""
+
+    CHECKPOINT_EVERY = 64  # trunk-log fold threshold (bounds host memory)
 
     def __init__(
         self,
@@ -136,6 +145,12 @@ class TreeBatchEngine:
             apply_commit(self.fallbacks[doc_idx].root, trunk)
             return
         h.trunk_log.append(trunk)
+        if len(h.trunk_log) >= self.CHECKPOINT_EVERY:
+            # Fold the suffix into the checkpoint forest: bounded host
+            # memory, and fallback routing replays only the tail.
+            for t in h.trunk_log:
+                apply_commit(h.checkpoint.root, t)
+            h.trunk_log.clear()
         try:
             rows = self._flatten(trunk, msg.seq)
         except UnsupportedShape:
@@ -184,8 +199,8 @@ class TreeBatchEngine:
             elif isinstance(m, Insert):
                 vals = []
                 for node in m.content:
-                    if node.fields or not isinstance(node.value, int):
-                        raise UnsupportedShape("non-leaf insert content")
+                    if node.fields or not _int32(node.value):
+                        raise UnsupportedShape("non-int32-leaf insert content")
                     vals.append(node.value)
                 if len(vals) > self.max_insert_len:
                     raise UnsupportedShape("insert wider than payload row")
@@ -197,8 +212,8 @@ class TreeBatchEngine:
                 ch = m.change
                 if ch.fields or ch.value is None:
                     raise UnsupportedShape("nested modify")
-                if not isinstance(ch.value[0], int):
-                    raise UnsupportedShape("non-int value")
+                if not _int32(ch.value[0]):
+                    raise UnsupportedShape("non-int32 value")
                 pending.append(("set", in_pos, ch.value[0]))
                 in_pos += 1
             elif isinstance(m, MoveOut):
@@ -233,11 +248,12 @@ class TreeBatchEngine:
         """Rebuild the document as a host Forest from its trunk log; all
         future commits apply there (route-to-oracle, like the string
         engine's recovery lanes)."""
-        f = Forest()
         h = self.hosts[doc_idx]
+        f = h.checkpoint  # trunk state up to the last checkpoint fold
         for trunk in h.trunk_log:
             apply_commit(f.root, trunk)
         self.fallbacks[doc_idx] = f
+        h.checkpoint = Forest()
         h.trunk_log.clear()  # never replayed again
         h.queue.clear()
         h.payloads.clear()

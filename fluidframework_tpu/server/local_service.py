@@ -242,6 +242,10 @@ class LocalService:
             self._docs[doc_id].token_manager = self._token_manager
         return self._docs[doc_id]
 
+    def peek_document(self, doc_id: str) -> LocalDocument | None:
+        """Non-creating lookup (read fronts must not instantiate docs)."""
+        return self._docs.get(doc_id)
+
     def enable_auth(self, token_manager) -> None:
         """Require valid tenant tokens on every write connection (riddler)."""
         self._token_manager = token_manager

@@ -1,0 +1,365 @@
+"""Networked service front-ends: TCP delta stream + HTTP storage reads.
+
+Reference parity: the routerlicious front-end plane —
+
+- **nexus** (websocket front, server/routerlicious/packages/lambdas/src/
+  nexus/index.ts:127): here a TCP JSON-lines protocol (one JSON object per
+  line) carrying the connect_document handshake, op submission, signal
+  relay, and the sequenced broadcast back to every connected socket.
+- **alfred/historian** (REST front + snapshot storage): an HTTP endpoint
+  serving delta ranges, snapshot read/write, and summary uploads.
+
+Both fronts sit over the same in-process ordering core (``LocalService`` —
+sequencer, broadcast, snapshot store), which is exactly the reference's
+local-server/tinylicious shape: real network fronts, in-memory ordering.
+Every mutation of the core runs under one lock; ticketed ops broadcast
+immediately (network mode has no test-controlled delivery interleaving —
+clients buffer and pump on their side).
+
+Run standalone for cross-process use:
+
+    python -m fluidframework_tpu.server.netserver --port 7070 --http-port 7071
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..protocol.messages import SequencedMessage, UnsequencedMessage
+from .local_service import LocalService
+
+
+def seq_msg_to_dict(msg: SequencedMessage) -> dict:
+    return json.loads(msg.to_json())
+
+
+def seq_msg_from_dict(d: dict) -> SequencedMessage:
+    return SequencedMessage.from_json(json.dumps(d))
+
+
+class _ClientSession:
+    """Server-side state for one TCP connection."""
+
+    def __init__(self, handler: "_NexusHandler") -> None:
+        self.handler = handler
+        self.doc_id: str | None = None
+        self.client_id: str | None = None
+        self._wlock = threading.Lock()
+
+    def send(self, obj: dict) -> None:
+        data = (json.dumps(obj) + "\n").encode()
+        try:
+            with self._wlock:
+                self.handler.wfile.write(data)
+                self.handler.wfile.flush()
+        except OSError:
+            pass  # peer went away; the read loop will clean up
+
+
+class _NexusHandler(socketserver.StreamRequestHandler):
+    """One thread per TCP client (ref: one socket.io connection)."""
+
+    def handle(self) -> None:  # noqa: C901 - protocol dispatch
+        server: NetworkServer = self.server.owner  # type: ignore[attr-defined]
+        session = _ClientSession(self)
+        try:
+            for raw in self.rfile:
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                except json.JSONDecodeError:
+                    session.send({"t": "error", "reason": "bad json", "canRetry": False})
+                    continue
+                kind = req.get("t")
+                if kind == "connect":
+                    server.handle_connect(session, req)
+                elif kind == "submit":
+                    server.handle_submit(session, req)
+                elif kind == "signal":
+                    server.handle_signal(session, req)
+                elif kind == "sync":
+                    # Echo AFTER everything already broadcast on this socket:
+                    # the client's deterministic quiescence marker.
+                    session.send({"t": "sync", "n": req.get("n", 0)})
+                elif kind == "disconnect":
+                    break
+                else:
+                    session.send(
+                        {"t": "error", "reason": f"unknown op {kind!r}", "canRetry": False}
+                    )
+        finally:
+            server.drop_session(session)
+
+
+class NetworkServer:
+    """The TCP front over one LocalService core."""
+
+    def __init__(self, service: LocalService | None = None, port: int = 0) -> None:
+        self.service = service if service is not None else LocalService()
+        self.lock = threading.RLock()
+
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._tcp = _Srv(("127.0.0.1", port), _NexusHandler)
+        self._tcp.owner = self  # type: ignore[attr-defined]
+        self.port = self._tcp.server_address[1]
+        self._thread = threading.Thread(target=self._tcp.serve_forever, daemon=True)
+
+    def start(self) -> "NetworkServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    # ----------------------------------------------------------- op handlers
+    def handle_connect(self, session: _ClientSession, req: dict) -> None:
+        from .auth import AuthError
+
+        doc_id = req["doc"]
+        client_id = req["client"]
+        mode = req.get("mode", "write")
+        with self.lock:
+            doc = self.service.document(doc_id)
+
+            def on_op(msg: SequencedMessage, s=session) -> None:
+                s.send({"t": "op", "msg": seq_msg_to_dict(msg)})
+
+            def on_nack(nack, s=session) -> None:
+                s.send(
+                    {
+                        "t": "nack",
+                        "clientId": nack.client_id,
+                        "clientSeq": nack.client_seq,
+                        "reason": nack.reason,
+                        "retryAfter": nack.retry_after,
+                    }
+                )
+
+            try:
+                join, delivered_seq = doc.connect_stream(
+                    client_id, on_op, on_nack, mode=mode, token=req.get("token")
+                )
+            except AuthError as e:
+                session.send(
+                    {"t": "error", "reason": f"connection rejected: {e}", "canRetry": False}
+                )
+                return
+            if req.get("signals"):
+                doc.subscribe_signals(
+                    client_id,
+                    lambda sig, s=session: s.send(
+                        {"t": "signal", "clientId": sig.client_id, "contents": sig.contents}
+                    ),
+                )
+            session.doc_id = doc_id
+            session.client_id = client_id
+            session.send(
+                {
+                    "t": "joined",
+                    "join": seq_msg_to_dict(join) if join else None,
+                    "deliveredSeq": delivered_seq,
+                }
+            )
+            doc.process_all()  # broadcast the join immediately
+
+    def handle_submit(self, session: _ClientSession, req: dict) -> None:
+        with self.lock:
+            if session.doc_id is None:
+                session.send({"t": "error", "reason": "submit before connect", "canRetry": False})
+                return
+            doc = self.service.document(session.doc_id)
+            msg = UnsequencedMessage.from_json(json.dumps(req["msg"]))
+            doc.submit(msg)
+            doc.process_all()  # network mode: broadcast as ticketed
+
+    def handle_signal(self, session: _ClientSession, req: dict) -> None:
+        with self.lock:
+            if session.doc_id is None:
+                return
+            self.service.document(session.doc_id).submit_signal(
+                session.client_id, req.get("content")
+            )
+
+    def drop_session(self, session: _ClientSession) -> None:
+        with self.lock:
+            if session.doc_id is not None and session.client_id is not None:
+                doc = self.service.document(session.doc_id)
+                doc.disconnect(session.client_id)
+                doc.process_all()  # broadcast the leave
+
+
+class _AlfredHandler(BaseHTTPRequestHandler):
+    """REST storage front (alfred delta reads + historian snapshots)."""
+
+    def log_message(self, *a) -> None:  # quiet
+        pass
+
+    def _json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _route(self):
+        u = urlparse(self.path)
+        parts = [p for p in u.path.split("/") if p]
+        return parts, parse_qs(u.query)
+
+    def _doc(self, server: "HttpFront", doc_id: str, create: bool = False):
+        """Authenticated document lookup.  Reads are NON-creating (a read
+        probe must not instantiate state; alfred 404s unknown docs); writes
+        get-or-create (historian creates storage on first write).  When
+        tenant auth is on, every front validates (riddler validates all
+        fronts)."""
+        if create:
+            doc = server.service.document(doc_id)
+        else:
+            doc = server.service.peek_document(doc_id)
+            if doc is None:
+                self._json(404, {"error": "no such document"})
+                return None
+        if doc.token_manager is not None:
+            from .auth import AuthError
+
+            auth = self.headers.get("Authorization", "")
+            token = auth.removeprefix("Bearer ").strip() or None
+            try:
+                doc.token_manager.validate(token, doc_id, "__storage__")
+            except AuthError as e:
+                self._json(401, {"error": str(e)})
+                return None
+        return doc
+
+    def do_GET(self) -> None:  # noqa: N802
+        server: HttpFront = self.server.owner  # type: ignore[attr-defined]
+        parts, q = self._route()
+        with server.lock:
+            if len(parts) != 3 or parts[0] != "doc":
+                self._json(404, {"error": "bad route"})
+                return
+            doc = self._doc(server, parts[1])
+            if doc is None:
+                return
+            if parts[2] == "deltas":
+                lo = int(q.get("from", ["1"])[0])
+                hi = int(q.get("to", ["0"])[0]) or 1 << 30
+                ops = [seq_msg_to_dict(m) for m in doc.ops_range(lo, hi)]
+                self._json(200, {"ops": ops})
+            elif parts[2] == "snapshot":
+                snap = doc.latest_snapshot()
+                if snap is None:
+                    self._json(404, {"error": "no snapshot"})
+                else:
+                    self._json(200, {"seq": snap[0], "summary": snap[1]})
+            elif parts[2] == "stats":
+                self._json(
+                    200,
+                    {
+                        "logLen": len(doc.sequencer.log),
+                        "pending": doc.pending_count,
+                        "clients": sorted(doc.sequencer.clients()),
+                    },
+                )
+            else:
+                self._json(404, {"error": "bad route"})
+
+    def do_PUT(self) -> None:  # noqa: N802
+        server: HttpFront = self.server.owner  # type: ignore[attr-defined]
+        parts, _q = self._route()
+        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        with server.lock:
+            if len(parts) == 3 and parts[0] == "doc" and parts[2] == "snapshot":
+                doc = self._doc(server, parts[1], create=True)
+                if doc is None:
+                    return
+                doc.save_snapshot(body["seq"], body["summary"])
+                self._json(200, {"ok": True})
+            else:
+                self._json(404, {"error": "bad route"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        server: HttpFront = self.server.owner  # type: ignore[attr-defined]
+        parts, _q = self._route()
+        length = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(length)) if length else {}
+        with server.lock:
+            if len(parts) == 3 and parts[0] == "doc" and parts[2] == "summary":
+                doc = self._doc(server, parts[1], create=True)
+                if doc is None:
+                    return
+                handle = doc.upload_summary(body["tree"])
+                self._json(200, {"handle": handle})
+            else:
+                self._json(404, {"error": "bad route"})
+
+
+class HttpFront:
+    def __init__(self, service: LocalService, lock: threading.RLock, port: int = 0) -> None:
+        self.service = service
+        self.lock = lock
+        self._http = ThreadingHTTPServer(("127.0.0.1", port), _AlfredHandler)
+        self._http.owner = self  # type: ignore[attr-defined]
+        self.port = self._http.server_address[1]
+        self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+
+    def start(self) -> "HttpFront":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+
+
+class ServicePlane:
+    """Both fronts over one shared core: the deployable unit (tinylicious
+    analog).  ``ports`` are assigned when 0 (tests use ephemeral ports)."""
+
+    def __init__(self, port: int = 0, http_port: int = 0) -> None:
+        self.nexus = NetworkServer(port=port)
+        self.http = HttpFront(self.nexus.service, self.nexus.lock, port=http_port)
+
+    @property
+    def service(self) -> LocalService:
+        return self.nexus.service
+
+    def start(self) -> "ServicePlane":
+        self.nexus.start()
+        self.http.start()
+        return self
+
+    def stop(self) -> None:
+        self.nexus.stop()
+        self.http.stop()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=7070)
+    p.add_argument("--http-port", type=int, default=0)
+    args = p.parse_args()
+    http_port = args.http_port
+    if not http_port:
+        http_port = args.port + 1 if args.port else 0  # ephemeral stays ephemeral
+    plane = ServicePlane(port=args.port, http_port=http_port)
+    plane.start()
+    # Readiness line for process supervisors / tests.
+    print(json.dumps({"port": plane.nexus.port, "httpPort": plane.http.port}), flush=True)
+    threading.Event().wait()  # serve until killed
+
+
+if __name__ == "__main__":
+    main()

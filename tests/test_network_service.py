@@ -1,0 +1,147 @@
+"""Cross-process service-plane tests: a standalone server process, client
+containers in separate OS processes, real TCP/HTTP in between.
+
+(The full loader suite also runs over the network driver in-process via the
+parametrized ``env`` fixture in test_loader.py; this module proves the
+plane works across PROCESS boundaries — the reference's client/service
+split.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CLIENT_SCRIPT = r"""
+import json, os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.driver.network_driver import NetworkDocumentServiceFactory
+from fluidframework_tpu.loader import Container
+
+port, http_port = int(sys.argv[1]), int(sys.argv[2])
+factory = NetworkDocumentServiceFactory("127.0.0.1", port, http_port)
+c = Container.load("doc", factory, default_registry(), "procB")
+factory.sync_all()
+s = c.runtime.datastore("root").get_channel("text")
+before = s.text
+s.insert_text(len(s.text), " world")
+c.runtime.flush()
+factory.sync_all()
+print(json.dumps({"before": before, "after": s.text}), flush=True)
+c.disconnect()
+"""
+
+
+@pytest.fixture
+def server_proc():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.server.netserver", "--port", "0"],
+        stdout=subprocess.PIPE,
+        text=True,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    ready = json.loads(proc.stdout.readline())
+    yield ready["port"], ready["httpPort"]
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def test_two_process_convergence(server_proc):
+    port, http_port = server_proc
+    from fluidframework_tpu.dds.channels import default_registry
+    from fluidframework_tpu.driver.network_driver import NetworkDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+
+    factory = NetworkDocumentServiceFactory("127.0.0.1", port, http_port)
+    d = Container.create_detached(default_registry(), container_id="procA")
+    ds = d.runtime.create_datastore("root")
+    ds.create_channel("sharedString", "text")
+    d.attach("doc", factory, "procA")
+    s = d.runtime.datastore("root").get_channel("text")
+    s.insert_text(0, "hello")
+    d.runtime.flush()
+    factory.sync_all()
+
+    # A second OS process loads the same document, reads, edits, exits.
+    out = subprocess.run(
+        [sys.executable, "-c", CLIENT_SCRIPT, str(port), str(http_port)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    result = json.loads(out.stdout.strip().splitlines()[-1])
+    assert result["before"] == "hello"
+    assert result["after"] == "hello world"
+
+    # Process A sees process B's edit through the broadcast.
+    factory.sync_all()
+    assert s.text == "hello world"
+
+
+def test_cross_process_concurrent_edits(server_proc):
+    """Both processes edit concurrently (neither has seen the other's op
+    when it submits); the sequencer orders them and both converge."""
+    port, http_port = server_proc
+    from fluidframework_tpu.dds.channels import default_registry
+    from fluidframework_tpu.driver.network_driver import NetworkDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+
+    factory = NetworkDocumentServiceFactory("127.0.0.1", port, http_port)
+    d = Container.create_detached(default_registry(), container_id="procA")
+    d.runtime.create_datastore("root").create_channel("sharedString", "text")
+    d.attach("doc", factory, "procA")
+    s = d.runtime.datastore("root").get_channel("text")
+    s.insert_text(0, "base")
+    d.runtime.flush()
+    factory.sync_all()
+
+    concurrent = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.driver.network_driver import NetworkDocumentServiceFactory
+from fluidframework_tpu.loader import Container
+port, http_port = int(sys.argv[1]), int(sys.argv[2])
+factory = NetworkDocumentServiceFactory("127.0.0.1", port, http_port)
+c = Container.load("doc", factory, default_registry(), "procB")
+factory.sync_all()
+s = c.runtime.datastore("root").get_channel("text")
+s.insert_text(0, "B")          # submitted before pumping A's concurrent op
+c.runtime.flush()
+deadline = time.time() + 60
+while "A" not in s.text:       # wait until A's concurrent op arrives
+    factory.sync_all()
+    if time.time() > deadline:
+        break
+    time.sleep(0.02)
+print(json.dumps({"text": s.text}), flush=True)
+c.disconnect()
+"""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", concurrent, str(port), str(http_port)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    # A edits concurrently (without pumping B's op first).
+    s.insert_text(0, "A")
+    d.runtime.flush()
+    out, err = proc.communicate(timeout=120)
+    assert proc.returncode == 0, err
+    factory.sync_all()
+    other = json.loads(out.strip().splitlines()[-1])["text"]
+    assert s.text == other, f"{s.text!r} != {other!r}"
+    assert sorted(s.text) == sorted("ABbase")
