@@ -66,6 +66,13 @@ class StorageService:
     def write_snapshot(self, seq: int, summary: dict) -> None:
         raise NotImplementedError
 
+    def upload_blob_content(self, content: str) -> str:
+        """Content-addressed attachment blob upload; returns the blob id."""
+        raise NotImplementedError
+
+    def read_blob_content(self, blob_id: str) -> str:
+        raise NotImplementedError
+
     def upload_summary(self, summary_tree: dict) -> str:
         """Stage an ISummaryTree upload; returns the handle a summarize op
         carries (ref uploadSummaryWithContext)."""

@@ -98,6 +98,12 @@ class LocalStorageService(StorageService):
     def write_snapshot(self, seq: int, summary: dict) -> None:
         self._doc.save_snapshot(seq, summary)
 
+    def upload_blob_content(self, content: str) -> str:
+        return self._doc.upload_blob(content)
+
+    def read_blob_content(self, blob_id: str) -> str:
+        return self._doc.read_blob(blob_id)
+
     def upload_summary(self, summary_tree: dict) -> str:
         return self._doc.upload_summary(summary_tree)
 

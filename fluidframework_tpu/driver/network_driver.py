@@ -64,30 +64,38 @@ class NetworkDeltaConnection(DeltaConnection):
         self._sock = socket.create_connection((host, port), timeout=30)
         self._rfile = self._sock.makefile("r", encoding="utf-8")
         self._wlock = threading.Lock()
-        self._send(
-            {
-                "t": "connect",
-                "doc": doc_id,
-                "client": client_id,
-                "mode": mode,
-                "token": token,
-                "signals": signal_listener is not None,
-            }
-        )
-        # Handshake: block for the joined ack (the server sends it before
-        # any broadcast for this socket).
-        line = self._rfile.readline()
-        if not line:
-            raise DriverError("connection closed during handshake")
-        ack = json.loads(line)
-        if ack.get("t") == "error":
-            raise DriverError(
-                f"connection rejected: {ack.get('reason')}",
-                can_retry=bool(ack.get("canRetry", False)),
+        try:
+            self._send(
+                {
+                    "t": "connect",
+                    "doc": doc_id,
+                    "client": client_id,
+                    "mode": mode,
+                    "token": token,
+                    "signals": signal_listener is not None,
+                }
             )
-        assert ack.get("t") == "joined", f"unexpected handshake reply {ack}"
-        self.join_msg = _seq_from_dict(ack["join"]) if ack.get("join") else None
-        self.checkpoint_seq = ack["deliveredSeq"]
+            # Handshake: block for the joined ack (the server sends it
+            # before any broadcast for this socket).
+            line = self._rfile.readline()
+            if not line:
+                raise DriverError("connection closed during handshake")
+            ack = json.loads(line)
+            if ack.get("t") == "error":
+                raise DriverError(
+                    f"connection rejected: {ack.get('reason')}",
+                    can_retry=bool(ack.get("canRetry", False)),
+                )
+            if ack.get("t") != "joined":
+                raise DriverError(f"unexpected handshake reply {ack}", can_retry=False)
+            self.join_msg = _seq_from_dict(ack["join"]) if ack.get("join") else None
+            self.checkpoint_seq = ack["deliveredSeq"]
+        except BaseException:
+            # A failed handshake must not leak the socket (reconnect loops
+            # would exhaust fds).
+            self._rfile.close()
+            self._sock.close()
+            raise
         self._connected = True
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._reader.start()
@@ -291,6 +299,23 @@ class HttpStorageService(StorageService):
             raise DriverError(f"summary upload failed: {body}")
         return body["handle"]
 
+    def upload_blob_content(self, content: str) -> str:
+        status, body = self._http.request(
+            "POST", f"/doc/{self._doc}/blob", {"content": content},
+            token=self._token,
+        )
+        if status != 200:
+            raise DriverError(f"blob upload failed: {body}")
+        return body["id"]
+
+    def read_blob_content(self, blob_id: str) -> str:
+        status, body = self._http.request(
+            "GET", f"/doc/{self._doc}/blob/{blob_id}", token=self._token
+        )
+        if status != 200:
+            raise DriverError(f"blob read failed: {body}")
+        return body["content"]
+
 
 class NetworkDocumentService(DocumentService):
     def __init__(self, factory: "NetworkDocumentServiceFactory", doc_id: str) -> None:
@@ -356,7 +381,10 @@ class NetworkDocumentServiceFactory(DocumentServiceFactory):
                 if conn.connected:
                     n += conn.sync()
                 else:
+                    # Final drain, then drop: dead connections must not
+                    # accumulate across reconnect churn.
                     n += conn.pump()
+                    self.live_connections.remove(conn)
             total += n
             if n == 0:
                 return total

@@ -183,6 +183,22 @@ class DeltaManager:
             raise RuntimeError("signal while disconnected")
         conn.submit_signal(content)
 
+    # Attachment blob passthroughs (the runtime's BlobManager talks to its
+    # "document", which through the loader is this adapter; storage owns
+    # blobs — ref blobManager uploads via the storage service).  One cached
+    # storage service per document: per-call construction would re-mint the
+    # storage token for every blob op.
+    def _blob_storage(self):
+        if not hasattr(self, "_blob_storage_svc"):
+            self._blob_storage_svc = self._service.connect_to_storage()
+        return self._blob_storage_svc
+
+    def upload_blob(self, content: str) -> str:
+        return self._blob_storage().upload_blob_content(content)
+
+    def read_blob(self, blob_id: str) -> str:
+        return self._blob_storage().read_blob_content(blob_id)
+
 class DeltaScheduler:
     """Drives a paused DeltaManager in slices (ref DeltaScheduler's 50 ms
     budget, deltaScheduler.ts:25-33): call ``run_slice()`` from the host

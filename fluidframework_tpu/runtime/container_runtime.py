@@ -75,11 +75,48 @@ class ContainerRuntime:
         self.last_summary_ref_seq: int | None = None
         self.on_summary_ack = None
         self.on_summary_nack = None
+        # Attachment blobs + GC (runtime/blob_manager.py, runtime/gc.py).
+        from .blob_manager import BlobManager
+        from .gc import GCState
+
+        self.blobs = BlobManager(
+            upload=self._upload_blob_to_storage,
+            read=self._read_blob_from_storage,
+            submit_attach=lambda blob_id: self._submit_datastore_op(
+                RUNTIME_ADDRESS, {"runtimeOp": "attachBlob", "id": blob_id}, None
+            ),
+        )
+        self.gc_state = GCState()
+        # Sweep distance in sequence numbers: a node must stay unreferenced
+        # this long before a gcDelete op removes it everywhere (the
+        # reference ages by wall clock; seq distance is deterministic).
+        self.gc_sweep_after_ops = 64
+
+    # ------------------------------------------------------------------- blobs
+    def _upload_blob_to_storage(self, content: str) -> str:
+        if self._document is None:
+            raise RuntimeError("blob upload requires a connected container")
+        return self._document.upload_blob(content)
+
+    def _read_blob_from_storage(self, blob_id: str) -> str:
+        if self._document is None:
+            raise RuntimeError("blob read requires a connected container")
+        return self._document.read_blob(blob_id)
+
+    def upload_blob(self, content: str) -> str:
+        """Upload an attachment blob; returns its ``blob:<id>`` handle
+        (store it in any DDS value to keep the blob referenced)."""
+        return self.blobs.create_blob(content)
+
+    def get_blob(self, handle: str) -> str:
+        return self.blobs.get_blob(handle)
 
     # -------------------------------------------------------------- datastores
-    def create_datastore(self, ds_id: str) -> DataStoreRuntime:
+    def create_datastore(self, ds_id: str, root: bool = True) -> DataStoreRuntime:
         if ds_id in self._datastores:
             raise ValueError(f"datastore {ds_id!r} already exists")
+        if ds_id in self.gc_state.tombstoned:
+            raise ValueError(f"datastore {ds_id!r} was deleted by GC")
 
         def submit(
             contents: dict, metadata: Any, internal: bool = False, _ds_id: str = ds_id
@@ -94,6 +131,7 @@ class ContainerRuntime:
             lambda: self.client_id,
             lambda: list(self._quorum),
             lambda: self.ref_seq,
+            root=root,
         )
         self._datastores[ds_id] = ds
         return ds
@@ -134,8 +172,15 @@ class ContainerRuntime:
         summaries don't emit handles into snapshots predating them."""
         op = inner["runtimeOp"]
         if op == "attachDataStore":
+            if inner["id"] in self.gc_state.tombstoned:
+                # A stale client (pre-sweep snapshot) re-attaching a swept
+                # datastore must not poison every replica: drop the op
+                # (tombstones win; ref GC tombstone enforcement).
+                return
             if inner["id"] not in self._datastores:
-                self.create_datastore(inner["id"]).load(inner["structure"])
+                self.create_datastore(
+                    inner["id"], root=inner["structure"].get("root", True)
+                ).load(inner["structure"])
             ds = self._datastores[inner["id"]]
             for cid in ds.channels:
                 ds.changed_seqs[cid] = max(ds.changed_seqs.get(cid, 0), seq)
@@ -146,8 +191,24 @@ class ContainerRuntime:
             ds.changed_seqs[inner["id"]] = max(
                 ds.changed_seqs.get(inner["id"], 0), seq
             )
+        elif op == "attachBlob":
+            self.blobs.on_attach(inner["id"])
+        elif op == "gcDelete":
+            # Sequenced sweep (ref GC sweep-ready op): every replica deletes
+            # the same nodes at the same point in the total order.
+            self._apply_gc_delete(inner["ids"])
         else:
             raise DataProcessingError(f"unknown runtime op {op!r}")
+
+    def _apply_gc_delete(self, node_keys: list[str]) -> None:
+        for key in node_keys:
+            kind, _, node_id = key.partition("/")
+            if kind == "ds":
+                self._datastores.pop(node_id, None)
+                self.gc_state.tombstoned.add(node_id)
+            elif kind == "blob":
+                self.blobs.delete(node_id)
+            self.gc_state.unreferenced_since.pop(key, None)
 
     def _handle_runtime_messages(self, env, run) -> None:
         for inner, _local, _md in run:
@@ -404,6 +465,23 @@ class ContainerRuntime:
         # counted only after duplicate-batch drops, so resubmitted ops that
         # never mutate state don't inflate the summarizer's trigger.
         self.ops_since_summary_ack += len(inbound)
+
+        # Outbound-reference detection (ref addedGCOutboundReference): any
+        # sequenced op carrying a handle string resets that node's
+        # unreferenced age — without this, a node re-referenced and
+        # re-unreferenced BETWEEN two GC runs would keep its stale age and
+        # sweep early.
+        if self.gc_state.unreferenced_since:
+            from .gc import scan_handles
+
+            ds_refs: set[str] = set()
+            blob_refs: set[str] = set()
+            for m in inbound:
+                scan_handles(m.contents, ds_refs, blob_refs)
+            for ref in ds_refs:
+                self.gc_state.unreferenced_since.pop(f"ds/{ref}", None)
+            for ref in blob_refs:
+                self.gc_state.unreferenced_since.pop(f"blob/{ref}", None)
         zipped: list[tuple[InboundRuntimeMessage, Any]] = []
         for m in inbound:
             md = self._psm.match_inbound(m.contents) if local else None
@@ -426,6 +504,10 @@ class ContainerRuntime:
                 lambda addr, run: (
                     self._handle_runtime_messages(env, run)
                     if addr == RUNTIME_ADDRESS
+                    else None
+                    if addr in self.gc_state.tombstoned
+                    # Tombstone drop (ref GC tombstone routing): ops from a
+                    # stale client to a swept datastore are discarded.
                     else self._datastores[addr].process_messages(env, run)
                 ),
             )
@@ -475,6 +557,30 @@ class ContainerRuntime:
         self._inflight_proposals.append({"type": mtype, "contents": contents})
         self._document.submit(self._outbox.mint_direct(mtype, contents, self.ref_seq))
 
+    # --------------------------------------------------------------------- gc
+    def run_gc(self) -> dict[str, Any]:
+        """One GC round (ref container-runtime/src/gc/): mark reachability
+        from root datastores through handle strings, age unreferenced
+        nodes, and submit a sequenced gcDelete op for sweep-ready ones.
+        Returns {"unreferenced": {...}, "swept": [...]}."""
+        from .gc import mark
+
+        result = mark(self)
+        self.gc_state.unreferenced_since = result.unreferenced
+        sweep_ready = [
+            key
+            for key, since in result.unreferenced.items()
+            if self.ref_seq - since >= self.gc_sweep_after_ops
+        ]
+        if sweep_ready and self._document is not None:
+            self._submit_datastore_op(
+                RUNTIME_ADDRESS,
+                {"runtimeOp": "gcDelete", "ids": sorted(sweep_ready)},
+                None,
+            )
+            self.flush()
+        return {"unreferenced": dict(result.unreferenced), "swept": sweep_ready}
+
     # -------------------------------------------------------------- checkpoint
     def summarize(self) -> dict[str, Any]:
         """Runtime state checkpoint: quorum short-id table + every datastore
@@ -485,6 +591,8 @@ class ContainerRuntime:
             "minSeq": self.min_seq,
             "quorum": dict(self._quorum),
             "datastores": {k: ds.summarize() for k, ds in self._datastores.items()},
+            "blobs": self.blobs.summarize(),
+            "gc": self.gc_state.to_json(),
         }
 
     def load_snapshot(self, summary: dict[str, Any]) -> None:
@@ -492,10 +600,14 @@ class ContainerRuntime:
         called before any datastore creation or op processing."""
         if self._datastores or self.ref_seq != 0:
             raise RuntimeError("load_snapshot on a non-fresh runtime")
+        from .gc import GCState
+
         self.last_summary_ref_seq = summary["seq"]
         self.ref_seq = summary["seq"]
         self.min_seq = summary.get("minSeq", 0)
         self._quorum = dict(summary["quorum"])
+        self.blobs.load(summary.get("blobs", {}))
+        self.gc_state = GCState.from_json(summary.get("gc", {}))
         for ds_id, ds_summary in summary["datastores"].items():
             self.create_datastore(ds_id).load(ds_summary)
 
@@ -516,6 +628,8 @@ class ContainerRuntime:
                 "seq": blob(self.ref_seq),
                 "minSeq": blob(self.min_seq),
                 "quorum": blob(dict(self._quorum)),
+                "blobs": blob(self.blobs.summarize()),
+                "gc": blob(self.gc_state.to_json()),
                 "datastores": tree(
                     {
                         ds_id: ds.summary_tree(

@@ -36,8 +36,13 @@ class DataStoreRuntime:
         client_id_fn: Callable[[], str],
         members_fn: Callable[[], list[str]] | None = None,
         ref_seq_fn: Callable[[], int] | None = None,
+        root: bool = True,
     ) -> None:
         self.id = ds_id
+        # GC roots are always reachable; non-root (dynamically created)
+        # stores survive only while a handle to them exists (ref aliased/
+        # root datastores vs handle-reachable ones, container-runtime gc).
+        self.is_root = root
         self._registry = registry
         self._submit = submit_fn
         self._quorum = quorum_fn
@@ -154,6 +159,7 @@ class DataStoreRuntime:
     # ------------------------------------------------------------ checkpoint
     def summarize(self) -> dict[str, Any]:
         return {
+            "root": self.is_root,
             "channels": {
                 cid: {"type": ch.channel_type, "summary": ch.summarize()}
                 for cid, ch in self._channels.items()
@@ -161,6 +167,7 @@ class DataStoreRuntime:
         }
 
     def load(self, summary: dict[str, Any]) -> None:
+        self.is_root = summary.get("root", True)
         for cid, entry in summary["channels"].items():
             # _create_channel: snapshot-loaded channels are covered by that
             # snapshot, not dirty.
@@ -189,6 +196,7 @@ class DataStoreRuntime:
     def structure_summary(self) -> dict[str, Any]:
         """Layout-only summary: channel ids + types, no state."""
         return {
+            "root": self.is_root,
             "channels": {
                 cid: {"type": ch.channel_type, "summary": None}
                 for cid, ch in self._channels.items()

@@ -40,6 +40,9 @@ class LocalDocument:
         # uploads the ISummaryTree to storage, then the op carries a handle).
         self._uploads: dict[str, dict] = {}
         self._upload_counter = 0
+        # Attachment blob store (historian blob analog): content-addressed,
+        # so identical uploads dedup to one id (ref blobManager.ts dedup).
+        self._blobs: dict[str, str] = {}
         # Optional riddler-analog token validation (server/auth.py); set via
         # LocalService.enable_auth.
         self.token_manager = None
@@ -160,6 +163,21 @@ class LocalDocument:
 
     def latest_snapshot(self) -> tuple[int, dict] | None:
         return self._snapshots[-1] if self._snapshots else None
+
+    # ------------------------------------------------------------------ blobs
+    def upload_blob(self, content: str) -> str:
+        """Content-addressed attachment blob upload; returns the blob id
+        (identical content dedups to the same id)."""
+        import hashlib
+
+        blob_id = hashlib.sha256(content.encode()).hexdigest()[:32]
+        self._blobs[blob_id] = content
+        return blob_id
+
+    def read_blob(self, blob_id: str) -> str:
+        if blob_id not in self._blobs:
+            raise KeyError(f"no blob {blob_id!r}")
+        return self._blobs[blob_id]
 
     @property
     def pending_count(self) -> int:

@@ -247,15 +247,29 @@ class _AlfredHandler(BaseHTTPRequestHandler):
         server: HttpFront = self.server.owner  # type: ignore[attr-defined]
         parts, q = self._route()
         with server.lock:
-            if len(parts) != 3 or parts[0] != "doc":
+            if (
+                parts[:1] != ["doc"]
+                or len(parts) < 3
+                or (len(parts) == 4 and parts[2] != "blob")
+                or len(parts) > 4
+            ):
                 self._json(404, {"error": "bad route"})
                 return
             doc = self._doc(server, parts[1])
             if doc is None:
                 return
-            if parts[2] == "deltas":
-                lo = int(q.get("from", ["1"])[0])
-                hi = int(q.get("to", ["0"])[0]) or 1 << 30
+            if len(parts) == 4:  # /doc/<id>/blob/<blobId>
+                try:
+                    self._json(200, {"content": doc.read_blob(parts[3])})
+                except KeyError:
+                    self._json(404, {"error": "no such blob"})
+            elif parts[2] == "deltas":
+                try:
+                    lo = int(q.get("from", ["1"])[0])
+                    hi = int(q.get("to", ["0"])[0]) or 1 << 30
+                except ValueError:
+                    self._json(400, {"error": "non-numeric range"})
+                    return
                 ops = [seq_msg_to_dict(m) for m in doc.ops_range(lo, hi)]
                 self._json(200, {"ops": ops})
             elif parts[2] == "snapshot":
@@ -279,7 +293,15 @@ class _AlfredHandler(BaseHTTPRequestHandler):
     def do_PUT(self) -> None:  # noqa: N802
         server: HttpFront = self.server.owner  # type: ignore[attr-defined]
         parts, _q = self._route()
-        body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if not length:
+            self._json(400, {"error": "missing body"})
+            return
+        try:
+            body = json.loads(self.rfile.read(length))
+        except json.JSONDecodeError:
+            self._json(400, {"error": "bad json"})
+            return
         with server.lock:
             if len(parts) == 3 and parts[0] == "doc" and parts[2] == "snapshot":
                 doc = self._doc(server, parts[1], create=True)
@@ -302,6 +324,11 @@ class _AlfredHandler(BaseHTTPRequestHandler):
                     return
                 handle = doc.upload_summary(body["tree"])
                 self._json(200, {"handle": handle})
+            elif len(parts) == 3 and parts[0] == "doc" and parts[2] == "blob":
+                doc = self._doc(server, parts[1], create=True)
+                if doc is None:
+                    return
+                self._json(200, {"id": doc.upload_blob(body["content"])})
             else:
                 self._json(404, {"error": "bad route"})
 

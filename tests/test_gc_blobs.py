@@ -1,0 +1,223 @@
+"""BlobManager + GC: attachment blobs round-trip through storage and
+summaries; unreferenced datastores/blobs age and are swept everywhere via a
+sequenced delete (ref blobManager.ts:237, container-runtime/src/gc/)."""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+
+def mk(doc, cid, channels=("meta",)):
+    rt = ContainerRuntime(default_registry(), container_id=cid)
+    ds = rt.create_datastore("root")
+    for ch in channels:
+        ds.create_channel("sharedMap", ch)
+    rt.connect(doc, cid)
+    return rt
+
+
+def meta(rt):
+    return rt.datastore("root").get_channel("meta")
+
+
+def _fleet(n=2):
+    svc = LocalService()
+    doc = svc.document("d")
+    rts = [mk(doc, f"c{i}") for i in range(n)]
+    doc.process_all()
+    return svc, doc, rts
+
+
+# ------------------------------------------------------------------- blobs
+
+def test_blob_upload_dedup_and_remote_read():
+    svc, doc, (a, b) = _fleet()
+    h1 = a.upload_blob("big payload " * 10)
+    h2 = a.upload_blob("big payload " * 10)  # identical content dedups
+    assert h1 == h2
+    meta(a).set("attachment", h1)
+    a.flush()
+    doc.process_all()
+    assert meta(b).get("attachment") == h1
+    assert b.get_blob(h1) == "big payload " * 10
+
+
+def test_blob_survives_summary_round_trip():
+    svc, doc, (a, b) = _fleet()
+    h = a.upload_blob("artifact-bytes")
+    meta(a).set("file", h)
+    a.flush()
+    doc.process_all()
+
+    summary = a.summarize()
+    assert h.removeprefix("blob:") in summary["blobs"]["attached"]
+
+    late = ContainerRuntime(default_registry(), container_id="late")
+    late.load_snapshot(summary)
+    late.connect(doc, "late")
+    doc.process_all()
+    assert late.get_blob(meta(late).get("file")) == "artifact-bytes"
+
+
+def test_unattached_blob_read_rejected():
+    svc, doc, (a, _b) = _fleet()
+    with pytest.raises(KeyError):
+        a.get_blob("blob:deadbeef")
+
+
+# ---------------------------------------------------------------------- gc
+
+def _make_child(rt, doc):
+    """Dynamically create a non-root datastore and attach it."""
+    child = rt.create_datastore("child", root=False)
+    child.create_channel("sharedMap", "data")
+    rt.submit_datastore_attach("child")
+    rt.flush()
+    doc.process_all()
+    return child
+
+
+def _age(rt, doc, n):
+    """Advance the sequence number with filler ops."""
+    for i in range(n):
+        meta(rt).set("_filler", i)
+        rt.flush()
+    doc.process_all()
+
+
+def test_gc_deletes_unreferenced_datastore_everywhere():
+    svc, doc, (a, b) = _fleet()
+    for rt in (a, b):
+        rt.gc_sweep_after_ops = 3
+    _make_child(a, doc)
+    meta(a).set("childRef", "fluid:child")
+    a.flush()
+    doc.process_all()
+    assert "child" in b.datastores
+
+    # Referenced: GC finds nothing unreferenced.
+    assert a.run_gc()["unreferenced"] == {}
+
+    # Drop the only handle; the child starts aging.
+    meta(a).delete("childRef")
+    a.flush()
+    doc.process_all()
+    first = a.run_gc()
+    assert "ds/child" in first["unreferenced"]
+    assert first["swept"] == []
+
+    # Age past the sweep distance; the next GC round sweeps via a
+    # SEQUENCED delete, so every replica drops the datastore.
+    _age(a, doc, 4)
+    result = a.run_gc()
+    assert result["swept"] == ["ds/child"]
+    doc.process_all()
+    assert "child" not in a.datastores and "child" not in b.datastores
+    assert "child" in a.gc_state.tombstoned and "child" in b.gc_state.tombstoned
+
+    # The swept store is gone from summaries; a loading client never sees it.
+    late = ContainerRuntime(default_registry(), container_id="late")
+    late.load_snapshot(a.summarize())
+    late.connect(doc, "late")
+    doc.process_all()
+    assert "child" not in late.datastores
+    with pytest.raises(ValueError):
+        late.create_datastore("child")
+
+
+def test_rereference_before_sweep_rescues():
+    svc, doc, (a, b) = _fleet()
+    a.gc_sweep_after_ops = 2
+    _make_child(a, doc)
+    meta(a).set("childRef", "fluid:child")
+    a.flush()
+    doc.process_all()
+    meta(a).delete("childRef")
+    a.flush()
+    doc.process_all()
+    assert "ds/child" in a.run_gc()["unreferenced"]
+
+    # Re-reference: the node leaves the unreferenced set entirely.
+    meta(a).set("childRef", "fluid:child")
+    a.flush()
+    doc.process_all()
+    _age(a, doc, 4)
+    result = a.run_gc()
+    assert result["unreferenced"] == {} and result["swept"] == []
+    assert "child" in a.datastores
+
+
+def test_rereference_between_gc_runs_resets_age():
+    """A node re-referenced and re-unreferenced BETWEEN two GC runs must
+    restart its grace window: the sequenced op carrying the handle resets
+    the age (ref addedGCOutboundReference), so the stale first-unreferenced
+    timestamp cannot trigger an early sweep (review regression)."""
+    svc, doc, (a, b) = _fleet()
+    for rt in (a, b):
+        rt.gc_sweep_after_ops = 6
+    _make_child(a, doc)
+    meta(a).set("childRef", "fluid:child")
+    a.flush()
+    doc.process_all()
+    meta(a).delete("childRef")
+    a.flush()
+    doc.process_all()
+    first = a.run_gc()
+    assert "ds/child" in first["unreferenced"]
+
+    # Re-reference then re-unreference WITHOUT a GC run in between.
+    meta(a).set("childRef", "fluid:child")
+    a.flush()
+    doc.process_all()
+    meta(a).delete("childRef")
+    a.flush()
+    doc.process_all()
+    reref_seq = a.ref_seq
+
+    _age(a, doc, 3)  # stale age would now exceed the window; true age not
+    result = a.run_gc()
+    assert result["swept"] == [], "early sweep from stale unreferenced age"
+    assert result["unreferenced"]["ds/child"] >= reref_seq - 1
+    assert "child" in a.datastores and "child" in b.datastores
+
+
+def test_gc_sweeps_unreferenced_blob():
+    svc, doc, (a, b) = _fleet()
+    for rt in (a, b):
+        rt.gc_sweep_after_ops = 2
+    h = a.upload_blob("ephemeral")
+    meta(a).set("file", h)
+    a.flush()
+    doc.process_all()
+    assert a.run_gc()["unreferenced"] == {}
+
+    meta(a).delete("file")
+    a.flush()
+    doc.process_all()
+    a.run_gc()
+    _age(a, doc, 3)
+    result = a.run_gc()
+    blob_key = "blob/" + h.removeprefix("blob:")
+    assert blob_key in result["swept"]
+    doc.process_all()
+    # Deleted from the attached table on EVERY replica.
+    with pytest.raises(KeyError):
+        b.get_blob(h)
+    assert a.summarize()["blobs"]["attached"] == []
+
+
+def test_handle_reference_through_nested_values():
+    """Handles buried in nested JSON values still count as references."""
+    svc, doc, (a, b) = _fleet()
+    a.gc_sweep_after_ops = 1
+    _make_child(a, doc)
+    meta(a).set("config", {"refs": [{"target": "fluid:child"}]})
+    a.flush()
+    doc.process_all()
+    _age(a, doc, 3)
+    assert a.run_gc()["unreferenced"] == {}
+    assert "child" in a.datastores
