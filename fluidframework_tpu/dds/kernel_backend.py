@@ -532,6 +532,9 @@ class KernelMergeTree:
             self.state = mk.drop_squashed(self.state)
 
         out: list[tuple[int, dict]] = []
+        # Split removes shift later pieces left by what earlier pieces
+        # removed (see mergetree_ref.regenerate_pending).
+        removed_before = 0
         for kind, pos1, pos2, payload, uids in plans:
             fresh = new_local_seq()
             fresh_key = LOCAL_BASE + fresh
@@ -541,7 +544,11 @@ class KernelMergeTree:
                 out.append((fresh, {"type": 0, "pos1": pos1, "seg": payload}))
             elif kind == 1:
                 self._restamp(uids, key, fresh_key, new_client, "rem")
-                out.append((fresh, {"type": 1, "pos1": pos1, "pos2": pos2}))
+                out.append(
+                    (fresh, {"type": 1, "pos1": pos1 - removed_before,
+                             "pos2": pos2 - removed_before})
+                )
+                removed_before += pos2 - pos1
             else:
                 self._restamp(uids, key, fresh_key, None, "prop")
                 out.append(

@@ -758,6 +758,11 @@ class RefMergeTree:
             ]
 
         out: list[tuple[int, dict]] = []
+        # A remove split into several re-minted ops: the receiver applies
+        # them SEQUENTIALLY, and each later op's perspective includes its
+        # earlier siblings (same client), so later pieces must shift left by
+        # the length the earlier pieces already removed.
+        removed_before = 0
         for kind, pos1, pos2, payload, segs in plans:
             fresh = new_local_seq()
             fresh_key = encode_stamp(-1, fresh)
@@ -777,7 +782,11 @@ class RefMergeTree:
                          new_client if new_client is not None and k == key else c)
                         for k, c in s.removes
                     )
-                out.append((fresh, {"type": 1, "pos1": pos1, "pos2": pos2}))
+                out.append(
+                    (fresh, {"type": 1, "pos1": pos1 - removed_before,
+                             "pos2": pos2 - removed_before})
+                )
+                removed_before += pos2 - pos1
             else:
                 for s in segs:
                     for p, (v, k) in list(s.props.items()):

@@ -174,13 +174,17 @@ class DeltaManager:
     def submit(self, wire: Any) -> None:
         conn = self.connection_manager.connection
         if conn is None or not conn.connected:
-            raise RuntimeError("submit while disconnected")
+            from ..driver.definitions import DriverError
+
+            raise DriverError("submit while disconnected")
         conn.submit(wire)
 
     def submit_signal(self, content: Any) -> None:
         conn = self.connection_manager.connection
         if conn is None or not conn.connected:
-            raise RuntimeError("signal while disconnected")
+            from ..driver.definitions import DriverError
+
+            raise DriverError("signal while disconnected")
         conn.submit_signal(content)
 
     # Attachment blob passthroughs (the runtime's BlobManager talks to its

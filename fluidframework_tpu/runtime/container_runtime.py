@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Any
 
+from ..driver.definitions import DriverError
 from ..protocol.messages import MessageType, Nack, SequencedMessage
 from .channel import MessageEnvelope, bunch_contiguous
 from .datastore import DataStoreRuntime
@@ -250,10 +251,7 @@ class ContainerRuntime:
             # Not connected — or connected but our join hasn't sequenced yet
             # (the reference holds outbound until connected): park staged
             # messages as unsent pending state; they replay on join.
-            self._detached_counter += 1
-            batch = self._outbox.park(f"unsent_{self.id}_{self._detached_counter}")
-            if batch is not None:
-                self._psm.on_flush_batch(batch.messages, batch.batch_id, client_id="")
+            self._park_outbox(keep_outbox=True)
             return
         batch = self._outbox.flush(self.ref_seq)
         if batch is None:
@@ -262,7 +260,16 @@ class ContainerRuntime:
         for wire in batch.wire_messages:
             if self._document is None:
                 break  # a nack mid-batch dropped the connection
-            self._document.submit(wire)
+            try:
+                self._document.submit(wire)
+            except DriverError:
+                # A failed send invalidates the connection (the reference
+                # treats socket submit errors as disconnects).  The batch is
+                # already pending under this identity, so reconnect replay
+                # re-sends whatever never arrived; sending the REST of the
+                # batch now would tear the batch's atomicity.
+                self._drop_connection()
+                break
 
     def rollback_staged(self) -> None:
         """Undo every staged-but-unflushed local op, newest first (ref
@@ -321,14 +328,36 @@ class ContainerRuntime:
     def disconnect(self) -> None:
         if self._document is None:
             return
-        self.flush()  # anything staged rides out before the leave
+        try:
+            self.flush()  # anything staged rides out before the leave
+        except DriverError:
+            # The connection may already be dead (unclean drop — network
+            # fault, injected disconnect): staged ops stay in the outbox and
+            # park as pending on the next connect instead of crashing the
+            # teardown.
+            pass
         if self._document is None:
             return  # the flush was nacked; _on_nack already dropped the link
         self._document.disconnect(self.client_id)
         self._document = None
-        self._outbox = None
+        self._park_outbox()
         self.joined = False
         self._reject_inflight_proposals()
+
+    def _park_outbox(self, keep_outbox: bool = False) -> None:
+        """Staged-but-unflushed ops must survive losing the connection: park
+        them as pending (client_id "") so the next connect replays them —
+        dropping the outbox would orphan the channels' optimistic state
+        (their pending bookkeeping has no ack coming).  ``keep_outbox``
+        retains the (drained) outbox for continued staging — the
+        disconnected-flush path, where the connection identity persists."""
+        if self._outbox is not None and not self._outbox.is_empty:
+            self._detached_counter += 1
+            batch = self._outbox.park(f"unsent_{self.id}_{self._detached_counter}")
+            if batch is not None:
+                self._psm.on_flush_batch(batch.messages, batch.batch_id, client_id="")
+        if not keep_outbox:
+            self._outbox = None
 
     def close(self, error: Exception | None = None) -> None:
         """Terminal: detach from the document and refuse further work (ref
@@ -336,21 +365,27 @@ class ContainerRuntime:
         if self._document is not None:
             self._document.disconnect(self.client_id)
             self._document = None
-        self._outbox = None
+        self._park_outbox()  # keeps the stash (get_pending_local_state) whole
         self.joined = False
         self.closed = True
         self.close_error = error
+        self._reject_inflight_proposals()
+
+    def _drop_connection(self) -> None:
+        """Sever the document link after a connection-fatal failure: staged
+        ops park as pending, in-flight proposals reject, the host reconnects."""
+        if self._document is not None:
+            self._document.disconnect(self.client_id)
+            self._document = None
+        self._park_outbox()
+        self.joined = False
         self._reject_inflight_proposals()
 
     def _on_nack(self, nack: Nack) -> None:
         """A nack invalidates the connection: drop it and let the host
         reconnect (ref ConnectionManager reconnect-on-nack)."""
         if self._document is not None:
-            self._document.disconnect(self.client_id)
-            self._document = None
-            self._outbox = None
-            self.joined = False
-            self._reject_inflight_proposals()
+            self._drop_connection()
 
     def _reject_inflight_proposals(self) -> None:
         """A dropped connection cannot sequence what it had in flight:
@@ -517,9 +552,17 @@ class ContainerRuntime:
     # --------------------------------------------------------------- reconnect
     def _replay_pending(self) -> None:
         """Resubmit everything still pending, under the current identity but
-        with original batch ids (ref replayPendingStates)."""
+        with original batch ids (ref replayPendingStates).  A send failure
+        mid-replay drops the connection; groups not yet re-staged go back
+        into the pending set untouched so the NEXT reconnect replays them
+        (take_pending_for_replay removed them up front)."""
         groups = self._psm.take_pending_for_replay()
-        for group in groups:
+        for gi, group in enumerate(groups):
+            if self._document is None:
+                # Connection died mid-replay: restore the untouched tail
+                # verbatim for the next reconnect's replay.
+                self._psm.restore([p for later in groups[gi:] for p in later])
+                return
             for p in group:
                 if p.contents["address"] == RUNTIME_ADDRESS:
                     # Attach ops resubmit verbatim (position-free).
@@ -537,7 +580,14 @@ class ContainerRuntime:
             for wire in batch.wire_messages:
                 if self._document is None:
                     break
-                self._document.submit(wire)
+                try:
+                    self._document.submit(wire)
+                except DriverError:
+                    # Same policy as flush(): a failed send invalidates the
+                    # connection; this group is already pending under the
+                    # current identity, so the next replay re-sends it.
+                    self._drop_connection()
+                    break
 
     # ---------------------------------------------------------------- protocol
     def submit_protocol_message(self, mtype: str, contents: Any) -> None:

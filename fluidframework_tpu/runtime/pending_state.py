@@ -82,6 +82,11 @@ class PendingStateManager:
         return head.local_metadata
 
     # ------------------------------------------------------------- reconnect
+    def restore(self, messages: list[PendingMessage]) -> None:
+        """Put taken-but-not-replayed messages back verbatim (a replay
+        aborted by a connection failure re-stages the untouched tail)."""
+        self._pending.extend(messages)
+
     def take_pending_for_replay(self) -> list[list[PendingMessage]]:
         """Remove and return all pending messages grouped by original batch
         (order preserved); the caller re-stages each group through channel

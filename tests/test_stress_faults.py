@@ -1,0 +1,252 @@
+"""Stress under fault injection: randomized schedules of edits + injected
+nacks/errors/disconnects over full loader stacks, randomized runtime
+options per seed (ref test-service-load runner + optionsMatrix), asserting
+fleet convergence after recovery every time."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.driver.definitions import DriverError
+from fluidframework_tpu.driver.fault_injection import (
+    FaultInjectionDocumentServiceFactory,
+)
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.server import LocalService
+
+
+def string_of(c):
+    return c.runtime.datastore("root").get_channel("text")
+
+
+def map_of(c):
+    return c.runtime.datastore("root").get_channel("meta")
+
+
+def _boot(factory):
+    d = Container.create_detached(default_registry(), container_id="creator")
+    ds = d.runtime.create_datastore("root")
+    ds.create_channel("sharedString", "text")
+    ds.create_channel("sharedMap", "meta")
+    d.attach("doc", factory, "creator")
+    return d
+
+
+def _safe_flush(c):
+    try:
+        c.runtime.flush()
+    except (DriverError, RuntimeError):
+        pass  # injected failure: pending ops replay on reconnect
+
+
+def run_stress(
+    seed: int, steps: int = 80, n_clients: int = 3, trace: list | None = None,
+    replay: list | None = None,
+) -> None:
+    """Randomized stress run; ``trace`` records every EXECUTED action (for
+    shrinking) and ``replay`` executes a recorded list verbatim."""
+    rng = random.Random(seed)
+    svc = LocalService()
+    factory = FaultInjectionDocumentServiceFactory(LocalDocumentServiceFactory(svc))
+    clients = [_boot(factory)]
+    svc.process_all()
+    for i in range(1, n_clients):
+        clients.append(
+            Container.load("doc", factory, default_registry(), f"c{i}")
+        )
+    svc.process_all()
+
+    # Randomized options (ref optionsMatrix): every seed stresses a
+    # different mix of failure rates and edit pressure.
+    w_edit = rng.uniform(4, 10)
+    w_fault = rng.uniform(0.5, 3)
+    faults_injected = 0
+
+    def record(action: list) -> None:
+        if trace is not None:
+            trace.append(action)
+
+    def execute(action: list) -> None:
+        nonlocal faults_injected
+        kind = action[0]
+        if kind == "ins":
+            _ci, pos, chs = action[1], action[2], action[3]
+            string_of(clients[_ci]).insert_text(min(pos, len(string_of(clients[_ci]).text)), chs)
+        elif kind == "rm":
+            _ci, p = action[1], action[2]
+            n = len(string_of(clients[_ci]).text)
+            if p < n:
+                string_of(clients[_ci]).remove_range(p, min(n, p + 2))
+        elif kind == "set":
+            map_of(clients[action[1]]).set(action[2], action[3])
+        elif kind == "flush":
+            c = clients[action[1]]
+            if c.connected:
+                _safe_flush(c)
+        elif kind == "deliver":
+            svc.process_all()
+        elif kind == "fault":
+            live = factory.live()
+            if live:
+                victim = live[action[2] % len(live)]
+                which = action[1]
+                faults_injected += 1
+                if which == "nack":
+                    victim.inject_nack()
+                elif which == "error":
+                    victim.inject_error()
+                else:
+                    victim.inject_disconnect()
+        elif kind == "reconnect":
+            for cl in clients:
+                if not cl.connected and not cl.runtime.closed:
+                    cl.reconnect()
+            svc.process_all()
+
+    if replay is not None:
+        for action in replay:
+            execute(action)
+    else:
+        for _step in range(steps):
+            kind = rng.choices(
+                ["edit", "flush", "deliver", "fault", "reconnect"],
+                [w_edit, 3, 3, w_fault, 2],
+            )[0]
+            ci = rng.randrange(len(clients))
+            c = clients[ci]
+            if kind == "edit":
+                if rng.random() < 0.6:
+                    n = len(string_of(c).text)
+                    if rng.random() < 0.7 or n == 0:
+                        action = ["ins", ci, rng.randint(0, n), rng.choice("abcxyz")]
+                    else:
+                        action = ["rm", ci, rng.randrange(n)]
+                else:
+                    action = ["set", ci, f"k{rng.randrange(5)}", rng.randrange(100)]
+            elif kind == "flush":
+                action = ["flush", ci]
+            elif kind == "deliver":
+                action = ["deliver"]
+            elif kind == "fault":
+                live = factory.live()
+                if not live:
+                    continue
+                action = [
+                    "fault",
+                    rng.choice(["nack", "error", "disconnect"]),
+                    live.index(rng.choice(live)),
+                ]
+            else:
+                action = ["reconnect"]
+            record(action)
+            execute(action)
+
+    # Recovery epilogue: reconnect + flush until the fleet settles (a fault
+    # armed just before the epilogue can knock a client down again during
+    # the first settle pump).
+    for _round in range(6):
+        for cl in clients:
+            if not cl.connected and not cl.runtime.closed:
+                cl.reconnect()
+        svc.process_all()
+        for cl in clients:
+            if cl.connected:
+                _safe_flush(cl)
+        svc.process_all()
+        if all(cl.runtime.closed or (cl.connected and cl.joined) for cl in clients):
+            break
+    live = [cl for cl in clients if not cl.runtime.closed and cl.joined]
+    assert len(live) >= 2, "stress killed too many clients"
+    base_text = string_of(live[0]).text
+    base_map = map_of(live[0]).items()
+    for cl in live[1:]:
+        assert string_of(cl).text == base_text, f"seed {seed}: text diverged"
+        assert map_of(cl).items() == base_map, f"seed {seed}: map diverged"
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_stress_with_fault_injection(seed):
+    run_stress(seed)
+
+
+def test_injected_nack_tears_down_and_recovers():
+    svc = LocalService()
+    factory = FaultInjectionDocumentServiceFactory(LocalDocumentServiceFactory(svc))
+    d = _boot(factory)
+    svc.process_all()
+    string_of(d).insert_text(0, "hi")
+    d.runtime.flush()
+    svc.process_all()
+
+    factory.live()[-1].inject_nack()
+    assert not d.connected
+    d.reconnect()
+    svc.process_all()
+    string_of(d).insert_text(2, "!")
+    d.runtime.flush()
+    svc.process_all()
+    assert string_of(d).text == "hi!"
+
+
+def test_injected_error_drops_connection_and_replays():
+    """A failed send invalidates the connection (the reference treats
+    socket submit errors as disconnects); the flushed ops are pending and
+    replay on reconnect."""
+    svc = LocalService()
+    factory = FaultInjectionDocumentServiceFactory(LocalDocumentServiceFactory(svc))
+    d = _boot(factory)
+    svc.process_all()
+    string_of(d).insert_text(0, "x")
+    factory.live()[-1].inject_error()
+    d.runtime.flush()  # converted to a connection drop, not an exception
+    assert not d.connected
+    d.reconnect()
+    svc.process_all()
+    assert string_of(d).text == "x"
+
+
+def test_offline_remove_split_by_concurrent_insert_regenerates():
+    """A pending remove whose range an interleaved acked insert split must
+    regenerate as SEQUENTIALLY-consistent pieces: the receiver applies them
+    one by one under the sender's perspective, so later pieces shift left
+    by what the earlier pieces removed (found by the fault-injection
+    stress; pre-existing regeneration bug)."""
+    svc = LocalService()
+    factory = FaultInjectionDocumentServiceFactory(LocalDocumentServiceFactory(svc))
+    d = _boot(factory)
+    c2 = Container.load("doc", factory, default_registry(), "other")
+    svc.process_all()
+    string_of(d).insert_text(0, "cz")
+    d.runtime.flush()
+    svc.process_all()
+
+    # d goes offline holding a remove of [0,2) = "cz".
+    factory.live()[0].inject_disconnect()
+    string_of(d).remove_range(0, 2)
+    # Concurrent sequenced insert splits that range: "cz" -> "cxz".
+    string_of(c2).insert_text(1, "x")
+    c2.runtime.flush()
+    svc.process_all()
+    d.reconnect()
+    svc.process_all()
+    assert string_of(d).text == string_of(c2).text == "x"
+
+
+def test_injected_disconnect_replays_pending():
+    svc = LocalService()
+    factory = FaultInjectionDocumentServiceFactory(LocalDocumentServiceFactory(svc))
+    d = _boot(factory)
+    c2 = Container.load("doc", factory, default_registry(), "other")
+    svc.process_all()
+
+    string_of(d).insert_text(0, "offline")
+    factory.live()[0].inject_disconnect()
+    assert not d.connected
+    _safe_flush(d)  # parks as pending
+    d.reconnect()
+    svc.process_all()
+    assert string_of(c2).text == "offline"
