@@ -385,7 +385,50 @@ def bench_config3(args) -> dict:
     out["ingest_ops_per_sec"] = _string_ingest_rate(
         min(D, 128), rounds=16, writers=4
     )
+    native = _native_ingest_rate()
+    if native is not None:
+        out["native_ingest_ops_per_sec"] = native
     return out
+
+
+def _native_ingest_rate(n_ops: int = 200_000) -> float | None:
+    """Wire JSON-lines -> op tensors through the C++ encoder
+    (native/ingest.cpp) — the production byte-stream feed rate."""
+    from fluidframework_tpu.native.ingest_native import (
+        NativeIngestEncoder,
+        available,
+    )
+    from fluidframework_tpu.protocol.messages import MessageType, SequencedMessage
+
+    if not available():
+        return None
+    rng = np.random.default_rng(0)
+    lines = [
+        SequencedMessage(
+            seq=0, min_seq=0, ref_seq=0, client_id="w", client_seq=0,
+            type=MessageType.JOIN, contents={"clientId": "w", "short": 0},
+        ).to_json()
+    ]
+    length = 0
+    for i in range(n_ops):
+        pos = int(rng.integers(0, length + 1))
+        lines.append(
+            SequencedMessage(
+                seq=i + 1, min_seq=0, ref_seq=i, client_id="w", client_seq=i,
+                type=MessageType.OP,
+                contents={"type": 0, "pos1": pos, "seg": "abcd"},
+            ).to_json()
+        )
+        length += 4
+    data = ("\n".join(lines) + "\n").encode()
+    enc = NativeIngestEncoder(64, 4)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ops, _payloads = enc.encode(data)
+        best = min(best, time.perf_counter() - t0)
+    assert len(ops) == n_ops
+    return round(n_ops / best, 1)
 
 
 def bench_config2(args) -> dict:

@@ -64,8 +64,12 @@ class _DocHost:
     # Property id -> kernel prop slot (interned per document).
     prop_slot: dict[int, int] = field(default_factory=dict)
     # Retained wire log (every OP message, in sequence order): the replay
-    # source for overflow recovery.
+    # source for overflow recovery.  Docs fed through the native byte path
+    # retain raw lines instead (mode is fixed per doc at first ingest).
     log: list[SequencedMessage] = field(default_factory=list)
+    raw_log: list[bytes] = field(default_factory=list)
+    native: object = None  # NativeIngestEncoder once the byte path is used
+    mode: str | None = None  # "obj" | "native", fixed at first ingest
 
 
 @dataclass
@@ -169,6 +173,12 @@ class DocBatchEngine:
         application is deferred to the next batched device step.
         """
         h = self.hosts[doc_idx]
+        assert h.mode != "native" or doc_idx in self.oracles or doc_idx in self.overflow, (
+            f"doc {doc_idx} already fed through the native byte path; "
+            "pick one ingest path per document"
+        )
+        if h.mode is None:
+            h.mode = "obj"
         if msg.type == MessageType.JOIN:
             h.quorum[msg.contents["clientId"]] = msg.contents["short"]
             h.min_seq = max(h.min_seq, msg.min_seq)
@@ -182,6 +192,7 @@ class DocBatchEngine:
             # another replay — no point retaining their log further.
             self._oracle_apply(self.oracles[doc_idx], h, msg)
             return
+
         if self.recovery != "off":
             # Replay source for overflow recovery.  Unbounded by design for
             # now: bounding it needs DDS-level checkpoints to replay from
@@ -197,6 +208,76 @@ class DocBatchEngine:
         for op, payload in self._encode(h, msg):
             h.queue.append(op)
             h.payloads.append(payload)
+
+    def ingest_lines(self, doc_idx: int, data: bytes) -> int:
+        """Stage newline-separated wire JSON through the NATIVE encoder
+        (native/ingest.cpp): the whole decode+encode runs in C++, so this is
+        the production feed path for a server-side fleet consuming the
+        broadcast stream.  Returns the number of op rows staged (op count
+        applied, for oracle-routed docs).  Falls back to the Python path
+        message by message when the native library is unavailable.  A
+        healthy document stays on whichever path fed it first (the two
+        paths intern property slots independently); recovery-lane routing
+        normalizes a native doc onto the object path."""
+        from ..native.ingest_native import NativeIngestEncoder, available
+
+        h = self.hosts[doc_idx]
+        in_lane = doc_idx in self.oracles or doc_idx in self.overflow
+        if in_lane or not available():
+            # Lanes (and the no-native fallback) consume parsed messages.
+            self._normalize_native(h)
+            before = len(h.queue)
+            n_msgs = 0
+            for line in data.split(b"\n"):
+                if line.strip():
+                    msg = SequencedMessage.from_json(line.decode())
+                    n_msgs += msg.type == MessageType.OP
+                    self.ingest(doc_idx, msg)
+            if doc_idx in self.oracles:
+                return n_msgs
+            lane = self.overflow.get(doc_idx)
+            return len(lane.queue) if lane else len(h.queue) - before
+        assert h.mode != "obj", (
+            f"doc {doc_idx} already fed through the object path; "
+            "pick one ingest path per document"
+        )
+        if h.native is None:
+            h.native = NativeIngestEncoder(
+                self.max_insert_len, self.geometry["prop_slots"]
+            )
+            h.mode = "native"
+        ops, payloads = h.native.encode(data)
+        if self.recovery != "off":
+            h.raw_log.append(data)
+        h.queue.extend(ops)
+        h.payloads.extend(payloads)
+        h.min_seq = max(h.min_seq, h.native.min_seq)
+        return len(ops)
+
+    def _normalize_native(self, h: _DocHost) -> None:
+        """Move a native-path doc onto the object path: parse the retained
+        raw lines into quorum + message log (PREPENDED — they precede
+        anything the object path appended later) so recovery replay, oracle
+        takeover, and further ingest share one consistent stream and one
+        prop-slot interning order."""
+        if not h.raw_log:
+            if h.mode == "native":
+                h.mode = "obj"
+                h.native = None
+            return
+        prefix: list[SequencedMessage] = []
+        for chunk in h.raw_log:
+            for line in chunk.split(b"\n"):
+                if line.strip():
+                    m = SequencedMessage.from_json(line.decode())
+                    if m.type == MessageType.JOIN:
+                        h.quorum[m.contents["clientId"]] = m.contents["short"]
+                    elif m.type == MessageType.OP:
+                        prefix.append(m)
+        h.raw_log.clear()
+        h.log[:0] = prefix
+        h.mode = "obj"
+        h.native = None
 
     def _encode(
         self, h: _DocHost, msg: SequencedMessage
@@ -368,6 +449,9 @@ class DocBatchEngine:
         return recovered
 
     def _recover_doc(self, d: int, bits: int, growths: int) -> None:
+        # Recovery works on the parsed-message log: fold a native doc's raw
+        # lines in first (ordering: they precede any object-path appends).
+        self._normalize_native(self.hosts[d])
         if bits == mk.ERR_POS_RANGE:
             # POS_RANGE alone (no capacity bit) means the op stream itself is
             # malformed.  Alongside a capacity bit it is usually a CASCADE —

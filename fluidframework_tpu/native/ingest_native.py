@@ -1,0 +1,109 @@
+"""ctypes binding for the native wire-ingest encoder (native/ingest.cpp).
+
+One ``NativeIngestEncoder`` per document: JSON-lines sequenced messages in,
+kernel op-row tensors out — the whole decode+encode path (JSON parse,
+quorum lookup, insert chunking, property interning) runs in C++, replacing
+the per-op Python that bounds the fleet's ingest rate.  Differentially
+tested against the Python path in tests/test_native_ingest.py.
+
+Build: ``native/libtpuingest.so`` compiles on demand with g++ if missing or
+stale (same scheme as the native sequencer; no pip/pybind11 dependencies).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_SRC = _REPO_ROOT / "native" / "ingest.cpp"
+_LIB = _REPO_ROOT / "native" / "libtpuingest.so"
+
+OP_FIELDS = 8
+
+_lib_cache: list = []
+
+
+def _ensure_built() -> ctypes.CDLL | None:
+    if _lib_cache:
+        return _lib_cache[0]
+    try:
+        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 "-o", str(_LIB), str(_SRC)],
+                check=True, capture_output=True,
+            )
+        lib = ctypes.CDLL(str(_LIB))
+    except (OSError, subprocess.CalledProcessError):
+        _lib_cache.append(None)
+        return None
+    lib.ing_create.restype = ctypes.c_void_p
+    lib.ing_create.argtypes = [ctypes.c_int32, ctypes.c_int32]
+    lib.ing_destroy.argtypes = [ctypes.c_void_p]
+    lib.ing_min_seq.restype = ctypes.c_int64
+    lib.ing_min_seq.argtypes = [ctypes.c_void_p]
+    lib.ing_last_error.restype = ctypes.c_char_p
+    lib.ing_last_error.argtypes = [ctypes.c_void_p]
+    lib.ing_encode.restype = ctypes.c_int32
+    lib.ing_encode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+    ]
+    _lib_cache.append(lib)
+    return lib
+
+
+def available() -> bool:
+    return _ensure_built() is not None
+
+
+class NativeIngestEncoder:
+    """Per-document native wire decoder (quorum + prop tables live in C++)."""
+
+    def __init__(self, max_insert_len: int = 64, prop_slots: int = 4) -> None:
+        lib = _ensure_built()
+        if lib is None:
+            raise RuntimeError("native ingest encoder unavailable (g++ build failed)")
+        self._lib = lib
+        self.max_insert_len = max_insert_len
+        self._h = lib.ing_create(max_insert_len, prop_slots)
+
+    def __del__(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.ing_destroy(self._h)
+            self._h = None
+
+    @property
+    def min_seq(self) -> int:
+        return int(self._lib.ing_min_seq(self._h))
+
+    def encode(self, data: bytes, max_rows: int = 0) -> tuple[np.ndarray, np.ndarray]:
+        """Newline-separated JSON messages -> (ops[M, 8], payloads[M, L])."""
+        if max_rows <= 0:
+            # Every line yields at most a handful of rows; newline count is a
+            # safe starting capacity, doubled on overflow.
+            max_rows = max(16, 2 * (data.count(b"\n") + 1))
+        while True:
+            # np.empty is safe: the encoder writes every field of each row
+            # it returns (payload rows are memset before use).
+            ops = np.empty((max_rows, OP_FIELDS), np.int32)
+            payloads = np.empty((max_rows, self.max_insert_len), np.int32)
+            n = self._lib.ing_encode(
+                self._h, data, len(data),
+                ops.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                payloads.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                max_rows,
+            )
+            if n == -1:
+                raise ValueError(
+                    f"native ingest: {self._lib.ing_last_error(self._h).decode()}"
+                )
+            if n < -1:  # capacity exhausted mid-stream: grow and re-run
+                max_rows *= 2
+                continue
+            return ops[:n], payloads[:n]

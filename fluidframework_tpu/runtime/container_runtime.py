@@ -317,12 +317,10 @@ class ContainerRuntime:
 
     def _adopt_outbox(self, client_id: str) -> Outbox:
         """A fresh outbox for this connection; anything staged while
-        disconnected is flushed into pending first (it replays on join)."""
+        disconnected is parked as pending first (it replays on join)."""
         if self._outbox is not None and not self._outbox.is_empty:
             assert self._outbox.client_id == ""
-            self._detached_counter += 1
-            batch = self._outbox.park(f"unsent_{self.id}_{self._detached_counter}")
-            self._psm.on_flush_batch(batch.messages, batch.batch_id, client_id="")
+        self._park_outbox()
         return Outbox(client_id=client_id)
 
     def disconnect(self) -> None:

@@ -1,0 +1,144 @@
+"""Native wire-ingest encoder: byte-identical to the Python decode path.
+
+The C++ encoder (native/ingest.cpp) must produce exactly the op rows the
+Python ingest produces for the same wire stream — quorum resolution, insert
+chunk order, property interning, obliterate sidedness, MSN tracking — and
+the engine fed through ingest_lines must converge with one fed through
+ingest(), including through overflow recovery.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.models.doc_batch_engine import DocBatchEngine
+from fluidframework_tpu.native.ingest_native import NativeIngestEncoder, available
+
+from test_doc_batch_engine import drive_docs
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native ingest library failed to build"
+)
+
+
+def _wire_bytes(svc, doc_name) -> bytes:
+    return b"".join(
+        (m.to_json() + "\n").encode() for m in svc.document(doc_name).sequencer.log
+    )
+
+
+def test_rows_match_python_encoder_exactly():
+    svc, _texts = drive_docs(4, seed=3, rounds=4)
+    for d in range(4):
+        py = DocBatchEngine(1, max_insert_len=8, use_mesh=False, recovery="off")
+        for m in svc.document(f"doc{d}").sequencer.log:
+            py.ingest(0, m)
+        enc = NativeIngestEncoder(max_insert_len=8, prop_slots=4)
+        ops, payloads = enc.encode(_wire_bytes(svc, f"doc{d}"))
+        h = py.hosts[0]
+        assert len(ops) == len(h.queue), f"doc {d}: row count"
+        for i, (row, pay) in enumerate(zip(h.queue, h.payloads)):
+            assert np.array_equal(ops[i], row), f"doc {d} row {i}: {ops[i]} != {row}"
+            assert np.array_equal(payloads[i], pay), f"doc {d} payload {i}"
+        assert enc.min_seq == h.min_seq
+
+
+def test_engine_via_ingest_lines_converges():
+    n = 6
+    svc, expected = drive_docs(n, seed=9, rounds=4)
+    eng = DocBatchEngine(n, max_segments=256, text_capacity=4096,
+                         max_insert_len=8, ops_per_step=4, use_mesh=False)
+    for d in range(n):
+        eng.ingest_lines(d, _wire_bytes(svc, f"doc{d}"))
+    eng.step()
+    assert not eng.errors().any()
+    for d in range(n):
+        assert eng.text(d) == expected[d], f"doc {d} diverged"
+
+
+def test_ingest_lines_through_overflow_recovery():
+    """An under-provisioned doc fed through the native path must recover
+    via grow-and-replay (raw-line replay) and via oracle routing."""
+    svc, expected = drive_docs(2, seed=5, rounds=4)
+    for policy, lane in (("grow", "overflow"), ("oracle", "oracles")):
+        eng = DocBatchEngine(2, max_segments=8, text_capacity=4096,
+                             max_insert_len=8, ops_per_step=4,
+                             use_mesh=False, recovery=policy, max_growths=6)
+        for d in range(2):
+            eng.ingest_lines(d, _wire_bytes(svc, f"doc{d}"))
+        eng.step()
+        assert not eng.errors().any()
+        assert getattr(eng, lane), f"expected {lane} routing at S=8"
+        for d in range(2):
+            assert eng.text(d) == expected[d], f"{policy}: doc {d} diverged"
+
+
+def test_native_doc_keeps_serving_after_oracle_route():
+    """More wire bytes after a native-path doc routed to the oracle flow
+    through the recovery lane."""
+    from fluidframework_tpu.dds.shared_string import SharedString
+    from fluidframework_tpu.server.local_service import LocalService
+
+    svc = LocalService()
+    doc = svc.document("d")
+    a = SharedString(client_id="a")
+    doc.connect(a.client_id, a.process)
+    doc.process_all()
+    for _ in range(10):
+        a.insert_text(0, "ab")
+    for m in a.take_outbox():
+        doc.submit(m)
+    doc.process_all()
+
+    eng = DocBatchEngine(1, max_segments=4, max_insert_len=8, ops_per_step=4,
+                         use_mesh=False, recovery="oracle")
+    consumed = len(doc.sequencer.log)
+    eng.ingest_lines(0, _wire_bytes(svc, "d"))
+    eng.step()
+    assert 0 in eng.oracles
+
+    a.remove_range(0, 4)
+    for m in a.take_outbox():
+        doc.submit(m)
+    doc.process_all()
+    eng.ingest_lines(
+        0,
+        b"".join((m.to_json() + "\n").encode() for m in doc.sequencer.log[consumed:]),
+    )
+    eng.step()
+    assert eng.text(0) == a.text
+
+
+def test_mixed_path_rejected():
+    svc, _ = drive_docs(1, seed=1, rounds=1)
+    eng = DocBatchEngine(1, use_mesh=False)
+    log = svc.document("doc0").sequencer.log
+    eng.ingest(0, log[0])
+    with pytest.raises(AssertionError):
+        eng.ingest_lines(0, _wire_bytes(svc, "doc0"))
+
+
+def test_streaming_chunks_and_escapes():
+    """Feed the stream in arbitrary chunk boundaries of WHOLE lines and
+    exercise string escapes (unicode text through the wire)."""
+    from fluidframework_tpu.dds.shared_string import SharedString
+    from fluidframework_tpu.server.local_service import LocalService
+
+    svc = LocalService()
+    doc = svc.document("d")
+    a = SharedString(client_id="a")
+    doc.connect(a.client_id, a.process)
+    doc.process_all()
+    a.insert_text(0, 'héllo "wörld"\n\té✓')
+    a.insert_text(3, "中文🎈")
+    for m in a.take_outbox():
+        doc.submit(m)
+    doc.process_all()
+
+    eng = DocBatchEngine(1, use_mesh=False, max_insert_len=4)
+    for m in doc.sequencer.log:  # one chunk per line
+        eng.ingest_lines(0, (m.to_json() + "\n").encode())
+    eng.step()
+    assert not eng.errors().any()
+    assert eng.text(0) == a.text
