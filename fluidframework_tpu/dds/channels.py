@@ -10,11 +10,12 @@ and CRDT semantics are identical.
 
 from __future__ import annotations
 
+import json
 from typing import Any
 
 from ..protocol.stamps import ALL_ACKED, encode_stamp
 from .mergetree_ref import SIDE_AFTER, SIDE_BEFORE, RefMergeTree
-from .sequence_intervals import IntervalCollection, StringOpLog
+from .sequence_intervals import IntervalCollection, StringOpLog, transform_position
 from .shared_string import decode_obliterate_places as _decode_obliterate_places
 from ..runtime.channel import Channel, MessageCollection
 
@@ -32,12 +33,41 @@ def set_string_backend_factory(factory) -> None:
     _STRING_BACKEND_FACTORY = factory
 
 
+class LocalReference:
+    """A position that follows the text (ref merge-tree localReference.ts:232
+    LocalReferenceCollection): per-replica, NEVER replicated — cursor
+    anchors, selection endpoints.  SlideOnRemove semantics: removing the
+    containing range slides the reference to the range start.  Internally
+    anchored in converged coordinates and transformed by every sequenced
+    edit; ``position`` resolves into the local view (acked + own pending)."""
+
+    def __init__(self, channel: "SharedStringChannel", conv_pos: int) -> None:
+        self._channel = channel
+        self.conv = conv_pos
+        self.alive = True
+
+    @property
+    def position(self) -> int:
+        assert self.alive, "reference was removed"
+        return self._channel.backend.converged_to_local(self.conv)
+
+    def remove(self) -> None:
+        self.alive = False
+        self._channel._local_refs.discard(self)
+
+
 class SharedStringChannel(Channel):
     """SharedString over the channel boundary (ref SharedStringClass +
     merge-tree Client, sequence/src/sharedString.ts, merge-tree/src/client.ts).
 
     Local metadata per pending op: {"localSeq": n} — round-tripped by the
     container's PendingStateManager for ack zip and resubmit.
+
+    Properties are RICH (ref PropertiesManager: arbitrary keys and JSON
+    values): the channel interns keys/values to int ids for the columnar
+    backends and resolves them at every boundary (wire ops and summaries
+    carry raw values, so interning order never has to agree across
+    replicas).
     """
 
     channel_type = "sharedString"
@@ -58,6 +88,14 @@ class SharedStringChannel(Channel):
         # Converged-event listeners: (kind, pos, length, local_seq|None) per
         # sequenced edit, in converged coordinates (undo-redo range tracking).
         self._converged_listeners: list = []
+        # Local references (never replicated; converged coordinates).
+        self._local_refs: set[LocalReference] = set()
+        # Rich-property intern tables: key/value <-> int id (backends are
+        # int-columnar).  Replica-local; raw forms ride wire + summaries.
+        self._prop_ids: dict[str, int] = {}
+        self._prop_names: list[str] = []
+        self._val_ids: dict[str, int] = {}
+        self._val_raw: list[Any] = []
 
     # ------------------------------------------------------------ local edits
     def _next_local_seq(self) -> int:
@@ -127,17 +165,61 @@ class SharedStringChannel(Channel):
         )
         return ls
 
-    def annotate_range(self, pos1: int, pos2: int, prop: int, value: int) -> None:
+    # ------------------------------------------------------------- properties
+    def _prop_id(self, prop) -> int:
+        name = prop if isinstance(prop, str) else str(prop)
+        if name not in self._prop_ids:
+            self._prop_ids[name] = len(self._prop_names)
+            self._prop_names.append(name)
+        return self._prop_ids[name]
+
+    def _val_id(self, value) -> int:
+        key = json.dumps(value, sort_keys=True, separators=(",", ":"))
+        if key not in self._val_ids:
+            self._val_ids[key] = len(self._val_raw)
+            # Store the JSON-CANONICAL form, not the caller's object: a
+            # replica across a real wire sees the round-tripped value (tuple
+            # -> list, int dict keys -> str), and resolved views/summaries
+            # must agree byte for byte.
+            self._val_raw.append(json.loads(key))
+        return self._val_ids[key]
+
+    def annotate_range(self, pos1: int, pos2: int, prop, value) -> None:
+        """Annotate with an arbitrary key and JSON value (ref
+        annotateRange + PropertiesManager rich property maps)."""
         assert pos1 < pos2
         ls = self._next_local_seq()
         self.backend.apply_annotate(
-            pos1, pos2, prop, value,
+            pos1, pos2, self._prop_id(prop), self._val_id(value),
             encode_stamp(-1, ls), self.backend.local_client, ALL_ACKED,
         )
+        name = prop if isinstance(prop, str) else str(prop)
         self.submit_local_message(
-            {"type": 2, "pos1": pos1, "pos2": pos2, "props": {str(prop): value}},
+            {"type": 2, "pos1": pos1, "pos2": pos2, "props": {name: value}},
             {"localSeq": ls},
         )
+
+    def annotations(self) -> list[dict]:
+        """Per local-view character: resolved {key: value} property maps."""
+        out = []
+        for d in self.backend.annotations(
+            ALL_ACKED, self.backend.local_client
+        ):
+            out.append(
+                {self._prop_names[p]: self._val_raw[v] for p, v in d.items()}
+            )
+        return out
+
+    # ------------------------------------------------------- local references
+    def create_local_reference(self, pos: int) -> LocalReference:
+        """Anchor a reference at local-view position ``pos`` (ref
+        createLocalReferencePosition, SlideOnRemove)."""
+        conv = self.backend.converged_position(
+            pos, ALL_ACKED, self.backend.local_client
+        )
+        ref = LocalReference(self, conv)
+        self._local_refs.add(ref)
+        return ref
 
     # ------------------------------------------------------------- intervals
     def get_interval_collection(self, label: str) -> IntervalCollection:
@@ -180,6 +262,8 @@ class SharedStringChannel(Channel):
             self._op_log.record(seq, kind, pos, length)
             for coll in self._collections.values():
                 coll.transform_endpoints(kind, pos, length)
+            for ref in self._local_refs:
+                ref.conv = transform_position(ref.conv, kind, pos, length)
             for listener in list(self._converged_listeners):
                 listener(kind, pos, length, local_seq)
 
@@ -217,7 +301,9 @@ class SharedStringChannel(Channel):
             elif c["type"] == 2:
                 for prop, value in c["props"].items():
                     self.backend.apply_annotate(
-                        c["pos1"], c["pos2"], int(prop), value, env.seq, sender, env.ref_seq
+                        c["pos1"], c["pos2"],
+                        self._prop_id(prop), self._val_id(value),
+                        env.seq, sender, env.ref_seq,
                     )
             elif c["type"] in (4, 5):
                 p1, s1, p2, s2 = _decode_obliterate_places(c)
@@ -265,6 +351,14 @@ class SharedStringChannel(Channel):
             local_metadata["localSeq"], self._next_local_seq, squash=squash
         )
         for fresh_ls, op in regenerated:
+            if op.get("type") == 2:
+                # The backend speaks interned ids; the wire carries raw
+                # property keys/values.
+                op = dict(op)
+                op["props"] = {
+                    self._prop_names[int(p)]: self._val_raw[v]
+                    for p, v in op["props"].items()
+                }
             self.submit_local_message(op, {"localSeq": fresh_ls})
 
     def apply_stashed(self, contents: Any) -> Any:
@@ -286,7 +380,9 @@ class SharedStringChannel(Channel):
         elif c["type"] == 2:
             for prop, value in c["props"].items():
                 self.backend.apply_annotate(
-                    c["pos1"], c["pos2"], int(prop), value, key, short, ALL_ACKED
+                    c["pos1"], c["pos2"],
+                    self._prop_id(prop), self._val_id(value),
+                    key, short, ALL_ACKED,
                 )
         elif c["type"] in (4, 5):
             p1, s1, p2, s2 = _decode_obliterate_places(c)
@@ -298,8 +394,15 @@ class SharedStringChannel(Channel):
     # ------------------------------------------------------------ checkpoint
     def summarize(self) -> dict[str, Any]:
         """Merge-tree snapshot (backend-owned; ref snapshotV1.ts:42) plus
-        the channel's interval collections and converged op log."""
+        the channel's interval collections and converged op log.  Interned
+        property ids resolve to their raw forms so summaries are identical
+        across replicas regardless of interning order."""
         out = self.backend.export_summary()
+        for seg in out["segments"]:
+            seg["props"] = {
+                self._prop_names[int(p)]: [self._val_raw[v], k]
+                for p, (v, k) in seg["props"].items()
+            }
         # Lazily-materialized empty collections are omitted so replicas
         # that never touched a label summarize identically.
         out["intervals"] = {
@@ -314,6 +417,17 @@ class SharedStringChannel(Channel):
         for label, data in summary.get("intervals", {}).items():
             self.get_interval_collection(label).load(data)
         self._op_log.load_json(summary.get("opLog", []))
+        summary = dict(summary)
+        summary["segments"] = [
+            {
+                **seg,
+                "props": {
+                    str(self._prop_id(p)): [self._val_id(v), k]
+                    for p, (v, k) in seg["props"].items()
+                },
+            }
+            for seg in summary["segments"]
+        ]
         self.backend.import_summary(summary)
 
     # ------------------------------------------------------------------ views

@@ -226,7 +226,8 @@ class DocBatchEngine:
         if in_lane or not available():
             # Lanes (and the no-native fallback) consume parsed messages.
             self._normalize_native(h)
-            before = len(h.queue)
+            lane = self.overflow.get(doc_idx)
+            before = len(lane.queue) if lane else len(h.queue)
             n_msgs = 0
             for line in data.split(b"\n"):
                 if line.strip():
@@ -236,7 +237,7 @@ class DocBatchEngine:
             if doc_idx in self.oracles:
                 return n_msgs
             lane = self.overflow.get(doc_idx)
-            return len(lane.queue) if lane else len(h.queue) - before
+            return (len(lane.queue) if lane else len(h.queue)) - before
         assert h.mode != "obj", (
             f"doc {doc_idx} already fed through the object path; "
             "pick one ingest path per document"

@@ -314,8 +314,12 @@ bool parse_contents(Scanner& s, Encoder& e, Contents* c) {
           if (!s.consume(':')) return false;
           double d;
           if (!parse_number(s, &d)) return false;
-          c->props.emplace_back(
-              strtoll(e.str_b.c_str(), nullptr, 10), (int64_t)d);
+          // Match the Python path's int(prop): a non-numeric key must error
+          // loudly, never collapse to id 0.
+          char* kend = nullptr;
+          int64_t pid = strtoll(e.str_b.c_str(), &kend, 10);
+          if (kend == e.str_b.c_str() || *kend != '\0') return false;
+          c->props.emplace_back(pid, (int64_t)d);
           if (s.consume(',')) continue;
           if (!s.consume('}')) return false;
           break;

@@ -54,8 +54,9 @@ def mixed_fleet():
 
 def mixed_check(a, b) -> None:
     assert a.text == b.text, f"text divergence: {a.text!r} != {b.text!r}"
-    ann_a = a.backend.annotations(view_client=a.backend.local_client)
-    ann_b = b.backend.annotations(view_client=b.backend.local_client)
+    # Resolved (raw-value) annotations: interned ids are replica-local.
+    ann_a = a.annotations()
+    ann_b = b.annotations()
     assert ann_a == ann_b, f"annotation divergence: {ann_a} != {ann_b}"
     ia = {iv.interval_id: (iv.start, iv.end) for iv in a.get_interval_collection("f")}
     ib = {iv.interval_id: (iv.start, iv.end) for iv in b.get_interval_collection("f")}

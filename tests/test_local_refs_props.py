@@ -1,0 +1,152 @@
+"""Local references + rich properties on the string channel, both backends.
+
+Local references (ref merge-tree localReference.ts:232): per-replica
+positions that follow the text through local and remote edits, sliding to
+the range start when their containing range is removed.
+
+Rich properties (ref PropertiesManager): arbitrary keys and JSON values,
+interned to int ids for the columnar backends; wire ops and summaries
+carry the raw forms, so replicas with different interning orders stay
+byte-identical where it matters.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+pytestmark = pytest.mark.usefixtures("string_backend")
+
+
+def _fleet(n=2):
+    svc = LocalService()
+    doc = svc.document("d")
+    rts = []
+    for i in range(n):
+        rt = ContainerRuntime(default_registry(), container_id=f"c{i}")
+        rt.create_datastore("root").create_channel("sharedString", "t")
+        rt.connect(doc, f"c{i}")
+        rts.append(rt)
+    doc.process_all()
+    return doc, rts
+
+
+def _ch(rt):
+    return rt.datastore("root").get_channel("t")
+
+
+def _sync(doc, rts):
+    for rt in rts:
+        rt.flush()
+    doc.process_all()
+
+
+# ------------------------------------------------------------ local references
+
+def test_reference_follows_remote_edits():
+    doc, (a, b) = _fleet()
+    _ch(a).insert_text(0, "hello world")
+    _sync(doc, (a, b))
+    ref = _ch(a).create_local_reference(6)  # before "world"
+    assert _ch(a).text[ref.position :].startswith("world")
+
+    _ch(b).insert_text(0, "XX ")
+    _sync(doc, (a, b))
+    assert _ch(a).text[ref.position :].startswith("world")
+
+    _ch(b).remove_range(0, 3)
+    _sync(doc, (a, b))
+    assert _ch(a).text[ref.position :].startswith("world")
+
+
+def test_reference_slides_on_containing_remove():
+    doc, (a, b) = _fleet()
+    _ch(a).insert_text(0, "abcdef")
+    _sync(doc, (a, b))
+    ref = _ch(a).create_local_reference(3)  # at "d"
+    _ch(b).remove_range(2, 5)  # removes "cde" containing the anchor
+    _sync(doc, (a, b))
+    assert _ch(a).text == "abf"
+    assert ref.position == 2  # slid to the removed range's start
+
+
+def test_reference_with_local_pending_edits():
+    doc, (a, b) = _fleet()
+    _ch(a).insert_text(0, "abcd")
+    _sync(doc, (a, b))
+    ref = _ch(a).create_local_reference(2)
+    _ch(a).insert_text(0, "zz")  # pending local edit shifts the local view
+    assert ref.position == 4
+    _sync(doc, (a, b))
+    assert ref.position == 4
+
+
+def test_reference_remove():
+    doc, (a, _b) = _fleet()
+    _ch(a).insert_text(0, "abc")
+    ref = _ch(a).create_local_reference(1)
+    ref.remove()
+    with pytest.raises(AssertionError):
+        _ = ref.position
+
+
+# ------------------------------------------------------------ rich properties
+
+def test_rich_values_converge_across_replicas():
+    doc, (a, b) = _fleet()
+    _ch(a).insert_text(0, "styled text")
+    _sync(doc, (a, b))
+    _ch(a).annotate_range(0, 6, "style", {"bold": True, "size": 12})
+    _ch(b).annotate_range(3, 9, "author", "user-b")
+    _sync(doc, (a, b))
+    assert _ch(a).annotations() == _ch(b).annotations()
+    ann = _ch(a).annotations()
+    assert ann[0] == {"style": {"bold": True, "size": 12}}
+    assert ann[4] == {"style": {"bold": True, "size": 12}, "author": "user-b"}
+    assert ann[8] == {"author": "user-b"}
+
+
+def test_rich_props_lww_and_summary_round_trip():
+    doc, (a, b) = _fleet()
+    _ch(a).insert_text(0, "abc")
+    _sync(doc, (a, b))
+    # Different interning orders on each replica: a interns "x" first, b
+    # interns "y" first — raw-form summaries must still agree.
+    _ch(a).annotate_range(0, 2, "x", [1, 2])
+    _sync(doc, (a, b))
+    _ch(b).annotate_range(1, 3, "y", None)
+    _ch(b).annotate_range(0, 1, "x", [9])  # later write wins
+    _sync(doc, (a, b))
+    sa, sb = _ch(a).summarize(), _ch(b).summarize()
+    assert sa == sb
+    assert _ch(a).annotations()[0] == {"x": [9]}
+
+    # A loading replica resolves the summarized raw forms.
+    rt = ContainerRuntime(default_registry(), container_id="late")
+    rt.create_datastore("root").create_channel("sharedString", "t")
+    rt.connect(doc, "late")
+    doc.process_all()
+    assert _ch(rt).annotations() == _ch(a).annotations()
+    # And keeps collaborating with rich values.
+    _ch(rt).annotate_range(0, 3, "style", {"em": True})
+    _sync(doc, (a, b, rt))
+    assert _ch(rt).annotations() == _ch(a).annotations() == _ch(b).annotations()
+
+
+def test_rich_props_survive_reconnect_regeneration():
+    doc, (a, b) = _fleet()
+    _ch(a).insert_text(0, "abcdef")
+    _sync(doc, (a, b))
+    _ch(a).annotate_range(1, 5, "mark", {"kind": "comment", "id": 7})
+    a.flush()
+    _ch(b).insert_text(3, "XY")  # concurrent: splits the annotate range
+    b.flush()
+    a.disconnect()
+    doc.process_all()
+    a.connect(doc, "c0.r1")
+    doc.process_all()
+    assert _ch(a).annotations() == _ch(b).annotations()
+    assert _ch(a).annotations()[1] == {"mark": {"kind": "comment", "id": 7}}

@@ -187,14 +187,13 @@ def test_interceptions_stamp_writes():
     fc.flush(); client.service.process_all()
     assert fc.initial_objects["meta"].get("k") == {"value": 42, "author": me}
 
-    AUTHOR_PROP = 7
     istr = InterceptedSharedString(
-        fc.initial_objects["text"], lambda: {AUTHOR_PROP: 99}
+        fc.initial_objects["text"], lambda: {"author": me}
     )
     istr.insert_text(0, "hi")
     fc.flush(); client.service.process_all()
-    annotations = fc.initial_objects["text"].backend.annotations()
-    assert all(a.get(AUTHOR_PROP) == 99 for a in annotations)
+    annotations = fc.initial_objects["text"].annotations()
+    assert all(a.get("author") == me for a in annotations)
 
 
 def test_oldest_client_observer():
