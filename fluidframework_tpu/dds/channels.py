@@ -15,7 +15,13 @@ from typing import Any
 
 from ..protocol.stamps import ALL_ACKED, encode_stamp
 from .mergetree_ref import SIDE_AFTER, SIDE_BEFORE, RefMergeTree
-from .sequence_intervals import IntervalCollection, StringOpLog, transform_position
+from .sequence_intervals import (
+    SENTINEL_POS,
+    IntervalCollection,
+    StringOpLog,
+    place_boundary,
+    transform_position,
+)
 from .shared_string import decode_obliterate_places as _decode_obliterate_places
 from ..runtime.channel import Channel, MessageCollection
 
@@ -222,12 +228,19 @@ class SharedStringChannel(Channel):
         return ref
 
     # ------------------------------------------------------------- intervals
+    def _converged_length(self) -> int:
+        from ..protocol.stamps import NON_COLLAB_CLIENT
+
+        return self.backend.visible_length(ALL_ACKED, NON_COLLAB_CLIENT)
+
     def get_interval_collection(self, label: str) -> IntervalCollection:
         """Named interval collection over this string (ref
-        sharedString.getIntervalCollection)."""
+        sharedString.getIntervalCollection). The collection's length_fn is
+        the LOCAL view (what the author sees when adding); converged-space
+        lengths are passed explicitly at sequencing time."""
         if label not in self._collections:
             self._collections[label] = IntervalCollection(
-                label, self._submit_interval_op
+                label, self._submit_interval_op, lambda: len(self.text)
             )
         return self._collections[label]
 
@@ -242,13 +255,27 @@ class SharedStringChannel(Channel):
         perspective (acked at its refSeq + its own prior ops, all sequenced
         by now thanks to per-client FIFO) — into converged coordinates, the
         space interval endpoints live in. Exact perspective walk, so no
-        positional drift between replicas (the merge-tree-reference analog)."""
+        positional drift between replicas (the merge-tree-reference analog).
+        Sided endpoints resolve their character position and keep the side;
+        the start/end sentinels (pos=-1) pass through untouched."""
         out = dict(op)
+        n = self._converged_length()
         for k in ("start", "end"):
-            if out.get(k) is not None:
-                out[k] = self.backend.converged_position(out[k], ref_seq, sender)
-        if out.get("end") is not None and out.get("start") is not None and out["end"] < out["start"]:
-            out["end"] = out["start"]
+            if out.get(k) is not None and out[k] != SENTINEL_POS:
+                out[k] = min(
+                    self.backend.converged_position(out[k], ref_seq, sender),
+                    max(n - 1, 0) if "startSide" in out or "endSide" in out else n,
+                )
+        if out.get("end") is not None and out.get("start") is not None:
+            if "startSide" in out or "endSide" in out:
+                ss = out.get("startSide", 0)
+                es = out.get("endSide", 0)
+                if place_boundary(out["start"], ss) > place_boundary(
+                    out["end"], es
+                ):
+                    out["end"], out["endSide"] = out["start"], ss
+            elif out["end"] < out["start"]:
+                out["end"] = out["start"]
         return out
 
     def _record_converged_events(
@@ -266,6 +293,12 @@ class SharedStringChannel(Channel):
                 ref.conv = transform_position(ref.conv, kind, pos, length)
             for listener in list(self._converged_listeners):
                 listener(kind, pos, length, local_seq)
+        # Sentinel-degrade/crossing cleanup is only meaningful (and the
+        # length query only paid) when sided intervals exist.
+        if ordered and any(c.has_sided() for c in self._collections.values()):
+            n = self._converged_length()
+            for coll in self._collections.values():
+                coll.finalize_op(n)
 
     # ---------------------------------------------------------------- inbound
     def process_messages(self, collection: MessageCollection) -> None:
@@ -337,11 +370,25 @@ class SharedStringChannel(Channel):
             # sequenced since it was authored, then resubmit fresh.
             op = dict(contents["op"])
             ref = local_metadata["intervalRef"]
-            for k in ("start", "end"):
-                if op.get(k) is not None:
+            sided = "startSide" in op or "endSide" in op
+            for k, sk in (("start", "startSide"), ("end", "endSide")):
+                if op.get(k) is None:
+                    continue
+                if sided:
+                    if op[k] != SENTINEL_POS:
+                        op[k], op[sk] = self._op_log.transform_place_from(
+                            op[k], op.get(sk, 0), ref
+                        )
+                else:
                     op[k] = self._op_log.transform_from(op[k], ref)
-            if op.get("start") is not None and op.get("end") is not None and op["end"] < op["start"]:
-                op["end"] = op["start"]
+            if op.get("start") is not None and op.get("end") is not None:
+                if sided:
+                    if place_boundary(op["start"], op.get("startSide", 0)) > \
+                            place_boundary(op["end"], op.get("endSide", 0)):
+                        op["end"] = op["start"]
+                        op["endSide"] = op.get("startSide", 0)
+                elif op["end"] < op["start"]:
+                    op["end"] = op["start"]
             self.submit_local_message(
                 {"type": 3, "label": contents["label"], "op": op},
                 {"intervalRef": self._connection.ref_seq()},

@@ -299,6 +299,9 @@ class KernelMergeTree:
         vc = self.local_client if view_client is None else view_client
         return mk.visible_text(self.state, ref_seq, vc)
 
+    def visible_length(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> int:
+        return len(self.visible_text(ref_seq, view_client))
+
     def annotations(self, ref_seq: int = ALL_ACKED, view_client: int | None = None):
         vc = self.local_client if view_client is None else view_client
         raw = mk.annotations(self.state, ref_seq, vc)

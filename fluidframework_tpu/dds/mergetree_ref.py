@@ -404,8 +404,17 @@ class RefMergeTree:
                 ins_concurrent = not has_occurred(
                     seg.ins_key, seg.ins_client, ref_seq, op_client
                 )
+                # The issuer swallowed this concurrent insert at INSERT time
+                # by appending its OLDEST covering pending obliterate (plus
+                # all acked stamps).  Our stamp therefore already exists on
+                # the issuer iff some same-client stamp came from an
+                # obliterate that was pending there when the insert arrived:
+                # sequenced after the insert, at or before this op
+                # (ins_seq < k <= op_key; == op_key is an earlier op of the
+                # same grouped batch, which shares our sequence number).
                 same_client_stamp = any(
-                    c == op_client and k < op_key for k, c in seg.removes
+                    c == op_client and seg.ins_key < k <= op_key
+                    for k, c in seg.removes
                 )
                 if (
                     has_acked_rem

@@ -561,8 +561,16 @@ def _do_obliterate(s: DocState, op, payload) -> DocState:
     # remove stamp from the same client already covers them (then the extra
     # stamp would be unobservable and the issuer never added it).
     ins_conc = ~((s.ins_key <= ref_seq) | (s.ins_client == client))
+    # The issuer swallowed a concurrent insert at INSERT time by appending
+    # its OLDEST covering pending obliterate; our stamp already exists there
+    # iff some same-client stamp came from an obliterate pending at the
+    # issuer when the insert arrived: ins_seq < k <= key (== key is an
+    # earlier op of the same grouped batch, sharing our sequence number).
     same_client_stamp = _any_tree(
-        [(c == client) & (k < key) for k, c in zip(s.rem_keys, s.rem_clients)]
+        [
+            (c == client) & (k > s.ins_key) & (k <= key)
+            for k, c in zip(s.rem_keys, s.rem_clients)
+        ]
     )
     visit = jnp.where(
         key >= LOCAL_BASE,

@@ -54,8 +54,9 @@ MAP_MODEL = DDSFuzzModel(name="sharedMap", channel_type="sharedMap",
 def string_generate(rng: random.Random, channel) -> dict | None:
     n = len(channel.text)
     kind = rng.choices(
-        ["insert", "remove", "annotate", "interval", "obliterate", "obliterate_sided"],
-        [8, 4, 2, 2, 2, 1],
+        ["insert", "remove", "annotate", "interval", "obliterate",
+         "obliterate_sided", "interval_sided"],
+        [8, 4, 2, 2, 2, 1, 2],
     )[0]
     if kind == "insert":
         return {"t": "insert", "pos": rng.randint(0, n),
@@ -80,6 +81,25 @@ def string_generate(rng: random.Random, channel) -> dict | None:
         p1 = rng.randrange(n)
         return {"t": "annotate", "p1": p1, "p2": rng.randint(p1 + 1, n),
                 "prop": rng.randrange(3), "val": rng.randrange(10)}
+    if kind == "interval_sided":
+        from fluidframework_tpu.dds.sequence_intervals import Side, place_boundary
+
+        def one_place():
+            r = rng.random()
+            if r < 0.1:
+                return "start"
+            if r < 0.2:
+                return "end"
+            return (rng.randrange(n), rng.choice((Side.BEFORE, Side.AFTER)))
+
+        from fluidframework_tpu.dds.sequence_intervals import normalize_place
+
+        p1, p2 = one_place(), one_place()
+        b1 = place_boundary(*normalize_place(p1))
+        b2 = place_boundary(*normalize_place(p2))
+        if b1 > b2:
+            p1, p2 = p2, p1
+        return {"t": "interval_sided", "p1": p1, "p2": p2}
     p1 = rng.randrange(n)
     return {"t": "interval", "p1": p1, "p2": rng.randint(p1, n - 1)}
 
@@ -95,6 +115,11 @@ def string_reduce(channel, op: dict) -> None:
         channel.obliterate_range_sided(tuple(op["p1"]), tuple(op["p2"]))
     elif op["t"] == "annotate":
         channel.annotate_range(op["p1"], op["p2"], op["prop"], op["val"])
+    elif op["t"] == "interval_sided":
+        def as_place(p):
+            return tuple(p) if isinstance(p, (list, tuple)) else p
+
+        channel.get_interval_collection("f").add(as_place(op["p1"]), as_place(op["p2"]))
     else:
         channel.get_interval_collection("f").add(op["p1"], op["p2"])
 
@@ -102,8 +127,10 @@ def string_reduce(channel, op: dict) -> None:
 def string_check(a, b) -> None:
     assert a.text == b.text, f"text divergence: {a.text!r} != {b.text!r}"
     assert a.summarize() == b.summarize()
-    ia = {iv.interval_id: (iv.start, iv.end) for iv in a.get_interval_collection("f")}
-    ib = {iv.interval_id: (iv.start, iv.end) for iv in b.get_interval_collection("f")}
+    ia = {iv.interval_id: (iv.start, iv.start_side, iv.end, iv.end_side)
+          for iv in a.get_interval_collection("f")}
+    ib = {iv.interval_id: (iv.start, iv.start_side, iv.end, iv.end_side)
+          for iv in b.get_interval_collection("f")}
     assert ia == ib, f"interval divergence: {ia} != {ib}"
 
 

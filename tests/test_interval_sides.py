@@ -1,0 +1,327 @@
+"""Sided interval endpoints and stickiness.
+
+Mirrors the reference's sided-interval suites (sequence
+intervalCollection with intervalStickinessEnabled: merge-tree
+sequencePlace.ts Side/normalizePlace, sequence intervals/intervalUtils.ts
+computeStickinessFromSide, sequenceInterval.ts slide-to-endpoint):
+- insert adjacency for every side combination (stickiness),
+- slide-on-remove direction per side, degrading to the start/end sentinels,
+- "start"/"end" literal endpoints,
+- convergence, summary round-trip, and reconnect resubmit with sides.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.dds.sequence_intervals import (
+    SENTINEL_POS,
+    IntervalStickiness,
+    Side,
+    compute_stickiness,
+    normalize_place,
+    transform_place,
+)
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+pytestmark = pytest.mark.usefixtures("string_backend")
+
+
+def make_container(doc, name: str, stash: str | None = None) -> ContainerRuntime:
+    c = ContainerRuntime(default_registry(), container_id=name)
+    ds = c.create_datastore("root")
+    ds.create_channel("sharedString", "text")
+    c.connect(doc, name, stash=stash)
+    return c
+
+
+def string_of(c):
+    return c.datastore("root").get_channel("text")
+
+
+def setup_pair():
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    b = make_container(doc, "B")
+    doc.process_all()
+    return svc, doc, a, b
+
+
+def seeded(doc, a, text="hello world"):
+    string_of(a).insert_text(0, text)
+    a.flush()
+    doc.process_all()
+
+
+def places(c, label="c1"):
+    coll = string_of(c).get_interval_collection(label)
+    return {
+        iv.interval_id: (iv.start, iv.start_side, iv.end, iv.end_side)
+        for iv in coll
+    }
+
+
+def covered(c, iid, label="c1"):
+    """The substring the interval covers in the (fully acked) text."""
+    s = string_of(c)
+    iv = s.get_interval_collection(label).get(iid)
+    n = len(s.text)
+    lo, hi = iv.first_char(n), iv.last_char(n)
+    return s.text[lo : hi + 1] if hi >= lo else ""
+
+
+# ---------------------------------------------------------------- unit level
+
+def test_normalize_and_stickiness():
+    assert normalize_place(5) == (5, Side.BEFORE)
+    assert normalize_place((5, Side.AFTER)) == (5, Side.AFTER)
+    assert normalize_place("start") == (SENTINEL_POS, Side.AFTER)
+    assert normalize_place("end") == (SENTINEL_POS, Side.BEFORE)
+    # ref intervalUtils.ts: START from an After start, END from a Before end.
+    assert compute_stickiness(Side.BEFORE, Side.AFTER) == IntervalStickiness.NONE
+    assert compute_stickiness(Side.AFTER, Side.AFTER) == IntervalStickiness.START
+    assert compute_stickiness(Side.BEFORE, Side.BEFORE) == IntervalStickiness.END
+    assert compute_stickiness(Side.AFTER, Side.BEFORE) == IntervalStickiness.FULL
+
+
+def test_transform_place_insert_and_remove():
+    # Anchors follow their character on insert.
+    assert transform_place(6, Side.BEFORE, "insert", 6, 3) == (9, Side.BEFORE)
+    assert transform_place(6, Side.AFTER, "insert", 7, 3) == (6, Side.AFTER)
+    # Remove: BEFORE slides forward, AFTER slides backward.
+    assert transform_place(6, Side.BEFORE, "remove", 4, 4) == (4, Side.BEFORE)
+    assert transform_place(6, Side.AFTER, "remove", 4, 4) == (3, Side.AFTER)
+    # Backward off the front: the "start" sentinel.
+    assert transform_place(2, Side.AFTER, "remove", 0, 5) == (
+        SENTINEL_POS, Side.AFTER,
+    )
+    # Sentinels never move.
+    assert transform_place(SENTINEL_POS, Side.BEFORE, "insert", 0, 9) == (
+        SENTINEL_POS, Side.BEFORE,
+    )
+
+
+# ------------------------------------------------------- stickiness (insert)
+
+def test_nonsticky_start_excludes_adjacent_insert():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a)  # "hello world"
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add((6, Side.BEFORE), (10, Side.AFTER))  # "world"
+    a.flush(); doc.process_all()
+    assert covered(a, iid) == "world"
+    string_of(b).insert_text(6, "big ")
+    b.flush(); doc.process_all()
+    assert covered(a, iid) == covered(b, iid) == "world"
+    assert places(a) == places(b) == {iid: (10, Side.BEFORE, 14, Side.AFTER)}
+
+
+def test_sticky_start_includes_adjacent_insert():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a)
+    ca = string_of(a).get_interval_collection("c1")
+    # Anchor after char 5 (' '): first char 6, START sticky.
+    iid = ca.add((5, Side.AFTER), (10, Side.AFTER))
+    a.flush(); doc.process_all()
+    assert covered(a, iid) == "world"
+    string_of(b).insert_text(6, "big ")
+    b.flush(); doc.process_all()
+    assert covered(a, iid) == covered(b, iid) == "big world"
+    assert places(a) == places(b) == {iid: (5, Side.AFTER, 14, Side.AFTER)}
+
+
+def test_sticky_end_includes_adjacent_insert():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a)
+    ca = string_of(a).get_interval_collection("c1")
+    # End before char 10 ('d'): last char 9, END sticky at that boundary.
+    iid = ca.add((6, Side.BEFORE), (10, Side.BEFORE))
+    a.flush(); doc.process_all()
+    assert covered(a, iid) == "worl"
+    string_of(b).insert_text(10, "XY")
+    b.flush(); doc.process_all()
+    assert covered(a, iid) == covered(b, iid) == "worlXY"
+    assert places(a) == places(b) == {iid: (6, Side.BEFORE, 12, Side.BEFORE)}
+
+
+def test_nonsticky_end_excludes_adjacent_insert():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a)
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add((6, Side.BEFORE), (10, Side.AFTER))  # includes 'd'
+    a.flush(); doc.process_all()
+    string_of(b).insert_text(11, "!!")
+    b.flush(); doc.process_all()
+    assert covered(a, iid) == covered(b, iid) == "world"
+    assert places(a) == places(b) == {iid: (6, Side.BEFORE, 10, Side.AFTER)}
+
+
+# --------------------------------------------------------- slide (on remove)
+
+def test_remove_slides_before_forward_and_after_backward():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a)  # "hello world"
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add((6, Side.BEFORE), (10, Side.AFTER))
+    a.flush(); doc.process_all()
+    # Remove "o wo": start char 6 dies -> slides forward to the survivor 'r'.
+    string_of(b).remove_range(4, 8)
+    b.flush(); doc.process_all()
+    assert string_of(a).text == "hellrld"
+    assert places(a) == places(b) == {iid: (4, Side.BEFORE, 6, Side.AFTER)}
+    assert covered(a, iid) == "rld"
+    # Remove "ld": end char dies -> slides backward to 'r'.
+    string_of(b).remove_range(5, 7)
+    b.flush(); doc.process_all()
+    assert string_of(a).text == "hellr"
+    assert places(a) == places(b) == {iid: (4, Side.BEFORE, 4, Side.AFTER)}
+    assert covered(a, iid) == "r"
+
+
+def test_remove_off_front_slides_to_start_sentinel():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a, "abcdef")
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add((1, Side.AFTER), (4, Side.AFTER))  # chars 2..4 "cde"
+    a.flush(); doc.process_all()
+    string_of(b).remove_range(0, 3)  # start anchor char 1 dies, nothing before
+    b.flush(); doc.process_all()
+    assert string_of(a).text == "def"
+    assert places(a) == places(b) == {
+        iid: (SENTINEL_POS, Side.AFTER, 1, Side.AFTER)
+    }
+    assert covered(a, iid) == "de"
+
+
+def test_remove_off_back_slides_to_end_sentinel():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a, "abcdef")
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add((2, Side.BEFORE), (4, Side.BEFORE))  # chars 2..3 "cd"
+    a.flush(); doc.process_all()
+    string_of(b).remove_range(3, 6)  # end anchor char 4 dies, no survivor after
+    b.flush(); doc.process_all()
+    assert string_of(a).text == "abc"
+    assert places(a) == places(b) == {
+        iid: (2, Side.BEFORE, SENTINEL_POS, Side.BEFORE)
+    }
+    assert covered(a, iid) == "c"
+    # END-sentinel end now sticks to appended text.
+    string_of(a).insert_text(3, "zz")
+    a.flush(); doc.process_all()
+    assert covered(a, iid) == covered(b, iid) == "czz"
+
+
+def test_crossed_endpoints_collapse_empty():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a, "abcdef")
+    ca = string_of(a).get_interval_collection("c1")
+    # start BEFORE 2, end AFTER 3; removing 2..5 slides start fwd to 2 (='f'
+    # post-remove) and end backward to 1 -> crossed -> empty at start place.
+    iid = ca.add((2, Side.BEFORE), (3, Side.AFTER))
+    a.flush(); doc.process_all()
+    string_of(b).remove_range(2, 5)
+    b.flush(); doc.process_all()
+    assert string_of(a).text == "abf"
+    pa = places(a)
+    assert pa == places(b)
+    assert covered(a, iid) == ""
+
+
+# --------------------------------------------------- "start"/"end" literals
+
+def test_start_end_literals_pin_whole_string():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a, "middle")
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add("start", "end")
+    a.flush(); doc.process_all()
+    assert covered(a, iid) == "middle"
+    string_of(b).insert_text(0, "<<")
+    string_of(b).insert_text(8, ">>")
+    b.flush(); doc.process_all()
+    assert covered(a, iid) == covered(b, iid) == "<<middle>>"
+    assert places(a) == places(b) == {
+        iid: (SENTINEL_POS, Side.AFTER, SENTINEL_POS, Side.BEFORE)
+    }
+
+
+# ------------------------------------------------ change / summary / stash
+
+def test_change_to_sided_endpoints():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a)
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add(0, 4)  # legacy
+    a.flush(); doc.process_all()
+    ca.change(iid, start=(5, Side.AFTER), end=(10, Side.BEFORE))
+    a.flush(); doc.process_all()
+    assert places(a) == places(b) == {iid: (5, Side.AFTER, 10, Side.BEFORE)}
+    assert string_of(b).get_interval_collection("c1").get(iid).stickiness \
+        == IntervalStickiness.FULL
+
+
+def test_sided_change_requires_both_endpoints_and_validates():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a)
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add(2, 5)
+    with pytest.raises(AssertionError):
+        ca.change(iid, start=(1, Side.AFTER))  # sided needs both endpoints
+    with pytest.raises(AssertionError):
+        ca.change(iid, start=(99, Side.BEFORE), end=(100, Side.AFTER))
+    # Valid sided change converts the interval; a later single-endpoint
+    # legacy change reverts it wholesale (never half-sided).
+    ca.change(iid, start=(1, Side.AFTER), end=(5, Side.BEFORE))
+    a.flush(); doc.process_all()
+    assert places(a) == places(b) == {iid: (1, Side.AFTER, 5, Side.BEFORE)}
+    ca.change(iid, start=2)
+    a.flush(); doc.process_all()
+    iv = string_of(b).get_interval_collection("c1").get(iid)
+    assert (iv.start_side, iv.end_side) == (None, None)
+    assert (iv.start, iv.end) == (2, 5)
+
+
+def test_summary_roundtrip_preserves_sides():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a)
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add((5, Side.AFTER), "end", {"k": 1})
+    a.flush(); doc.process_all()
+    summary = string_of(a).summarize()
+    from fluidframework_tpu.dds.channels import SharedStringChannel
+
+    fresh = SharedStringChannel("text")
+    fresh.load(summary)
+    got = {
+        iv.interval_id: (iv.start, iv.start_side, iv.end, iv.end_side)
+        for iv in fresh.get_interval_collection("c1")
+    }
+    assert got == {iid: (5, Side.AFTER, SENTINEL_POS, Side.BEFORE)}
+    assert fresh.get_interval_collection("c1").get(iid).props == {"k": 1}
+
+
+def test_reconnect_resubmits_sided_pending_op():
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a)  # "hello world"
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add((6, Side.BEFORE), (10, Side.AFTER))
+    a.flush()
+    # Not yet sequenced: A drops; B edits before A's op lands.
+    a.disconnect()
+    string_of(b).insert_text(0, ">> ")
+    b.flush(); doc.process_all()
+    a.connect(doc, "A2")
+    a.flush(); doc.process_all()
+    assert places(a) == places(b)
+    assert covered(a, iid) == covered(b, iid) == "world"
+
+
+def test_fuzz_sided_intervals_converge():
+    from fluidframework_tpu.testing.fuzz import run_fuzz_suite
+    from test_fuzz_harness import STRING_MODEL
+
+    run_fuzz_suite(STRING_MODEL, range(6), steps=60, n_clients=3)
