@@ -371,6 +371,7 @@ class SharedStringChannel(Channel):
             op = dict(contents["op"])
             ref = local_metadata["intervalRef"]
             sided = "startSide" in op or "endSide" in op
+            n_conv = self._converged_length() if sided else 0
             for k, sk in (("start", "startSide"), ("end", "endSide")):
                 if op.get(k) is None:
                     continue
@@ -379,6 +380,13 @@ class SharedStringChannel(Channel):
                         op[k], op[sk] = self._op_log.transform_place_from(
                             op[k], op.get(sk, 0), ref
                         )
+                        if op[k] >= n_conv:
+                            # Forward slide off the back: the "end" sentinel,
+                            # matching what finalize_op gives connected
+                            # replicas for the same removal.
+                            from .sequence_intervals import Side
+
+                            op[k], op[sk] = SENTINEL_POS, Side.BEFORE
                 else:
                     op[k] = self._op_log.transform_from(op[k], ref)
             if op.get("start") is not None and op.get("end") is not None:

@@ -300,7 +300,8 @@ class KernelMergeTree:
         return mk.visible_text(self.state, ref_seq, vc)
 
     def visible_length(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> int:
-        return len(self.visible_text(ref_seq, view_client))
+        vc = self.local_client if view_client is None else view_client
+        return mk.visible_length(self.state, ref_seq, vc)
 
     def annotations(self, ref_seq: int = ALL_ACKED, view_client: int | None = None):
         vc = self.local_client if view_client is None else view_client

@@ -34,6 +34,7 @@ from .changeset import (
     invert_node_change,
     rebase_node_change,
 )
+from .branch import TreeBranch
 from .editmanager import EditManager, TrunkCommit
 from .forest import Forest, Node, UniformChunk
 from .schema import (
@@ -41,13 +42,20 @@ from .schema import (
     FieldSchema,
     LeafKind,
     NodeSchema,
+    SchemaCompatibility,
     SchemaRegistry,
+    SchemaView,
     TreeView,
+    schema_compat,
 )
 from .shared_tree import SharedTreeChannel, SharedTreeFactory
 
 __all__ = [
     "EditManager",
+    "SchemaCompatibility",
+    "SchemaView",
+    "TreeBranch",
+    "schema_compat",
     "FieldKind",
     "FieldSchema",
     "Forest",

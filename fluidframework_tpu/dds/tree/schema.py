@@ -152,6 +152,111 @@ class SchemaRegistry:
 
 
 # ---------------------------------------------------------------------------
+# Schema evolution (ref tree/src/shared-tree/schematizingTreeView.ts
+# compatibility + simple-tree SchemaCompatibilityStatus: a VIEW schema is
+# checked against the document's STORED schema; viewing requires every
+# stored-schema document to be readable under the view schema, upgrading
+# replaces the stored schema with the view schema when that holds).
+# ---------------------------------------------------------------------------
+
+_KIND_WIDTH = {FieldKind.VALUE: 0, FieldKind.OPTIONAL: 1, FieldKind.SEQUENCE: 2}
+
+
+@dataclass
+class SchemaCompatibility:
+    """ref simple-tree SchemaCompatibilityStatus {isEquivalent, canView,
+    canUpgrade}."""
+
+    is_equivalent: bool
+    can_view: bool
+    can_upgrade: bool
+
+
+def field_subsumes(view: FieldSchema, stored: FieldSchema) -> bool:
+    """Every field content valid under ``stored`` is valid under ``view``:
+    multiplicity may widen (value -> optional -> sequence) and allowed
+    types may grow, never shrink."""
+    if _KIND_WIDTH[view.kind] < _KIND_WIDTH[stored.kind]:
+        return False
+    return stored.allowed_types <= view.allowed_types
+
+
+def _subsumes(wider: SchemaRegistry, narrower: SchemaRegistry) -> bool:
+    """Every document valid under ``narrower`` is valid under ``wider``:
+    ``wider`` must know every ``narrower`` node type with each field
+    widened-or-equal; it may add node types freely but may add NEW fields
+    to an existing type only with non-VALUE kinds (existing documents lack
+    the field entirely)."""
+    if narrower.root is not None:
+        if wider.root is None or not field_subsumes(wider.root, narrower.root):
+            return False
+    for name, s in narrower.nodes.items():
+        w = wider.nodes.get(name)
+        if w is None:
+            return False
+        for key, fs in s.fields.items():
+            wf = w.fields.get(key)
+            if wf is None or not field_subsumes(wf, fs):
+                return False
+        for key, wf in w.fields.items():
+            if key not in s.fields and wf.kind == FieldKind.VALUE:
+                return False  # new required field: old documents can't satisfy
+    return True
+
+
+def schema_compat(view: SchemaRegistry, stored: SchemaRegistry) -> SchemaCompatibility:
+    """Compare a view schema against the stored schema.
+
+    ``can_upgrade`` needs the view to subsume the stored schema (stored
+    documents stay valid once the view schema replaces it).  ``can_view``
+    is stricter — no-upgrade compatibility: edits written under the view
+    schema must also satisfy the CURRENT stored schema, so the two must
+    subsume each other (a strictly wider view only grants upgrade; ref
+    SchemaCompatibilityStatus canView vs canUpgrade)."""
+    forward = _subsumes(view, stored)
+    return SchemaCompatibility(
+        is_equivalent=view.to_json() == stored.to_json(),
+        can_view=forward and _subsumes(stored, view),
+        can_upgrade=forward,
+    )
+
+
+class SchemaView:
+    """The gate a client goes through to read/edit a document with ITS OWN
+    schema (ref ITree.viewWith -> TreeView with .compatibility and
+    .upgradeSchema). Reads/edits raise until the view schema can read the
+    stored schema; upgrade_schema ships the view schema as the new stored
+    schema when permitted."""
+
+    def __init__(self, channel, view_schema: SchemaRegistry) -> None:
+        self._channel = channel
+        self.view_schema = view_schema
+
+    @property
+    def compatibility(self) -> SchemaCompatibility:
+        return schema_compat(self.view_schema, self._channel.schema)
+
+    @property
+    def root(self):
+        c = self.compatibility
+        if not c.can_view:
+            raise RuntimeError(
+                "view schema cannot read the document's stored schema "
+                "(compatibility.can_view is False)"
+            )
+        return TreeView(
+            self._channel.forest, self._channel.submit_change, self.view_schema
+        ).root
+
+    def upgrade_schema(self) -> None:
+        c = self.compatibility
+        if not c.can_upgrade:
+            raise RuntimeError("view schema cannot upgrade the stored schema")
+        if not c.is_equivalent:
+            self._channel.set_schema(self.view_schema)
+
+
+# ---------------------------------------------------------------------------
 # Leaf construction helpers
 # ---------------------------------------------------------------------------
 

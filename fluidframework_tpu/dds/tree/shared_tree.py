@@ -147,6 +147,25 @@ class SharedTreeChannel(Channel):
             {"type": "schema", "schema": registry.to_json()}, {"rev": None}
         )
 
+    def view_with(self, view_schema: SchemaRegistry):
+        """Open the document under the CLIENT's schema (ref ITree.viewWith):
+        returns a SchemaView whose .compatibility reports
+        {is_equivalent, can_view, can_upgrade} against the stored schema and
+        whose .upgrade_schema() ships the view schema when permitted."""
+        from .schema import SchemaView
+
+        return SchemaView(self, view_schema)
+
+    def fork(self):
+        """Branch the tree at its current (optimistic) state (ref
+        branch.ts / TreeBranch): edits on the fork are local-only until
+        merge_into_parent ships them as one atomic commit."""
+        from .branch import TreeBranch
+
+        if self._txn is not None:
+            raise RuntimeError("fork inside an open transaction")
+        return TreeBranch(self)
+
     @property
     def view(self) -> TreeView:
         return TreeView(self.forest, self.submit_change, self.schema)

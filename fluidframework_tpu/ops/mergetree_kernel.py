@@ -869,6 +869,14 @@ def visible_text(s: DocState, ref_seq: int = ALL_ACKED, view_client: int = -3) -
     return "".join(parts)
 
 
+def visible_length(s: DocState, ref_seq: int = ALL_ACKED, view_client: int = -3) -> int:
+    """Perspective-visible character count without materializing the text
+    (sum of visible segment lengths)."""
+    nseg, vis = _host_vis(s, ref_seq, view_client)
+    length = np.asarray(s.seg_len)[:nseg]
+    return int(length[vis[:nseg]].sum()) if nseg else 0
+
+
 def annotations(
     s: DocState, ref_seq: int = ALL_ACKED, view_client: int = -3
 ) -> list[dict[int, int]]:

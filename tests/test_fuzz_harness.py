@@ -141,8 +141,23 @@ STRING_MODEL = DDSFuzzModel(name="sharedString", channel_type="sharedString",
 
 def tree_generate(rng: random.Random, channel) -> dict | None:
     def one(n, allow_txn=True):
-        kinds = ["ins", "rm", "set", "move"] + (["txn"] if allow_txn else [])
-        kind = rng.choices(kinds, [6, 3, 3, 2] + ([1] if allow_txn else []))[0]
+        kinds = ["ins", "rm", "set", "move"] + (
+            ["txn", "branch"] if allow_txn else []
+        )
+        kind = rng.choices(kinds, [6, 3, 3, 2] + ([1, 1] if allow_txn else []))[0]
+        if kind == "branch":
+            # Fork, a few branch-local edits, merge back (one atomic commit).
+            subs, m = [], n
+            for _ in range(rng.randint(1, 3)):
+                sub = one(m, allow_txn=False)
+                if sub is None:
+                    continue
+                if sub["t"] == "ins":
+                    m += 1
+                elif sub["t"] == "rm":
+                    m -= sub["n"]
+                subs.append(sub)
+            return {"t": "branch", "subs": subs} if subs else None
         if kind == "txn":
             # 2-3 sub-edits applied atomically; sizes evolve inside, so
             # sub-edits are generated against a running length estimate.
@@ -189,6 +204,12 @@ def tree_reduce(channel, op: dict) -> None:
         with channel.transaction():
             for sub in op["subs"]:
                 _tree_edit(channel, sub)
+        return
+    if op["t"] == "branch":
+        br = channel.fork()
+        for sub in op["subs"]:
+            _tree_edit(br, sub)
+        br.merge_into_parent()
         return
     _tree_edit(channel, op)
 

@@ -304,6 +304,29 @@ def test_summary_roundtrip_preserves_sides():
     assert fresh.get_interval_collection("c1").get(iid).props == {"k": 1}
 
 
+def test_reconnect_resubmit_matches_connected_end_sentinel_degrade():
+    """The reconnect transform must degrade a forward slide off the back to
+    the "end" sentinel exactly like connected replicas' finalize_op — same
+    user actions, same converged interval either way."""
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a, "abcdef")
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add((2, Side.BEFORE), (4, Side.BEFORE))
+    a.flush()
+    a.disconnect()
+    string_of(b).remove_range(3, 6)  # end anchor char 4 dies, nothing after
+    b.flush(); doc.process_all()
+    a.connect(doc, "A2")
+    a.flush(); doc.process_all()
+    assert places(a) == places(b) == {
+        iid: (2, Side.BEFORE, SENTINEL_POS, Side.BEFORE)
+    }
+    # The sticky sentinel end picks up appended text on both replicas.
+    string_of(b).insert_text(3, "zz")
+    b.flush(); doc.process_all()
+    assert covered(a, iid) == covered(b, iid) == "czz"
+
+
 def test_reconnect_resubmits_sided_pending_op():
     svc, doc, a, b = setup_pair()
     seeded(doc, a)  # "hello world"

@@ -1,0 +1,277 @@
+"""SharedTree branch API (fork/rebase/merge) and schema evolution.
+
+Mirrors the reference's branch suites (tree/src/test/shared-tree-core/
+branch.spec.ts, simple-tree branch tests) and the schematize/compatibility
+suites (shared-tree/schematizingTreeView.spec.ts: canView/canUpgrade/
+upgradeSchema)."""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.dds.tree.changeset import (
+    make_insert,
+    make_remove,
+    make_set_value,
+)
+from fluidframework_tpu.dds.tree.schema import (
+    FieldKind,
+    FieldSchema,
+    NodeSchema,
+    SchemaRegistry,
+    array_schema,
+    leaf,
+    schema_compat,
+)
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+
+def make_container(doc, name: str) -> ContainerRuntime:
+    c = ContainerRuntime(default_registry(), container_id=name)
+    ds = c.create_datastore("root")
+    ds.create_channel("sharedTree", "tree")
+    c.connect(doc, name)
+    return c
+
+
+def tree_of(c):
+    return c.datastore("root").get_channel("tree")
+
+
+def root_values(t) -> list:
+    return [n.value for n in t.forest.root_field]
+
+
+def setup_pair():
+    svc = LocalService()
+    doc = svc.document("d1")
+    a = make_container(doc, "A")
+    b = make_container(doc, "B")
+    doc.process_all()
+    return svc, doc, a, b
+
+
+def ins(i, v):
+    return make_insert([], "", i, [leaf(v)])
+
+
+# --------------------------------------------------------------------------
+# branches
+# --------------------------------------------------------------------------
+
+def test_branch_edits_stay_local_until_merge():
+    svc, doc, a, b = setup_pair()
+    ta = tree_of(a)
+    ta.submit_change(ins(0, 1))
+    a.flush(); doc.process_all()
+    br = ta.fork()
+    br.submit_change(ins(1, 2))
+    br.submit_change(ins(2, 3))
+    assert [n.value for n in br.forest.root_field] == [1, 2, 3]
+    assert root_values(ta) == [1]  # parent untouched
+    a.flush(); doc.process_all()
+    assert root_values(tree_of(b)) == [1]  # nothing shipped
+    br.merge_into_parent()
+    assert root_values(ta) == [1, 2, 3]
+    a.flush(); doc.process_all()
+    assert root_values(tree_of(b)) == [1, 2, 3]
+    assert br.disposed
+    with pytest.raises(RuntimeError):
+        br.submit_change(ins(0, 9))
+
+
+def test_branch_rebase_onto_parent_picks_up_remote_edits():
+    svc, doc, a, b = setup_pair()
+    ta, tb = tree_of(a), tree_of(b)
+    ta.submit_change(ins(0, 10))
+    a.flush(); doc.process_all()
+    br = ta.fork()
+    br.submit_change(ins(1, 20))       # branch: [10, 20]
+    tb.submit_change(ins(0, 5))        # B concurrently prepends
+    b.flush(); doc.process_all()
+    assert root_values(ta) == [5, 10]
+    assert [n.value for n in br.forest.root_field] == [10, 20]  # not yet
+    br.rebase_onto_parent()
+    assert [n.value for n in br.forest.root_field] == [5, 10, 20]
+    br.merge_into_parent()
+    a.flush(); doc.process_all()
+    assert root_values(ta) == root_values(tb) == [5, 10, 20]
+
+
+def test_branch_merge_is_atomic_on_the_wire():
+    svc, doc, a, b = setup_pair()
+    ta = tree_of(a)
+    n_before = len(tree_of(b).em.trunk)
+    br = ta.fork()
+    for i, v in enumerate([1, 2, 3]):
+        br.submit_change(ins(i, v))
+    br.merge_into_parent()
+    a.flush()
+    doc.process_all()
+    assert root_values(tree_of(b)) == [1, 2, 3]
+    # One trunk commit (one transaction on the wire), not three.
+    assert len(tree_of(b).em.trunk) == n_before + 1
+
+
+def test_nested_branches():
+    svc, doc, a, b = setup_pair()
+    ta = tree_of(a)
+    ta.submit_change(ins(0, 1))
+    br = ta.fork()
+    br.submit_change(ins(1, 2))
+    grand = br.fork()
+    grand.submit_change(ins(2, 3))
+    assert [n.value for n in grand.forest.root_field] == [1, 2, 3]
+    br.submit_change(ins(0, 0))        # branch diverges under grandchild
+    grand.rebase_onto_parent()
+    assert [n.value for n in grand.forest.root_field] == [0, 1, 2, 3]
+    grand.merge_into_parent()
+    assert [n.value for n in br.forest.root_field] == [0, 1, 2, 3]
+    br.merge_into_parent()
+    a.flush(); doc.process_all()
+    assert root_values(ta) == root_values(tree_of(b)) == [0, 1, 2, 3]
+
+
+def test_nested_branch_view_resolves_document_schema():
+    svc, doc, a, b = setup_pair()
+    ta = tree_of(a)
+    ta.set_schema(reg_v1())
+    a.flush(); doc.process_all()
+    br = ta.fork()
+    grand = br.fork()
+    assert grand.view.registry is not None
+    assert grand.view.registry.to_json() == reg_v1().to_json()
+
+
+def test_branch_transaction_and_abort():
+    svc, doc, a, b = setup_pair()
+    ta = tree_of(a)
+    br = ta.fork()
+    with br.transaction():
+        br.submit_change(ins(0, 1))
+        br.submit_change(ins(1, 2))
+    with pytest.raises(ValueError):
+        with br.transaction():
+            br.submit_change(ins(2, 3))
+            raise ValueError("abort")
+    assert [n.value for n in br.forest.root_field] == [1, 2]
+    br.merge_into_parent()
+    a.flush(); doc.process_all()
+    assert root_values(tree_of(b)) == [1, 2]
+
+
+def test_concurrent_branch_merges_converge():
+    svc, doc, a, b = setup_pair()
+    ta, tb = tree_of(a), tree_of(b)
+    ta.submit_change(ins(0, 100))
+    a.flush(); doc.process_all()
+    ba = ta.fork(); ba.submit_change(ins(1, 1))
+    bb = tb.fork(); bb.submit_change(ins(1, 2))
+    ba.merge_into_parent()
+    bb.merge_into_parent()
+    a.flush(); b.flush(); doc.process_all()
+    assert root_values(ta) == root_values(tb)
+    assert sorted(root_values(ta)) == [1, 2, 100]
+
+
+# --------------------------------------------------------------------------
+# schema evolution
+# --------------------------------------------------------------------------
+
+def reg_v1() -> SchemaRegistry:
+    r = SchemaRegistry()
+    r.add(array_schema("list", {"number"}))
+    r.root = FieldSchema(FieldKind.VALUE, {"list"})
+    return r
+
+
+def reg_widened() -> SchemaRegistry:
+    r = SchemaRegistry()
+    r.add(array_schema("list", {"number", "string"}))  # widened items
+    r.root = FieldSchema(FieldKind.VALUE, {"list"})
+    return r
+
+
+def reg_new_required_field() -> SchemaRegistry:
+    r = SchemaRegistry()
+    s = array_schema("list", {"number"})
+    s.fields["meta"] = FieldSchema(FieldKind.VALUE, {"string"})
+    r.add(s)
+    r.root = FieldSchema(FieldKind.VALUE, {"list"})
+    return r
+
+
+def reg_new_optional_field() -> SchemaRegistry:
+    r = SchemaRegistry()
+    s = array_schema("list", {"number"})
+    s.fields["meta"] = FieldSchema(FieldKind.OPTIONAL, {"string"})
+    r.add(s)
+    r.root = FieldSchema(FieldKind.VALUE, {"list"})
+    return r
+
+
+def test_schema_compat_rules():
+    c = schema_compat(reg_v1(), reg_v1())
+    assert c.is_equivalent and c.can_view and c.can_upgrade
+    # widening allowed types: upgrade only — viewing would let this client
+    # write strings the stored schema forbids (canView is no-upgrade compat)
+    c = schema_compat(reg_widened(), reg_v1())
+    assert not c.is_equivalent and not c.can_view and c.can_upgrade
+    # narrowing: nothing works
+    c = schema_compat(reg_v1(), reg_widened())
+    assert not c.can_view and not c.can_upgrade
+    # new REQUIRED field: existing documents can't satisfy it
+    c = schema_compat(reg_new_required_field(), reg_v1())
+    assert not c.can_view and not c.can_upgrade
+    # new OPTIONAL field: upgradeable
+    c = schema_compat(reg_new_optional_field(), reg_v1())
+    assert not c.can_view and c.can_upgrade
+    # multiplicity widening value -> optional is an upgrade
+    v = SchemaRegistry(); v.add(array_schema("list", {"number"}))
+    v.root = FieldSchema(FieldKind.OPTIONAL, {"list"})
+    c = schema_compat(v, reg_v1())
+    assert not c.can_view and c.can_upgrade
+    # multiplicity narrowing optional -> value is not
+    c = schema_compat(reg_v1(), v)
+    assert not c.can_view and not c.can_upgrade
+
+
+def test_view_with_upgrade_flow():
+    svc, doc, a, b = setup_pair()
+    ta, tb = tree_of(a), tree_of(b)
+    ta.set_schema(reg_v1())
+    a.flush(); doc.process_all()
+    # B opens with a WIDER schema: not viewable as-is, upgradeable.
+    vb = tb.view_with(reg_widened())
+    compat = vb.compatibility
+    assert not compat.can_view and compat.can_upgrade and not compat.is_equivalent
+    with pytest.raises(RuntimeError):
+        _ = vb.root
+    vb.upgrade_schema()
+    # Locally upgraded: the view opens immediately (optimistic schema).
+    assert vb.compatibility.can_view
+    b.flush(); doc.process_all()
+    assert ta.schema.to_json() == reg_widened().to_json()
+    # A client with the OLD schema can no longer view (stored is wider now).
+    va = ta.view_with(reg_v1())
+    assert not va.compatibility.can_view
+    with pytest.raises(RuntimeError):
+        _ = va.root
+    with pytest.raises(RuntimeError):
+        va.upgrade_schema()
+
+
+def test_view_with_equivalent_upgrade_is_noop():
+    svc, doc, a, b = setup_pair()
+    ta = tree_of(a)
+    ta.set_schema(reg_v1())
+    a.flush(); doc.process_all()
+    v = ta.view_with(reg_v1())
+    assert v.compatibility.is_equivalent
+    v.upgrade_schema()  # no-op: ships nothing
+    a.flush()
+    assert not a.has_pending_changes if hasattr(a, "has_pending_changes") else True
+    doc.process_all()
+    assert tree_of(b).schema.to_json() == reg_v1().to_json()
