@@ -371,7 +371,13 @@ class SharedStringChannel(Channel):
             op = dict(contents["op"])
             ref = local_metadata["intervalRef"]
             sided = "startSide" in op or "endSide" in op
-            n_conv = self._converged_length() if sided else 0
+            # Degrade bound: the author's LOCAL view (acked + own pending,
+            # including inserts resubmitted ahead of this op) — endpoints
+            # anchored in own pending text must NOT collapse, while a
+            # genuine forward slide off a removed suffix still degrades to
+            # the "end" sentinel exactly like finalize_op on connected
+            # replicas.
+            n_local = len(self.text) if sided else 0
             for k, sk in (("start", "startSide"), ("end", "endSide")):
                 if op.get(k) is None:
                     continue
@@ -380,10 +386,7 @@ class SharedStringChannel(Channel):
                         op[k], op[sk] = self._op_log.transform_place_from(
                             op[k], op.get(sk, 0), ref
                         )
-                        if op[k] >= n_conv:
-                            # Forward slide off the back: the "end" sentinel,
-                            # matching what finalize_op gives connected
-                            # replicas for the same removal.
+                        if op[k] >= n_local:
                             from .sequence_intervals import Side
 
                             op[k], op[sk] = SENTINEL_POS, Side.BEFORE

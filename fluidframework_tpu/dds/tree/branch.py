@@ -30,7 +30,7 @@ from .changeset import (
     NodeChange,
     apply_commit,
     clone_commit,
-    invert_commit,
+    rollback_staged,
 )
 from .editmanager import bridge
 from .forest import Forest, Node, ROOT_FIELD
@@ -74,10 +74,7 @@ class TreeBranch:
             yield self
         except BaseException:
             staged, self._txn = self._txn, None
-            for change in reversed(staged):
-                inverse = invert_commit([change])
-                apply_commit(self.forest.root, inverse)
-                self.applied_log.extend(inverse)
+            rollback_staged(self.forest.root, staged, self.applied_log)
             raise
         staged, self._txn = self._txn, None
         if staged:
@@ -127,12 +124,15 @@ class TreeBranch:
         if self._txn is not None:
             raise RuntimeError("merge inside an open transaction")
         self.rebase_onto_parent()
-        commits, self._commits = self._commits, []
-        if commits:
+        if self._commits:
+            # Commits are cleared only after the parent transaction lands:
+            # a failure (e.g. parent inside an open transaction) leaves the
+            # branch intact for a retry.
             with self._parent.transaction():
-                for commit in commits:
+                for commit in self._commits:
                     for change in commit:
                         self._parent.submit_change(clone_commit([change])[0])
+            self._commits = []
         self.dispose()
 
     # ------------------------------------------------------------------ misc

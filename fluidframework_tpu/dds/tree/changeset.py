@@ -634,6 +634,16 @@ def apply_commit(root: Node, cs: "Commit") -> None:
         apply_node_change(root, c)
 
 
+def rollback_staged(root: Node, staged: list[NodeChange], applied_log: list[NodeChange]) -> None:
+    """Transaction abort: invert and apply the staged changes newest-first,
+    recording the inverses on the coordinate trail (shared by channel and
+    branch transactions)."""
+    for change in reversed(staged):
+        inverse = invert_commit([change])
+        apply_commit(root, inverse)
+        applied_log.extend(inverse)
+
+
 def clone_commit(cs: "Commit") -> "Commit":
     return [clone_change(c) for c in cs]
 

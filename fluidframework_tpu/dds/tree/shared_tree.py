@@ -39,6 +39,7 @@ from .changeset import (
     commit_from_json,
     commit_to_json,
     invert_commit,
+    rollback_staged,
 )
 from .editmanager import EditManager, bridge
 from .forest import Forest, Node, decode_field_chunked, encode_field_chunked, ROOT_FIELD
@@ -130,10 +131,7 @@ class SharedTreeChannel(Channel):
             yield self
         except BaseException:
             staged, self._txn = self._txn, None
-            for change in reversed(staged):
-                inverse_commit = invert_commit([change])
-                apply_commit(self.forest.root, inverse_commit)
-                self.applied_log.extend(inverse_commit)
+            rollback_staged(self.forest.root, staged, self.applied_log)
             self._notify()
             raise
         staged, self._txn = self._txn, None

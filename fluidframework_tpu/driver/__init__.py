@@ -14,6 +14,14 @@ from .definitions import (
     StorageService,
 )
 from .local_driver import LocalDocumentServiceFactory
+from .virtual_storage import (
+    PrefetchStorageService,
+    SnapshotCache,
+    ThrottlingError,
+    VirtualizedDocumentServiceFactory,
+    VirtualizedStorageService,
+    run_with_retry,
+)
 
 __all__ = [
     "DeltaConnection",
@@ -22,5 +30,11 @@ __all__ = [
     "DocumentServiceFactory",
     "DriverError",
     "LocalDocumentServiceFactory",
+    "PrefetchStorageService",
+    "SnapshotCache",
     "StorageService",
+    "ThrottlingError",
+    "VirtualizedDocumentServiceFactory",
+    "VirtualizedStorageService",
+    "run_with_retry",
 ]

@@ -343,6 +343,21 @@ def test_reconnect_resubmits_sided_pending_op():
     assert covered(a, iid) == covered(b, iid) == "world"
 
 
+def test_reconnect_keeps_anchor_in_own_pending_insert():
+    """An endpoint anchored in the author's own pending (resubmitted-ahead)
+    insert must survive reconnect, not collapse to the end sentinel."""
+    svc, doc, a, b = setup_pair()
+    seeded(doc, a, "abcdef")
+    a.disconnect()
+    string_of(a).insert_text(6, "xyz")
+    ca = string_of(a).get_interval_collection("c1")
+    iid = ca.add((6, Side.BEFORE), (8, Side.AFTER))  # over pending "xyz"
+    a.connect(doc, "A2")
+    a.flush(); doc.process_all()
+    assert places(a) == places(b) == {iid: (6, Side.BEFORE, 8, Side.AFTER)}
+    assert covered(a, iid) == covered(b, iid) == "xyz"
+
+
 def test_fuzz_sided_intervals_converge():
     from fluidframework_tpu.testing.fuzz import run_fuzz_suite
     from test_fuzz_harness import STRING_MODEL

@@ -162,6 +162,21 @@ def test_branch_transaction_and_abort():
     assert root_values(tree_of(b)) == [1, 2]
 
 
+def test_failed_merge_keeps_branch_intact_for_retry():
+    svc, doc, a, b = setup_pair()
+    ta = tree_of(a)
+    br = ta.fork()
+    br.submit_change(ins(0, 7))
+    with pytest.raises(RuntimeError):
+        with ta.transaction():
+            ta.submit_change(ins(0, 1))
+            br.merge_into_parent()  # parent txn open: nested txn raises
+    assert not br.disposed and br.has_changes
+    br.merge_into_parent()  # retry succeeds
+    a.flush(); doc.process_all()
+    assert 7 in root_values(tree_of(b))
+
+
 def test_concurrent_branch_merges_converge():
     svc, doc, a, b = setup_pair()
     ta, tb = tree_of(a), tree_of(b)
