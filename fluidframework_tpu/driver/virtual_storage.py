@@ -126,9 +126,16 @@ def shred_summary(
     def walk(value: Any, depth: int) -> Any:
         if isinstance(value, dict):
             keys = set(value.keys())
+            if keys == {VBLOB_KEY} and isinstance(value[VBLOB_KEY], str):
+                # An existing chunk marker (re-shredding a skeleton that was
+                # not fully hydrated, e.g. dict(lazy_snapshot)): pass it
+                # through — the id still resolves in the blob store.
+                # VBLOB_KEY is a reserved key; genuine user data shaped
+                # exactly {VBLOB_KEY: <str>} is not representable.
+                return dict(value)
             if keys == {VBLOB_KEY} or keys == {VBLOB_ESCAPE}:
-                # A genuine single-key dict that would read as a marker (or
-                # as an escape): escape it, recording which key it had.
+                # Marker- or escape-shaped user data (non-string payload):
+                # escape it, recording which key it had.
                 (k,) = keys
                 return {VBLOB_ESCAPE: {"k": k, "v": walk(value[k], depth + 1)}}
             out: Any = {k: walk(v, depth + 1) for k, v in value.items()}
@@ -254,6 +261,10 @@ class VirtualizedStorageService(StorageService):
         return seq, LazySnapshot(skeleton, self._fetch_chunk)
 
     def write_snapshot(self, seq: int, summary: dict) -> None:
+        if isinstance(summary, LazySnapshot):
+            # Force per-key hydration so we shred content, not markers
+            # (markers that do sneak in pass through shred_summary intact).
+            summary = {k: summary[k] for k in summary.keys()}
         skeleton = shred_summary(dict(summary), self._upload_chunk, self._threshold)
         self._inner.write_snapshot(seq, skeleton)
 

@@ -25,8 +25,11 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
+import json
+import os
+
 from ..protocol.messages import MessageType, Nack, SequencedMessage, UnsequencedMessage
-from .ordered_log import Topic
+from .ordered_log import DurableTopic, Topic
 from .sequencer import Sequencer
 
 
@@ -50,6 +53,21 @@ class DeliLambda:
         self.offset = 0
         self.sequencers: dict[str, Any] = {}
         self.nacks: list[tuple[str, Nack]] = []
+        # Idempotent re-produce guard for durable deployments: deli p is the
+        # SOLE producer into deltas partition p, so on recovery-by-replay
+        # the first ``dedup_until - produced`` produces are already in the
+        # log (deterministic sequencing re-creates them identically) and
+        # are skipped instead of appended twice.
+        self.produced = 0
+        self.dedup_until = 0
+        # Service-message dedup by (upload handle, message type): a
+        # crash-replayed scribe re-emits the SAME ack/nack it produced
+        # before the crash; the first ticket wins and exact duplicates are
+        # dropped — ack/nack per handle is idempotent state. A nack
+        # FOLLOWING an ack (stale-handle retry) has a different type and
+        # passes. (The reference leans on scribe checkpoints + broker
+        # transactions for the same guarantee.)
+        self.seen_service: dict[str, set[tuple[str, int]]] = {}
 
     def _sequencer(self, doc_id: str):
         if doc_id not in self.sequencers:
@@ -67,6 +85,14 @@ class DeliLambda:
                 out = seqr.leave(payload)
             elif kind == "service":
                 mtype, contents = payload
+                handle = contents.get("handle") if isinstance(contents, dict) else None
+                if handle is not None:
+                    seen = self.seen_service.setdefault(rec.doc_id, set())
+                    if (handle, mtype) in seen:
+                        self.offset = rec.offset + 1
+                        n += 1
+                        continue
+                    seen.add((handle, mtype))
                 out = seqr.mint_service(mtype, contents)
             else:  # op
                 out = seqr.ticket(payload)
@@ -74,7 +100,9 @@ class DeliLambda:
                     self.nacks.append((rec.doc_id, out))
                     out = None
             if out is not None:
-                self._deltas.produce(rec.doc_id, out)
+                if self.produced >= self.dedup_until:
+                    self._deltas.produce(rec.doc_id, out)
+                self.produced += 1
             self.offset = rec.offset + 1
             n += 1
         return n
@@ -89,7 +117,16 @@ class DeliLambda:
                 docs[doc_id] = {"native": s.checkpoint_bytes().hex()}
             else:
                 docs[doc_id] = {"py": s.checkpoint()}
-        return {"offset": self.offset, "docs": docs, "useNative": self._use_native}
+        return {
+            "offset": self.offset,
+            "docs": docs,
+            "useNative": self._use_native,
+            "produced": self.produced,
+            "seenService": {
+                doc: sorted([h, t] for h, t in seen)
+                for doc, seen in self.seen_service.items()
+            },
+        }
 
     @staticmethod
     def restore(state: dict, rawdeltas: Topic, deltas: Topic, partition: int) -> "DeliLambda":
@@ -97,6 +134,11 @@ class DeliLambda:
             rawdeltas, deltas, partition, use_native=state.get("useNative", False)
         )
         lam.offset = state["offset"]
+        lam.produced = state.get("produced", 0)
+        lam.seen_service = {
+            doc: {(h, t) for h, t in seen}
+            for doc, seen in state.get("seenService", {}).items()
+        }
         for doc_id, entry in state["docs"].items():
             if "native" in entry:
                 from ..native import NativeSequencer
@@ -221,10 +263,17 @@ class PipelineService:
     exactly the reference's per-partition deployment (SURVEY §2.6.2).
     """
 
-    def __init__(self, n_partitions: int = 4, use_native_sequencer: bool = False):
-        self.rawdeltas = Topic("rawdeltas", n_partitions)
-        self.deltas = Topic("deltas", n_partitions)
-        self.uploads: dict[str, Any] = {}
+    def __init__(
+        self,
+        n_partitions: int = 4,
+        use_native_sequencer: bool = False,
+        rawdeltas: Topic | None = None,
+        deltas: Topic | None = None,
+        uploads: dict | None = None,
+    ):
+        self.rawdeltas = rawdeltas if rawdeltas is not None else Topic("rawdeltas", n_partitions)
+        self.deltas = deltas if deltas is not None else Topic("deltas", n_partitions)
+        self.uploads: dict[str, Any] = uploads if uploads is not None else {}
         self._upload_counter = 0
         self.deli = [
             DeliLambda(self.rawdeltas, self.deltas, p, use_native_sequencer)
@@ -283,3 +332,173 @@ class PipelineService:
     def snapshots_of(self, doc_id: str) -> list[tuple[int, dict]]:
         p = self.deltas.partition_for(doc_id)
         return self.scribe[p].snapshots.get(doc_id, [])
+
+
+# ---------------------------------------------------------------------------
+# Durable deployment: topics on disk + deli checkpoints, crash-recoverable
+# ---------------------------------------------------------------------------
+
+def _encode_raw(payload) -> dict:
+    kind, body = payload
+    if kind == "op":
+        return {"k": "op", "m": body.to_json()}
+    if kind in ("join", "leave"):
+        return {"k": kind, "c": body}
+    mtype, contents = body
+    return {"k": "service", "t": mtype, "c": contents}
+
+
+def _decode_raw(d: dict):
+    if d["k"] == "op":
+        return ("op", UnsequencedMessage.from_json(d["m"]))
+    if d["k"] in ("join", "leave"):
+        return (d["k"], d["c"])
+    return ("service", (d["t"], d["c"]))
+
+
+def _encode_delta(msg: SequencedMessage) -> str:
+    return msg.to_json()
+
+
+def _decode_delta(raw: str) -> SequencedMessage:
+    return SequencedMessage.from_json(raw)
+
+
+def _atomic_json_dump(obj, path: str) -> None:
+    """Write-temp-then-rename: a crash mid-write never destroys the
+    previous good file (checkpoint files are the recovery state — losing
+    one to a torn write would be worse than having none)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+class DurableUploads(dict):
+    """Staged summary uploads, persisted on upload (the reference's
+    historian staging is durable): a crash between upload and checkpoint
+    replays the SUMMARIZE against the same tree. Pops (consumption) stay
+    in-memory — a no-checkpoint replay must re-consume the same handles —
+    and the file is compacted to the live set at every checkpoint, so
+    consumed handles cannot accrete across restarts."""
+
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        self._path = path
+        self.counter = 0
+        if os.path.exists(path):
+            with open(path) as f:
+                data = json.load(f)
+            super().update(data["uploads"])
+            self.counter = data["counter"]
+        # The replay set: everything known at open, including handles a
+        # pre-crash scribe consumed after the last compaction.
+        self._persisted = dict(self)
+
+    def _flush(self) -> None:
+        _atomic_json_dump(
+            {"uploads": self._persisted, "counter": self.counter}, self._path
+        )
+
+    def compact(self) -> None:
+        """At checkpoint: scribe resumes past every consumption, so only
+        live (unconsumed) uploads need to survive."""
+        self._persisted = dict(self)
+        self._flush()
+
+    def __setitem__(self, key, value) -> None:
+        super().__setitem__(key, value)
+        self._persisted[key] = value
+        self._flush()
+
+
+class DurablePipelineService(PipelineService):
+    """PipelineService over file-backed topics with checkpointed deli state
+    (the reference's production shape: Kafka retains the log, deli rides a
+    checkpoint {state, input offset} so a crashed sequencer restarts
+    losslessly — deli/checkpointManager.ts; scriptorium/broadcaster are
+    rebuilt by replaying the durable deltas topic, which is deterministic;
+    scribe resumes from its checkpoint so consumed uploads never re-ack
+    divergently)."""
+
+    def __init__(
+        self,
+        directory: str,
+        n_partitions: int = 4,
+        use_native_sequencer: bool = False,
+    ):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+        rawdeltas = DurableTopic(
+            "rawdeltas", n_partitions, directory, _encode_raw, _decode_raw
+        )
+        deltas = DurableTopic(
+            "deltas", n_partitions, directory, _encode_delta, _decode_delta
+        )
+        rawdeltas.open_all()
+        deltas.open_all()
+        super().__init__(
+            n_partitions,
+            use_native_sequencer,
+            rawdeltas=rawdeltas,
+            deltas=deltas,
+            uploads=DurableUploads(os.path.join(directory, "uploads.json")),
+        )
+        self._restore()
+
+    def upload_summary(self, tree: dict) -> str:
+        h = super().upload_summary(tree)
+        self.uploads.counter = self._upload_counter
+        self.uploads._flush()
+        return h
+
+    # ------------------------------------------------------------ checkpoint
+    def _ckpt_path(self) -> str:
+        return os.path.join(self._dir, "deli-checkpoint.json")
+
+    def checkpoint(self) -> None:
+        """Persist the stateful lambdas: deli (sequencer state + input
+        offset) and scribe (snapshots + offset). Scriptorium and
+        broadcaster rebuild from the deltas topic side-effect-free."""
+        state = {
+            "deli": {str(p): lam.checkpoint() for p, lam in enumerate(self.deli)},
+            "scribe": {
+                str(p): {"offset": lam.offset, "snapshots": lam.snapshots}
+                for p, lam in enumerate(self.scribe)
+            },
+        }
+        _atomic_json_dump(state, self._ckpt_path())
+        self.uploads.compact()
+
+    def _restore(self) -> None:
+        self._upload_counter = self.uploads.counter
+        path = self._ckpt_path()
+        if os.path.exists(path):
+            with open(path) as f:
+                state = json.load(f)
+            self.deli = [
+                DeliLambda.restore(
+                    state["deli"][str(p)], self.rawdeltas, self.deltas, p
+                )
+                for p in range(len(self.deli))
+            ]
+            for p, lam in enumerate(self.scribe):
+                entry = state["scribe"][str(p)]
+                lam.offset = entry["offset"]
+                lam.snapshots = {
+                    doc: [(s, snap) for s, snap in snaps]
+                    for doc, snaps in entry["snapshots"].items()
+                }
+        # Whatever already reached the durable deltas log (possibly beyond
+        # the checkpoint — flushes keep running between checkpoints) must
+        # not be appended twice during replay.
+        for p, lam in enumerate(self.deli):
+            lam.dedup_until = self.deltas.partition(p).head
+        # Scriptorium/broadcaster replay the durable deltas topic from zero
+        # — deterministic rebuild of the op store; broadcaster has no
+        # subscribers yet (stateless fronts re-register on reconnect).
+        self.pump()
+
+    def close(self) -> None:
+        self.rawdeltas.close()
+        self.deltas.close()

@@ -99,11 +99,21 @@ class _NexusHandler(socketserver.StreamRequestHandler):
 
 
 class NetworkServer:
-    """The TCP front over one LocalService core."""
+    """The TCP front over one LocalService core.
 
-    def __init__(self, service: LocalService | None = None, port: int = 0) -> None:
+    Fronts are STATELESS (§2.6.5): several NetworkServer/HttpFront
+    instances may share one core — pass the same ``service`` and ``lock``
+    to each (the reference scales nexus/alfred horizontally behind
+    Redis/Kafka the same way; here the shared core is in-process)."""
+
+    def __init__(
+        self,
+        service: LocalService | None = None,
+        port: int = 0,
+        lock: threading.RLock | None = None,
+    ) -> None:
         self.service = service if service is not None else LocalService()
-        self.lock = threading.RLock()
+        self.lock = lock if lock is not None else threading.RLock()
 
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True

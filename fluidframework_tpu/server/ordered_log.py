@@ -1,16 +1,28 @@
-"""In-memory ordered log: the Kafka analog the lambda pipeline consumes.
+"""Ordered log: the Kafka analog the lambda pipeline consumes.
 
 Reference parity: routerlicious' ordering backbone (SURVEY §2.5) — topics
 partitioned by document id, append-only per-partition order, consumer
 offsets checkpointed by each lambda (lambdas-driver/src/partitionManager.ts,
-checkpoint offsets). A networked deployment swaps this for a real broker;
-the pipeline code only sees this interface.
+checkpoint offsets).
+
+Two backends share the interface:
+- ``Topic``/``Partition`` — in-memory (memory-orderer analog);
+- ``DurableTopic``/``DurablePartition`` — file-backed append-only JSONL
+  per partition, reloaded on open (the services-ordering-rdkafka role:
+  a broker whose log survives process restarts).
+
+``ConsumerGroup`` is the lambdas-driver partition manager: members join
+and leave, partitions rebalance round-robin across the membership, and
+committed offsets persist so a restarted consumer resumes where the group
+left off (partitionManager.ts + checkpointManager offsets).
 """
 
 from __future__ import annotations
 
+import json
+import os
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, Callable
 
 
 @dataclass
@@ -64,3 +76,160 @@ class Topic:
             self.partition(i).head - offsets.get(i, 0)
             for i in range(self.n_partitions)
         )
+
+
+# ---------------------------------------------------------------------------
+# Durable backend
+# ---------------------------------------------------------------------------
+
+class DurablePartition(Partition):
+    """Append-only JSONL file per partition: every append encodes and
+    flushes one line; opening replays the file into memory (the broker's
+    log segment). ``encode``/``decode`` map payloads <-> JSON values."""
+
+    def __init__(
+        self,
+        path: str,
+        encode: Callable[[Any], Any] = lambda p: p,
+        decode: Callable[[Any], Any] = lambda p: p,
+    ) -> None:
+        super().__init__()
+        self._path = path
+        self._encode = encode
+        self._decode = decode
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    rec = json.loads(line)
+                    super().append(rec["doc"], decode(rec["payload"]))
+        self._file = open(path, "a")
+
+    def append(self, doc_id: str, payload: Any) -> int:
+        off = super().append(doc_id, payload)
+        self._file.write(
+            json.dumps({"doc": doc_id, "payload": self._encode(payload)}) + "\n"
+        )
+        self._file.flush()
+        return off
+
+    def close(self) -> None:
+        self._file.close()
+
+
+class DurableTopic(Topic):
+    """A Topic whose partitions persist under ``directory/<name>/p<idx>``."""
+
+    def __init__(
+        self,
+        name: str,
+        n_partitions: int,
+        directory: str,
+        encode: Callable[[Any], Any] = lambda p: p,
+        decode: Callable[[Any], Any] = lambda p: p,
+    ) -> None:
+        super().__init__(name=name, n_partitions=n_partitions)
+        self._dir = os.path.join(directory, name)
+        os.makedirs(self._dir, exist_ok=True)
+        self._encode = encode
+        self._decode = decode
+
+    def partition(self, idx: int) -> Partition:
+        if idx not in self.partitions:
+            self.partitions[idx] = DurablePartition(
+                os.path.join(self._dir, f"p{idx}.jsonl"),
+                self._encode,
+                self._decode,
+            )
+        return self.partitions[idx]
+
+    def open_all(self) -> None:
+        """Eagerly open every partition (reload all segments on recovery)."""
+        for i in range(self.n_partitions):
+            self.partition(i)
+
+    def close(self) -> None:
+        for p in self.partitions.values():
+            if isinstance(p, DurablePartition):
+                p.close()
+
+
+# ---------------------------------------------------------------------------
+# Consumer groups (lambdas-driver partition manager)
+# ---------------------------------------------------------------------------
+
+class ConsumerGroup:
+    """Partition assignment + committed offsets for one consumer group.
+
+    Membership changes rebalance immediately: partitions are dealt
+    round-robin over the sorted membership (deterministic, like the
+    reference's rebalance callback tearing down/recreating per-partition
+    lambdas). Committed offsets are group-global, so any member resuming a
+    partition continues from the group's checkpoint; with ``directory``
+    they persist across restarts."""
+
+    def __init__(self, topic: Topic, group_id: str, directory: str | None = None) -> None:
+        self.topic = topic
+        self.group_id = group_id
+        self.members: list[str] = []
+        self.generation = 0  # bumps on every rebalance
+        self._offsets: dict[int, int] = {}
+        self._path = (
+            os.path.join(directory, f"offsets-{group_id}.json")
+            if directory is not None
+            else None
+        )
+        if self._path is not None and os.path.exists(self._path):
+            with open(self._path) as f:
+                self._offsets = {int(k): v for k, v in json.load(f).items()}
+
+    # ------------------------------------------------------------ membership
+    def join(self, member_id: str) -> None:
+        if member_id not in self.members:
+            self.members.append(member_id)
+            self.generation += 1
+
+    def leave(self, member_id: str) -> None:
+        if member_id in self.members:
+            self.members.remove(member_id)
+            self.generation += 1
+
+    def assignments(self, member_id: str) -> list[int]:
+        ordered = sorted(self.members)
+        if member_id not in ordered:
+            return []
+        rank = ordered.index(member_id)
+        return [
+            p for p in range(self.topic.n_partitions)
+            if p % len(ordered) == rank
+        ]
+
+    # --------------------------------------------------------------- offsets
+    def committed(self, partition: int) -> int:
+        return self._offsets.get(partition, 0)
+
+    def commit(self, partition: int, offset: int) -> None:
+        self._offsets[partition] = offset
+        if self._path is not None:
+            # Temp-then-rename: a torn write must not destroy the last good
+            # offsets file (it IS the group's recovery state).
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._offsets, f)
+            os.replace(tmp, self._path)
+
+    def consume(
+        self, member_id: str, max_records: int = 1 << 30
+    ) -> list[tuple[int, LogRecord]]:
+        """(partition, record) for every assigned partition from its
+        committed offset (the caller commits after processing —
+        at-least-once)."""
+        out: list[tuple[int, LogRecord]] = []
+        for p in self.assignments(member_id):
+            for rec in self.topic.partition(p).read(self.committed(p), max_records):
+                out.append((p, rec))
+        return out
+
+    def lag(self) -> int:
+        return self.topic.lag(self._offsets)

@@ -1,0 +1,275 @@
+"""Durable ordered log (Kafka analog), consumer groups, crash-recoverable
+pipeline, and stateless multi-front scale-out.
+
+Mirrors the reference's ordering backbone guarantees (SURVEY §2.5):
+services-ordering-rdkafka durability, lambdas-driver partition
+assignment/rebalance with checkpointed offsets, deli's
+checkpoint-and-restart losslessness (deli/checkpointManager.ts), and the
+stateless horizontal scaling of nexus fronts (§2.6.5).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.protocol.messages import UnsequencedMessage
+from fluidframework_tpu.server.lambdas import DurablePipelineService, PipelineService
+from fluidframework_tpu.server.ordered_log import ConsumerGroup, DurableTopic, Topic
+
+
+def op(client: str, cseq: int, ref: int = 0) -> UnsequencedMessage:
+    return UnsequencedMessage(
+        client_id=client, client_seq=cseq, ref_seq=ref, type=0,
+        contents={"n": cseq},
+    )
+
+
+# ------------------------------------------------------------- durable topic
+
+def test_durable_topic_survives_reopen(tmp_path):
+    t = DurableTopic("raw", 2, str(tmp_path))
+    t.produce("docA", {"x": 1})
+    t.produce("docA", {"x": 2})
+    t.produce("docB", {"x": 3})
+    t.close()
+    # Reopen: records reload from the segment files in order.
+    t2 = DurableTopic("raw", 2, str(tmp_path))
+    t2.open_all()
+    p = t2.partition_for("docA")
+    recs = t2.partition(p).read(0)
+    payloads = [r.payload for r in recs if r.doc_id == "docA"]
+    assert payloads == [{"x": 1}, {"x": 2}]
+    assert sum(t2.partition(i).head for i in range(2)) == 3
+    t2.close()
+
+
+def test_durable_topic_codec_roundtrip(tmp_path):
+    enc = lambda m: m.to_json()
+    dec = lambda raw: UnsequencedMessage.from_json(raw)
+    t = DurableTopic("ops", 1, str(tmp_path), enc, dec)
+    msg = op("alice", 7)
+    t.produce("d", msg)
+    t.close()
+    t2 = DurableTopic("ops", 1, str(tmp_path), enc, dec)
+    rec = t2.partition(0).read(0)[0]
+    assert rec.payload.client_id == "alice" and rec.payload.client_seq == 7
+    t2.close()
+
+
+# ------------------------------------------------------------ consumer group
+
+def test_consumer_group_assignment_and_rebalance():
+    topic = Topic("t", 4)
+    g = ConsumerGroup(topic, "g1")
+    g.join("m1")
+    assert g.assignments("m1") == [0, 1, 2, 3]
+    g.join("m2")
+    a1, a2 = g.assignments("m1"), g.assignments("m2")
+    assert sorted(a1 + a2) == [0, 1, 2, 3]
+    assert set(a1).isdisjoint(a2)
+    gen = g.generation
+    g.leave("m1")
+    assert g.generation == gen + 1
+    assert g.assignments("m2") == [0, 1, 2, 3]
+    assert g.assignments("m1") == []
+
+
+def test_consumer_group_offsets_persist(tmp_path):
+    topic = DurableTopic("t", 2, str(tmp_path))
+    for i in range(5):
+        topic.produce("doc", {"i": i})
+    g = ConsumerGroup(topic, "g1", str(tmp_path))
+    g.join("m1")
+    consumed = g.consume("m1")
+    assert len(consumed) == 5
+    for p, rec in consumed:
+        g.commit(p, rec.offset + 1)
+    assert g.lag() == 0
+    topic.close()
+    # Restarted member resumes from the committed offsets.
+    topic2 = DurableTopic("t", 2, str(tmp_path))
+    topic2.open_all()
+    g2 = ConsumerGroup(topic2, "g1", str(tmp_path))
+    g2.join("m9")
+    assert g2.consume("m9") == []
+    topic2.produce("doc", {"i": 99})
+    assert [r.payload for _p, r in g2.consume("m9")] == [{"i": 99}]
+    topic2.close()
+
+
+# --------------------------------------------------- crash-recovery pipeline
+
+def drive_ops(svc, n=6) -> None:
+    svc.join("docA", "alice")
+    svc.join("docB", "bob")
+    svc.pump()
+    for i in range(1, n + 1):
+        svc.submit_op("docA", op("alice", i, ref=0))
+        svc.submit_op("docB", op("bob", i, ref=0))
+    svc.pump()
+
+
+def stream_of(svc, doc) -> list[tuple[int, str, int | None]]:
+    return [
+        (m.seq, m.client_id, m.client_seq) for m in svc.ops_of(doc)
+    ]
+
+
+def test_durable_pipeline_recovers_after_checkpoint(tmp_path):
+    svc = DurablePipelineService(str(tmp_path), n_partitions=2)
+    drive_ops(svc)
+    svc.checkpoint()
+    # More traffic AFTER the checkpoint (sequenced + persisted, then crash).
+    svc.submit_op("docA", op("alice", 7))
+    svc.pump()
+    want_a, want_b = stream_of(svc, "docA"), stream_of(svc, "docB")
+    svc.close()  # crash
+
+    rec = DurablePipelineService(str(tmp_path), n_partitions=2)
+    assert stream_of(rec, "docA") == want_a
+    assert stream_of(rec, "docB") == want_b
+    # The service keeps sequencing where it left off, no seq reuse.
+    rec.submit_op("docA", op("alice", 8))
+    rec.pump()
+    seqs = [s for s, _c, _n in stream_of(rec, "docA")]
+    assert seqs == sorted(set(seqs)), f"duplicate/regressed seqs: {seqs}"
+    rec.close()
+
+
+def test_durable_pipeline_recovers_without_checkpoint(tmp_path):
+    """Recovery with no checkpoint at all: full deterministic replay, no
+    double-ticketing into the durable deltas log."""
+    svc = DurablePipelineService(str(tmp_path), n_partitions=2)
+    drive_ops(svc, n=4)
+    want = stream_of(svc, "docA")
+    svc.close()
+
+    rec = DurablePipelineService(str(tmp_path), n_partitions=2)
+    assert stream_of(rec, "docA") == want
+    rec.close()
+
+
+def test_durable_pipeline_matches_memory_pipeline(tmp_path):
+    mem = PipelineService(n_partitions=2)
+    dur = DurablePipelineService(str(tmp_path), n_partitions=2)
+    for svc in (mem, dur):
+        drive_ops(svc, n=5)
+    assert stream_of(mem, "docA") == stream_of(dur, "docA")
+    assert stream_of(mem, "docB") == stream_of(dur, "docB")
+    dur.close()
+
+
+def test_durable_summary_ack_not_duplicated_on_recovery(tmp_path):
+    from fluidframework_tpu.protocol.messages import MessageType
+    from fluidframework_tpu.runtime.summary import blob, tree
+
+    svc = DurablePipelineService(str(tmp_path), n_partitions=1)
+    svc.join("doc", "alice")
+    svc.pump()
+    handle = svc.upload_summary(tree({"root": blob({"v": 1})}))
+    svc.submit_op(
+        "doc",
+        UnsequencedMessage(
+            client_id="alice", client_seq=1, ref_seq=1,
+            type=MessageType.SUMMARIZE,
+            contents={"handle": handle, "refSeq": 1},
+        ),
+    )
+    svc.pump()
+    acks = [
+        m for m in svc.ops_of("doc")
+        if m.type in (MessageType.SUMMARY_ACK, MessageType.SUMMARY_NACK)
+    ]
+    assert len(acks) == 1 and acks[0].type == MessageType.SUMMARY_ACK
+    svc.close()
+
+    rec = DurablePipelineService(str(tmp_path), n_partitions=1)
+    acks2 = [
+        m for m in rec.ops_of("doc")
+        if m.type in (MessageType.SUMMARY_ACK, MessageType.SUMMARY_NACK)
+    ]
+    assert len(acks2) == 1 and acks2[0].type == MessageType.SUMMARY_ACK
+    assert rec.snapshots_of("doc") == svc.snapshots_of("doc")
+    rec.close()
+
+
+def test_stale_handle_retry_still_gets_nacked():
+    """Dedup drops only EXACT (handle, type) duplicates: a client retrying
+    SUMMARIZE with an already-consumed handle must still receive the nack
+    (different type than the recorded ack)."""
+    from fluidframework_tpu.protocol.messages import MessageType
+    from fluidframework_tpu.runtime.summary import blob, tree
+
+    svc = PipelineService(n_partitions=1)
+    svc.join("doc", "alice")
+    svc.pump()
+    h = svc.upload_summary(tree({"root": blob({"v": 1})}))
+
+    def summarize(cseq):
+        svc.submit_op(
+            "doc",
+            UnsequencedMessage(
+                client_id="alice", client_seq=cseq, ref_seq=1,
+                type=MessageType.SUMMARIZE,
+                contents={"handle": h, "refSeq": 1},
+            ),
+        )
+        svc.pump()
+
+    summarize(1)
+    summarize(2)  # handle already consumed -> unknown-handle nack
+    types = [
+        m.type for m in svc.ops_of("doc")
+        if m.type in (MessageType.SUMMARY_ACK, MessageType.SUMMARY_NACK)
+    ]
+    assert types == [MessageType.SUMMARY_ACK, MessageType.SUMMARY_NACK]
+
+
+# ------------------------------------------------------ stateless multi-front
+
+def test_two_front_pairs_share_one_core():
+    """Two full front pairs (TCP nexus + HTTP alfred) over ONE ordering
+    core: containers attached through DIFFERENT fronts converge — the
+    front holds no document state (§2.6.5 stateless scale-out)."""
+    import threading
+
+    from fluidframework_tpu.dds.channels import default_registry
+    from fluidframework_tpu.driver.network_driver import (
+        NetworkDocumentServiceFactory,
+    )
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.server.local_service import LocalService
+    from fluidframework_tpu.server.netserver import HttpFront, NetworkServer
+
+    core = LocalService()
+    lock = threading.RLock()
+    tcp1 = NetworkServer(core, lock=lock).start()
+    tcp2 = NetworkServer(core, lock=lock).start()
+    http1 = HttpFront(core, lock).start()
+    http2 = HttpFront(core, lock).start()
+    try:
+        fa = NetworkDocumentServiceFactory("127.0.0.1", tcp1.port, http1.port)
+        fb = NetworkDocumentServiceFactory("127.0.0.1", tcp2.port, http2.port)
+
+        d = Container.create_detached(default_registry(), container_id="A")
+        ds = d.runtime.create_datastore("root")
+        ds.create_channel("sharedString", "text")
+        d.attach("doc", fa, "A")  # via front pair 1
+        fa.sync_all()
+
+        c2 = Container.load("doc", fb, default_registry(), "B")  # front pair 2
+        fb.sync_all()
+
+        sa = d.runtime.datastore("root").get_channel("text")
+        sb = c2.runtime.datastore("root").get_channel("text")
+        sa.insert_text(0, "front1 ")
+        d.runtime.flush()
+        fa.sync_all(); fb.sync_all()
+        sb.insert_text(len(sb.text), "front2")
+        c2.runtime.flush()
+        fb.sync_all(); fa.sync_all()
+        assert sa.text == sb.text == "front1 front2"
+        d.disconnect()
+        c2.disconnect()
+    finally:
+        tcp1.stop(); tcp2.stop(); http1.stop(); http2.stop()

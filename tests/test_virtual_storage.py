@@ -93,9 +93,31 @@ def test_shred_hydrate_roundtrip():
 
 
 def test_shred_escapes_marker_shaped_dicts():
-    original = {"runtime": {VBLOB_KEY: "user-data"}, "protocol": {}}
+    # Marker-shaped user data with a NON-string payload escapes cleanly
+    # (string payloads are reserved: they ARE markers and pass through).
+    original = {"runtime": {VBLOB_KEY: 42}, "protocol": {}}
     skeleton = shred_summary(original, lambda c: "never", threshold=10_000)
     assert hydrate_summary(skeleton, lambda b: "") == original
+
+
+def test_reshredding_unhydrated_skeleton_preserves_content():
+    """write_snapshot over a lazily-read snapshot (or a dict() copy of one,
+    which bypasses hydration) must keep chunk markers resolvable rather
+    than corrupting them into literal content."""
+    store = CountingStore()
+    v = VirtualizedStorageService(store, threshold=128)
+    v.write_snapshot(1, big_summary())
+    _, lazy = v.get_latest_snapshot()
+    raw_copy = dict.copy(lazy)  # unhydrated: still contains markers
+    v.write_snapshot(2, raw_copy)
+    reader = VirtualizedStorageService(store, cache=SnapshotCache(), threshold=128)
+    _, snap = reader.get_latest_snapshot()
+    assert snap["runtime"] == big_summary()["runtime"]
+    # And the LazySnapshot direct path hydrates before shredding.
+    _, lazy2 = v.get_latest_snapshot()
+    v.write_snapshot(3, lazy2)
+    _, snap3 = reader.get_latest_snapshot()
+    assert snap3["runtime"] == big_summary()["runtime"]
 
 
 def test_unchanged_subtrees_keep_their_chunk_ids():
@@ -179,7 +201,7 @@ def test_warm_cache_never_suppresses_uploads_after_server_restart():
 def test_shred_escape_of_escape_marker_roundtrips():
     from fluidframework_tpu.driver.virtual_storage import VBLOB_ESCAPE
 
-    original = {"runtime": {VBLOB_ESCAPE: "user"}, "p": {VBLOB_KEY: "u2"}}
+    original = {"runtime": {VBLOB_ESCAPE: "user"}, "p": {VBLOB_KEY: [1, 2]}}
     skeleton = shred_summary(original, lambda c: "never", threshold=10_000)
     assert hydrate_summary(skeleton, lambda b: "") == original
 
