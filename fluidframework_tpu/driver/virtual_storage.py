@@ -116,12 +116,19 @@ def _canonical(value: Any) -> str:
 
 
 def shred_summary(
-    summary: dict, upload: Callable[[str], str], threshold: int = 256
+    summary: dict,
+    upload: Callable[[str], str],
+    threshold: int = 256,
+    known_chunk: Callable[[str], bool] | None = None,
 ) -> dict:
     """Replace large subtrees (bottom-up) with ``{VBLOB_KEY: id}`` markers.
     Children shred first, so a huge tree becomes a spine of small nodes
     pointing at content-addressed chunks — unchanged chunks keep their ids
-    across snapshots (the virtualization dedup)."""
+    across snapshots (the virtualization dedup).
+
+    ``known_chunk`` validates pass-through markers (re-shredding an
+    unhydrated skeleton): an id it rejects raises at WRITE time instead of
+    silently storing a dangling marker that fails far away at hydration."""
 
     def walk(value: Any, depth: int) -> Any:
         if isinstance(value, dict):
@@ -132,6 +139,11 @@ def shred_summary(
                 # through — the id still resolves in the blob store.
                 # VBLOB_KEY is a reserved key; genuine user data shaped
                 # exactly {VBLOB_KEY: <str>} is not representable.
+                if known_chunk is not None and not known_chunk(value[VBLOB_KEY]):
+                    raise ValueError(
+                        f"marker-shaped value {value!r} does not name a known "
+                        f"chunk ({VBLOB_KEY!r} is a reserved key)"
+                    )
                 return dict(value)
             if keys == {VBLOB_KEY} or keys == {VBLOB_ESCAPE}:
                 # Marker- or escape-shaped user data (non-string payload):
@@ -260,12 +272,23 @@ class VirtualizedStorageService(StorageService):
         seq, skeleton = snap
         return seq, LazySnapshot(skeleton, self._fetch_chunk)
 
+    def _known_chunk(self, blob_id: str) -> bool:
+        if self._cache.get(blob_id) is not None:
+            return True
+        try:
+            self._cache.put(blob_id, self._inner.read_blob_content(blob_id))
+            return True
+        except Exception:
+            return False
+
     def write_snapshot(self, seq: int, summary: dict) -> None:
         if isinstance(summary, LazySnapshot):
             # Force per-key hydration so we shred content, not markers
             # (markers that do sneak in pass through shred_summary intact).
             summary = {k: summary[k] for k in summary.keys()}
-        skeleton = shred_summary(dict(summary), self._upload_chunk, self._threshold)
+        skeleton = shred_summary(
+            dict(summary), self._upload_chunk, self._threshold, self._known_chunk
+        )
         self._inner.write_snapshot(seq, skeleton)
 
     def upload_blob_content(self, content: str) -> str:

@@ -29,7 +29,7 @@ import json
 import os
 
 from ..protocol.messages import MessageType, Nack, SequencedMessage, UnsequencedMessage
-from .ordered_log import DurableTopic, Topic
+from .ordered_log import DurableTopic, Topic, atomic_json_dump
 from .sequencer import Sequencer
 
 
@@ -60,14 +60,20 @@ class DeliLambda:
         # are skipped instead of appended twice.
         self.produced = 0
         self.dedup_until = 0
-        # Service-message dedup by (upload handle, message type): a
-        # crash-replayed scribe re-emits the SAME ack/nack it produced
-        # before the crash; the first ticket wins and exact duplicates are
-        # dropped — ack/nack per handle is idempotent state. A nack
-        # FOLLOWING an ack (stale-handle retry) has a different type and
-        # passes. (The reference leans on scribe checkpoints + broker
-        # transactions for the same guarantee.)
-        self.seen_service: dict[str, set[tuple[str, int]]] = {}
+        # Replay-response drop set, STATIC per recovery: (doc, handle,
+        # type) of every summary response already TICKETED into the
+        # durable deltas log before this restart (populated by the durable
+        # service at restore; always empty for in-memory deployments). A
+        # crash-replayed scribe re-emits responses for SUMMARIZE ops it
+        # could not know it had handled; those exact duplicates are
+        # dropped — clients already receive the originals via catch-up.
+        # Live traffic is never suppressed: this set never grows at
+        # runtime, so a genuine retry always gets its fresh response.
+        # ``replay_boundary`` separates replayed originals (below: must
+        # re-ticket to rebuild sequencer state) from re-emitted duplicates
+        # (at/above: dropped).
+        self.replay_responses: set[tuple[str, str, int]] = set()
+        self.replay_boundary = 0
 
     def _sequencer(self, doc_id: str):
         if doc_id not in self.sequencers:
@@ -86,13 +92,15 @@ class DeliLambda:
             elif kind == "service":
                 mtype, contents = payload
                 handle = contents.get("handle") if isinstance(contents, dict) else None
-                if handle is not None:
-                    seen = self.seen_service.setdefault(rec.doc_id, set())
-                    if (handle, mtype) in seen:
-                        self.offset = rec.offset + 1
-                        n += 1
-                        continue
-                    seen.add((handle, mtype))
+                if (
+                    handle is not None
+                    and rec.offset >= self.replay_boundary
+                    and (rec.doc_id, handle, mtype) in self.replay_responses
+                ):
+                    self.replay_responses.discard((rec.doc_id, handle, mtype))
+                    self.offset = rec.offset + 1
+                    n += 1
+                    continue
                 out = seqr.mint_service(mtype, contents)
             else:  # op
                 out = seqr.ticket(payload)
@@ -122,10 +130,6 @@ class DeliLambda:
             "docs": docs,
             "useNative": self._use_native,
             "produced": self.produced,
-            "seenService": {
-                doc: sorted([h, t] for h, t in seen)
-                for doc, seen in self.seen_service.items()
-            },
         }
 
     @staticmethod
@@ -135,10 +139,6 @@ class DeliLambda:
         )
         lam.offset = state["offset"]
         lam.produced = state.get("produced", 0)
-        lam.seen_service = {
-            doc: {(h, t) for h, t in seen}
-            for doc, seen in state.get("seenService", {}).items()
-        }
         for doc_id, entry in state["docs"].items():
             if "native" in entry:
                 from ..native import NativeSequencer
@@ -364,16 +364,6 @@ def _decode_delta(raw: str) -> SequencedMessage:
     return SequencedMessage.from_json(raw)
 
 
-def _atomic_json_dump(obj, path: str) -> None:
-    """Write-temp-then-rename: a crash mid-write never destroys the
-    previous good file (checkpoint files are the recovery state — losing
-    one to a torn write would be worse than having none)."""
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(obj, f)
-    os.replace(tmp, path)
-
-
 class DurableUploads(dict):
     """Staged summary uploads, persisted on upload (the reference's
     historian staging is durable): a crash between upload and checkpoint
@@ -396,7 +386,7 @@ class DurableUploads(dict):
         self._persisted = dict(self)
 
     def _flush(self) -> None:
-        _atomic_json_dump(
+        atomic_json_dump(
             {"uploads": self._persisted, "counter": self.counter}, self._path
         )
 
@@ -467,7 +457,7 @@ class DurablePipelineService(PipelineService):
                 for p, lam in enumerate(self.scribe)
             },
         }
-        _atomic_json_dump(state, self._ckpt_path())
+        atomic_json_dump(state, self._ckpt_path())
         self.uploads.compact()
 
     def _restore(self) -> None:
@@ -491,9 +481,26 @@ class DurablePipelineService(PipelineService):
                 }
         # Whatever already reached the durable deltas log (possibly beyond
         # the checkpoint — flushes keep running between checkpoints) must
-        # not be appended twice during replay.
+        # not be appended twice during replay; likewise summary responses
+        # already ticketed must not re-sequence when a replaying scribe
+        # re-emits them, and upload handles consumed by SUMMARIZE ops the
+        # scribe is already past must not resurrect (a crash between the
+        # checkpoint write and the uploads compaction leaves them behind).
         for p, lam in enumerate(self.deli):
             lam.dedup_until = self.deltas.partition(p).head
+            lam.replay_boundary = self.rawdeltas.partition(p).head
+        for p in range(len(self.deli)):
+            scribe_offset = self.scribe[p].offset
+            for rec in self.deltas.partition(p).read(0):
+                msg: SequencedMessage = rec.payload
+                contents = msg.contents if isinstance(msg.contents, dict) else {}
+                handle = contents.get("handle")
+                if handle is None:
+                    continue
+                if msg.type in (MessageType.SUMMARY_ACK, MessageType.SUMMARY_NACK):
+                    self.deli[p].replay_responses.add((rec.doc_id, handle, msg.type))
+                elif msg.type == MessageType.SUMMARIZE and rec.offset < scribe_offset:
+                    self.uploads.pop(handle, None)
         # Scriptorium/broadcaster replay the durable deltas topic from zero
         # — deterministic rebuild of the op store; broadcaster has no
         # subscribers yet (stateless fronts re-register on reconnect).

@@ -25,6 +25,16 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 
+def atomic_json_dump(obj, path: str) -> None:
+    """Write-temp-then-rename: a crash mid-write never destroys the
+    previous good file (these files ARE the recovery state — a torn write
+    would be worse than no file)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
 @dataclass
 class LogRecord:
     offset: int
@@ -98,12 +108,27 @@ class DurablePartition(Partition):
         self._encode = encode
         self._decode = decode
         if os.path.exists(path):
-            with open(path) as f:
-                for line in f:
-                    if not line.strip():
-                        continue
-                    rec = json.loads(line)
-                    super().append(rec["doc"], decode(rec["payload"]))
+            good_bytes = 0
+            with open(path, "rb") as f:
+                raw_lines = f.read().split(b"\n")
+            for i, raw in enumerate(raw_lines):
+                if not raw.strip():
+                    good_bytes += len(raw) + 1
+                    continue
+                try:
+                    rec = json.loads(raw)
+                except json.JSONDecodeError:
+                    if i == len(raw_lines) - 1:
+                        # Torn trailing write (crash/disk-full mid-append):
+                        # drop the partial record, keep the good prefix —
+                        # recovery must not be blocked by the very crash it
+                        # exists for.
+                        break
+                    raise
+                super().append(rec["doc"], decode(rec["payload"]))
+                good_bytes += len(raw) + 1
+            with open(path, "r+b") as f:
+                f.truncate(min(good_bytes, os.path.getsize(path)))
         self._file = open(path, "a")
 
     def append(self, doc_id: str, payload: Any) -> int:
@@ -212,12 +237,7 @@ class ConsumerGroup:
     def commit(self, partition: int, offset: int) -> None:
         self._offsets[partition] = offset
         if self._path is not None:
-            # Temp-then-rename: a torn write must not destroy the last good
-            # offsets file (it IS the group's recovery state).
-            tmp = self._path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(self._offsets, f)
-            os.replace(tmp, self._path)
+            atomic_json_dump(self._offsets, self._path)
 
     def consume(
         self, member_id: str, max_records: int = 1 << 30

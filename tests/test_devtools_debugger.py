@@ -1,0 +1,212 @@
+"""Debugger driver (op-stepping interposer) and devtools inspection.
+
+Mirrors the reference's packages/drivers/debugger (FluidDebugger +
+DebugReplayController: hold inbound ops, step/play/resume) and
+packages/tools/devtools/devtools-core (FluidDevtools container registry,
+ContainerDevtools metadata/audience/DDS visualization, DevtoolsLogger).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.driver import LocalDocumentServiceFactory
+from fluidframework_tpu.driver.debugger_driver import (
+    DebugController,
+    DebuggerDocumentServiceFactory,
+)
+from fluidframework_tpu.loader import Container
+from fluidframework_tpu.server import LocalService
+from fluidframework_tpu.tools.devtools import (
+    DevtoolsLogger,
+    DevtoolsServer,
+    FluidDevtools,
+    visualize_channel,
+)
+
+
+def boot(svc, factory, name="creator"):
+    d = Container.create_detached(default_registry(), container_id=name)
+    ds = d.runtime.create_datastore("root")
+    ds.create_channel("sharedString", "text")
+    ds.create_channel("sharedMap", "map")
+    d.attach("doc", factory, name)
+    return d
+
+
+def string_of(c):
+    return c.runtime.datastore("root").get_channel("text")
+
+
+# ------------------------------------------------------------------ debugger
+
+def test_debugger_holds_and_steps_live_ops():
+    svc = LocalService()
+    inner = LocalDocumentServiceFactory(svc)
+    writer = boot(svc, inner)
+    svc.process_all()
+
+    dbg = DebuggerDocumentServiceFactory(inner)
+    viewer = Container.load("doc", dbg, default_registry(), "viewer")
+    svc.process_all()
+    ctl = dbg.controller_for("doc")
+    base = string_of(viewer).text
+
+    # Writer makes three edits; the viewer's debugger holds them.
+    for ch in "abc":
+        string_of(writer).insert_text(len(string_of(writer).text), ch)
+        writer.runtime.flush()
+        svc.process_all()
+    assert string_of(viewer).text == base
+    assert ctl.pending >= 3
+
+    # Step ops through one at a time (the buffer also holds joins/noops)
+    # until exactly the first edit has landed — never overshooting.
+    while string_of(viewer).text != base + "a":
+        assert ctl.step(1) == 1, "buffer drained before the first edit?"
+    assert string_of(viewer).text == base + "a"
+    # Play to the end.
+    ctl.resume()
+    assert string_of(viewer).text == base + "abc"
+    # Live now: the next edit flows straight through.
+    string_of(writer).insert_text(0, ">")
+    writer.runtime.flush()
+    svc.process_all()
+    assert string_of(viewer).text == ">" + base + "abc"
+    viewer.disconnect()
+    writer.disconnect()
+
+
+def test_debugger_play_to_seq():
+    svc = LocalService()
+    inner = LocalDocumentServiceFactory(svc)
+    writer = boot(svc, inner)
+    svc.process_all()
+    dbg = DebuggerDocumentServiceFactory(inner)
+    viewer = Container.load("doc", dbg, default_registry(), "viewer")
+    svc.process_all()
+    ctl = dbg.controller_for("doc")
+    for ch in "xyz":
+        string_of(writer).insert_text(0, ch)
+        writer.runtime.flush()
+        svc.process_all()
+    assert ctl.pending >= 3
+    target = ctl.next_seq() + 1
+    ctl.play_to_seq(target)
+    assert ctl.pending >= 1  # one or more still held
+    assert ctl.next_seq() > target
+    ctl.resume()
+    assert string_of(viewer).text == string_of(writer).text
+    viewer.disconnect()
+    writer.disconnect()
+
+
+def test_debugger_two_viewers_no_double_delivery():
+    """Two containers behind ONE controller: each op delivers only to its
+    own connection's listener, never fanned out to every sink."""
+    svc = LocalService()
+    inner = LocalDocumentServiceFactory(svc)
+    writer = boot(svc, inner)
+    svc.process_all()
+    dbg = DebuggerDocumentServiceFactory(inner)
+    v1 = Container.load("doc", dbg, default_registry(), "v1")
+    v2 = Container.load("doc", dbg, default_registry(), "v2")
+    svc.process_all()
+    ctl = dbg.controller_for("doc")
+    string_of(writer).insert_text(0, "solo")
+    writer.runtime.flush()
+    svc.process_all()
+    ctl.resume()
+    assert string_of(v1).text == string_of(v2).text == "solo"
+    v1.disconnect(); v2.disconnect(); writer.disconnect()
+
+
+# ------------------------------------------------------------------ devtools
+
+def make_pair():
+    svc = LocalService()
+    factory = LocalDocumentServiceFactory(svc)
+    writer = boot(svc, factory)
+    svc.process_all()
+    return svc, factory, writer
+
+
+def test_devtools_container_inspection():
+    svc, factory, writer = make_pair()
+    string_of(writer).insert_text(0, "inspect me")
+    writer.runtime.datastore("root").get_channel("map").set("k", 7)
+    writer.runtime.flush()
+    svc.process_all()
+
+    devtools = FluidDevtools()
+    devtools.register_container("main", writer.runtime)
+    snap = devtools.to_json()
+    c = snap["containers"]["main"]
+    assert c["metadata"]["connected"] is True
+    assert c["metadata"]["containerId"] == "creator"
+    assert c["data"]["root"]["text"]["type"] == "sharedString"
+    assert c["data"]["root"]["text"]["text"] == "inspect me"
+    assert c["data"]["root"]["map"]["entries"] == {"k": 7}
+    assert any(m["clientId"] == "creator" for m in c["audience"])
+    with pytest.raises(ValueError):
+        devtools.register_container("main", writer.runtime)
+    devtools.close_container("main")
+    assert "main" not in devtools.containers
+    writer.disconnect()
+
+
+def test_devtools_logger_and_metrics():
+    base = DevtoolsLogger()
+    devtools = FluidDevtools(logger=base)
+    base.generic("opApplied", docs=3)
+    base.generic("opApplied", docs=4)
+    base.performance("step", 0.25)
+    m = devtools.metrics()
+    assert m["eventCounts"]["generic:opApplied"] == 2
+    assert m["eventCounts"]["performance:step"] == 1
+    assert abs(m["eventDurations"]["performance:step"] - 0.25) < 1e-9
+
+
+def test_devtools_http_surface():
+    svc, factory, writer = make_pair()
+    string_of(writer).insert_text(0, "over http")
+    writer.runtime.flush()
+    svc.process_all()
+    devtools = FluidDevtools()
+    devtools.register_container("main", writer.runtime)
+    server = DevtoolsServer(devtools).start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/devtools"
+        ) as resp:
+            body = json.loads(resp.read())
+        assert body["containers"]["main"]["data"]["root"]["text"]["text"] == "over http"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/devtools/container/main"
+        ) as resp:
+            one = json.loads(resp.read())
+        assert one["metadata"]["containerKey"] == "main"
+        assert (
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/devtools/metrics"
+            ).status
+            == 200
+        )
+    finally:
+        server.stop()
+    writer.disconnect()
+
+
+def test_visualize_unknown_channel_never_raises():
+    class Weird:
+        channel_type = "weird"
+
+        def summarize(self):
+            raise RuntimeError("boom")
+
+    out = visualize_channel(Weird())
+    assert out["type"] == "weird" and "error" in out

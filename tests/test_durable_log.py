@@ -193,6 +193,53 @@ def test_durable_summary_ack_not_duplicated_on_recovery(tmp_path):
     rec.close()
 
 
+def test_durable_partition_tolerates_torn_trailing_line(tmp_path):
+    """A crash mid-append leaves a partial JSONL line; reopen must keep
+    the good prefix instead of refusing to start."""
+    t = DurableTopic("raw", 1, str(tmp_path))
+    t.produce("doc", {"x": 1})
+    t.produce("doc", {"x": 2})
+    t.close()
+    import os
+
+    path = os.path.join(str(tmp_path), "raw", "p0.jsonl")
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 5)  # tear the last record
+    t2 = DurableTopic("raw", 1, str(tmp_path))
+    recs = t2.partition(0).read(0)
+    assert [r.payload for r in recs] == [{"x": 1}]
+    # Appends continue cleanly after the repair.
+    t2.produce("doc", {"x": 3})
+    t2.close()
+    t3 = DurableTopic("raw", 1, str(tmp_path))
+    assert [r.payload for r in t3.partition(0).read(0)] == [{"x": 1}, {"x": 3}]
+    t3.close()
+
+
+def test_live_duplicate_summarize_nacked_every_time():
+    """The replay dedup must never suppress LIVE traffic: retrying a bogus
+    handle gets a nack on every attempt, even in-memory."""
+    from fluidframework_tpu.protocol.messages import MessageType
+
+    svc = PipelineService(n_partitions=1)
+    svc.join("doc", "alice")
+    svc.pump()
+    for cseq in (1, 2):
+        svc.submit_op(
+            "doc",
+            UnsequencedMessage(
+                client_id="alice", client_seq=cseq, ref_seq=1,
+                type=MessageType.SUMMARIZE,
+                contents={"handle": "bogus", "refSeq": 1},
+            ),
+        )
+        svc.pump()
+    nacks = [
+        m for m in svc.ops_of("doc") if m.type == MessageType.SUMMARY_NACK
+    ]
+    assert len(nacks) == 2
+
+
 def test_stale_handle_retry_still_gets_nacked():
     """Dedup drops only EXACT (handle, type) duplicates: a client retrying
     SUMMARIZE with an already-consumed handle must still receive the nack
