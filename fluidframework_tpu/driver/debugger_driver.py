@@ -132,6 +132,12 @@ class _EmptyStorage(StorageService):
     def upload_summary(self, summary_tree: dict) -> str:
         return self._inner.upload_summary(summary_tree)
 
+    def get_versions(self, max_count: int = 5) -> list[dict]:
+        return self._inner.get_versions(max_count)
+
+    def get_snapshot_version(self, version_id: str) -> tuple[int, dict] | None:
+        return self._inner.get_snapshot_version(version_id)
+
 
 class DebuggerDocumentService(DocumentService):
     def __init__(

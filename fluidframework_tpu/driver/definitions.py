@@ -78,6 +78,16 @@ class StorageService:
         carries (ref uploadSummaryWithContext)."""
         raise NotImplementedError
 
+    def get_versions(self, max_count: int = 5) -> list[dict]:
+        """Newest-first snapshot version descriptors ({id, seq}; ref
+        IDocumentStorageService.getVersions)."""
+        raise NotImplementedError
+
+    def get_snapshot_version(self, version_id: str) -> tuple[int, dict] | None:
+        """A specific stored snapshot version (ref getSnapshotTree with a
+        version header)."""
+        raise NotImplementedError
+
 
 class DocumentService:
     """One document's service endpoints (ref IDocumentService)."""

@@ -107,6 +107,12 @@ class LocalStorageService(StorageService):
     def upload_summary(self, summary_tree: dict) -> str:
         return self._doc.upload_summary(summary_tree)
 
+    def get_versions(self, max_count: int = 5) -> list[dict]:
+        return self._doc.snapshot_versions(max_count)
+
+    def get_snapshot_version(self, version_id: str) -> tuple[int, dict] | None:
+        return self._doc.snapshot_at(version_id)
+
 
 class LocalDocumentService(DocumentService):
     def __init__(self, doc: LocalDocument, token_provider=None) -> None:

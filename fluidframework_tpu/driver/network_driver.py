@@ -313,8 +313,30 @@ class HttpStorageService(StorageService):
             "GET", f"/doc/{self._doc}/blob/{blob_id}", token=self._token
         )
         if status != 200:
-            raise DriverError(f"blob read failed: {body}")
+            # 404 = definitively absent (not retryable); other statuses may
+            # be transient — callers distinguishing "missing" from "broken"
+            # rely on can_retry.
+            raise DriverError(f"blob read failed: {body}", can_retry=status != 404)
         return body["content"]
+
+    def get_versions(self, max_count: int = 5) -> list[dict]:
+        status, body = self._http.request(
+            "GET", f"/doc/{self._doc}/versions?max={max_count}", token=self._token
+        )
+        if status != 200:
+            raise DriverError(f"version list failed: {body}")
+        return body["versions"]
+
+    def get_snapshot_version(self, version_id: str) -> tuple[int, dict] | None:
+        status, body = self._http.request(
+            "GET", f"/doc/{self._doc}/snapshot?version={version_id}",
+            token=self._token,
+        )
+        if status == 404:
+            return None
+        if status != 200:
+            raise DriverError(f"versioned snapshot read failed: {body}")
+        return body["seq"], body["summary"]
 
 
 class NetworkDocumentService(DocumentService):

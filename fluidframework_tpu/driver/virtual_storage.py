@@ -273,13 +273,22 @@ class VirtualizedStorageService(StorageService):
         return seq, LazySnapshot(skeleton, self._fetch_chunk)
 
     def _known_chunk(self, blob_id: str) -> bool:
+        """Existence probe for pass-through markers. Only a DEFINITIVE
+        absence (missing blob) reports unknown; a transient storage failure
+        surfaces as itself, never as a reserved-key complaint. A successful
+        probe warms the cache (the content was fetched anyway)."""
         if self._cache.get(blob_id) is not None:
             return True
         try:
-            self._cache.put(blob_id, self._inner.read_blob_content(blob_id))
-            return True
-        except Exception:
+            content = self._inner.read_blob_content(blob_id)
+        except KeyError:
             return False
+        except DriverError as e:
+            if e.can_retry:
+                raise  # transient: report the real failure
+            return False
+        self._cache.put(blob_id, content)
+        return True
 
     def write_snapshot(self, seq: int, summary: dict) -> None:
         if isinstance(summary, LazySnapshot):
@@ -299,6 +308,16 @@ class VirtualizedStorageService(StorageService):
 
     def upload_summary(self, summary_tree: dict) -> str:
         return self._inner.upload_summary(summary_tree)
+
+    def get_versions(self, max_count: int = 5) -> list[dict]:
+        return self._inner.get_versions(max_count)
+
+    def get_snapshot_version(self, version_id: str) -> tuple[int, dict] | None:
+        snap = self._inner.get_snapshot_version(version_id)
+        if snap is None:
+            return None
+        seq, skeleton = snap
+        return seq, LazySnapshot(skeleton, self._fetch_chunk)
 
 
 class VirtualizedDocumentServiceFactory:

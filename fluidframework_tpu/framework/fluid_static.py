@@ -63,6 +63,15 @@ class FluidContainer:
                 )
         return fc
 
+    @staticmethod
+    def view_version(schema: ContainerSchema, summary: dict) -> "FluidContainer":
+        """A read-only view of a container at a stored snapshot version,
+        never connected to the service (ref AzureClient.viewContainerVersion
+        via loadContainerPaused)."""
+        c = Container.create_detached(schema.registry, container_id="version-view")
+        c.runtime.load_snapshot(summary["runtime"])
+        return FluidContainer(c, schema)
+
     # ----------------------------------------------------------------- access
     @property
     def initial_objects(self) -> dict[str, Any]:

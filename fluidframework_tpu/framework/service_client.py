@@ -1,9 +1,18 @@
 """Service-client façade: create/get containers against a service.
 
-Reference parity: packages/service-clients — ``TinyliciousClient`` /
-``AzureClient`` (AzureClient.ts createContainer/getContainer): the
-three-line app entry that hides loader/driver wiring behind a schema, and
-exposes container "services" (audience).
+Reference parity: packages/service-clients —
+- ``TinyliciousClient``/``AzureClient`` (AzureClient.ts): createContainer /
+  getContainer hiding loader+driver wiring behind a ContainerSchema,
+  container services (audience), getContainerVersions, and
+  viewContainerVersion (a paused, read-only container at a stored version);
+- ``OdspClient``: the same surface over a virtualizing storage path.
+
+Three deployment shapes share one base:
+- ``LocalServiceClient`` — in-process service (unit tests, single process);
+- ``NetworkServiceClient`` — a real service plane over TCP/HTTP with
+  token-provider auth (the AzureClient/TinyliciousClient deployment shape);
+- either with ``virtualize=True`` — storage reads/writes go through
+  odsp-style snapshot virtualization with a persistent cache (OdspClient).
 """
 
 from __future__ import annotations
@@ -11,6 +20,7 @@ from __future__ import annotations
 from typing import Any
 
 from ..driver.local_driver import LocalDocumentServiceFactory
+from ..driver.virtual_storage import VirtualizedDocumentServiceFactory
 from ..server.local_service import LocalService
 from .fluid_static import ContainerSchema, FluidContainer
 
@@ -30,15 +40,20 @@ class Audience:
         return self._container.runtime.client_id
 
 
-class LocalServiceClient:
-    """Client for the in-process service (ref TinyliciousClient shape; a
-    networked deployment swaps the DocumentServiceFactory, nothing else)."""
+class _ServiceClientBase:
+    """Shared create/get/version surface; subclasses supply the driver
+    factory (the only thing that differs between deployments — the same
+    swap the reference makes between Tinylicious/Azure/Odsp clients)."""
 
-    def __init__(self, service: LocalService | None = None) -> None:
-        self.service = service or LocalService()
-        self._factory = LocalDocumentServiceFactory(self.service)
+    def __init__(self, factory, virtualize: bool = False, cache_dir: str | None = None) -> None:
+        self._factory = (
+            VirtualizedDocumentServiceFactory(factory, cache_dir=cache_dir)
+            if virtualize
+            else factory
+        )
         self._counter = 0
 
+    # ------------------------------------------------------------- lifecycle
     def create_container(
         self, schema: ContainerSchema, doc_id: str, client_id: str = "creator"
     ) -> tuple[FluidContainer, dict[str, Any]]:
@@ -55,5 +70,70 @@ class LocalServiceClient:
         fc = FluidContainer.load(doc_id, self._factory, schema, client_id)
         return fc, self._services(fc)
 
+    # -------------------------------------------------------------- versions
+    def _storage(self, doc_id: str):
+        return self._factory.create_document_service(doc_id).connect_to_storage()
+
+    def get_container_versions(self, doc_id: str, max_count: int = 5) -> list[dict]:
+        """Newest-first stored snapshot versions (ref getContainerVersions)."""
+        return self._storage(doc_id).get_versions(max_count)
+
+    def view_container_version(
+        self, doc_id: str, schema: ContainerSchema, version_id: str
+    ) -> FluidContainer:
+        """Read-only container at a specific stored version, never
+        connected (ref viewContainerVersion/loadContainerPaused)."""
+        snap = self._storage(doc_id).get_snapshot_version(version_id)
+        if snap is None:
+            raise KeyError(f"no snapshot version {version_id!r} for {doc_id!r}")
+        _seq, summary = snap
+        return FluidContainer.view_version(schema, summary)
+
     def _services(self, fc: FluidContainer) -> dict[str, Any]:
         return {"audience": Audience(fc.container)}
+
+
+class LocalServiceClient(_ServiceClientBase):
+    """Client for the in-process service (ref TinyliciousClient shape; a
+    networked deployment swaps the DocumentServiceFactory, nothing else)."""
+
+    def __init__(
+        self,
+        service: LocalService | None = None,
+        virtualize: bool = False,
+        cache_dir: str | None = None,
+    ) -> None:
+        self.service = service or LocalService()
+        super().__init__(
+            LocalDocumentServiceFactory(self.service),
+            virtualize=virtualize,
+            cache_dir=cache_dir,
+        )
+
+
+class NetworkServiceClient(_ServiceClientBase):
+    """Client bound to a network service plane (ref AzureClient: endpoint +
+    token provider; here host + nexus/alfred ports). ``sync()`` pumps the
+    underlying connections to quiescence — the deterministic stand-in for
+    background dispatch."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        http_port: int,
+        token_provider=None,
+        virtualize: bool = False,
+        cache_dir: str | None = None,
+    ) -> None:
+        from ..driver.network_driver import NetworkDocumentServiceFactory
+
+        self.network_factory = NetworkDocumentServiceFactory(
+            host, port, http_port, token_provider=token_provider
+        )
+        super().__init__(
+            self.network_factory, virtualize=virtualize, cache_dir=cache_dir
+        )
+
+    def sync(self) -> int:
+        return self.network_factory.sync_all()

@@ -255,6 +255,25 @@ class ScribeLambda:
         return n
 
 
+class CopierLambda:
+    """Raw-op archival: copies every RAW ingestion record (pre-sequencing)
+    into a per-document archive (ref copier/lambda.ts — raw deltas land in
+    Mongo for audit/debugging before deli tickets them)."""
+
+    def __init__(self, rawdeltas: Topic, partition: int):
+        self._in = rawdeltas.partition(partition)
+        self.offset = 0
+        self.archive: dict[str, list] = {}
+
+    def pump(self) -> int:
+        n = 0
+        for rec in self._in.read(self.offset):
+            self.archive.setdefault(rec.doc_id, []).append(rec.payload)
+            self.offset = rec.offset + 1
+            n += 1
+        return n
+
+
 class PipelineService:
     """The assembled ordering service: rawdeltas -> deli -> deltas -> fans.
 
@@ -289,6 +308,7 @@ class PipelineService:
             ScribeLambda(self.deltas, self.rawdeltas, p, self.uploads)
             for p in range(n_partitions)
         ]
+        self.copier = [CopierLambda(self.rawdeltas, p) for p in range(n_partitions)]
 
     # -------------------------------------------------------------- front-end
     def submit_op(self, doc_id: str, msg: UnsequencedMessage) -> None:
@@ -317,7 +337,10 @@ class PipelineService:
         total = 0
         for _ in range(max_rounds):
             moved = 0
-            for lam in (*self.deli, *self.scriptorium, *self.broadcaster, *self.scribe):
+            for lam in (
+                *self.deli, *self.scriptorium, *self.broadcaster,
+                *self.scribe, *self.copier,
+            ):
                 moved += lam.pump()
             total += moved
             if moved == 0:
@@ -332,6 +355,10 @@ class PipelineService:
     def snapshots_of(self, doc_id: str) -> list[tuple[int, dict]]:
         p = self.deltas.partition_for(doc_id)
         return self.scribe[p].snapshots.get(doc_id, [])
+
+    def raw_of(self, doc_id: str) -> list:
+        p = self.rawdeltas.partition_for(doc_id)
+        return self.copier[p].archive.get(doc_id, [])
 
 
 # ---------------------------------------------------------------------------
@@ -491,16 +518,31 @@ class DurablePipelineService(PipelineService):
             lam.replay_boundary = self.rawdeltas.partition(p).head
         for p in range(len(self.deli)):
             scribe_offset = self.scribe[p].offset
+            # Handles whose SUMMARIZE the restarted scribe WILL re-process
+            # (at/after its checkpoint offset) — only their responses can
+            # be re-emitted, so only those may be dropped as duplicates;
+            # a stale entry would swallow a live post-restart retry.
+            re_emittable: set[tuple[str, str]] = set()
             for rec in self.deltas.partition(p).read(0):
                 msg: SequencedMessage = rec.payload
                 contents = msg.contents if isinstance(msg.contents, dict) else {}
                 handle = contents.get("handle")
-                if handle is None:
+                if handle is None or msg.type != MessageType.SUMMARIZE:
                     continue
-                if msg.type in (MessageType.SUMMARY_ACK, MessageType.SUMMARY_NACK):
-                    self.deli[p].replay_responses.add((rec.doc_id, handle, msg.type))
-                elif msg.type == MessageType.SUMMARIZE and rec.offset < scribe_offset:
+                if rec.offset >= scribe_offset:
+                    re_emittable.add((rec.doc_id, handle))
+                else:
                     self.uploads.pop(handle, None)
+            for rec in self.deltas.partition(p).read(0):
+                msg = rec.payload
+                contents = msg.contents if isinstance(msg.contents, dict) else {}
+                handle = contents.get("handle")
+                if (
+                    handle is not None
+                    and msg.type in (MessageType.SUMMARY_ACK, MessageType.SUMMARY_NACK)
+                    and (rec.doc_id, handle) in re_emittable
+                ):
+                    self.deli[p].replay_responses.add((rec.doc_id, handle, msg.type))
         # Scriptorium/broadcaster replay the durable deltas topic from zero
         # — deterministic rebuild of the op store; broadcaster has no
         # subscribers yet (stateless fronts re-register on reconnect).

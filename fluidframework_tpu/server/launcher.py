@@ -1,0 +1,201 @@
+"""Deployment launcher: spawn and supervise service-plane shards.
+
+Reference parity: the routerlicious deployment layer
+(server/routerlicious/docker-compose.yml + server/charts helm): one config
+declares the service processes; an operator command brings them up, waits
+for readiness, and restarts crashed members. Here each "shard" is one
+netserver ServicePlane process owning a disjoint document set (the
+document-sharded scale-out axis, SURVEY §2.6.2); ``shard_for`` is the
+client-side router (the Kafka partition-by-key analog at deployment
+granularity).
+
+Usage:
+    python -m fluidframework_tpu.server.launcher --config deploy/service-plane.json
+or programmatically:
+    dep = launch({"shards": [{"name": "s0"}, {"name": "s1"}]})
+    host, port, http_port = dep.endpoint_for("some-doc-id")
+    ...
+    dep.stop()
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import select
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Shard:
+    name: str
+    port: int = 0  # 0 = ephemeral
+    http_port: int = 0
+    proc: subprocess.Popen | None = None
+    restarts: int = 0
+
+
+@dataclass
+class Deployment:
+    shards: list[Shard]
+    supervise: bool = False
+    _stopping: bool = field(default=False, repr=False)
+    _thread: threading.Thread | None = field(default=None, repr=False)
+
+    # ------------------------------------------------------------- routing
+    def endpoint_for(self, doc_id: str) -> tuple[str, int, int]:
+        s = self.shards[shard_index(doc_id, len(self.shards))]
+        return ("127.0.0.1", s.port, s.http_port)
+
+    def manifest(self) -> dict:
+        return {
+            "shards": [
+                {
+                    "name": s.name,
+                    "port": s.port,
+                    "httpPort": s.http_port,
+                    "pid": s.proc.pid if s.proc else None,
+                    "restarts": s.restarts,
+                }
+                for s in self.shards
+            ]
+        }
+
+    # ----------------------------------------------------------- lifecycle
+    def stop(self) -> None:
+        # Quiesce the supervisor FIRST: otherwise it can respawn a shard
+        # concurrently with (or after) the termination sweep, leaking a
+        # live child bound to the shard's ports.
+        self._stopping = True
+        if self._thread is not None:
+            self._thread.join(timeout=15)
+        for s in self.shards:
+            if s.proc is not None and s.proc.poll() is None:
+                s.proc.terminate()
+        for s in self.shards:
+            if s.proc is not None:
+                try:
+                    s.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    s.proc.kill()
+
+    def _supervise_loop(self) -> None:
+        while not self._stopping:
+            for s in self.shards:
+                if self._stopping:
+                    break
+                if s.proc is not None and s.proc.poll() is not None:
+                    # Crashed member: relaunch on the SAME ports so clients
+                    # reconnect without re-routing (compose restart policy).
+                    s.restarts += 1
+                    try:
+                        _spawn(s)
+                    except RuntimeError:
+                        pass  # next tick retries; the supervisor never dies
+            time.sleep(0.2)
+
+
+def shard_index(doc_id: str, n_shards: int) -> int:
+    return sum(doc_id.encode()) % n_shards
+
+
+def _spawn(shard: Shard, attempts: int = 10) -> None:
+    """Start the shard process and wait for its readiness line. Retries a
+    few times: a restart may race the dying process's listener (transient
+    bind failure)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")  # service shards never need a device
+    cmd = [
+        sys.executable, "-m", "fluidframework_tpu.server.netserver",
+        "--port", str(shard.port),
+        "--http-port", str(shard.http_port),
+    ]
+    last_err = ""
+    for attempt in range(attempts):
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
+        )
+        rdy, _w, _x = select.select([proc.stdout], [], [], 30)
+        line = proc.stdout.readline() if rdy else ""
+        if line.strip():
+            shard.proc = proc
+            ready = json.loads(line)
+            shard.port = ready["port"]
+            shard.http_port = ready["httpPort"]
+            # Drain both pipes for the life of the process: a chatty child
+            # must never block on a full pipe buffer (which would stall the
+            # server while poll() still says alive).
+            for stream in (proc.stdout, proc.stderr):
+                threading.Thread(
+                    target=_drain, args=(stream,), daemon=True
+                ).start()
+            return
+        proc.kill()
+        try:
+            _out, err = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            err = "readiness timeout"
+        last_err = err.strip().splitlines()[-1] if err.strip() else "no output"
+        time.sleep(0.1 * (attempt + 1))
+    raise RuntimeError(f"shard {shard.name} failed to start: {last_err}")
+
+
+def _drain(stream) -> None:
+    try:
+        for _line in stream:
+            pass
+    except (ValueError, OSError):
+        pass  # stream closed at shutdown
+
+
+def launch(config: dict, supervise: bool = False) -> Deployment:
+    """Bring up every shard in the config, wait for readiness, optionally
+    start the crash-restart supervisor."""
+    shards = [
+        Shard(
+            name=entry.get("name", f"shard{i}"),
+            port=int(entry.get("port", 0)),
+            http_port=int(entry.get("httpPort", 0)),
+        )
+        for i, entry in enumerate(config.get("shards", [{}]))
+    ]
+    dep = Deployment(shards=shards, supervise=supervise)
+    try:
+        for s in shards:
+            _spawn(s)
+    except BaseException:
+        dep.stop()
+        raise
+    if supervise:
+        dep._thread = threading.Thread(target=dep._supervise_loop, daemon=True)
+        dep._thread.start()
+    return dep
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", required=True)
+    p.add_argument("--supervise", action="store_true")
+    args = p.parse_args()
+    with open(args.config) as f:
+        config = json.load(f)
+    dep = launch(config, supervise=args.supervise)
+    print(json.dumps(dep.manifest()), flush=True)
+
+    def on_term(_sig, _frm):
+        dep.stop()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -164,6 +164,22 @@ class LocalDocument:
     def latest_snapshot(self) -> tuple[int, dict] | None:
         return self._snapshots[-1] if self._snapshots else None
 
+    def snapshot_versions(self, max_count: int = 5) -> list[dict]:
+        """Newest-first version descriptors (ref AzureClient
+        getContainerVersions over historian's version listing)."""
+        if max_count <= 0:
+            return []
+        return [
+            {"id": str(seq), "seq": seq}
+            for seq, _s in reversed(self._snapshots[-max_count:])
+        ]
+
+    def snapshot_at(self, version_id: str) -> tuple[int, dict] | None:
+        for seq, summary in self._snapshots:
+            if str(seq) == version_id:
+                return seq, summary
+        return None
+
     # ------------------------------------------------------------------ blobs
     def upload_blob(self, content: str) -> str:
         """Content-addressed attachment blob upload; returns the blob id

@@ -283,11 +283,26 @@ class _AlfredHandler(BaseHTTPRequestHandler):
                 ops = [seq_msg_to_dict(m) for m in doc.ops_range(lo, hi)]
                 self._json(200, {"ops": ops})
             elif parts[2] == "snapshot":
-                snap = doc.latest_snapshot()
+                version = q.get("version", [None])[0]
+                snap = (
+                    doc.latest_snapshot()
+                    if version is None
+                    else doc.snapshot_at(version)
+                )
                 if snap is None:
                     self._json(404, {"error": "no snapshot"})
                 else:
                     self._json(200, {"seq": snap[0], "summary": snap[1]})
+            elif parts[2] == "versions":
+                try:
+                    max_count = int(q.get("max", ["5"])[0])
+                except ValueError:
+                    self._json(400, {"error": "non-numeric max"})
+                    return
+                if max_count <= 0:
+                    self._json(400, {"error": "max must be positive"})
+                    return
+                self._json(200, {"versions": doc.snapshot_versions(max_count)})
             elif parts[2] == "stats":
                 self._json(
                     200,

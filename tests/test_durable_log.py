@@ -240,6 +240,42 @@ def test_live_duplicate_summarize_nacked_every_time():
     assert len(nacks) == 2
 
 
+def test_post_restart_live_retry_gets_response(tmp_path):
+    """A response recorded BEFORE the scribe checkpoint must not poison the
+    dedup set: after restart, a live retry with the same handle still gets
+    its (new) response sequenced."""
+    from fluidframework_tpu.protocol.messages import MessageType
+
+    svc = DurablePipelineService(str(tmp_path), n_partitions=1)
+    svc.join("doc", "alice")
+    svc.pump()
+    svc.submit_op(
+        "doc",
+        UnsequencedMessage(
+            client_id="alice", client_seq=1, ref_seq=1,
+            type=MessageType.SUMMARIZE,
+            contents={"handle": "bogus", "refSeq": 1},
+        ),
+    )
+    svc.pump()
+    svc.checkpoint()  # scribe offset moves past the SUMMARIZE + its nack
+    svc.close()
+
+    rec = DurablePipelineService(str(tmp_path), n_partitions=1)
+    rec.submit_op(
+        "doc",
+        UnsequencedMessage(
+            client_id="alice", client_seq=2, ref_seq=1,
+            type=MessageType.SUMMARIZE,
+            contents={"handle": "bogus", "refSeq": 1},
+        ),
+    )
+    rec.pump()
+    nacks = [m for m in rec.ops_of("doc") if m.type == MessageType.SUMMARY_NACK]
+    assert len(nacks) == 2, "live retry after restart lost its nack"
+    rec.close()
+
+
 def test_stale_handle_retry_still_gets_nacked():
     """Dedup drops only EXACT (handle, type) duplicates: a client retrying
     SUMMARIZE with an already-consumed handle must still receive the nack
