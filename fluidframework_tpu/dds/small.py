@@ -374,6 +374,17 @@ class TaskManager(_VerbatimResubmitChannel):
     def __init__(self, channel_id: str) -> None:
         super().__init__(channel_id)
         self.queues: dict[str, list[str]] = {}
+        # (task_id, current_assignee | None) after every sequenced queue
+        # mutation — the hook the agent-scheduler layer drives workers
+        # from. Fires on ANY membership change (not just head changes), so
+        # a scheduler can notice its own eviction (reconnect under a new
+        # id) even while another client holds the task.
+        self.assignment_listeners: list = []
+
+    def _notify(self, task_id: str) -> None:
+        after = self.assignee(task_id)
+        for fn in list(self.assignment_listeners):
+            fn(task_id, after)
 
     def volunteer(self, task_id: str) -> None:
         self.submit_local_message({"type": "volunteer", "taskId": task_id})
@@ -403,11 +414,13 @@ class TaskManager(_VerbatimResubmitChannel):
                 queue.clear()
             else:
                 raise ValueError(f"unknown task op {op['type']}")
+            self._notify(op["taskId"])
 
     def on_client_leave(self, client_id: str, seq: int) -> None:
-        for queue in self.queues.values():
+        for task_id, queue in self.queues.items():
             if client_id in queue:
                 queue.remove(client_id)
+                self._notify(task_id)
 
     def assignee(self, task_id: str) -> str | None:
         queue = self.queues.get(task_id)

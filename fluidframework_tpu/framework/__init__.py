@@ -7,28 +7,41 @@ DataObject authoring model, ``presence`` (ephemeral state over signals),
 op stream), and the service-client façade (tinylicious-client analog).
 """
 
+from .agent_scheduler import AgentScheduler
 from .aqueduct import DataObject, DataObjectFactory
 from .attributor import OpStreamAttributor
 from .fluid_static import ContainerSchema, FluidContainer
 from .interceptions import InterceptedSharedMap, InterceptedSharedString
 from .oldest_client import OldestClientObserver
 from .presence import Presence
-from .service_client import LocalServiceClient
+from .request_handler import (
+    RequestParser,
+    RuntimeRequestHandlerBuilder,
+    datastore_request_handler,
+)
+from .service_client import LocalServiceClient, NetworkServiceClient
+from .synthesize import DependencyContainer
 from .tree_agent import TreeAgent, render_schema_prompt
 from .undo_redo import UndoRedoStackManager
 
 __all__ = [
+    "AgentScheduler",
     "ContainerSchema",
     "DataObject",
     "DataObjectFactory",
+    "DependencyContainer",
     "FluidContainer",
     "InterceptedSharedMap",
     "InterceptedSharedString",
     "LocalServiceClient",
+    "NetworkServiceClient",
     "OldestClientObserver",
     "OpStreamAttributor",
     "Presence",
+    "RequestParser",
+    "RuntimeRequestHandlerBuilder",
     "TreeAgent",
     "UndoRedoStackManager",
+    "datastore_request_handler",
     "render_schema_prompt",
 ]

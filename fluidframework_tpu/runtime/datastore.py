@@ -158,15 +158,19 @@ class DataStoreRuntime:
 
     # ------------------------------------------------------------ checkpoint
     def summarize(self) -> dict[str, Any]:
+        from .snapshot_formats import stamp
+
         return {
             "root": self.is_root,
             "channels": {
-                cid: {"type": ch.channel_type, "summary": ch.summarize()}
+                cid: {"type": ch.channel_type, "summary": stamp(ch.channel_type, ch.summarize())}
                 for cid, ch in self._channels.items()
             }
         }
 
     def load(self, summary: dict[str, Any]) -> None:
+        from .snapshot_formats import upgrade
+
         self.is_root = summary.get("root", True)
         for cid, entry in summary["channels"].items():
             # _create_channel: snapshot-loaded channels are covered by that
@@ -175,13 +179,14 @@ class DataStoreRuntime:
             # A None summary is structure-only (detached attach writes the
             # channel layout; content replays as trailing ops).
             if entry["summary"] is not None:
-                channel.load(entry["summary"])
+                channel.load(upgrade(entry["type"], entry["summary"]))
 
     def summary_tree(self, covered_seq: int | None, prefix: str) -> dict[str, Any]:
         """Incremental summary subtree: a channel whose last sequenced
         change is at or below ``covered_seq`` (the last acked summary's
         refSeq) emits a handle to its previous summary content
         (ref SummarizerNode handle reuse)."""
+        from .snapshot_formats import stamp
         from .summary import blob, handle, tree
 
         channels: dict[str, Any] = {}
@@ -190,7 +195,9 @@ class DataStoreRuntime:
             if covered_seq is not None and self.changed_seqs.get(cid, 0) <= covered_seq:
                 channels[cid] = handle(path)
             else:
-                channels[cid] = blob({"type": ch.channel_type, "summary": ch.summarize()})
+                channels[cid] = blob(
+                    {"type": ch.channel_type, "summary": stamp(ch.channel_type, ch.summarize())}
+                )
         return tree({"channels": tree(channels)})
 
     def structure_summary(self) -> dict[str, Any]:

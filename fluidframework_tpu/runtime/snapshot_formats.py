@@ -1,0 +1,57 @@
+"""Versioned DDS snapshot formats.
+
+Reference parity: the reference evolves per-DDS snapshot formats behind
+explicit versions (merge-tree snapshotV1.ts vs snapshotlegacy.ts, tree's
+versioned editManagerCodecs/messageCodecs) and pins them with a committed
+golden corpus (packages/test/snapshots: real snapshot files validated
+against every supported read-version on every run).
+
+Here every channel summary is stamped ``{"fmt": N, ...}`` at the datastore
+boundary; loading strips the stamp and runs any upgraders from the file's
+version to the current one. Version-1 files (or files from before
+stamping existed) load unchanged: v1 IS the shipping layout. The golden
+corpus lives in ``tests/snapshots/`` with the scripted documents that
+produced it in ``fluidframework_tpu/testing/snapshot_corpus.py`` —
+regenerating requires a deliberate ``python -m fluidframework_tpu.testing.
+snapshot_corpus`` run, so format drift always shows up as a reviewed diff.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+FORMAT_KEY = "fmt"
+
+# Current write-format per channel type; unlisted types are version 1.
+CURRENT_FORMATS: dict[str, int] = {}
+
+# channel type -> list of upgraders; UPGRADERS[t][k] rewrites a version
+# k+1 summary dict into version k+2. Empty today: every type is at v1.
+UPGRADERS: dict[str, list[Callable[[dict], dict]]] = {}
+
+
+def current_format(channel_type: str) -> int:
+    return CURRENT_FORMATS.get(channel_type, 1)
+
+
+def stamp(channel_type: str, summary: dict[str, Any]) -> dict[str, Any]:
+    """Attach the write-format version to a freshly-built summary."""
+    out = dict(summary)
+    out[FORMAT_KEY] = current_format(channel_type)
+    return out
+
+
+def upgrade(channel_type: str, summary: dict[str, Any]) -> dict[str, Any]:
+    """Strip the stamp and lift the payload to the current format.
+    Unstamped summaries are version 1 (the pre-stamping layout)."""
+    out = dict(summary)
+    fmt = out.pop(FORMAT_KEY, 1)
+    cur = current_format(channel_type)
+    if fmt > cur:
+        raise ValueError(
+            f"snapshot of {channel_type!r} uses format {fmt}, newer than "
+            f"this build's {cur} — refusing a lossy downgrade read"
+        )
+    for upgrader in UPGRADERS.get(channel_type, [])[fmt - 1 : cur - 1]:
+        out = upgrader(out)
+    return out

@@ -74,7 +74,9 @@ class Deployment:
         # live child bound to the shard's ports.
         self._stopping = True
         if self._thread is not None:
-            self._thread.join(timeout=15)
+            # _spawn aborts within one attempt cycle once _stopping is set
+            # (readiness wait <= 10s, then the abort check fires).
+            self._thread.join(timeout=45)
         for s in self.shards:
             if s.proc is not None and s.proc.poll() is None:
                 s.proc.terminate()
@@ -95,8 +97,8 @@ class Deployment:
                     # reconnect without re-routing (compose restart policy).
                     s.restarts += 1
                     try:
-                        _spawn(s)
-                    except RuntimeError:
+                        _spawn(s, abort=lambda: self._stopping)
+                    except Exception:
                         pass  # next tick retries; the supervisor never dies
             time.sleep(0.2)
 
@@ -105,10 +107,11 @@ def shard_index(doc_id: str, n_shards: int) -> int:
     return sum(doc_id.encode()) % n_shards
 
 
-def _spawn(shard: Shard, attempts: int = 10) -> None:
+def _spawn(shard: Shard, attempts: int = 10, abort=None) -> None:
     """Start the shard process and wait for its readiness line. Retries a
     few times: a restart may race the dying process's listener (transient
-    bind failure)."""
+    bind failure). ``abort`` (checked between attempts and after readiness)
+    lets a stopping supervisor bail without leaking the fresh child."""
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")  # service shards never need a device
     cmd = [
@@ -118,12 +121,18 @@ def _spawn(shard: Shard, attempts: int = 10) -> None:
     ]
     last_err = ""
     for attempt in range(attempts):
+        if abort is not None and abort():
+            raise RuntimeError(f"shard {shard.name} spawn aborted (stopping)")
         proc = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
         )
-        rdy, _w, _x = select.select([proc.stdout], [], [], 30)
+        rdy, _w, _x = select.select([proc.stdout], [], [], 10)
         line = proc.stdout.readline() if rdy else ""
         if line.strip():
+            if abort is not None and abort():
+                proc.kill()
+                proc.wait(timeout=10)
+                raise RuntimeError(f"shard {shard.name} spawn aborted (stopping)")
             shard.proc = proc
             ready = json.loads(line)
             shard.port = ready["port"]

@@ -1,0 +1,202 @@
+"""Framework utilities: dependency synthesizer, request routing, and the
+agent scheduler.
+
+Mirrors the reference's packages/framework/synthesize (DependencyContainer
+optional/required synthesis + parent fallback), request-handler
+(RuntimeRequestHandlerBuilder + stock handlers), and agent-scheduler
+(exclusive pick/release with worker handoff and leader election)."""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.framework import (
+    AgentScheduler,
+    DependencyContainer,
+    RuntimeRequestHandlerBuilder,
+    datastore_request_handler,
+)
+from fluidframework_tpu.framework.request_handler import (
+    create_fluid_object_handler,
+    default_route_handler,
+)
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+
+# ----------------------------------------------------------------- synthesize
+
+def test_dependency_container_required_optional():
+    dc = DependencyContainer()
+    dc.register("logger", {"name": "log"})
+    calls = []
+
+    def factory():
+        calls.append(1)
+        return {"made": True}
+
+    dc.register("service", factory)
+    s = dc.synthesize(optional=["missing", "logger"], required=["service"])
+    assert s.logger == {"name": "log"}
+    assert s.missing is None
+    assert s.service == {"made": True}
+    # factories memoize
+    dc.synthesize(required=["service"])
+    assert calls == [1]
+    with pytest.raises(KeyError):
+        dc.synthesize(required=["absent"])
+    with pytest.raises(ValueError):
+        dc.register("logger", {})
+
+
+def test_dependency_container_parent_chain():
+    parent = DependencyContainer()
+    parent.register("shared", "from-parent")
+    child = DependencyContainer(parent)
+    child.register("local", 42)
+    assert child.has("shared") and not child.has("shared", exclude_parents=True)
+    s = child.synthesize(required=["shared", "local"])
+    assert s.shared == "from-parent" and s.local == 42
+    assert child.registered_types == ["local"]
+
+
+# ------------------------------------------------------------ request handler
+
+def make_runtime():
+    svc = LocalService()
+    doc = svc.document("d")
+    c = ContainerRuntime(default_registry(), container_id="A")
+    ds = c.create_datastore("root")
+    ds.create_channel("sharedString", "text")
+    c.connect(doc, "A")
+    doc.process_all()
+    return svc, doc, c
+
+
+def test_request_routing():
+    svc, doc, c = make_runtime()
+    route = (
+        RuntimeRequestHandlerBuilder()
+        .push(
+            default_route_handler("root"),
+            create_fluid_object_handler({"health": {"ok": True}}),
+            datastore_request_handler,
+        )
+        .build()
+    )
+    assert route("/", c)["value"] is c.datastore("root")
+    assert route("/health", c)["value"] == {"ok": True}
+    assert route("/root", c)["value"] is c.datastore("root")
+    ch = route("/root/text", c)
+    assert ch["status"] == 200 and ch["value"].channel_type == "sharedString"
+    assert route("/nope/deep/path", c)["status"] == 404
+    assert route("/root/missing", c)["status"] == 404
+
+
+def test_request_parser_unescapes():
+    from fluidframework_tpu.framework import RequestParser
+
+    p = RequestParser("/a%20b/c", {"h": 1})
+    assert p.path_parts == ["a b", "c"]
+    assert p.sub_request(1).path_parts == ["c"]
+    # sub_request never re-decodes: encoded '/' and literal '%' survive.
+    p2 = RequestParser("/ds/a%2Fb/file%2520name")
+    assert p2.path_parts == ["ds", "a/b", "file%20name"]
+    assert p2.sub_request(1).path_parts == ["a/b", "file%20name"]
+
+
+# ------------------------------------------------------------- agent scheduler
+
+def scheduler_pair():
+    svc = LocalService()
+    doc = svc.document("d")
+
+    def mk(name):
+        c = ContainerRuntime(default_registry(), container_id=name)
+        ds = c.create_datastore("root")
+        ds.create_channel("taskManager", "tasks")
+        c.connect(doc, name)
+        return c
+
+    a, b = mk("A"), mk("B")
+    doc.process_all()
+    ta = a.datastore("root").get_channel("tasks")
+    tb = b.datastore("root").get_channel("tasks")
+    return svc, doc, a, b, AgentScheduler(ta), AgentScheduler(tb)
+
+
+def test_exclusive_pick_and_handoff_on_release():
+    svc, doc, a, b, sa, sb = scheduler_pair()
+    events = []
+    sa.pick("index", lambda: events.append("A-start"), lambda: events.append("A-lost"))
+    sb.pick("index", lambda: events.append("B-start"), lambda: events.append("B-lost"))
+    a.flush(); b.flush(); doc.process_all()
+    # Exactly one runs.
+    assert events == ["A-start"]
+    assert sa.picked_tasks() == ["index"] and sb.picked_tasks() == []
+    # Release hands off to the queued volunteer.
+    sa.release("index")
+    a.flush(); doc.process_all()
+    assert events == ["A-start", "B-start"]
+    assert sb.picked_tasks() == ["index"] and sa.picked_tasks() == []
+
+
+def test_handoff_on_client_leave():
+    svc, doc, a, b, sa, sb = scheduler_pair()
+    ran = []
+    sa.pick("job", lambda: ran.append("A"))
+    sb.pick("job", lambda: ran.append("B"))
+    a.flush(); b.flush(); doc.process_all()
+    assert ran == ["A"]
+    a.disconnect()
+    doc.process_all()
+    assert ran == ["A", "B"]
+    assert sb.picked_tasks() == ["job"]
+
+
+def test_leader_election_and_takeover():
+    svc, doc, a, b, sa, sb = scheduler_pair()
+    log = []
+    sa.volunteer_for_leadership(lambda: log.append("A-lead"), lambda: log.append("A-deposed"))
+    sb.volunteer_for_leadership(lambda: log.append("B-lead"))
+    a.flush(); b.flush(); doc.process_all()
+    assert log == ["A-lead"]
+    assert sa.is_leader and not sb.is_leader
+    assert sb.leader == "A"
+    a.disconnect()
+    doc.process_all()
+    assert log == ["A-lead", "B-lead"]
+    assert sb.is_leader
+
+
+def test_reconnect_re_volunteers_picked_tasks():
+    """A reconnect under a new identity evicts the old id from the queues;
+    the scheduler must re-volunteer so picked tasks are never lost."""
+    svc, doc, a, b, sa, sb = scheduler_pair()
+    ran = []
+    sa.pick("job", lambda: ran.append("A"), lambda: ran.append("A-lost"))
+    sb.pick("job", lambda: ran.append("B"))
+    a.flush(); b.flush(); doc.process_all()
+    assert ran == ["A"]
+    # A reconnects under a fresh identity: loses the task to B...
+    a.disconnect()
+    doc.process_all()
+    a.connect(doc, "A2")
+    a.flush(); doc.process_all()
+    # Replica listener ordering may interleave A-lost and B-start.
+    assert ran[0] == "A" and sorted(ran[1:]) == ["A-lost", "B"]
+    # ...but is queued again, so when B releases, A (as A2) takes over.
+    sb.release("job")
+    b.flush(); doc.process_all()
+    assert ran[-1] == "A" and len(ran) == 4
+    assert sa.picked_tasks() == ["job"]
+
+
+def test_double_pick_rejected():
+    svc, doc, a, b, sa, sb = scheduler_pair()
+    sa.pick("t", lambda: None)
+    with pytest.raises(ValueError):
+        sa.pick("t", lambda: None)
+    with pytest.raises(ValueError):
+        sa.release("never-picked")

@@ -1,0 +1,134 @@
+"""Versioned snapshot formats + the golden corpus.
+
+Mirrors the reference's packages/test/snapshots workflow: committed
+snapshot files are validated on every run — old formats must keep
+loading, and the current write format must not drift without a deliberate
+corpus regeneration (python -m fluidframework_tpu.testing.snapshot_corpus).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.runtime.snapshot_formats import (
+    FORMAT_KEY,
+    current_format,
+    stamp,
+    upgrade,
+)
+from fluidframework_tpu.testing.snapshot_corpus import (
+    SCRIPTS,
+    SNAPSHOT_DIR,
+    build_entry,
+    canonical,
+    extract_state,
+)
+
+GOLDEN_FILES = sorted(glob.glob(os.path.join(SNAPSHOT_DIR, "*.json")))
+
+
+def load_channel(channel_type: str, summary: dict):
+    factory = default_registry()[channel_type]
+    ch = factory.create("golden")
+    ch.load(upgrade(channel_type, summary))
+    return ch
+
+
+def test_corpus_exists_and_covers_scripts():
+    assert GOLDEN_FILES, "golden corpus missing — run the corpus generator"
+    covered = {json.load(open(p))["type"] for p in GOLDEN_FILES}
+    assert covered == set(SCRIPTS), (
+        f"corpus/scripts mismatch: {covered ^ set(SCRIPTS)}"
+    )
+
+
+@pytest.mark.parametrize("path", GOLDEN_FILES, ids=[os.path.basename(p) for p in GOLDEN_FILES])
+def test_golden_snapshot_loads_and_matches_state(path):
+    """Every committed file — at ANY recorded format version — loads into
+    a fresh channel that reproduces the recorded user state."""
+    entry = json.load(open(path))
+    ch = load_channel(entry["type"], entry["summary"])
+    assert extract_state(entry["type"], ch) == entry["state"]
+
+
+@pytest.mark.parametrize("name", sorted(SCRIPTS), ids=sorted(SCRIPTS))
+def test_current_format_has_not_drifted(name):
+    """Re-running the script produces byte-identical current-format output
+    to the committed file; intentional format changes must bump the
+    version and regenerate the corpus deliberately."""
+    entry = build_entry(name)
+    path = os.path.join(SNAPSHOT_DIR, f"{name}.v{entry['format']}.json")
+    assert os.path.exists(path), (
+        f"no committed golden for {name} at format v{entry['format']} — "
+        "regenerate the corpus"
+    )
+    committed = open(path).read()
+    assert canonical(entry) + "\n" == committed, (
+        f"summary format drift for {name}: regenerate the corpus if this "
+        "change is intentional (and bump the format version if the layout "
+        "changed incompatibly)"
+    )
+
+
+def test_stamp_and_upgrade_roundtrip():
+    s = stamp("sharedMap", {"entries": {}})
+    assert s[FORMAT_KEY] == current_format("sharedMap") == 1
+    out = upgrade("sharedMap", s)
+    assert FORMAT_KEY not in out and out == {"entries": {}}
+    # Unstamped (pre-versioning) summaries read as v1.
+    assert upgrade("sharedMap", {"entries": {"a": 1}}) == {"entries": {"a": 1}}
+    # Future formats refuse a lossy downgrade read.
+    with pytest.raises(ValueError):
+        upgrade("sharedMap", {FORMAT_KEY: 99, "entries": {}})
+
+
+def test_upgraders_run_in_sequence():
+    """Exercise the upgrade machinery with a synthetic two-version type."""
+    from fluidframework_tpu.runtime import snapshot_formats as sf
+
+    sf.CURRENT_FORMATS["syntheticType"] = 3
+    sf.UPGRADERS["syntheticType"] = [
+        lambda s: {**s, "b": s["a"] + 1},        # v1 -> v2
+        lambda s: {**s, "c": s["b"] * 2},        # v2 -> v3
+    ]
+    try:
+        assert upgrade("syntheticType", {FORMAT_KEY: 1, "a": 1}) == {
+            "a": 1, "b": 2, "c": 4,
+        }
+        assert upgrade("syntheticType", {FORMAT_KEY: 2, "a": 1, "b": 7}) == {
+            "a": 1, "b": 7, "c": 14,
+        }
+        assert upgrade("syntheticType", {FORMAT_KEY: 3, "a": 0, "b": 0, "c": 9}) == {
+            "a": 0, "b": 0, "c": 9,
+        }
+    finally:
+        del sf.CURRENT_FORMATS["syntheticType"]
+        del sf.UPGRADERS["syntheticType"]
+
+
+def test_container_roundtrip_carries_format_stamps():
+    """Full container summaries stamp every channel and strip on load."""
+    from fluidframework_tpu.runtime import ContainerRuntime
+    from fluidframework_tpu.server.local_service import LocalService
+
+    svc = LocalService()
+    doc = svc.document("d")
+    c = ContainerRuntime(default_registry(), container_id="A")
+    ds = c.create_datastore("root")
+    ds.create_channel("sharedString", "text")
+    c.connect(doc, "A")
+    doc.process_all()
+    c.datastore("root").get_channel("text").insert_text(0, "stamped")
+    c.flush()
+    doc.process_all()
+    summary = c.summarize()
+    entry = summary["datastores"]["root"]["channels"]["text"]
+    assert entry["summary"][FORMAT_KEY] == 1
+    c2 = ContainerRuntime(default_registry(), container_id="B")
+    c2.load_snapshot(summary)
+    assert c2.datastore("root").get_channel("text").text == "stamped"
