@@ -187,38 +187,55 @@ def _mergetree_run(args, D, gen, metric):
         # would swamp everything).
         return jax.tree.map(lambda x: jnp.broadcast_to(x, (D,) + x.shape), proto)
 
-    apply_batch = jax.vmap(mk.apply_ops, in_axes=(0, 2, 2, None))
-    compact_batch = jax.vmap(
-        lambda s, m, f: mk.compact(mk.set_min_seq(s, m), f), in_axes=(0, 0, None)
-    )
+    import functools
+
     ce = args.compact_every
 
-    def run(state, all_ops, all_payloads, all_minseqs):
-        def body(carry, xs):
-            s, i = carry
-            ops, payloads, min_seqs = xs
-            flag = jnp.any(s.ob_key >= 0) | jnp.any(
-                ops[:, 0, :] == mk.OpKind.OBLITERATE
-            )
-            s = apply_batch(s, ops, payloads, flag)
-            s = jax.lax.cond(
-                (i + 1) % ce == 0,
-                lambda s: compact_batch(s, min_seqs, jnp.any(s.ob_key >= 0)),
-                lambda s: s,
-                s,
-            )
-            return (s, i + 1), None
-
-        (s, _), _ = jax.lax.scan(
-            body, (state, jnp.zeros((), jnp.int32)), (all_ops, all_payloads, all_minseqs)
+    def make_scan(ob_static: bool):
+        """The whole run specialized on a STATIC obliterate flag: the
+        common no-obliterate trace is one fully-fused, fully-donated scan.
+        (A per-step lax.cond forces whole-state copies across the branch
+        boundary — measured ~37% of the headline.)"""
+        apply_batch = jax.vmap(
+            functools.partial(mk.apply_ops, ob_flag=ob_static), in_axes=(0, 2, 2)
         )
-        return s
+        compact_batch = jax.vmap(
+            lambda s, m: mk.compact(mk.set_min_seq(s, m), ob_static)
+        )
 
-    runner = jax.jit(run, donate_argnums=(0,))
+        def scan(state, all_ops, all_payloads, all_minseqs):
+            def body(carry, xs):
+                s, i = carry
+                ops, payloads, min_seqs = xs
+                s = apply_batch(s, ops, payloads)
+                s = jax.lax.cond(
+                    (i + 1) % ce == 0,
+                    lambda s: compact_batch(s, min_seqs),
+                    lambda s: s,
+                    s,
+                )
+                return (s, i + 1), None
 
+            (s, _), _ = jax.lax.scan(
+                body,
+                (state, jnp.zeros((), jnp.int32)),
+                (all_ops, all_payloads, all_minseqs),
+            )
+            return s
+
+        return scan
+
+    # HOST-side dispatch between the two specializations: the trace is
+    # host-built, so whether it contains obliterates is known before
+    # launch. A device-side lax.cond would defeat the scan carry's
+    # in-place aliasing (the whole [D,...] state re-copies per step —
+    # measured ~40% of the headline) and a fresh bench state has an empty
+    # ob table by construction.
     # Warmup and timed runs must share the SAME shapes, or jit re-traces and
     # the timed region would include a fresh XLA compile.
     ops, payloads, min_seqs, real_ops = gen()
+    has_ob = bool((ops[:, :, 0, :] == mk.OpKind.OBLITERATE).any())
+    runner = jax.jit(make_scan(has_ob), donate_argnums=(0,))
     w = args.steps
     dev_w = (jnp.asarray(ops[:w]), jnp.asarray(payloads[:w]), jnp.asarray(min_seqs[:w]))
     dev_t = (jnp.asarray(ops[w:]), jnp.asarray(payloads[w:]), jnp.asarray(min_seqs[w:]))
@@ -752,7 +769,10 @@ def main() -> None:
     p.add_argument("--insert-len", type=int, default=4)
     p.add_argument("--payload-len", type=int, default=8)
     p.add_argument("--compact-every", type=int, default=4)
-    p.add_argument("--reps", type=int, default=3)
+    # Best-of-N: the chip is shared behind a network tunnel; interleaved
+    # measurements show >3x swing between cold/contended and warm steady
+    # state, and N=3 regularly reports a contention dip as the result.
+    p.add_argument("--reps", type=int, default=8)
     args = p.parse_args()
     args.docs_explicit = args.docs is not None
     args.segments_explicit = args.segments is not None

@@ -374,17 +374,19 @@ class TaskManager(_VerbatimResubmitChannel):
     def __init__(self, channel_id: str) -> None:
         super().__init__(channel_id)
         self.queues: dict[str, list[str]] = {}
-        # (task_id, current_assignee | None) after every sequenced queue
-        # mutation — the hook the agent-scheduler layer drives workers
-        # from. Fires on ANY membership change (not just head changes), so
-        # a scheduler can notice its own eviction (reconnect under a new
-        # id) even while another client holds the task.
+        # (task_id, current_assignee | None, reason) after every sequenced
+        # queue mutation — the hook the agent-scheduler layer drives
+        # workers from. Fires on ANY membership change (not just head
+        # changes), so a scheduler can notice its own eviction (reconnect
+        # under a new id) even while another client holds the task. The
+        # reason distinguishes a COMPLETED task (queue cleared for good)
+        # from ordinary churn.
         self.assignment_listeners: list = []
 
-    def _notify(self, task_id: str) -> None:
+    def _notify(self, task_id: str, reason: str = "change") -> None:
         after = self.assignee(task_id)
         for fn in list(self.assignment_listeners):
-            fn(task_id, after)
+            fn(task_id, after, reason)
 
     def volunteer(self, task_id: str) -> None:
         self.submit_local_message({"type": "volunteer", "taskId": task_id})
@@ -414,7 +416,10 @@ class TaskManager(_VerbatimResubmitChannel):
                 queue.clear()
             else:
                 raise ValueError(f"unknown task op {op['type']}")
-            self._notify(op["taskId"])
+            self._notify(
+                op["taskId"],
+                "complete" if op["type"] == "complete" else "change",
+            )
 
     def on_client_leave(self, client_id: str, seq: int) -> None:
         for task_id, queue in self.queues.items():

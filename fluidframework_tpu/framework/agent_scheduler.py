@@ -48,7 +48,14 @@ class AgentScheduler:
             raise ValueError(f"already picked {task_id!r}")
         self._workers[task_id] = (worker, on_lost)
         self._pending_volunteer.add(task_id)
-        self._tm.volunteer(task_id)
+        try:
+            self._tm.volunteer(task_id)
+        except BaseException:
+            # Submission failed (e.g. detached channel): leave no residue —
+            # the caller may retry pick() after attaching.
+            del self._workers[task_id]
+            self._pending_volunteer.discard(task_id)
+            raise
 
     def release(self, task_id: str) -> None:
         """Stop volunteering (ref release): the next volunteer takes over."""
@@ -84,8 +91,19 @@ class AgentScheduler:
         conn = getattr(self._tm, "_connection", None)
         return conn.client_id() if conn is not None else None
 
-    def _on_assignment(self, task_id: str, assignee: str | None) -> None:
+    def _on_assignment(
+        self, task_id: str, assignee: str | None, reason: str = "change"
+    ) -> None:
         if task_id not in self._workers:
+            return
+        if reason == "complete":
+            # The task is FINISHED (complete() clears the queue so nobody
+            # picks it up again) — drop it entirely instead of treating the
+            # eviction as a reconnect and resurrecting it. No on_lost:
+            # normal completion is not a lost assignment.
+            self._running.discard(task_id)
+            self._pending_volunteer.discard(task_id)
+            del self._workers[task_id]
             return
         queued = self._tm.queued(task_id)
         if queued:

@@ -431,15 +431,24 @@ def _do_insert(s: DocState, op, payload, ob_flag) -> DocState:
     dst = jnp.where((tpos < text_len) & ~text_over, s.text_end + tpos, T)
     text = s.text.at[dst].set(payload, mode="drop")
 
-    # The [OB,S] swallow analysis only runs when an obliterate can exist
-    # (``ob_flag`` is a SCALAR so this stays a real branch under vmap —
-    # batched predicates would degrade cond to select-of-both-branches).
-    new_rem_k, new_rem_c, obpre, rem_over = jax.lax.cond(
-        ob_flag,
-        lambda s: _obliterate_new_segment(s, k, key, client, ref_seq),
-        _no_obliterate_swallow,
-        s,
-    )
+    # The [OB,S] swallow analysis only runs when an obliterate can exist.
+    # A PYTHON-bool ob_flag specializes the trace outright (no cond at all
+    # — apply_ops hoists the runtime branch to whole-scan level so the op
+    # body stays one fused kernel); a traced scalar falls back to lax.cond
+    # (scalar, so it stays a real branch under vmap).
+    if isinstance(ob_flag, bool):
+        new_rem_k, new_rem_c, obpre, rem_over = (
+            _obliterate_new_segment(s, k, key, client, ref_seq)
+            if ob_flag
+            else _no_obliterate_swallow(s)
+        )
+    else:
+        new_rem_k, new_rem_c, obpre, rem_over = jax.lax.cond(
+            ob_flag,
+            lambda s: _obliterate_new_segment(s, k, key, client, ref_seq),
+            _no_obliterate_swallow,
+            s,
+        )
     P = len(s.prop_keys)
     zero = jnp.zeros((), I32)
     new = _NewSeg(
@@ -653,15 +662,26 @@ def apply_op(
     if ob_flag is None:
         ob_flag = jnp.any(s.ob_key >= 0) | (op[0] == OpKind.OBLITERATE)
     kind = op[0]
+    if isinstance(ob_flag, bool):
+        # Specialized trace (see _do_insert): with False the obliterate
+        # branch is unreachable by the flag's contract, so it traces to
+        # identity and the whole op body fuses with no interior cond.
+        ob_branch = (
+            (lambda s, op, p: _do_obliterate(s, op, p))
+            if ob_flag
+            else (lambda s, op, p: s)
+        )
+    else:
+        ob_branch = lambda s, op, p: jax.lax.cond(  # noqa: E731
+            ob_flag, lambda st: _do_obliterate(st, op, p), lambda st: st, s
+        )
     branches = [
         lambda s, op, p: s,  # NOOP
         lambda s, op, p: _do_insert(s, op, p, ob_flag),
         _do_remove,
         _do_annotate,
         _do_ack,
-        lambda s, op, p: jax.lax.cond(
-            ob_flag, lambda st: _do_obliterate(st, op, p), lambda st: st, s
-        ),
+        ob_branch,
     ]
     s = jax.lax.switch(kind, branches, s, op, payload)
     return s
@@ -680,12 +700,26 @@ def apply_ops(
     if ob_flag is None:
         ob_flag = jnp.any(s.ob_key >= 0) | jnp.any(ops[:, 0] == OpKind.OBLITERATE)
 
-    def step(carry, xs):
-        op, payload = xs
-        return apply_op(carry, op, payload, ob_flag), None
+    def scan_spec(st: DocState, flag: bool) -> DocState:
+        def step(carry, xs):
+            op, payload = xs
+            return apply_op(carry, op, payload, flag), None
 
-    out, _ = jax.lax.scan(step, s, (ops, payloads))
-    return out
+        out, _ = jax.lax.scan(step, st, (ops, payloads))
+        return out
+
+    if isinstance(ob_flag, bool):
+        return scan_spec(s, ob_flag)
+    # Hoist the runtime branch to WHOLE-SCAN level: one cond per batch
+    # instead of two per op, so the common no-obliterate path is a single
+    # fully-fused scan body (conds inside a scan break XLA fusion and were
+    # costing ~2x on obliterate-free workloads).
+    return jax.lax.cond(
+        ob_flag,
+        lambda st: scan_spec(st, True),
+        lambda st: scan_spec(st, False),
+        s,
+    )
 
 
 # --------------------------------------------------------------------------
@@ -742,9 +776,12 @@ def compact(s: DocState, ob_flag=None) -> DocState:
     alive = _alive(s)
     rem0 = _min_tree(s.rem_keys)
     dead = alive & (rem0 < LOCAL_BASE) & (rem0 <= s.min_seq)
-    anchored = jax.lax.cond(
-        ob_flag, _anchored_mask, lambda s: jnp.zeros_like(alive), s
-    )
+    if isinstance(ob_flag, bool):
+        anchored = _anchored_mask(s) if ob_flag else jnp.zeros_like(alive)
+    else:
+        anchored = jax.lax.cond(
+            ob_flag, _anchored_mask, lambda s: jnp.zeros_like(alive), s
+        )
     return _gather_keep(s, alive & ~(dead & ~anchored))
 
 

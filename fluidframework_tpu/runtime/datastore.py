@@ -158,12 +158,16 @@ class DataStoreRuntime:
 
     # ------------------------------------------------------------ checkpoint
     def summarize(self) -> dict[str, Any]:
-        from .snapshot_formats import stamp
+        from .snapshot_formats import current_format
 
         return {
             "root": self.is_root,
             "channels": {
-                cid: {"type": ch.channel_type, "summary": stamp(ch.channel_type, ch.summarize())}
+                cid: {
+                    "type": ch.channel_type,
+                    "fmt": current_format(ch.channel_type),
+                    "summary": ch.summarize(),
+                }
                 for cid, ch in self._channels.items()
             }
         }
@@ -179,14 +183,16 @@ class DataStoreRuntime:
             # A None summary is structure-only (detached attach writes the
             # channel layout; content replays as trailing ops).
             if entry["summary"] is not None:
-                channel.load(upgrade(entry["type"], entry["summary"]))
+                channel.load(
+                    upgrade(entry["type"], entry["summary"], entry.get("fmt", 1))
+                )
 
     def summary_tree(self, covered_seq: int | None, prefix: str) -> dict[str, Any]:
         """Incremental summary subtree: a channel whose last sequenced
         change is at or below ``covered_seq`` (the last acked summary's
         refSeq) emits a handle to its previous summary content
         (ref SummarizerNode handle reuse)."""
-        from .snapshot_formats import stamp
+        from .snapshot_formats import current_format
         from .summary import blob, handle, tree
 
         channels: dict[str, Any] = {}
@@ -196,7 +202,11 @@ class DataStoreRuntime:
                 channels[cid] = handle(path)
             else:
                 channels[cid] = blob(
-                    {"type": ch.channel_type, "summary": stamp(ch.channel_type, ch.summarize())}
+                    {
+                        "type": ch.channel_type,
+                        "fmt": current_format(ch.channel_type),
+                        "summary": ch.summarize(),
+                    }
                 )
         return tree({"channels": tree(channels)})
 

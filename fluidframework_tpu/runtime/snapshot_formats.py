@@ -6,14 +6,17 @@ versioned editManagerCodecs/messageCodecs) and pins them with a committed
 golden corpus (packages/test/snapshots: real snapshot files validated
 against every supported read-version on every run).
 
-Here every channel summary is stamped ``{"fmt": N, ...}`` at the datastore
-boundary; loading strips the stamp and runs any upgraders from the file's
-version to the current one. Version-1 files (or files from before
-stamping existed) load unchanged: v1 IS the shipping layout. The golden
-corpus lives in ``tests/snapshots/`` with the scripted documents that
-produced it in ``fluidframework_tpu/testing/snapshot_corpus.py`` —
-regenerating requires a deliberate ``python -m fluidframework_tpu.testing.
-snapshot_corpus`` run, so format drift always shows up as a reviewed diff.
+The version rides BESIDE the payload, never inside it (several DDS
+summaries are keyed directly by user-chosen names — e.g. a register named
+"fmt" — so injecting a key into the payload could clobber user data): the
+datastore's channel entry is ``{"type": t, "fmt": N, "summary": ...}``.
+Loading runs any upgraders from the entry's version to the current one;
+entries with no ``fmt`` (pre-versioning files) read as version 1 — the
+shipping layout. The golden corpus lives in ``tests/snapshots/`` with the
+scripted documents that produced it in
+``fluidframework_tpu/testing/snapshot_corpus.py`` — regenerating requires
+a deliberate ``python -m fluidframework_tpu.testing.snapshot_corpus``
+run, so format drift always shows up as a reviewed diff.
 """
 
 from __future__ import annotations
@@ -34,24 +37,16 @@ def current_format(channel_type: str) -> int:
     return CURRENT_FORMATS.get(channel_type, 1)
 
 
-def stamp(channel_type: str, summary: dict[str, Any]) -> dict[str, Any]:
-    """Attach the write-format version to a freshly-built summary."""
-    out = dict(summary)
-    out[FORMAT_KEY] = current_format(channel_type)
-    return out
-
-
-def upgrade(channel_type: str, summary: dict[str, Any]) -> dict[str, Any]:
-    """Strip the stamp and lift the payload to the current format.
-    Unstamped summaries are version 1 (the pre-stamping layout)."""
-    out = dict(summary)
-    fmt = out.pop(FORMAT_KEY, 1)
+def upgrade(channel_type: str, summary: dict[str, Any], fmt: int = 1) -> dict[str, Any]:
+    """Lift a summary payload recorded at format ``fmt`` to the current
+    format (the payload itself is never stamped)."""
     cur = current_format(channel_type)
     if fmt > cur:
         raise ValueError(
             f"snapshot of {channel_type!r} uses format {fmt}, newer than "
             f"this build's {cur} — refusing a lossy downgrade read"
         )
+    out = summary
     for upgrader in UPGRADERS.get(channel_type, [])[fmt - 1 : cur - 1]:
         out = upgrader(out)
     return out

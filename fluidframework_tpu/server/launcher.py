@@ -75,8 +75,9 @@ class Deployment:
         self._stopping = True
         if self._thread is not None:
             # _spawn aborts within one attempt cycle once _stopping is set
-            # (readiness wait <= 10s, then the abort check fires).
-            self._thread.join(timeout=45)
+            # (readiness polls 1s slices with abort checks; worst case one
+            # communicate() timeout of ~10s still applies).
+            self._thread.join(timeout=60)
         for s in self.shards:
             if s.proc is not None and s.proc.poll() is None:
                 s.proc.terminate()
@@ -126,7 +127,16 @@ def _spawn(shard: Shard, attempts: int = 10, abort=None) -> None:
         proc = subprocess.Popen(
             cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env
         )
-        rdy, _w, _x = select.select([proc.stdout], [], [], 10)
+        # Readiness wait: full 30s budget (cold hosts can take >10s), but
+        # polled in 1s slices so an abort (stop()) reacts promptly.
+        rdy = False
+        for _tick in range(30):
+            r, _w, _x = select.select([proc.stdout], [], [], 1)
+            if r:
+                rdy = True
+                break
+            if abort is not None and abort():
+                break
         line = proc.stdout.readline() if rdy else ""
         if line.strip():
             if abort is not None and abort():

@@ -23,7 +23,7 @@ from typing import Any, Callable
 from ..dds.channels import default_registry
 from ..dds.sequence_intervals import Side
 from ..runtime import ContainerRuntime
-from ..runtime.snapshot_formats import current_format, stamp
+from ..runtime.snapshot_formats import current_format
 from ..server.local_service import LocalService
 
 SNAPSHOT_DIR = os.path.join(
@@ -180,7 +180,7 @@ def build_entry(name: str) -> dict:
     return {
         "type": name,
         "format": current_format(name),
-        "summary": stamp(name, ch.summarize()),
+        "summary": ch.summarize(),
         "state": extract_state(name, ch),
     }
 

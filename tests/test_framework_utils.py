@@ -193,6 +193,25 @@ def test_reconnect_re_volunteers_picked_tasks():
     assert sa.picked_tasks() == ["job"]
 
 
+def test_completed_task_is_not_resurrected():
+    """complete() clears the queue for good: no scheduler may re-volunteer
+    the finished task (the DDS docstring's contract)."""
+    svc, doc, a, b, sa, sb = scheduler_pair()
+    ran = []
+    sa.pick("build", lambda: ran.append("A"))
+    sb.pick("build", lambda: ran.append("B"))
+    a.flush(); b.flush(); doc.process_all()
+    assert ran == ["A"]
+    ta = a.datastore("root").get_channel("tasks")
+    ta.complete("build")
+    a.flush(); doc.process_all()
+    assert ran == ["A"], "completed task re-ran a worker"
+    assert sa.picked_tasks() == [] and sb.picked_tasks() == []
+    tb = b.datastore("root").get_channel("tasks")
+    assert ta.assignee("build") is None and tb.assignee("build") is None
+    assert not ta.queues.get("build") and not tb.queues.get("build")
+
+
 def test_double_pick_rejected():
     svc, doc, a, b, sa, sb = scheduler_pair()
     sa.pick("t", lambda: None)

@@ -18,7 +18,6 @@ from fluidframework_tpu.dds.channels import default_registry
 from fluidframework_tpu.runtime.snapshot_formats import (
     FORMAT_KEY,
     current_format,
-    stamp,
     upgrade,
 )
 from fluidframework_tpu.testing.snapshot_corpus import (
@@ -32,10 +31,10 @@ from fluidframework_tpu.testing.snapshot_corpus import (
 GOLDEN_FILES = sorted(glob.glob(os.path.join(SNAPSHOT_DIR, "*.json")))
 
 
-def load_channel(channel_type: str, summary: dict):
+def load_channel(channel_type: str, summary: dict, fmt: int = 1):
     factory = default_registry()[channel_type]
     ch = factory.create("golden")
-    ch.load(upgrade(channel_type, summary))
+    ch.load(upgrade(channel_type, summary, fmt))
     return ch
 
 
@@ -52,7 +51,7 @@ def test_golden_snapshot_loads_and_matches_state(path):
     """Every committed file — at ANY recorded format version — loads into
     a fresh channel that reproduces the recorded user state."""
     entry = json.load(open(path))
-    ch = load_channel(entry["type"], entry["summary"])
+    ch = load_channel(entry["type"], entry["summary"], entry["format"])
     assert extract_state(entry["type"], ch) == entry["state"]
 
 
@@ -75,16 +74,16 @@ def test_current_format_has_not_drifted(name):
     )
 
 
-def test_stamp_and_upgrade_roundtrip():
-    s = stamp("sharedMap", {"entries": {}})
-    assert s[FORMAT_KEY] == current_format("sharedMap") == 1
-    out = upgrade("sharedMap", s)
-    assert FORMAT_KEY not in out and out == {"entries": {}}
-    # Unstamped (pre-versioning) summaries read as v1.
-    assert upgrade("sharedMap", {"entries": {"a": 1}}) == {"entries": {"a": 1}}
+def test_upgrade_contract():
+    assert current_format("sharedMap") == 1
+    # Current-format payloads pass through untouched (and the version never
+    # rides INSIDE the payload, so user keys can never collide with it).
+    assert upgrade("sharedMap", {"entries": {FORMAT_KEY: 7}}, 1) == {
+        "entries": {FORMAT_KEY: 7}
+    }
     # Future formats refuse a lossy downgrade read.
     with pytest.raises(ValueError):
-        upgrade("sharedMap", {FORMAT_KEY: 99, "entries": {}})
+        upgrade("sharedMap", {"entries": {}}, 99)
 
 
 def test_upgraders_run_in_sequence():
@@ -97,13 +96,11 @@ def test_upgraders_run_in_sequence():
         lambda s: {**s, "c": s["b"] * 2},        # v2 -> v3
     ]
     try:
-        assert upgrade("syntheticType", {FORMAT_KEY: 1, "a": 1}) == {
-            "a": 1, "b": 2, "c": 4,
-        }
-        assert upgrade("syntheticType", {FORMAT_KEY: 2, "a": 1, "b": 7}) == {
+        assert upgrade("syntheticType", {"a": 1}, 1) == {"a": 1, "b": 2, "c": 4}
+        assert upgrade("syntheticType", {"a": 1, "b": 7}, 2) == {
             "a": 1, "b": 7, "c": 14,
         }
-        assert upgrade("syntheticType", {FORMAT_KEY: 3, "a": 0, "b": 0, "c": 9}) == {
+        assert upgrade("syntheticType", {"a": 0, "b": 0, "c": 9}, 3) == {
             "a": 0, "b": 0, "c": 9,
         }
     finally:
@@ -128,7 +125,8 @@ def test_container_roundtrip_carries_format_stamps():
     doc.process_all()
     summary = c.summarize()
     entry = summary["datastores"]["root"]["channels"]["text"]
-    assert entry["summary"][FORMAT_KEY] == 1
+    assert entry["fmt"] == 1
+    assert FORMAT_KEY not in entry["summary"]
     c2 = ContainerRuntime(default_registry(), container_id="B")
     c2.load_snapshot(summary)
     assert c2.datastore("root").get_channel("text").text == "stamped"
