@@ -374,6 +374,13 @@ class TaskManager(_VerbatimResubmitChannel):
     def __init__(self, channel_id: str) -> None:
         super().__init__(channel_id)
         self.queues: dict[str, list[str]] = {}
+        # task -> sequence number of its latest COMPLETE: a volunteer
+        # authored before seeing the completion (ref_seq < that seq) is
+        # dropped on every replica — an in-flight volunteer racing a
+        # complete must not resurrect the finished task as a zombie
+        # assignee. Volunteering after seeing the completion restarts the
+        # task deliberately.
+        self.completed_at: dict[str, int] = {}
         # (task_id, current_assignee | None, reason) after every sequenced
         # queue mutation — the hook the agent-scheduler layer drives
         # workers from. Fires on ANY membership change (not just head
@@ -407,6 +414,8 @@ class TaskManager(_VerbatimResubmitChannel):
             op = m.contents
             queue = self.queues.setdefault(op["taskId"], [])
             if op["type"] == "volunteer":
+                if env.ref_seq < self.completed_at.get(op["taskId"], 0):
+                    continue  # authored before seeing the completion
                 if env.client_id not in queue:
                     queue.append(env.client_id)
             elif op["type"] == "abandon":
@@ -414,6 +423,7 @@ class TaskManager(_VerbatimResubmitChannel):
                     queue.remove(env.client_id)
             elif op["type"] == "complete":
                 queue.clear()
+                self.completed_at[op["taskId"]] = env.seq
             else:
                 raise ValueError(f"unknown task op {op['type']}")
             self._notify(
@@ -443,11 +453,22 @@ class TaskManager(_VerbatimResubmitChannel):
             and self._connection.client_id() in self.queues.get(task_id, [])
         )
 
+    def on_min_seq(self, min_seq: int) -> None:
+        # A completion below the collab-window floor can never race a live
+        # volunteer (its ref_seq would be >= min_seq): drop the tombstone.
+        self.completed_at = {
+            t: s for t, s in self.completed_at.items() if s > min_seq
+        }
+
     def summarize(self) -> dict[str, Any]:
-        return {"queues": {k: list(v) for k, v in self.queues.items()}}
+        return {
+            "queues": {k: list(v) for k, v in self.queues.items()},
+            "completedAt": dict(self.completed_at),
+        }
 
     def load(self, summary: dict[str, Any]) -> None:
         self.queues = {k: list(v) for k, v in summary["queues"].items()}
+        self.completed_at = dict(summary.get("completedAt", {}))
 
 
 # ---------------------------------------------------------------------------

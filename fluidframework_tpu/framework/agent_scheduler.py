@@ -100,7 +100,9 @@ class AgentScheduler:
             # The task is FINISHED (complete() clears the queue so nobody
             # picks it up again) — drop it entirely instead of treating the
             # eviction as a reconnect and resurrecting it. No on_lost:
-            # normal completion is not a lost assignment.
+            # normal completion is not a lost assignment. An in-flight
+            # volunteer of ours is harmless: the DDS drops volunteers
+            # authored before the completion (completed_at tombstone).
             self._running.discard(task_id)
             self._pending_volunteer.discard(task_id)
             del self._workers[task_id]

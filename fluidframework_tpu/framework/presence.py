@@ -8,6 +8,15 @@ broadcast via ``runtime.submitSignal`` (:343) with a batched outbound queue
 members respond with their state so the newcomer catches up (protocol.ts).
 Presence data rides signals only — no ops, no sequence numbers, no summary
 footprint.
+
+The typed surface mirrors presence-definitions:
+- ``states_workspace(id)`` -> workspace of value managers: ``latest``
+  (one value per attendee, latestTypes.ts) and ``latest_map``
+  (per-attendee keyed items, latestMapTypes.ts);
+- ``notifications_workspace(id)`` -> named fire-and-forget notification
+  emitters (notificationsTypes.ts — broadcast, never retained);
+- attendee events (``on_attendee_joined``/``on_attendee_left``,
+  presenceTypes.ts Attendee) derived from the same signal fabric.
 """
 
 from __future__ import annotations
@@ -26,6 +35,11 @@ class Presence:
         self._local: dict[str, Any] = {}
         self._queue: dict[str, Any] = {}  # batched unflushed local sets
         self._listeners: list[Callable[[str, str, Any], None]] = []
+        # Attendees: client ids seen on the presence fabric.
+        self._attendees: set[str] = set()
+        self._joined_listeners: list[Callable[[str], None]] = []
+        self._left_listeners: list[Callable[[str], None]] = []
+        self._notification_listeners: dict[str, list] = {}
         container.on_signal(self._on_signal)
         # Join handshake: ask current members for their state.
         container.submit_signal({"presence": "join"})
@@ -68,6 +82,38 @@ class Presence:
     def _my_id(self) -> str:
         return self._container.runtime.client_id or self._client_id or ""
 
+    # -------------------------------------------------------------- attendees
+    def attendees(self) -> set[str]:
+        """Remote client ids currently on the presence fabric."""
+        return set(self._attendees)
+
+    def on_attendee_joined(self, fn: Callable[[str], None]) -> None:
+        self._joined_listeners.append(fn)
+
+    def on_attendee_left(self, fn: Callable[[str], None]) -> None:
+        self._left_listeners.append(fn)
+
+    def _saw(self, client_id: str) -> None:
+        if client_id not in self._attendees:
+            self._attendees.add(client_id)
+            for fn in list(self._joined_listeners):
+                fn(client_id)
+
+    # ------------------------------------------------------------- workspaces
+    def states_workspace(self, workspace_id: str) -> "StatesWorkspace":
+        """Typed value-manager workspace (ref StatesWorkspace)."""
+        return StatesWorkspace(self, workspace_id)
+
+    def notifications_workspace(self, workspace_id: str) -> "NotificationsWorkspace":
+        """Fire-and-forget notification emitters (ref NotificationsWorkspace:
+        broadcast only, never retained, no late-joiner catch-up)."""
+        return NotificationsWorkspace(self, workspace_id)
+
+    def _emit_notification(self, channel: str, name: str, payload: Any) -> None:
+        self._container.submit_signal(
+            {"presence": "notify", "ch": channel, "name": name, "payload": payload}
+        )
+
     # ---------------------------------------------------------------- inbound
     def _on_signal(self, sig) -> None:
         content = sig.contents
@@ -76,28 +122,148 @@ class Presence:
         if sig.client_id == self._my_id():
             return
         kind = content["presence"]
+        if kind != "leave":
+            self._saw(sig.client_id)
         if kind == "join":
             # A newcomer asked for state: respond with ours (ref join
             # response broadcast). Flush queued values first so the response
-            # is complete.
+            # is complete. Respond EVEN when stateless — the response is
+            # also how the newcomer learns we exist (attendees()).
             self.flush()
-            if self._local:
-                self._container.submit_signal(
-                    {"presence": "update", "states": dict(self._local)}
-                )
+            self._container.submit_signal(
+                {"presence": "update", "states": dict(self._local)}
+            )
         elif kind == "update":
             for key, value in content["states"].items():
                 self._remote.setdefault(key, {})[sig.client_id] = value
                 for listener in self._listeners:
                     listener(sig.client_id, key, value)
+        elif kind == "notify":
+            for fn in list(self._notification_listeners.get(content["ch"], [])):
+                fn(sig.client_id, content["name"], content["payload"])
         elif kind == "leave":
             self._drop_client(sig.client_id)
 
     def _drop_client(self, client_id: str) -> None:
         for per_key in self._remote.values():
             per_key.pop(client_id, None)
+        if client_id in self._attendees:
+            self._attendees.discard(client_id)
+            for fn in list(self._left_listeners):
+                fn(client_id)
 
     def leave(self) -> None:
         """Announce departure (ref disconnect cleanup): peers drop our state."""
         self._container.submit_signal({"presence": "leave"})
         self._queue.clear()
+
+
+# ---------------------------------------------------------------------------
+# Typed workspaces (ref presence-definitions value managers)
+# ---------------------------------------------------------------------------
+
+def _esc(part: str) -> str:
+    """Escape the ':' namespace separator inside user-chosen ids, so a
+    Latest key containing ':' can never collide with a LatestMap item path
+    (the same user-key-collision class the snapshot format stamp avoids)."""
+    return part.replace("%", "%25").replace(":", "%3A")
+
+
+def _unesc(part: str) -> str:
+    return part.replace("%3A", ":").replace("%25", "%")
+
+
+class Latest:
+    """One value per attendee (ref LatestRaw, latestTypes.ts): ``local``
+    get/set, per-attendee remote reads, update events."""
+
+    def __init__(self, ws: "StatesWorkspace", key: str, initial: Any = None) -> None:
+        self._p = ws._presence
+        self._key = f"{_esc(ws.workspace_id)}:{_esc(key)}"
+        if initial is not None:
+            self._p.set(self._key, initial)
+
+    @property
+    def local(self) -> Any:
+        return self._p.local(self._key)
+
+    @local.setter
+    def local(self, value: Any) -> None:
+        self._p.set(self._key, value)
+
+    def get_remote(self, client_id: str) -> Any:
+        return self._p.remote_states(self._key).get(client_id)
+
+    def get_remotes(self) -> dict[str, Any]:
+        return self._p.remote_states(self._key)
+
+    def on_updated(self, fn: Callable[[str, Any], None]) -> None:
+        key = self._key
+
+        def listener(client_id: str, k: str, value: Any) -> None:
+            if k == key:
+                fn(client_id, value)
+
+        self._p.on_update(listener)
+
+
+class LatestMap:
+    """Per-attendee keyed items (ref LatestMapRaw, latestMapTypes.ts):
+    each attendee holds a map; items update independently."""
+
+    def __init__(self, ws: "StatesWorkspace", key: str) -> None:
+        self._p = ws._presence
+        self._prefix = f"{_esc(ws.workspace_id)}:{_esc(key)}:"
+
+    def set_item(self, item: str, value: Any) -> None:
+        self._p.set(self._prefix + _esc(item), value)
+
+    def local_item(self, item: str) -> Any:
+        return self._p.local(self._prefix + _esc(item))
+
+    def get_remote(self, client_id: str) -> dict[str, Any]:
+        out = {}
+        for full_key, per_client in self._p._remote.items():
+            if full_key.startswith(self._prefix) and client_id in per_client:
+                out[_unesc(full_key[len(self._prefix):])] = per_client[client_id]
+        return out
+
+    def on_item_updated(self, fn: Callable[[str, str, Any], None]) -> None:
+        prefix = self._prefix
+
+        def listener(client_id: str, k: str, value: Any) -> None:
+            if k.startswith(prefix):
+                fn(client_id, _unesc(k[len(prefix):]), value)
+
+        self._p.on_update(listener)
+
+
+class StatesWorkspace:
+    def __init__(self, presence: Presence, workspace_id: str) -> None:
+        self._presence = presence
+        self.workspace_id = workspace_id
+
+    def latest(self, key: str, initial: Any = None) -> Latest:
+        return Latest(self, key, initial)
+
+    def latest_map(self, key: str) -> LatestMap:
+        return LatestMap(self, key)
+
+    def flush(self) -> None:
+        self._presence.flush()
+
+
+class NotificationsWorkspace:
+    def __init__(self, presence: Presence, workspace_id: str) -> None:
+        self._presence = presence
+        self.workspace_id = workspace_id
+
+    def emit(self, name: str, payload: Any = None) -> None:
+        """Broadcast immediately; never queued, never retained."""
+        self._presence._emit_notification(self.workspace_id, name, payload)
+
+    def on_notification(self, fn: Callable[[str, str, Any], None]) -> None:
+        """fn(client_id, name, payload) per received notification."""
+        self._presence._notification_listeners.setdefault(
+            self.workspace_id, []
+        ).append(fn)

@@ -107,6 +107,84 @@ def test_data_object_factory_roundtrip(client):
 # presence
 # --------------------------------------------------------------------------
 
+def test_presence_typed_workspaces(client):
+    """Typed value managers + notifications + attendees (ref
+    presence-definitions latestTypes/latestMapTypes/notificationsTypes)."""
+    fc1, _ = client.create_container(SCHEMA, "doc1")
+    process(client)
+    p1 = Presence(fc1.container)
+    ws1 = p1.states_workspace("app")
+    cursor1 = ws1.latest("cursor", initial=[0, 0])
+    sel1 = ws1.latest_map("selection")
+    sel1.set_item("start", 3)
+    sel1.set_item("end", 9)
+    ws1.flush()
+
+    fc2, _ = client.get_container("doc1", SCHEMA)
+    process(client)
+    p2 = Presence(fc2.container)
+    ws2 = p2.states_workspace("app")
+    cursor2 = ws2.latest("cursor")
+    sel2 = ws2.latest_map("selection")
+    c1_id = fc1.container.runtime.client_id
+
+    # Join catch-up delivered the typed state.
+    assert cursor2.get_remote(c1_id) == [0, 0]
+    assert sel2.get_remote(c1_id) == {"start": 3, "end": 9}
+    assert c1_id in p2.attendees()
+
+    # Updates flow with events.
+    seen = []
+    cursor2.on_updated(lambda cid, v: seen.append((cid, v)))
+    cursor1.local = [7, 8]
+    ws1.flush()
+    assert cursor2.get_remote(c1_id) == [7, 8]
+    assert seen == [(c1_id, [7, 8])]
+
+    # Notifications: fire-and-forget, never retained.
+    n1 = p1.notifications_workspace("alerts")
+    n2 = p2.notifications_workspace("alerts")
+    pings = []
+    n2.on_notification(lambda cid, name, payload: pings.append((name, payload)))
+    n1.emit("ping", {"n": 1})
+    assert pings == [("ping", {"n": 1})]
+
+    # Attendee departure fires and clears state.
+    left = []
+    p2.on_attendee_left(left.append)
+    p1.leave()
+    assert left == [c1_id]
+    assert cursor2.get_remote(c1_id) is None
+
+
+def test_presence_stateless_member_visible_to_newcomer(client):
+    fc1, _ = client.create_container(SCHEMA, "doc1")
+    process(client)
+    p1 = Presence(fc1.container)  # no state set at all
+    fc2, _ = client.get_container("doc1", SCHEMA)
+    process(client)
+    p2 = Presence(fc2.container)
+    assert fc1.container.runtime.client_id in p2.attendees()
+
+
+def test_presence_namespace_separator_escaped(client):
+    fc1, _ = client.create_container(SCHEMA, "doc1")
+    process(client)
+    fc2, _ = client.get_container("doc1", SCHEMA)
+    process(client)
+    p1, p2 = Presence(fc1.container), Presence(fc2.container)
+    ws1 = p1.states_workspace("app")
+    a = ws1.latest("sel:start")
+    m = ws1.latest_map("sel")
+    a.local = "latest-value"
+    m.set_item("start", "map-value")
+    ws1.flush()
+    c1 = fc1.container.runtime.client_id
+    ws2 = p2.states_workspace("app")
+    assert ws2.latest("sel:start").get_remote(c1) == "latest-value"
+    assert ws2.latest_map("sel").get_remote(c1) == {"start": "map-value"}
+
+
 def test_presence_updates_and_join_catchup(client):
     fc1, _ = client.create_container(SCHEMA, "doc1")
     process(client)

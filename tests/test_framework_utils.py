@@ -212,6 +212,30 @@ def test_completed_task_is_not_resurrected():
     assert not ta.queues.get("build") and not tb.queues.get("build")
 
 
+def test_inflight_volunteer_after_complete_leaves_no_zombie():
+    """B's volunteer is in flight when A completes the task: B's follow-up
+    abandon must clear the re-created queue so no assignee exists without a
+    worker and later picks are not blocked."""
+    svc, doc, a, b, sa, sb = scheduler_pair()
+    ran = []
+    sa.pick("build", lambda: ran.append("A"))
+    a.flush(); doc.process_all()
+    sb.pick("build", lambda: ran.append("B"))  # volunteer NOT yet flushed
+    ta = a.datastore("root").get_channel("tasks")
+    ta.complete("build")
+    a.flush()
+    doc.process_all()  # the COMPLETE sequences before B's volunteer
+    b.flush()
+    doc.process_all()  # B's stale volunteer is dropped by the tombstone
+    tb = b.datastore("root").get_channel("tasks")
+    assert ta.assignee("build") is None and tb.assignee("build") is None
+    assert ran == ["A"]
+    # The task id is free for a fresh round of picks.
+    sa.pick("build", lambda: ran.append("A2"))
+    a.flush(); doc.process_all()
+    assert ran == ["A", "A2"]
+
+
 def test_double_pick_rejected():
     svc, doc, a, b, sa, sb = scheduler_pair()
     sa.pick("t", lambda: None)
