@@ -374,13 +374,15 @@ class TaskManager(_VerbatimResubmitChannel):
     def __init__(self, channel_id: str) -> None:
         super().__init__(channel_id)
         self.queues: dict[str, list[str]] = {}
-        # task -> sequence number of its latest COMPLETE: a volunteer
-        # authored before seeing the completion (ref_seq < that seq) is
-        # dropped on every replica — an in-flight volunteer racing a
-        # complete must not resurrect the finished task as a zombie
-        # assignee. Volunteering after seeing the completion restarts the
-        # task deliberately.
-        self.completed_at: dict[str, int] = {}
+        # task -> (seq, completer client id) of its latest COMPLETE: a
+        # volunteer authored before seeing the completion (ref_seq < that
+        # seq) is dropped on every replica — an in-flight volunteer racing
+        # a complete must not resurrect the finished task as a zombie
+        # assignee. Two exemptions keep deliberate restarts working: the
+        # COMPLETER's own volunteers (it has seen its completion by
+        # definition, even before the ack), and any volunteer sent after
+        # seeing the completion.
+        self.completed_at: dict[str, tuple[int, str]] = {}
         # (task_id, current_assignee | None, reason) after every sequenced
         # queue mutation — the hook the agent-scheduler layer drives
         # workers from. Fires on ANY membership change (not just head
@@ -396,7 +398,13 @@ class TaskManager(_VerbatimResubmitChannel):
             fn(task_id, after, reason)
 
     def volunteer(self, task_id: str) -> None:
-        self.submit_local_message({"type": "volunteer", "taskId": task_id})
+        # The authored refSeq rides the local metadata: resubmission stamps
+        # a fresh wire ref_seq, and the tombstone check needs the ORIGINAL
+        # perspective to tell a stale replay from a deliberate restart.
+        ref = self._connection.ref_seq() if self._connection is not None else 0
+        self.submit_local_message(
+            {"type": "volunteer", "taskId": task_id}, {"ref": ref}
+        )
 
     def abandon(self, task_id: str) -> None:
         self.submit_local_message({"type": "abandon", "taskId": task_id})
@@ -414,7 +422,12 @@ class TaskManager(_VerbatimResubmitChannel):
             op = m.contents
             queue = self.queues.setdefault(op["taskId"], [])
             if op["type"] == "volunteer":
-                if env.ref_seq < self.completed_at.get(op["taskId"], 0):
+                tomb = self.completed_at.get(op["taskId"])
+                if (
+                    tomb is not None
+                    and env.ref_seq < tomb[0]
+                    and env.client_id != tomb[1]
+                ):
                     continue  # authored before seeing the completion
                 if env.client_id not in queue:
                     queue.append(env.client_id)
@@ -423,7 +436,7 @@ class TaskManager(_VerbatimResubmitChannel):
                     queue.remove(env.client_id)
             elif op["type"] == "complete":
                 queue.clear()
-                self.completed_at[op["taskId"]] = env.seq
+                self.completed_at[op["taskId"]] = (env.seq, env.client_id)
             else:
                 raise ValueError(f"unknown task op {op['type']}")
             self._notify(
@@ -453,22 +466,38 @@ class TaskManager(_VerbatimResubmitChannel):
             and self._connection.client_id() in self.queues.get(task_id, [])
         )
 
+    def resubmit(self, contents: Any, local_metadata: Any, squash: bool = False) -> None:
+        # A replayed volunteer is resubmitted with a FRESH wire ref_seq,
+        # which would blind the tombstone's authored-before-completion
+        # check: compare the ORIGINAL authored refSeq (ridden in local
+        # metadata) instead, and drop the volunteer when the task completed
+        # after it was authored — a deliberate post-completion restart has
+        # an authored ref at/after the completion and goes through.
+        if contents.get("type") == "volunteer":
+            tomb = self.completed_at.get(contents.get("taskId"))
+            authored = (local_metadata or {}).get("ref", 1 << 60)
+            if tomb is not None and authored < tomb[0]:
+                return
+        super().resubmit(contents, local_metadata, squash)
+
     def on_min_seq(self, min_seq: int) -> None:
         # A completion below the collab-window floor can never race a live
         # volunteer (its ref_seq would be >= min_seq): drop the tombstone.
         self.completed_at = {
-            t: s for t, s in self.completed_at.items() if s > min_seq
+            t: e for t, e in self.completed_at.items() if e[0] > min_seq
         }
 
     def summarize(self) -> dict[str, Any]:
         return {
             "queues": {k: list(v) for k, v in self.queues.items()},
-            "completedAt": dict(self.completed_at),
+            "completedAt": {t: list(e) for t, e in self.completed_at.items()},
         }
 
     def load(self, summary: dict[str, Any]) -> None:
         self.queues = {k: list(v) for k, v in summary["queues"].items()}
-        self.completed_at = dict(summary.get("completedAt", {}))
+        self.completed_at = {
+            t: (e[0], e[1]) for t, e in summary.get("completedAt", {}).items()
+        }
 
 
 # ---------------------------------------------------------------------------

@@ -24,6 +24,17 @@ from __future__ import annotations
 from typing import Any, Callable
 
 
+def _subscribe(listeners: list, fn) -> Callable[[], None]:
+    """Append + return an idempotent unsubscribe handle."""
+    listeners.append(fn)
+
+    def unsubscribe() -> None:
+        if fn in listeners:
+            listeners.remove(fn)
+
+    return unsubscribe
+
+
 class Presence:
     """One client's view of a presence workspace on a container."""
 
@@ -41,6 +52,10 @@ class Presence:
         self._left_listeners: list[Callable[[str], None]] = []
         self._notification_listeners: dict[str, list] = {}
         container.on_signal(self._on_signal)
+        # Sequenced LEAVE (crash/disconnect without a voluntary leave()
+        # signal) also departs the fabric — the reference derives attendee
+        # disconnect from the audience, not from a courtesy signal.
+        container.runtime.member_left_listeners.append(self._drop_client)
         # Join handshake: ask current members for their state.
         container.submit_signal({"presence": "join"})
 
@@ -75,9 +90,11 @@ class Presence:
     def remote_states(self, key: str) -> dict[str, Any]:
         return dict(self._remote.get(key, {}))
 
-    def on_update(self, listener: Callable[[str, str, Any], None]) -> None:
-        """listener(client_id, key, value) per received remote update."""
-        self._listeners.append(listener)
+    def on_update(self, listener: Callable[[str, str, Any], None]) -> Callable[[], None]:
+        """listener(client_id, key, value) per received remote update;
+        returns an unsubscribe handle (repeated acquisition of value
+        managers must not accumulate permanent listeners)."""
+        return _subscribe(self._listeners, listener)
 
     def _my_id(self) -> str:
         return self._container.runtime.client_id or self._client_id or ""
@@ -87,11 +104,11 @@ class Presence:
         """Remote client ids currently on the presence fabric."""
         return set(self._attendees)
 
-    def on_attendee_joined(self, fn: Callable[[str], None]) -> None:
-        self._joined_listeners.append(fn)
+    def on_attendee_joined(self, fn: Callable[[str], None]) -> Callable[[], None]:
+        return _subscribe(self._joined_listeners, fn)
 
-    def on_attendee_left(self, fn: Callable[[str], None]) -> None:
-        self._left_listeners.append(fn)
+    def on_attendee_left(self, fn: Callable[[str], None]) -> Callable[[], None]:
+        return _subscribe(self._left_listeners, fn)
 
     def _saw(self, client_id: str) -> None:
         if client_id not in self._attendees:
@@ -197,14 +214,14 @@ class Latest:
     def get_remotes(self) -> dict[str, Any]:
         return self._p.remote_states(self._key)
 
-    def on_updated(self, fn: Callable[[str, Any], None]) -> None:
+    def on_updated(self, fn: Callable[[str, Any], None]) -> Callable[[], None]:
         key = self._key
 
         def listener(client_id: str, k: str, value: Any) -> None:
             if k == key:
                 fn(client_id, value)
 
-        self._p.on_update(listener)
+        return self._p.on_update(listener)
 
 
 class LatestMap:
@@ -228,14 +245,14 @@ class LatestMap:
                 out[_unesc(full_key[len(self._prefix):])] = per_client[client_id]
         return out
 
-    def on_item_updated(self, fn: Callable[[str, str, Any], None]) -> None:
+    def on_item_updated(self, fn: Callable[[str, str, Any], None]) -> Callable[[], None]:
         prefix = self._prefix
 
         def listener(client_id: str, k: str, value: Any) -> None:
             if k.startswith(prefix):
                 fn(client_id, _unesc(k[len(prefix):]), value)
 
-        self._p.on_update(listener)
+        return self._p.on_update(listener)
 
 
 class StatesWorkspace:
@@ -262,8 +279,10 @@ class NotificationsWorkspace:
         """Broadcast immediately; never queued, never retained."""
         self._presence._emit_notification(self.workspace_id, name, payload)
 
-    def on_notification(self, fn: Callable[[str, str, Any], None]) -> None:
-        """fn(client_id, name, payload) per received notification."""
-        self._presence._notification_listeners.setdefault(
-            self.workspace_id, []
-        ).append(fn)
+    def on_notification(self, fn: Callable[[str, str, Any], None]) -> Callable[[], None]:
+        """fn(client_id, name, payload) per received notification;
+        returns an unsubscribe handle."""
+        return _subscribe(
+            self._presence._notification_listeners.setdefault(self.workspace_id, []),
+            fn,
+        )

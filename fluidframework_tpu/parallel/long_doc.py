@@ -84,13 +84,18 @@ def _local_vis_lens(s: DocState, ref_seq, client, axis: str) -> jnp.ndarray:
     return jnp.where(vis, s.seg_len, 0)
 
 
-def _global_prefix(lens: jnp.ndarray, axis: str) -> jnp.ndarray:
-    """Per-segment exclusive prefix in GLOBAL visible coordinates: local
-    cumsum shifted by the sum of earlier shards' totals (one all_gather)."""
+def _shard_offset(lens: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Sum of EARLIER shards' visible totals (one all_gather): the offset
+    translating this shard's local coordinates to global ones."""
     totals = jax.lax.all_gather(jnp.sum(lens), axis)  # [n_shards]
     my = jax.lax.axis_index(axis)
-    shard_prefix = jnp.sum(jnp.where(jnp.arange(totals.shape[0]) < my, totals, 0))
-    return jnp.cumsum(lens) - lens + shard_prefix
+    return jnp.sum(jnp.where(jnp.arange(totals.shape[0]) < my, totals, 0))
+
+
+def _global_prefix(lens: jnp.ndarray, axis: str) -> jnp.ndarray:
+    """Per-segment exclusive prefix in GLOBAL visible coordinates: local
+    cumsum shifted by the earlier shards' totals."""
+    return jnp.cumsum(lens) - lens + _shard_offset(lens, axis)
 
 
 def make_sharded_ops(mesh: Mesh, state: DocState, axis: str = "segs"):
@@ -108,21 +113,26 @@ def make_sharded_ops(mesh: Mesh, state: DocState, axis: str = "segs"):
     )
     def _resolve(s: DocState, positions, ref_seq, client):
         """positions[Q] (replicated, in perspective-visible coordinates) ->
-        (global segment index, offset within segment) per query."""
+        (global segment index, offset within segment) per query.
+
+        The shard-local membership search runs as the blocked Pallas
+        kernel on TPU (ops/pallas_kernels.py — streams the segment axis
+        through VMEM instead of materializing [Q, S_local] in HBM); shard
+        coordinates reduce to local ones by subtracting the earlier
+        shards' visible total, then one psum merges the per-shard
+        one-hots."""
+        from ..ops.pallas_kernels import resolve_positions_blocked
+
         lens = _local_vis_lens(s, ref_seq, client, axis)
-        prefix = _global_prefix(lens, axis)
-        q = positions[:, None]  # [Q, 1]
-        inside = (q >= prefix[None, :]) & (q < (prefix + lens)[None, :])
-        n_local = lens.shape[0]
         my = jax.lax.axis_index(axis)
-        local_idx = jnp.argmax(inside, axis=1)
-        hit = jnp.any(inside, axis=1)
-        global_idx = jnp.where(hit, my * n_local + local_idx, 0)
-        offset = jnp.where(hit, positions - prefix[local_idx], 0)
+        local_q = positions - _shard_offset(lens, axis)
+        local_idx, offset, hit = resolve_positions_blocked(lens, local_q)
+        n_local = lens.shape[0]
+        global_idx = jnp.where(hit == 1, my * n_local + local_idx, 0)
         # Exactly one shard hits each in-range query; psum merges one-hots.
         return (
             jax.lax.psum(global_idx.astype(I32), axis),
-            jax.lax.psum(offset.astype(I32), axis),
+            jax.lax.psum(jnp.where(hit == 1, offset, 0).astype(I32), axis),
         )
 
     @partial(

@@ -68,6 +68,9 @@ class ContainerRuntime:
         # connection rejects them (the reference rejects the propose promise
         # on disconnect so callers can retry — quorum.ts propose).
         self._inflight_proposals: list[dict] = []
+        # (client_id) per sequenced LEAVE — audience-departure consumers
+        # (presence attendee tracking) that aren't channels.
+        self.member_left_listeners: list = []
         self.rejected_proposals: list[dict] = []
         # Summarization state (runtime/summary.py): ops since the last acked
         # summary drive the RunningSummarizer heuristics; last_summary_ref_seq
@@ -435,6 +438,8 @@ class ContainerRuntime:
             self._quorum.pop(msg.contents["clientId"], None)
             for ds in self._datastores.values():
                 ds.on_client_leave(msg.contents["clientId"], msg.seq)
+            for fn in list(self.member_left_listeners):
+                fn(msg.contents["clientId"])
         elif msg.type in (MessageType.PROPOSE, MessageType.SUMMARIZE):
             if (
                 msg.client_id == self.client_id

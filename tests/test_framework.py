@@ -157,6 +157,29 @@ def test_presence_typed_workspaces(client):
     assert cursor2.get_remote(c1_id) is None
 
 
+def test_presence_attendee_left_on_disconnect_without_leave(client):
+    """A crash/disconnect (sequenced LEAVE, no voluntary signal) departs
+    the fabric: attendees drop and state clears."""
+    fc1, _ = client.create_container(SCHEMA, "doc1")
+    process(client)
+    p1 = Presence(fc1.container)
+    p1.set_now("cursor", 9)
+    fc2, _ = client.get_container("doc1", SCHEMA)
+    process(client)
+    p2 = Presence(fc2.container)
+    c1 = fc1.container.runtime.client_id
+    assert c1 in p2.attendees()
+    left = []
+    unsub = p2.on_attendee_left(left.append)
+    fc1.disconnect()  # no p1.leave()
+    process(client)
+    assert left == [c1]
+    assert c1 not in p2.attendees()
+    assert p2.remote_states("cursor") == {}
+    unsub()
+    assert p2._left_listeners == []
+
+
 def test_presence_stateless_member_visible_to_newcomer(client):
     fc1, _ = client.create_container(SCHEMA, "doc1")
     process(client)

@@ -213,9 +213,10 @@ def test_completed_task_is_not_resurrected():
 
 
 def test_inflight_volunteer_after_complete_leaves_no_zombie():
-    """B's volunteer is in flight when A completes the task: B's follow-up
-    abandon must clear the re-created queue so no assignee exists without a
-    worker and later picks are not blocked."""
+    """B's volunteer is in flight when A completes the task: the DDS's
+    completion tombstone drops the stale volunteer (authored before seeing
+    the completion), so no assignee ever exists without a worker and later
+    picks are not blocked."""
     svc, doc, a, b, sa, sb = scheduler_pair()
     ran = []
     sa.pick("build", lambda: ran.append("A"))
@@ -234,6 +235,42 @@ def test_inflight_volunteer_after_complete_leaves_no_zombie():
     sa.pick("build", lambda: ran.append("A2"))
     a.flush(); doc.process_all()
     assert ran == ["A", "A2"]
+
+
+def test_completer_can_restart_its_own_task_immediately():
+    """complete() then volunteer() back-to-back from the assignee is a
+    deliberate restart — exempt from the tombstone drop."""
+    svc, doc, a, b, sa, sb = scheduler_pair()
+    ta = a.datastore("root").get_channel("tasks")
+    ta.volunteer("job")
+    a.flush(); doc.process_all()
+    assert ta.assigned("job")
+    ta.complete("job")
+    ta.volunteer("job")
+    a.flush(); doc.process_all()
+    tb = b.datastore("root").get_channel("tasks")
+    assert ta.assignee("job") == "A" and tb.assignee("job") == "A"
+
+
+def test_replayed_volunteer_dropped_after_completion():
+    """A pending volunteer replayed across a reconnect must not resurrect a
+    task completed while the client was away (the fresh wire ref_seq would
+    blind the sequenced tombstone check; the channel drops it at resubmit
+    using the authored refSeq)."""
+    svc, doc, a, b, sa, sb = scheduler_pair()
+    ta = a.datastore("root").get_channel("tasks")
+    tb = b.datastore("root").get_channel("tasks")
+    ta.volunteer("job")
+    a.flush(); doc.process_all()
+    tb.volunteer("job")  # pending, then B drops before it sequences
+    b.disconnect()
+    doc.process_all()
+    ta.complete("job")
+    a.flush(); doc.process_all()
+    b.connect(doc, "B2")
+    b.flush(); doc.process_all()
+    tb2 = b.datastore("root").get_channel("tasks")
+    assert ta.assignee("job") is None and tb2.assignee("job") is None
 
 
 def test_double_pick_rejected():
