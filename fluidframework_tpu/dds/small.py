@@ -383,6 +383,9 @@ class TaskManager(_VerbatimResubmitChannel):
         # definition, even before the ack), and any volunteer sent after
         # seeing the completion.
         self.completed_at: dict[str, tuple[int, str]] = {}
+        # Tasks THIS instance has completed (local knowledge: flags its own
+        # post-completion volunteers as deliberate restarts).
+        self._locally_completed: set[str] = set()
         # (task_id, current_assignee | None, reason) after every sequenced
         # queue mutation — the hook the agent-scheduler layer drives
         # workers from. Fires on ANY membership change (not just head
@@ -400,10 +403,15 @@ class TaskManager(_VerbatimResubmitChannel):
     def volunteer(self, task_id: str) -> None:
         # The authored refSeq rides the local metadata: resubmission stamps
         # a fresh wire ref_seq, and the tombstone check needs the ORIGINAL
-        # perspective to tell a stale replay from a deliberate restart.
+        # perspective to tell a stale replay from a deliberate restart. A
+        # volunteer following THIS client's own complete() is a deliberate
+        # restart even before the complete acks ("restart" flag — the
+        # completer exemption must survive reconnect resubmission, where
+        # the client id changes and tomb[1] can no longer match).
         ref = self._connection.ref_seq() if self._connection is not None else 0
         self.submit_local_message(
-            {"type": "volunteer", "taskId": task_id}, {"ref": ref}
+            {"type": "volunteer", "taskId": task_id},
+            {"ref": ref, "restart": task_id in self._locally_completed},
         )
 
     def abandon(self, task_id: str) -> None:
@@ -414,6 +422,7 @@ class TaskManager(_VerbatimResubmitChannel):
         other volunteers must not pick up a finished task)."""
         if not self.assigned(task_id):
             raise RuntimeError("complete() requires holding the task")
+        self._locally_completed.add(task_id)
         self.submit_local_message({"type": "complete", "taskId": task_id})
 
     def process_messages(self, collection: MessageCollection) -> None:
@@ -475,8 +484,12 @@ class TaskManager(_VerbatimResubmitChannel):
         # an authored ref at/after the completion and goes through.
         if contents.get("type") == "volunteer":
             tomb = self.completed_at.get(contents.get("taskId"))
-            authored = (local_metadata or {}).get("ref", 1 << 60)
-            if tomb is not None and authored < tomb[0]:
+            meta = local_metadata or {}
+            # No metadata (stashed-op rehydrate drops it) reads as authored
+            # ref 0: conservatively stale — a stashed volunteer surviving
+            # into a completed task is a replay, never a restart.
+            authored = meta.get("ref", 0)
+            if tomb is not None and authored < tomb[0] and not meta.get("restart"):
                 return
         super().resubmit(contents, local_metadata, squash)
 
@@ -496,7 +509,9 @@ class TaskManager(_VerbatimResubmitChannel):
     def load(self, summary: dict[str, Any]) -> None:
         self.queues = {k: list(v) for k, v in summary["queues"].items()}
         self.completed_at = {
-            t: (e[0], e[1]) for t, e in summary.get("completedAt", {}).items()
+            # Pre-(seq, clientId) summaries stored a bare int seq.
+            t: (e, "") if isinstance(e, int) else (e[0], e[1])
+            for t, e in summary.get("completedAt", {}).items()
         }
 
 

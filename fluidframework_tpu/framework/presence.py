@@ -55,7 +55,9 @@ class Presence:
         # Sequenced LEAVE (crash/disconnect without a voluntary leave()
         # signal) also departs the fabric — the reference derives attendee
         # disconnect from the audience, not from a courtesy signal.
-        container.runtime.member_left_listeners.append(self._drop_client)
+        self._unsub_member_left = _subscribe(
+            container.runtime.member_left_listeners, self._drop_client
+        )
         # Join handshake: ask current members for their state.
         container.submit_signal({"presence": "join"})
 
@@ -173,6 +175,16 @@ class Presence:
         """Announce departure (ref disconnect cleanup): peers drop our state."""
         self._container.submit_signal({"presence": "leave"})
         self._queue.clear()
+
+    def dispose(self) -> None:
+        """Detach from the runtime (unregisters the LEAVE listener) and drop
+        local listeners — constructing Presence repeatedly on one container
+        must not accumulate permanent registrations."""
+        self._unsub_member_left()
+        self._listeners.clear()
+        self._joined_listeners.clear()
+        self._left_listeners.clear()
+        self._notification_listeners.clear()
 
 
 # ---------------------------------------------------------------------------

@@ -274,6 +274,33 @@ class CopierLambda:
         return n
 
 
+class MoiraLambda:
+    """External sync: streams sequenced ops to a pluggable external sink
+    with at-least-once delivery and a committed offset (ref moira/lambda.ts
+    — Fluid-to-external-system bridging off the deltas topic). A failing
+    sink leaves the offset in place; the next pump retries."""
+
+    def __init__(self, deltas: Topic, partition: int, sink=None):
+        self._in = deltas.partition(partition)
+        self.offset = 0
+        # sink(doc_id, SequencedMessage) -> None; raising aborts the pump
+        # at the current offset (retry next pump).
+        self.sink = sink if sink is not None else (lambda doc, msg: None)
+        self.delivered = 0
+
+    def pump(self) -> int:
+        n = 0
+        for rec in self._in.read(self.offset):
+            try:
+                self.sink(rec.doc_id, rec.payload)
+            except Exception:
+                break  # offset uncommitted: redelivered next pump
+            self.delivered += 1
+            self.offset = rec.offset + 1
+            n += 1
+        return n
+
+
 class PipelineService:
     """The assembled ordering service: rawdeltas -> deli -> deltas -> fans.
 
@@ -309,6 +336,7 @@ class PipelineService:
             for p in range(n_partitions)
         ]
         self.copier = [CopierLambda(self.rawdeltas, p) for p in range(n_partitions)]
+        self.moira = [MoiraLambda(self.deltas, p) for p in range(n_partitions)]
 
     # -------------------------------------------------------------- front-end
     def submit_op(self, doc_id: str, msg: UnsequencedMessage) -> None:
@@ -339,7 +367,7 @@ class PipelineService:
             moved = 0
             for lam in (
                 *self.deli, *self.scriptorium, *self.broadcaster,
-                *self.scribe, *self.copier,
+                *self.scribe, *self.copier, *self.moira,
             ):
                 moved += lam.pump()
             total += moved
@@ -359,6 +387,12 @@ class PipelineService:
     def raw_of(self, doc_id: str) -> list:
         p = self.rawdeltas.partition_for(doc_id)
         return self.copier[p].archive.get(doc_id, [])
+
+    def set_external_sink(self, sink) -> None:
+        """Route every partition's sequenced stream to one external sink
+        (moira configuration)."""
+        for lam in self.moira:
+            lam.sink = sink
 
 
 # ---------------------------------------------------------------------------
@@ -443,6 +477,7 @@ class DurablePipelineService(PipelineService):
         directory: str,
         n_partitions: int = 4,
         use_native_sequencer: bool = False,
+        external_sink=None,
     ):
         self._dir = directory
         os.makedirs(directory, exist_ok=True)
@@ -461,6 +496,12 @@ class DurablePipelineService(PipelineService):
             deltas=deltas,
             uploads=DurableUploads(os.path.join(directory, "uploads.json")),
         )
+        # The external sink must be live BEFORE the restore pump, or the
+        # replayed stream drains through the default no-op sink; moira
+        # offsets checkpoint, so a restored service resumes delivery where
+        # the last checkpoint left off (at-least-once from there).
+        if external_sink is not None:
+            self.set_external_sink(external_sink)
         self._restore()
 
     def upload_summary(self, tree: dict) -> str:
@@ -483,6 +524,7 @@ class DurablePipelineService(PipelineService):
                 str(p): {"offset": lam.offset, "snapshots": lam.snapshots}
                 for p, lam in enumerate(self.scribe)
             },
+            "moira": {str(p): lam.offset for p, lam in enumerate(self.moira)},
         }
         atomic_json_dump(state, self._ckpt_path())
         self.uploads.compact()
@@ -506,6 +548,8 @@ class DurablePipelineService(PipelineService):
                     doc: [(s, snap) for s, snap in snaps]
                     for doc, snaps in entry["snapshots"].items()
                 }
+            for p, lam in enumerate(self.moira):
+                lam.offset = state.get("moira", {}).get(str(p), 0)
         # Whatever already reached the durable deltas log (possibly beyond
         # the checkpoint — flushes keep running between checkpoints) must
         # not be appended twice during replay; likewise summary responses

@@ -273,6 +273,58 @@ def test_replayed_volunteer_dropped_after_completion():
     assert ta.assignee("job") is None and tb2.assignee("job") is None
 
 
+def test_resubmit_tombstone_contract():
+    """The resubmit rules for volunteers against a live tombstone (the
+    unclean-drop replay path, where the wire ref_seq is re-stamped):
+    stale replays drop, restart-flagged replays go through, and
+    metadata-less (stash-rehydrated) replays conservatively drop."""
+    svc, doc, a, b, sa, sb = scheduler_pair()
+    ta = a.datastore("root").get_channel("tasks")
+    ta.volunteer("job")
+    a.flush(); doc.process_all()
+    ta.complete("job")
+    a.flush(); doc.process_all()
+    assert "job" in ta.completed_at
+    tomb_seq = ta.completed_at["job"][0]
+    op = {"type": "volunteer", "taskId": "job"}
+
+    def settle():
+        a.flush(); doc.process_all()
+        return ta.assignee("job")
+
+    # Stale replay: authored before the completion, no restart flag.
+    ta.resubmit(op, {"ref": tomb_seq - 1})
+    assert settle() is None
+    # Stash-rehydrated replay (metadata lost): conservatively stale.
+    ta.resubmit(op, None)
+    assert settle() is None
+    # Completer's own pre-ack restart: exempt via the restart flag.
+    ta.resubmit(op, {"ref": tomb_seq - 1, "restart": True})
+    assert settle() == "A"
+    ta.abandon("job")
+    assert settle() is None
+    # Post-completion volunteer (authored at/after the completion): through.
+    ta.resubmit(op, {"ref": tomb_seq})
+    assert settle() == "A"
+
+
+def test_presence_dispose_unregisters():
+    from fluidframework_tpu.framework import ContainerSchema, Presence
+    from fluidframework_tpu.framework.service_client import LocalServiceClient
+
+    client = LocalServiceClient()
+    schema = ContainerSchema(initial_objects={"text": "sharedString"})
+    fc, _ = client.create_container(schema, "pdoc")
+    client.service.process_all()
+    runtime = fc.container.runtime
+    before = len(runtime.member_left_listeners)
+    ps = [Presence(fc.container) for _ in range(3)]
+    assert len(runtime.member_left_listeners) == before + 3
+    for p in ps:
+        p.dispose()
+    assert len(runtime.member_left_listeners) == before
+
+
 def test_double_pick_rejected():
     svc, doc, a, b, sa, sb = scheduler_pair()
     sa.pick("t", lambda: None)

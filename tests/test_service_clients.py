@@ -131,6 +131,36 @@ def test_copier_archives_raw_ops():
     assert raw[1][1].contents == {"x": 1}
 
 
+def test_moira_external_sync_with_retry():
+    from fluidframework_tpu.protocol.messages import UnsequencedMessage
+    from fluidframework_tpu.server.lambdas import PipelineService
+
+    svc = PipelineService(n_partitions=1)
+    svc.join("doc", "a")
+    svc.pump()
+    delivered = []
+    fail = {"on": True}
+
+    def sink(doc_id, msg):
+        if fail["on"]:
+            raise IOError("external system down")
+        delivered.append((doc_id, msg.seq))
+
+    svc.set_external_sink(sink)
+    svc.submit_op(
+        "doc",
+        UnsequencedMessage(client_id="a", client_seq=1, ref_seq=1, type=0,
+                           contents={"x": 1}),
+    )
+    svc.pump()
+    assert delivered == []  # sink failing: offset holds, nothing lost
+    fail["on"] = False
+    svc.pump()
+    # At-least-once: the retried op lands (the join was consumed by the
+    # default no-op sink before the real sink was configured).
+    assert delivered == [("doc", 2)]
+
+
 # ------------------------------------------------------------------- launcher
 
 def test_launcher_two_shards_and_restart():
