@@ -2,9 +2,14 @@
 
 Reference parity: packages/framework/aqueduct — ``DataObject`` (a datastore
 with a root SharedMap under which apps organize state and handles to other
-channels) and ``DataObjectFactory`` (type name + channel registry +
-first-time initialization hook), the authoring pattern nearly every Fluid
-example app uses.
+channels) and ``DataObjectFactory`` (type name + channel registry + the
+PureDataObject initialization lifecycle: ``initializingFirstTime`` on the
+creating client only, ``initializingFromExisting`` on every later load,
+``hasInitialized`` after either), the authoring pattern nearly every Fluid
+example app uses. Handles (``DataObject.handle`` /
+``resolve_handle``) are serializable references resolvable on any replica
+— stored in maps like the reference stores IFluidHandles, resolved through
+the request-routing layer.
 """
 
 from __future__ import annotations
@@ -15,6 +20,39 @@ from ..runtime.container_runtime import ContainerRuntime
 from ..runtime.datastore import DataStoreRuntime
 
 ROOT_MAP_ID = "root"
+HANDLE_KEY = "__fluid_handle__"
+
+
+def make_handle(ds_id: str, channel_id: str | None = None) -> dict:
+    """A serializable reference to a datastore (or one of its channels) —
+    the IFluidHandle wire shape (absolute path URL; segments
+    percent-encoded, the inverse of RequestParser's unquote, so ids
+    containing '/' or '%' round-trip)."""
+    from urllib.parse import quote
+
+    url = "/" + quote(ds_id, safe="")
+    if channel_id is not None:
+        url += "/" + quote(channel_id, safe="")
+    return {HANDLE_KEY: url}
+
+
+def is_handle(value: Any) -> bool:
+    return isinstance(value, dict) and HANDLE_KEY in value
+
+
+def resolve_handle(runtime: ContainerRuntime, handle: dict):
+    """Resolve a stored handle on THIS replica (ref handle.get()): routes
+    the handle's URL through the request layer."""
+    from .request_handler import RuntimeRequestHandlerBuilder, datastore_request_handler
+
+    if not is_handle(handle):
+        raise TypeError(f"not a handle: {handle!r}")
+    route = RuntimeRequestHandlerBuilder().push(datastore_request_handler).build()
+    response = route(handle[HANDLE_KEY], runtime)
+    if response["status"] != 200:
+        raise KeyError(f"handle target not found: {handle[HANDLE_KEY]!r}")
+    value = response["value"]
+    return DataObject(value) if isinstance(value, DataStoreRuntime) else value
 
 
 class DataObject:
@@ -33,6 +71,15 @@ class DataObject:
         """The root SharedMap (ref DataObject.root)."""
         return self._ds.get_channel(ROOT_MAP_ID)
 
+    @property
+    def handle(self) -> dict:
+        """Serializable reference to this object (ref this.handle) —
+        storable in any map/cell and resolvable on every replica."""
+        return make_handle(self._ds.id)
+
+    def channel_handle(self, name: str) -> dict:
+        return make_handle(self._ds.id, name)
+
     def channel(self, name: str):
         return self._ds.get_channel(name)
 
@@ -44,8 +91,11 @@ class DataObjectFactory:
     """Creates/loads DataObjects of one named type (ref DataObjectFactory).
 
     ``initial_channels``: name -> DDS type string, created (with the root
-    map) on first-time initialization. ``initializing_first_time`` runs once
-    on the creating client, before attach (ref initializingFirstTime).
+    map) on first-time initialization. ``initializing_first_time`` runs
+    once on the creating client, AFTER the datastore attach is staged (its
+    edits ride as ops following the layout — remote replicas instantiate
+    the datastore first); ``initializing_from_existing`` runs on every
+    later load; ``has_initialized`` after either.
     """
 
     def __init__(
@@ -53,10 +103,14 @@ class DataObjectFactory:
         object_type: str,
         initial_channels: dict[str, str] | None = None,
         initializing_first_time: Callable[[DataObject], None] | None = None,
+        initializing_from_existing: Callable[[DataObject], None] | None = None,
+        has_initialized: Callable[[DataObject], None] | None = None,
     ) -> None:
         self.object_type = object_type
         self.initial_channels = dict(initial_channels or {})
-        self._init_hook = initializing_first_time
+        self._first_time = initializing_first_time
+        self._from_existing = initializing_from_existing
+        self._has_initialized = has_initialized
 
     def create(self, runtime: ContainerRuntime, ds_id: str) -> DataObject:
         ds = runtime.create_datastore(ds_id)
@@ -68,13 +122,21 @@ class DataObjectFactory:
         # init hook's edits included) so remote replicas instantiate it
         # first (ref attach ops).
         runtime.submit_datastore_attach(ds_id)
-        if self._init_hook is not None:
-            self._init_hook(obj)
+        if self._first_time is not None:
+            self._first_time(obj)
+        if self._has_initialized is not None:
+            self._has_initialized(obj)
         return obj
 
     def get(self, runtime: ContainerRuntime, ds_id: str) -> DataObject:
-        """Bind to an existing datastore created by this factory elsewhere."""
+        """Bind to an existing datastore created by this factory elsewhere
+        (ref initializingFromExisting -> hasInitialized lifecycle)."""
         ds = runtime.datastore(ds_id)
         for name in (ROOT_MAP_ID, *self.initial_channels):
             ds.get_channel(name)  # raises if the layout doesn't match
-        return DataObject(ds)
+        obj = DataObject(ds)
+        if self._from_existing is not None:
+            self._from_existing(obj)
+        if self._has_initialized is not None:
+            self._has_initialized(obj)
+        return obj

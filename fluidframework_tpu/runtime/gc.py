@@ -14,27 +14,37 @@ Two phases, exactly the reference's split:
   them at the same point in the total order (the reference's sweep-ready
   GC op) and late ops to deleted routes are dropped as tombstoned.
 
-Handles are plain strings in DDS values: ``fluid:<datastore id>`` for
-datastores, ``blob:<id>`` for attachment blobs (blob_manager.py).
+Handles come in two wire shapes, both GC-visible: plain strings
+(``fluid:<datastore id>`` for datastores, ``blob:<id>`` for attachment
+blobs — blob_manager.py) and the aqueduct IFluidHandle dict
+(``{"__fluid_handle__": "/<ds id>[/<channel id>]"}`` — framework/
+aqueduct.py make_handle; segments are percent-encoded).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 from typing import Any
+from urllib.parse import unquote
 
 DS_PREFIX = "fluid:"
 BLOB_PREFIX = "blob:"
+HANDLE_DICT_KEY = "__fluid_handle__"
 
 
 def scan_handles(value: Any, ds_refs: set[str], blob_refs: set[str]) -> None:
-    """Deep-scan a JSON-ish summary value for handle strings."""
+    """Deep-scan a JSON-ish summary value for handle references."""
     if isinstance(value, str):
         if value.startswith(DS_PREFIX):
             ds_refs.add(value[len(DS_PREFIX):])
         elif value.startswith(BLOB_PREFIX):
             blob_refs.add(value[len(BLOB_PREFIX):])
     elif isinstance(value, dict):
+        url = value.get(HANDLE_DICT_KEY)
+        if isinstance(url, str):
+            parts = [unquote(p) for p in url.strip("/").split("/") if p]
+            if parts:
+                ds_refs.add(parts[0])
         for v in value.values():
             scan_handles(v, ds_refs, blob_refs)
     elif isinstance(value, (list, tuple)):

@@ -325,6 +325,69 @@ def test_presence_dispose_unregisters():
     assert len(runtime.member_left_listeners) == before
 
 
+def test_data_object_lifecycle_and_handles():
+    """Aqueduct lifecycle hooks (initializingFirstTime on create only,
+    initializingFromExisting on load only, hasInitialized after both) and
+    handle round-trip: a handle stored in one object's root map resolves
+    to the target object on another replica."""
+    from fluidframework_tpu.framework.aqueduct import (
+        DataObjectFactory,
+        is_handle,
+        resolve_handle,
+    )
+
+    svc = LocalService()
+    doc = svc.document("d")
+    calls = []
+
+    def first_time(o):
+        calls.append("first")
+        o.root.set("title", "untitled")
+
+    factory = DataObjectFactory(
+        "note",
+        initial_channels={"text": "sharedString"},
+        initializing_first_time=first_time,
+        initializing_from_existing=lambda o: calls.append("existing"),
+        has_initialized=lambda o: calls.append("has"),
+    )
+
+    def mk(name):
+        c = ContainerRuntime(default_registry(), container_id=name)
+        c.connect(doc, name)
+        return c
+
+    a, b = mk("A"), mk("B")
+    doc.process_all()
+    note = factory.create(a, "note1")
+    linker = factory.create(a, "note2")
+    linker.root.set("link", note.handle)
+    linker.root.set("textLink", note.channel_handle("text"))
+    a.flush()
+    doc.process_all()
+    assert calls[:2] == ["first", "has"]
+
+    note_b = factory.get(b, "note1")
+    assert calls[-2:] == ["existing", "has"]
+    assert note_b.root.get("title") == "untitled"
+    # Handle resolution on the OTHER replica.
+    linker_b = factory.get(b, "note2")
+    h = linker_b.root.get("link")
+    assert is_handle(h)
+    resolved = resolve_handle(b, h)
+    assert resolved.id == "note1" and resolved.root.get("title") == "untitled"
+    ch = resolve_handle(b, linker_b.root.get("textLink"))
+    assert ch.channel_type == "sharedString"
+    with pytest.raises(KeyError):
+        resolve_handle(b, {"__fluid_handle__": "/nope"})
+    # GC sees dict-shaped handles: note1 is reachable via note2's map.
+    from fluidframework_tpu.runtime.gc import scan_handles
+
+    ds_refs, blob_refs = set(), set()
+    scan_handles(b.summarize(), ds_refs, blob_refs)
+    assert "note1" in ds_refs
+
+
 def test_double_pick_rejected():
     svc, doc, a, b, sa, sb = scheduler_pair()
     sa.pick("t", lambda: None)
