@@ -19,25 +19,16 @@ from typing import Any, Callable
 from ..runtime.container_runtime import ContainerRuntime
 from ..runtime.datastore import DataStoreRuntime
 
+from ..runtime.handles import HANDLE_KEY, is_handle, make_handle_url
+
 ROOT_MAP_ID = "root"
-HANDLE_KEY = "__fluid_handle__"
 
 
 def make_handle(ds_id: str, channel_id: str | None = None) -> dict:
     """A serializable reference to a datastore (or one of its channels) —
-    the IFluidHandle wire shape (absolute path URL; segments
-    percent-encoded, the inverse of RequestParser's unquote, so ids
-    containing '/' or '%' round-trip)."""
-    from urllib.parse import quote
-
-    url = "/" + quote(ds_id, safe="")
-    if channel_id is not None:
-        url += "/" + quote(channel_id, safe="")
-    return {HANDLE_KEY: url}
-
-
-def is_handle(value: Any) -> bool:
-    return isinstance(value, dict) and HANDLE_KEY in value
+    the IFluidHandle wire shape (runtime/handles.py; segments
+    percent-encoded so ids containing '/' or '%' round-trip)."""
+    return {HANDLE_KEY: make_handle_url(ds_id, channel_id)}
 
 
 def resolve_handle(runtime: ContainerRuntime, handle: dict):
@@ -46,6 +37,8 @@ def resolve_handle(runtime: ContainerRuntime, handle: dict):
     from .request_handler import RuntimeRequestHandlerBuilder, datastore_request_handler
 
     if not is_handle(handle):
+        # is_handle also requires a STRING url: a malformed
+        # {"__fluid_handle__": None} raises here, not deep in the parser.
         raise TypeError(f"not a handle: {handle!r}")
     route = RuntimeRequestHandlerBuilder().push(datastore_request_handler).build()
     response = route(handle[HANDLE_KEY], runtime)

@@ -25,11 +25,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 from typing import Any
-from urllib.parse import unquote
+
+from .handles import is_handle, parse_handle_url, HANDLE_KEY
 
 DS_PREFIX = "fluid:"
 BLOB_PREFIX = "blob:"
-HANDLE_DICT_KEY = "__fluid_handle__"
 
 
 def scan_handles(value: Any, ds_refs: set[str], blob_refs: set[str]) -> None:
@@ -40,9 +40,8 @@ def scan_handles(value: Any, ds_refs: set[str], blob_refs: set[str]) -> None:
         elif value.startswith(BLOB_PREFIX):
             blob_refs.add(value[len(BLOB_PREFIX):])
     elif isinstance(value, dict):
-        url = value.get(HANDLE_DICT_KEY)
-        if isinstance(url, str):
-            parts = [unquote(p) for p in url.strip("/").split("/") if p]
+        if is_handle(value):
+            parts = parse_handle_url(value[HANDLE_KEY])
             if parts:
                 ds_refs.add(parts[0])
         for v in value.values():

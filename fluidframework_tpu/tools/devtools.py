@@ -44,6 +44,24 @@ def visualize_channel(channel) -> dict[str, Any]:
             out["cols"] = channel.col_count
         elif ctype == "sharedTree":
             out["forest"] = channel.forest.to_json()
+        elif ctype == "sharedCell":
+            out["value"] = channel.get()
+        elif ctype == "sharedDirectory":
+            def walk(path: str) -> dict:
+                node: dict[str, Any] = {
+                    "keys": {k: channel.get(path, k) for k in sorted(channel.keys(path))},
+                }
+                subs = {
+                    name: walk(f"{path}/{name}" if path else name)
+                    for name in sorted(channel.subdirectories(path))
+                }
+                if subs:
+                    node["subdirectories"] = subs
+                return node
+
+            out["tree"] = walk("")
+        elif ctype == "taskManager":
+            out["queues"] = {k: list(v) for k, v in channel.queues.items()}
         elif hasattr(channel, "value"):
             out["value"] = channel.value
         elif hasattr(channel, "summarize"):

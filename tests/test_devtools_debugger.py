@@ -201,6 +201,31 @@ def test_devtools_http_surface():
     writer.disconnect()
 
 
+def test_visualize_remaining_dds_types():
+    from fluidframework_tpu.tools.devtools import visualize_channel
+
+    svc = LocalService()
+    c = Container.create_detached(default_registry(), container_id="w")
+    ds = c.runtime.create_datastore("root")
+    cell = ds.create_channel("sharedCell", "cell")
+    d = ds.create_channel("sharedDirectory", "dir")
+    tm = ds.create_channel("taskManager", "tasks")
+    c.attach("doc", LocalDocumentServiceFactory(svc), "w")
+    cell.set({"k": 1})
+    d.set("", "top", 5)
+    d.create_subdirectory("sub")
+    d.set("sub", "inner", "x")
+    tm.volunteer("job")
+    c.runtime.flush()
+    svc.process_all()
+    assert visualize_channel(cell)["value"] == {"k": 1}
+    tree = visualize_channel(d)["tree"]
+    assert tree["keys"] == {"top": 5}
+    assert tree["subdirectories"]["sub"]["keys"] == {"inner": "x"}
+    assert visualize_channel(tm)["queues"] == {"job": ["w"]}
+    c.disconnect()
+
+
 def test_visualize_unknown_channel_never_raises():
     class Weird:
         channel_type = "weird"

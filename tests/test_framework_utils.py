@@ -380,6 +380,8 @@ def test_data_object_lifecycle_and_handles():
     assert ch.channel_type == "sharedString"
     with pytest.raises(KeyError):
         resolve_handle(b, {"__fluid_handle__": "/nope"})
+    with pytest.raises(TypeError):
+        resolve_handle(b, {"__fluid_handle__": None})
     # GC sees dict-shaped handles: note1 is reachable via note2's map.
     from fluidframework_tpu.runtime.gc import scan_handles
 
