@@ -81,6 +81,14 @@ def generate_workload(n_docs, ops_per_step, n_steps, ins_len, payload_len, seed=
     return ops, payloads, min_seqs
 
 
+def zipf_counts(n_docs: int, ops_per_step: int, a: float) -> np.ndarray:
+    """Per-doc op counts by Zipf rank (doc 0 busiest, floor 1) — shared by
+    the trace generator and config3's lane-boundary computation so the two
+    can never diverge."""
+    w = (np.arange(n_docs, dtype=np.float64) + 1.0) ** (-a)
+    return np.maximum(1, np.round(ops_per_step * w / w[0]).astype(np.int64))
+
+
 def generate_multiwriter(
     n_docs, ops_per_step, n_steps, writers, ins_len, payload_len,
     zipf_a=0.0, seed=0,
@@ -109,8 +117,7 @@ def generate_multiwriter(
     payloads = rng.integers(97, 123, size=(S, D, B, L), dtype=np.int32)
 
     if zipf_a > 0:
-        w = (np.arange(D, dtype=np.float64) + 1.0) ** (-zipf_a)
-        counts = np.maximum(1, np.round(B * w / w[0]).astype(np.int64))
+        counts = zipf_counts(D, B, zipf_a)
     else:
         counts = np.full((D,), B, np.int64)
 
@@ -166,8 +173,15 @@ def generate_multiwriter(
 # Shared device runner (merge-tree fleet)
 # ---------------------------------------------------------------------------
 
-def _mergetree_run(args, D, gen, metric):
-    """Time a jitted scan of the merge-tree fleet over a generated trace."""
+def _mergetree_run(args, D, gen, metric, lane_k: int | None = None):
+    """Time a jitted scan of the merge-tree fleet over a generated trace.
+
+    ``lane_k`` enables the two-lane straggler split for skewed fleets: the
+    K busiest documents (front of the doc axis) run the full B-op scan,
+    the long tail runs a 1-op scan — a Zipf tail doc carries one real op
+    per step, and sweeping its state through HBM for all B scan iterations
+    is pure bandwidth waste (the step cost is per-iteration state traffic,
+    and HBM is the bottleneck)."""
     import jax
     import jax.numpy as jnp
 
@@ -181,11 +195,16 @@ def _mergetree_run(args, D, gen, metric):
         text_capacity=args.text_capacity,
     )
 
+    def _broadcast(n):
+        return jax.tree.map(lambda x: jnp.broadcast_to(x, (n,) + x.shape), proto)
+
     def fresh_state():
         # Broadcast on device: no host->device bulk transfer (the chip sits
         # behind a network tunnel, so re-uploading GB-scale state per rep
         # would swamp everything).
-        return jax.tree.map(lambda x: jnp.broadcast_to(x, (D,) + x.shape), proto)
+        if lane_k is None:
+            return _broadcast(D)
+        return (_broadcast(lane_k), _broadcast(D - lane_k))
 
     import functools
 
@@ -203,17 +222,33 @@ def _mergetree_run(args, D, gen, metric):
             lambda s, m: mk.compact(mk.set_min_seq(s, m), ob_static)
         )
 
+        def step_lane(s, ops, payloads, min_seqs, i):
+            s = apply_batch(s, ops, payloads)
+            return jax.lax.cond(
+                (i + 1) % ce == 0,
+                lambda s: compact_batch(s, min_seqs),
+                lambda s: s,
+                s,
+            )
+
         def scan(state, all_ops, all_payloads, all_minseqs):
             def body(carry, xs):
                 s, i = carry
                 ops, payloads, min_seqs = xs
-                s = apply_batch(s, ops, payloads)
-                s = jax.lax.cond(
-                    (i + 1) % ce == 0,
-                    lambda s: compact_batch(s, min_seqs),
-                    lambda s: s,
-                    s,
-                )
+                if lane_k is None:
+                    s = step_lane(s, ops, payloads, min_seqs, i)
+                else:
+                    sA, sB = s
+                    sA = step_lane(
+                        sA, ops[:, :, :lane_k], payloads[:, :, :lane_k],
+                        min_seqs[:lane_k], i,
+                    )
+                    # Tail lane: only op slot 0 is ever populated.
+                    sB = step_lane(
+                        sB, ops[:1, :, lane_k:], payloads[:1, :, lane_k:],
+                        min_seqs[lane_k:], i,
+                    )
+                    s = (sA, sB)
                 return (s, i + 1), None
 
             (s, _), _ = jax.lax.scan(
@@ -234,6 +269,10 @@ def _mergetree_run(args, D, gen, metric):
     # Warmup and timed runs must share the SAME shapes, or jit re-traces and
     # the timed region would include a fresh XLA compile.
     ops, payloads, min_seqs, real_ops = gen()
+    if lane_k is not None:
+        assert not (ops[:, 1:, 0, lane_k:] != 0).any(), (
+            "tail-lane docs must only use op slot 0"
+        )
     has_ob = bool((ops[:, :, 0, :] == mk.OpKind.OBLITERATE).any())
     runner = jax.jit(make_scan(has_ob), donate_argnums=(0,))
     w = args.steps
@@ -252,7 +291,10 @@ def _mergetree_run(args, D, gen, metric):
         st = runner(st, *dev_t)
         jax.block_until_ready(st)
         dt = min(dt, time.perf_counter() - t0)
-        errors = int(np.asarray(jnp.sum(st.error != 0)))
+        # DocState is a NamedTuple (tuple subclass): only a PLAIN tuple
+        # marks the two-lane carry.
+        lanes = st if type(st) is tuple else (st,)
+        errors = sum(int(np.asarray(jnp.sum(s.error != 0))) for s in lanes)
     ops_per_sec = (real_ops // 2) / dt  # generators emit 2*steps, half timed
     result = {
         "metric": metric,
@@ -397,8 +439,20 @@ def bench_config3(args) -> dict:
             args.insert_len, args.payload_len, zipf_a=1.1,
         )
 
-    out = _mergetree_run(args, D, gen, "config3_mergetree_zipf_ops_per_sec_per_chip")
+    # Two-lane straggler split: docs whose Zipf op count exceeds 1 run the
+    # full B-op scan; the long tail (1 op/step) runs a 1-op scan. The
+    # boundary comes from the same count law the generator uses, rounded
+    # up to a 128-lane multiple (doc is the minor/lane axis on TPU).
+    counts = zipf_counts(D, args.ops_per_step, 1.1)
+    busy = int(np.sum(counts > 1))
+    lane_k = min(max(-(-busy // 128) * 128, 128), D)
+    out = _mergetree_run(
+        args, D, gen, "config3_mergetree_zipf_ops_per_sec_per_chip",
+        lane_k=lane_k if lane_k < D else None,
+    )
     out["docs"] = D
+    if lane_k < D:
+        out["lanes"] = [lane_k, D - lane_k]
     out["ingest_ops_per_sec"] = _string_ingest_rate(
         min(D, 128), rounds=16, writers=4
     )
