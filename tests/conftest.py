@@ -24,6 +24,37 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Modules whose every test triggers JAX kernel compilation (the expensive
+# lane).  Everything else is host-plane Python and forms the <2-min smoke
+# lane (`pytest -m "not device"`).
+_DEVICE_MODULES = {
+    "test_doc_batch_engine",
+    "test_kernel_channel",
+    "test_long_doc",
+    "test_matrix_kernel",
+    "test_mergetree_kernel",
+    "test_multidevice",
+    "test_native_ingest",
+    "test_obliterate",
+    "test_overflow_recovery",
+    "test_pallas_kernels",
+    "test_shared_map",
+    "test_tree_batch_engine",
+    "test_tree_kernel",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.module.__name__ in _DEVICE_MODULES:
+            item.add_marker(pytest.mark.device)
+            continue
+        # The kernel leg of dual-backend tests compiles the merge-tree
+        # kernel; the oracle leg stays in the fast lane.
+        callspec = getattr(item, "callspec", None)
+        if callspec is not None and callspec.params.get("string_backend") == "kernel":
+            item.add_marker(pytest.mark.device)
+
 
 @pytest.fixture(params=["oracle", "kernel"])
 def string_backend(request):
