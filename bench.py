@@ -5,8 +5,17 @@ concurrent SharedString documents, target >= 1M ops/sec/chip on TPU with
 reference-equivalent semantics (the semantics are enforced by the
 differential test suite; this file measures throughput only).
 
-Default (no args) prints the driver headline: config 3's single-writer form,
-one JSON line — unchanged across rounds for comparability.  Explicit runs:
+Default (no args) is DRIVER MODE: probes the accelerator in a throwaway
+subprocess (bounded retries; falls back to forced-CPU degraded scale if the
+backend is unavailable or hangs — VERDICT r3 weak #1), then runs every
+config below as a time-boxed subprocess and prints one JSON line each:
+configs 1-5, p50/p99 latency, and LAST the round headline (config 3's
+single-writer form, metric name unchanged since r1 for comparability, with
+the multi-writer Zipf config-3 number attached as co-headline).  The run
+always exits 0; failures appear as structured {"error": ...} lines.  On
+mid-run accelerator failure earlier error lines are re-emitted with their
+degraded-CPU rerun values — the LAST line per metric is authoritative.
+Explicit runs:
 
     python bench.py --config 1   # SharedString single-doc replay, 4 writers
     python bench.py --config 2   # SharedMap LWW, 256 concurrent setters
@@ -32,9 +41,23 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
+
+# Set by the driver-mode parent for its children when the accelerator probe
+# failed: the image's sitecustomize forces jax_platforms=axon,cpu AFTER
+# env-var processing, so JAX_PLATFORMS=cpu alone cannot fall back — the
+# child must override the config in-process before any backend initializes.
+_FORCE_CPU_ENV = "FFTPU_BENCH_FORCE_CPU"
+
+if os.environ.get(_FORCE_CPU_ENV):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 # ---------------------------------------------------------------------------
@@ -807,11 +830,157 @@ def bench_latency(args) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Driver mode: the no-arg entry point the round driver runs.  It must be
+# unkillable (VERDICT r3 weak #1): a hung or unavailable TPU backend, or any
+# single config crashing, must still produce an rc-0 run whose last stdout
+# line is the headline JSON.
+# ---------------------------------------------------------------------------
+
+_CHILD_TIMEOUTS = {
+    "1": 900.0, "2": 600.0, "3": 1500.0, "4": 600.0, "5": 900.0,
+    "latency": 600.0, "headline": 1500.0,
+}
+
+# Recorded r2 headline (BENCH_r02.json): the obliterate-specialization
+# recovery is quantified against it on the headline line.
+_R2_HEADLINE_OPS = 433102224.6
+
+
+def _probe_backend(timeout_s: float = 180.0, attempts: int = 2):
+    """Probe accelerator init in a throwaway subprocess.
+
+    The r3 failure mode was both a raise (UNAVAILABLE) and a hang, so the
+    probe must be able to kill a wedged init.  Returns (platform, None) on
+    success or (None, error_string) after bounded retries."""
+    err = "unknown"
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            out = r.stdout.strip().splitlines()
+            if r.returncode == 0 and out:
+                return out[-1], None
+            err = (r.stderr or "no output").strip()[-500:]
+        except subprocess.TimeoutExpired:
+            err = f"backend init timed out after {timeout_s:.0f}s"
+        except OSError as e:
+            err = str(e)
+        if i + 1 < attempts:
+            time.sleep(20.0 * (i + 1))
+    return None, err
+
+
+def _run_child(key: str, degraded: bool, timeout_s: float):
+    """Run one config as a time-boxed subprocess; return (dict|None, err)."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--config", key]
+    if degraded:
+        # CPU fallback: shrink to scales that finish on a 1-core host; the
+        # numbers are marked degraded and exist to keep the artifact whole.
+        cmd += ["--docs", "128", "--steps", "4", "--reps", "2"]
+    env = dict(os.environ)
+    if degraded:
+        env[_FORCE_CPU_ENV] = "1"
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timed out after {timeout_s:.0f}s"
+    except OSError as e:
+        return None, str(e)
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict):  # scalars/null are stray prints
+            return parsed, None
+    return None, (r.stderr or "no JSON output").strip()[-500:]
+
+
+def _driver_main() -> None:
+    platform, probe_err = _probe_backend(
+        timeout_s=float(os.environ.get("FFTPU_BENCH_PROBE_TIMEOUT", "180")),
+        attempts=int(os.environ.get("FFTPU_BENCH_PROBE_ATTEMPTS", "2")),
+    )
+    # A probe answering "cpu" means the accelerator is absent (this image's
+    # platform list is axon,cpu): full accelerator-scale configs would burn
+    # their whole timeouts on one core, so degrade the scale up front.
+    if platform == "cpu":
+        probe_err = probe_err or "accelerator not present (probe returned cpu)"
+    degraded = platform is None or platform == "cpu"
+    results: dict[str, dict] = {}
+    consecutive_failures = 0
+    order = ["1", "2", "3", "4", "5", "latency", "headline"]
+
+    def finalize(key: str, res: dict | None, err: str | None) -> None:
+        if res is None:
+            res = {"metric": _metric_name(key), "value": None,
+                   "unit": _unit_name(key), "vs_baseline": None,
+                   "error": err}
+        res["platform"] = platform or "cpu"
+        if degraded:
+            res["degraded"] = True
+            if probe_err:
+                res["backend_error"] = probe_err
+        results[key] = res
+        if key != "headline":
+            print(json.dumps(res), flush=True)
+
+    for key in order:
+        res, err = _run_child(key, degraded, _CHILD_TIMEOUTS[key])
+        # ANY consecutive child failure pair trips the fallback: the r3
+        # failure mode was both a hang (timeout) and a fast UNAVAILABLE
+        # raise (rc != 0, no JSON) — both must degrade, not just timeouts.
+        if res is None and not degraded:
+            consecutive_failures += 1
+            if consecutive_failures >= 2:
+                # The accelerator wedged mid-run: finish the artifact on
+                # CPU, including degraded reruns of earlier failures so the
+                # artifact stays whole.
+                degraded, platform = True, None
+                probe_err = probe_err or f"config {key}: {err}"
+                for prev in order[: order.index(key)]:
+                    if results.get(prev, {}).get("value") is None:
+                        finalize(prev, *_run_child(prev, True,
+                                                   _CHILD_TIMEOUTS[prev]))
+                res, err = _run_child(key, True, _CHILD_TIMEOUTS[key])
+        elif res is not None:
+            consecutive_failures = 0
+        finalize(key, res, err)
+    head = results["headline"]
+    c3 = results.get("3", {})
+    if c3.get("value"):
+        head["config3_multiwriter_zipf_ops_per_sec"] = c3["value"]
+    if head.get("value") and not degraded:
+        head["vs_r2_headline"] = round(head["value"] / _R2_HEADLINE_OPS, 3)
+    print(json.dumps(head), flush=True)
+
+
+def _unit_name(key: str) -> str:
+    return {"latency": "us", "5": "rebases/s"}.get(key, "ops/s")
+
+
+def _metric_name(key: str) -> str:
+    return {
+        "1": "config1_singledoc_replay_ops_per_sec",
+        "2": "config2_map_lww_ops_per_sec",
+        "3": "config3_mergetree_zipf_ops_per_sec_per_chip",
+        "4": "config4_matrix_ops_per_sec",
+        "5": "config5_tree_rebases_per_sec",
+        "latency": "remote_op_apply_latency_p50",
+        "headline": "mergetree_ops_per_sec_per_chip",
+    }[key]
+
 
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--config", default=None,
-                   choices=["1", "2", "3", "4", "5", "latency", "all"])
+                   choices=["1", "2", "3", "4", "5", "latency", "headline",
+                            "all"])
     p.add_argument("--docs", type=int, default=None)
     # (segments/text-capacity/steps also use None defaults so per-config
     # tuning never clobbers an explicitly requested value.)
@@ -848,15 +1017,34 @@ def main() -> None:
         "4": bench_config4,
         "5": bench_config5,
         "latency": bench_latency,
+        "headline": bench_headline,
     }
     if args.config is None:
-        print(json.dumps(bench_headline(args)))
+        if len(sys.argv) == 1:
+            _driver_main()
+        else:
+            # Flags without --config: the pre-driver-mode behavior (headline
+            # at the requested scale, honoring the explicit flags).
+            print(json.dumps(bench_headline(args)))
     elif args.config == "all":
-        for key in ("1", "2", "3", "4", "5", "latency"):
+        for key in ("1", "2", "3", "4", "5", "latency", "headline"):
             print(json.dumps(table[key](args)), flush=True)
     else:
         print(json.dumps(table[args.config](args)))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) == 1:
+        # Driver mode must never fail the round artifact: whatever happens,
+        # emit a parseable final line and exit 0.
+        try:
+            main()
+        except BaseException as e:  # noqa: BLE001
+            print(json.dumps({
+                "metric": "mergetree_ops_per_sec_per_chip", "value": None,
+                "unit": "ops/s", "vs_baseline": None,
+                "error": repr(e)[-500:],
+            }))
+            sys.exit(0)
+    else:
+        main()
