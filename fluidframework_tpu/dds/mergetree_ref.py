@@ -52,6 +52,7 @@ overflow detection for the same reason.
 from __future__ import annotations
 
 from dataclasses import dataclass, field, replace
+from typing import Any
 
 from ..protocol.stamps import (
     ALL_ACKED,
@@ -64,6 +65,17 @@ from ..protocol.stamps import (
 # Endpoint sidedness for obliterate ranges (ref sequencePlace.ts Side).
 SIDE_BEFORE = 0
 SIDE_AFTER = 1
+
+
+def attribution_key_at(runs: list, pos: int) -> Any:
+    """The run key in effect at ``pos`` (shared by both backends — the walk
+    of reference attributionCollection.ts findIndex:258)."""
+    key = runs[0][1]
+    for start, k in runs:
+        if start > pos:
+            break
+        key = k
+    return key
 
 
 @dataclass
@@ -105,10 +117,30 @@ class Segment:
     # .obliteratePrecedingInsertion) — drives the last-obliterater-wins
     # tiebreak when later obliterates consider marking this segment.
     ob_preceding: "Obliterate | None" = None
+    # Attribution override runs [(start offset, key)] — set only when the
+    # segment was loaded from a snapshot that universalized its insert stamp
+    # (ref attributionCollection.ts:63: per-segment AttributionCollection
+    # populated from the summary's SequenceOffsets).  Keys: int = op seq,
+    # dict = detached key; None = unattributed.  When absent, attribution
+    # derives from the live insert stamp (attr_runs below).
+    attr: "list[tuple[int, Any]] | None" = None
 
     @property
     def rem_key(self) -> int:
         return self.removes[0][0] if self.removes else NO_REMOVE
+
+    def attr_runs(self) -> list[tuple[int, Any]]:
+        """Attribution runs [(start offset, key)] for this segment's chars.
+
+        Live segments attribute to their insert stamp (int seq when acked,
+        the ``{"type": "local"}`` key while pending — reference
+        attributionCollection local keys); snapshot-loaded segments use the
+        recorded override runs."""
+        if self.attr is not None:
+            return self.attr
+        if acked(self.ins_key):
+            return [(0, self.ins_key)]
+        return [(0, {"type": "local"})]
 
     def visible(self, ref_seq: int, view_client: int) -> bool:
         if not has_occurred(self.ins_key, self.ins_client, ref_seq, view_client):
@@ -128,6 +160,11 @@ class RefMergeTree:
         self.min_seq = 0
         # Obliterates inside the collab window (ref MergeTree.obliterates).
         self.obliterates: list[Obliterate] = []
+        # Every stamp key ever applied by an obliterate — outlives the
+        # window record so snapshotV1 encode can tell slice-removes from
+        # set-removes (the reference keeps the type on the stamp itself,
+        # stamps.ts RemoveOperationStamp.type).
+        self.slice_keys: set[int] = set()
         # Stamp keys minted by regenerate_pending during a reconnect replay.
         # When regenerating a LATER pending op, segments carrying these keys
         # must count as "will be sequenced before it" even though the fresh
@@ -157,16 +194,57 @@ class RefMergeTree:
                 out.extend(props for _ in s.text)
         return out
 
+    def attribution_runs(
+        self, ref_seq: int = ALL_ACKED, view_client: int | None = None
+    ) -> list[tuple[int, Any]]:
+        """Run-length attribution over the visible text: [(start, key)].
+
+        Keys are int op seqs, ``{"type": "local"}`` for pending content, or
+        snapshot-recorded override keys (ref attributionCollection.ts
+        getKeysInOffsetRange; the merged-run collapse matches its
+        serializer, attributionCollection.ts:465)."""
+        vc = self.local_client if view_client is None else view_client
+        runs: list[tuple[int, Any]] = []
+        pos = 0
+        for seg in self.segments:
+            if not seg.visible(ref_seq, vc):
+                continue
+            for off, key in seg.attr_runs():
+                if not runs or runs[-1][1] != key:
+                    runs.append((pos + off, key))
+            pos += len(seg.text)
+        return runs
+
+    def attribution_at(
+        self, pos: int, ref_seq: int = ALL_ACKED, view_client: int | None = None
+    ) -> Any:
+        """Attribution key for the visible character at ``pos``
+        (ref attributionCollection.ts getAtOffset)."""
+        vc = self.local_client if view_client is None else view_client
+        if not 0 <= pos < self.visible_length(ref_seq, vc):
+            raise ValueError(f"attribution offset {pos} out of range")
+        return attribution_key_at(self.attribution_runs(ref_seq, vc), pos)
+
     # ------------------------------------------------------------- primitives
     def _split(self, i: int, offset: int) -> None:
         """Split segment i at text offset, preserving all stamps (ref split)."""
         seg = self.segments[i]
         assert 0 < offset < len(seg.text)
+        attr_l = attr_r = None
+        if seg.attr is not None:
+            attr_l = [(o, k) for o, k in seg.attr if o < offset]
+            attr_r = [(o - offset, k) for o, k in seg.attr if o >= offset]
+            if not attr_r or attr_r[0][0] > 0:
+                # The run containing the split point continues into the
+                # right half (reference AttributionCollection.splitAt).
+                attr_r.insert(0, (0, attr_l[-1][1]))
         left = replace(
-            seg, text=seg.text[:offset], removes=list(seg.removes), props=dict(seg.props)
+            seg, text=seg.text[:offset], removes=list(seg.removes),
+            props=dict(seg.props), attr=attr_l,
         )
         right = replace(
-            seg, text=seg.text[offset:], removes=list(seg.removes), props=dict(seg.props)
+            seg, text=seg.text[offset:], removes=list(seg.removes),
+            props=dict(seg.props), attr=attr_r,
         )
         self.segments[i : i + 1] = [left, right]
         # Obliterate anchors follow the half holding their endpoint char:
@@ -441,6 +519,7 @@ class RefMergeTree:
             if not has_acked_rem:
                 marked.append(seg)
         self.obliterates.append(ob)
+        self.slice_keys.add(op_key)
         return marked
 
     def apply_remove(
@@ -496,6 +575,9 @@ class RefMergeTree:
         """
         local_key = encode_stamp(-1, local_seq)
         self._regenerated_keys.discard(local_key)
+        if local_key in self.slice_keys:
+            self.slice_keys.discard(local_key)
+            self.slice_keys.add(seq)
         inserted: list[Segment] = []
         removed: list[Segment] = []
         for seg in self.segments:
@@ -861,6 +943,7 @@ class RefMergeTree:
                 if any(k == key for k, _c in seg.removes):
                     seg.removes = [(k, c) for k, c in seg.removes if k != key]
             self.obliterates.remove(ob)
+            self.slice_keys.discard(key)
             return []
 
         # Re-stamp the marked segments and the obliterate record itself so
@@ -878,6 +961,8 @@ class RefMergeTree:
         ob.key = fresh_key
         if new_client is not None:
             ob.client = new_client
+        self.slice_keys.discard(key)
+        self.slice_keys.add(fresh_key)
         return [(fresh, {"type": 5, "pos1": start, "pos2": end})]
 
     # ------------------------------------------------------------ checkpoint
@@ -890,14 +975,15 @@ class RefMergeTree:
         for s in self.segments:
             if not acked(s.ins_key) or any(not acked(k) for k, _c in s.removes):
                 raise RuntimeError("summarize with pending merge-tree state")
-            segs.append(
-                {
-                    "text": s.text,
-                    "ins": [s.ins_key, s.ins_client],
-                    "removes": [[k, c] for k, c in s.removes],
-                    "props": {str(p): [v, k] for p, (v, k) in sorted(s.props.items())},
-                }
-            )
+            entry = {
+                "text": s.text,
+                "ins": [s.ins_key, s.ins_client],
+                "removes": [[k, c] for k, c in s.removes],
+                "props": {str(p): [v, k] for p, (v, k) in sorted(s.props.items())},
+            }
+            if s.attr is not None:
+                entry["attr"] = [[o, k] for o, k in s.attr]
+            segs.append(entry)
         seg_index = {id(s): i for i, s in enumerate(self.segments)}
         obs = []
         # Issuers append their own obliterate at issuance, remotes at apply:
@@ -916,7 +1002,17 @@ class RefMergeTree:
                     "refSeq": ob.ref_seq,
                 }
             )
-        return {"segments": segs, "obliterates": obs, "minSeq": self.min_seq}
+        # Slice keys still observable from the summary (present on a segment
+        # or in the window) — keeps remove-type labels through round-trips.
+        live = {k for s in self.segments for k, _c in s.removes} | {
+            ob.key for ob in self.obliterates
+        }
+        return {
+            "segments": segs,
+            "obliterates": obs,
+            "minSeq": self.min_seq,
+            "sliceKeys": sorted(self.slice_keys & live),
+        }
 
     def import_summary(self, summary: dict) -> None:
         self.min_seq = summary["minSeq"]
@@ -927,6 +1023,10 @@ class RefMergeTree:
                 ins_client=e["ins"][1],
                 removes=[(k, c) for k, c in e["removes"]],
                 props={int(p): (v, k) for p, (v, k) in e["props"].items()},
+                attr=(
+                    [(o, k) for o, k in e["attr"]]
+                    if "attr" in e else None
+                ),
             )
             for e in summary["segments"]
         ]
@@ -943,6 +1043,9 @@ class RefMergeTree:
             )
             for o in summary.get("obliterates", [])
         ]
+        self.slice_keys = set(summary.get("sliceKeys", [])) | {
+            ob.key for ob in self.obliterates
+        }
 
     # --------------------------------------------------------------- lifetime
     def update_min_seq(self, min_seq: int) -> None:

@@ -255,3 +255,9 @@ class SharedString:
     @property
     def text(self) -> str:
         return self.backend.visible_text(ALL_ACKED, self.short_client)
+
+    @property
+    def current_seq(self) -> int:
+        """Last sequence number this replica has applied (reference
+        Client.getCurrentSeq)."""
+        return self._ref_seq

@@ -888,7 +888,12 @@ def _host_vis(s: DocState, ref_seq: int, view_client: int):
     rem_keys = np.stack([np.asarray(a)[:nseg] for a in s.rem_keys])
     rem_clients = np.stack([np.asarray(a)[:nseg] for a in s.rem_clients])
     ins_occ = (ins_key <= ref_seq) | (ins_client == view_client)
-    rem_occ = ((rem_keys <= ref_seq) | (rem_clients == view_client)).any(axis=0)
+    # Padding slots (NO_REMOVE / client -1) must never match: a pure
+    # observer legitimately views as client -1.
+    rem_valid = rem_keys != NO_REMOVE
+    rem_occ = (
+        rem_valid & ((rem_keys <= ref_seq) | (rem_clients == view_client))
+    ).any(axis=0)
     return nseg, ins_occ & ~rem_occ
 
 

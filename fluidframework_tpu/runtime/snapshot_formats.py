@@ -25,12 +25,29 @@ from typing import Any, Callable
 
 FORMAT_KEY = "fmt"
 
+def _shared_string_v1_to_v2(summary: dict) -> dict:
+    """v2 adds ``sliceKeys`` — the stamp keys applied by obliterates, kept
+    beyond the window so snapshotV1 interop can label slice- vs set-removes
+    (mergetree_ref.RefMergeTree.slice_keys).  A v1 file can only recover
+    the keys still in its obliterate window table; stamps whose obliterate
+    had already left the window stay unlabeled (visibility is unaffected —
+    slice/set removes hide segments identically)."""
+    return {
+        **summary,
+        "sliceKeys": sorted({ob["key"] for ob in summary.get("obliterates", [])}),
+    }
+
+
 # Current write-format per channel type; unlisted types are version 1.
-CURRENT_FORMATS: dict[str, int] = {}
+CURRENT_FORMATS: dict[str, int] = {
+    "sharedString": 2,
+}
 
 # channel type -> list of upgraders; UPGRADERS[t][k] rewrites a version
-# k+1 summary dict into version k+2. Empty today: every type is at v1.
-UPGRADERS: dict[str, list[Callable[[dict], dict]]] = {}
+# k+1 summary dict into version k+2.
+UPGRADERS: dict[str, list[Callable[[dict], dict]]] = {
+    "sharedString": [_shared_string_v1_to_v2],
+}
 
 
 def current_format(channel_type: str) -> int:

@@ -1,0 +1,227 @@
+"""Per-segment attribution (VERDICT r3 missing #4).
+
+The columnar insert stamps (ins_key/ins_client) ARE the attribution data —
+these tests pin the query surface (ref attributionCollection.ts getAtOffset
+:203 / getKeysInOffsetRange:213), the snapshotV1 attribution channel
+(serializer :465, populate :389 — who-wrote-what survives below-MSN
+coalescing), resolution through the interned OpStreamAttributor
+(framework/attributor), and oracle/kernel agreement under randomized
+concurrent editing.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.dds.mergetree_ref import RefMergeTree
+from fluidframework_tpu.dds.snapshot_v1 import (
+    decode_snapshot_v1,
+    encode_snapshot_v1,
+)
+from fluidframework_tpu.framework.attributor import OpStreamAttributor
+from fluidframework_tpu.protocol.stamps import ALL_ACKED
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+
+def make_container(doc, name: str) -> ContainerRuntime:
+    c = ContainerRuntime(default_registry(), container_id=name)
+    ds = c.create_datastore("root")
+    ds.create_channel("sharedString", "text")
+    c.connect(doc, name)
+    return c
+
+
+def string_of(c: ContainerRuntime):
+    return c.datastore("root").get_channel("text")
+
+
+def settle(doc, *containers):
+    for c in containers:
+        c.flush()
+    doc.process_all()
+
+
+def test_attribution_tracks_writers():
+    """Each visible char attributes to the sequenced op that inserted it,
+    across concurrent writers, identically on every replica."""
+    svc = LocalService()
+    doc = svc.document("d")
+    a, b = make_container(doc, "A"), make_container(doc, "B")
+    doc.process_all()
+
+    string_of(a).insert_text(0, "aaaa")
+    settle(doc, a, b)
+    string_of(b).insert_text(2, "BB")
+    settle(doc, a, b)
+    assert string_of(a).text == "aaBBaa"
+
+    # The attributor consumes the sequenced stream (ref OpStreamAttributor
+    # listens on op events, attributor.ts:87).
+    attributor = OpStreamAttributor()
+    for msg in doc.ops_range(1, 1 << 20):
+        attributor.observe(msg)
+
+    for c in (a, b):
+        ch = string_of(c)
+        keys = [ch.attribution_at(i) for i in range(6)]
+        assert [k["type"] for k in keys] == ["op"] * 6
+        # One seq wrote the a-run, a later seq wrote the B-run.
+        assert keys[0] == keys[1] == keys[4] == keys[5]
+        assert keys[2] == keys[3]
+        assert keys[2]["seq"] > keys[0]["seq"]
+        # Resolution through the op-stream table names the actual writers.
+        assert attributor.get(keys[0]["seq"])["client"] == "A"
+        assert attributor.get(keys[2]["seq"])["client"] == "B"
+
+    # Range query: runs covering [1, 5) — first offset may precede start
+    # (ref getKeysInOffsetRange:213).
+    runs = string_of(a).attribution_range(1, 5)
+    assert [r["offset"] for r in runs] == [0, 2, 4]
+
+
+def test_pending_local_content_attributes_as_local():
+    svc = LocalService()
+    doc = svc.document("d")
+    a = make_container(doc, "A")
+    doc.process_all()
+    string_of(a).insert_text(0, "x")  # not flushed: pending
+    assert string_of(a).attribution_at(0) == {"type": "local"}
+    settle(doc, a)
+    assert string_of(a).attribution_at(0)["type"] == "op"
+
+
+def test_snapshot_v1_attribution_survives_coalescing():
+    """Below-MSN segments coalesce in the V1 snapshot (stamps dropped), but
+    the attribution channel preserves exact per-char provenance."""
+    tree = RefMergeTree()
+    # Three writers' acked inserts, all below the MSN.
+    tree.apply_insert(0, "aaaa", 1, 0, 0)
+    tree.apply_insert(4, "bb", 2, 1, 1)
+    tree.apply_insert(6, "cc", 3, 2, 2)
+    tree.update_min_seq(3)
+
+    names = ["w0", "w1", "w2"]
+    blobs = encode_snapshot_v1(
+        tree, seq=3, get_long_client_id=lambda s: names[s], attribution=True
+    )
+    # The coalesced snapshot melts everything into one spec...
+    import json
+
+    header = json.loads(blobs["header"])
+    assert header["segments"] == ["aaaabbcc"]
+    # ...but the attribution channel keeps the three runs, reference-shaped.
+    assert header["attribution"] == {
+        "seqs": [1, 2, 3],
+        "posBreakpoints": [0, 4, 6],
+        "length": 8,
+    }
+
+    loaded, _seq, _min = decode_snapshot_v1(blobs, names.index)
+    assert loaded.visible_text(ALL_ACKED, -1) == "aaaabbcc"
+    assert loaded.attribution_runs(ALL_ACKED, -1) == [(0, 1), (4, 2), (6, 3)]
+    assert loaded.attribution_at(5, ALL_ACKED, -1) == 2
+
+    # Second-generation snapshot: overrides re-serialize losslessly.
+    blobs2 = encode_snapshot_v1(
+        loaded, seq=3, get_long_client_id=lambda s: names[s], attribution=True
+    )
+    assert json.loads(blobs2["header"])["attribution"] == header["attribution"]
+
+
+def test_snapshot_v1_attribution_spans_mixed_segments():
+    """Attribution runs span coalesced AND merge-info segments, split
+    correctly across chunk boundaries."""
+    tree = RefMergeTree()
+    tree.apply_insert(0, "old", 1, 0, 0)     # below MSN after advance
+    tree.apply_insert(3, "newer", 5, 1, 4)   # above MSN: keeps merge info
+    tree.update_min_seq(4)
+    blobs = encode_snapshot_v1(
+        tree, seq=5, get_long_client_id=lambda s: f"w{s}",
+        chunk_size=3, attribution=True,  # force the 2nd seg into a body chunk
+    )
+    import json
+
+    header = json.loads(blobs["header"])
+    assert header["segments"] == ["old"]
+    assert header["attribution"] == {
+        "seqs": [1], "posBreakpoints": [0], "length": 3,
+    }
+    body = json.loads(blobs["body_0"])
+    assert body["segments"][0]["seq"] == 5
+    assert body["attribution"] == {
+        "seqs": [5], "posBreakpoints": [0], "length": 5,
+    }
+    loaded, _s, _m = decode_snapshot_v1(blobs, lambda n: int(n[1:]))
+    assert loaded.attribution_runs(ALL_ACKED, -1) == [(0, 1), (3, 5)]
+
+
+def test_attributor_summary_roundtrip_resolves_keys():
+    att = OpStreamAttributor()
+    for seq, client in [(1, "alice"), (2, "bob"), (3, "alice")]:
+        att.record(seq, client, 1000.0 + seq)
+    summary = att.summarize()
+    assert summary["clients"] == ["alice", "bob"]  # interned once each
+    restored = OpStreamAttributor()
+    restored.load(summary)
+    assert restored.get(3) == {"client": "alice", "timestamp": 1003.0}
+
+
+@pytest.mark.device
+def test_attribution_oracle_kernel_agreement_under_fuzz():
+    """Randomized concurrent editing on a mixed oracle/kernel fleet: every
+    replica reports identical attribution runs."""
+    import itertools
+
+    from fluidframework_tpu.dds import channels as ch_mod
+    from fluidframework_tpu.dds.kernel_backend import KernelMergeTree
+
+    counter = itertools.count()
+
+    def factory():
+        if next(counter) % 2 == 0:
+            return KernelMergeTree(
+                max_segments=1024, remove_slots=6, text_capacity=16384,
+                max_insert_len=8, ob_slots=16,
+            )
+        return RefMergeTree()
+
+    ch_mod.set_string_backend_factory(factory)
+    try:
+        rng = random.Random(11)
+        svc = LocalService()
+        doc = svc.document("d")
+        conts = [make_container(doc, f"C{i}") for i in range(3)]
+        doc.process_all()
+        for _round in range(6):
+            for c in conts:
+                ch = c.datastore("root").get_channel("text")
+                n = len(ch.text)
+                op = rng.random()
+                if op < 0.6 or n < 4:
+                    ch.insert_text(
+                        rng.randint(0, n),
+                        "".join(rng.choice("xyz") for _ in range(rng.randint(1, 4))),
+                    )
+                elif op < 0.85:
+                    p = rng.randint(0, n - 2)
+                    ch.remove_range(p, p + rng.randint(1, min(3, n - p)))
+                else:
+                    p = rng.randint(0, n - 2)
+                    ch.obliterate_range(p, p + 1)
+            settle(doc, *conts)
+        texts = {string_of(c).text for c in conts}
+        assert len(texts) == 1
+        runs = {
+            tuple(
+                (r["offset"], r["key"]["seq"])
+                for r in string_of(c).attribution_range()
+            )
+            for c in conts
+        }
+        assert len(runs) == 1, f"attribution divergence: {runs}"
+    finally:
+        ch_mod.set_string_backend_factory(None)

@@ -187,7 +187,7 @@ def test_kernel_summary_round_trip(mixed_fleet):
     fresh_k.import_summary(summary)
     assert fresh_k.visible_text() == _ch(a).text
     assert fresh_k.export_summary() == {
-        k: summary[k] for k in ("segments", "obliterates", "minSeq")
+        k: summary[k] for k in ("segments", "obliterates", "minSeq", "sliceKeys")
     }
     # And into the oracle.
     fresh_o = RefMergeTree()

@@ -1,0 +1,324 @@
+"""Interop with reference-produced artifacts (VERDICT r3 missing #1).
+
+Two contracts, both against files shipped INSIDE the reference checkout:
+
+1. **Replay**: the reference's own recorded conflict-farm traces
+   (`packages/dds/merge-tree/src/test/results/*.json`, the files its
+   client.replay.spec.ts replays) drive our stack; every group must
+   converge to the reference-computed ``resultText``.  The expected strings
+   were produced by the TypeScript implementation, not by our oracle.
+2. **snapshotV1**: our merge-tree summaries round-trip through the
+   reference's V1 wire format (snapshotV1.ts:42 — header/body blobs,
+   10k-char chunks) and a V1-loaded replica keeps converging on the rest of
+   a reference trace.
+
+Plus a literature-corpus farm mirroring the reference's beastTest
+(`src/test/beastTest.spec.ts:1564` drives pp.txt through a client/server
+round) to exercise multi-chunk snapshots on real text.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.snapshot_v1 import (
+    BODY_BLOB,
+    HEADER_BLOB,
+    decode_snapshot_v1,
+    encode_snapshot_v1,
+)
+from fluidframework_tpu.dds.shared_string import SharedString
+from fluidframework_tpu.protocol.messages import SequencedMessage
+from fluidframework_tpu.protocol.stamps import ALL_ACKED
+from fluidframework_tpu.server.local_service import LocalDocument
+from fluidframework_tpu.testing.reference_traces import (
+    bootstrap_text,
+    load_trace,
+    reference_trace_files,
+    replay_observer_only,
+    replay_trace,
+    trace_clients,
+    _join_msgs,
+)
+
+TRACE_FILES = reference_trace_files()
+pytestmark = pytest.mark.skipif(
+    not TRACE_FILES, reason="reference checkout not present"
+)
+
+PP_TXT = "/root/reference/packages/dds/merge-tree/src/test/literature/pp.txt"
+
+
+def _by_name(fragment: str) -> str:
+    return next(p for p in TRACE_FILES if fragment in p)
+
+
+# A representative slice for the heavier issuer-faithful replay: every
+# length regime, client count, and both variants appear.
+ISSUER_FILES = [
+    "len_1-clients_2-default-conflict-farm-0.40",
+    "len_1-clients_8-conflict-farm-with-obliterate-2.3.0",
+    "len_4-clients_4-conflict-farm-with-obliterate-2.3.0",
+    "len_8-clients_2-default-conflict-farm-0.40",
+    "len_16-clients_4-default-conflict-farm-0.40",
+    "len_32-clients_8-conflict-farm-with-obliterate-2.3.0",
+    "len_64-clients_2-conflict-farm-with-obliterate-2.3.0",
+    "len_128-clients_4-default-conflict-farm-0.40",
+    "len_256-clients_8-default-conflict-farm-0.40",
+    "len_256-clients_4-conflict-farm-with-obliterate-2.3.0",
+    "len_512-clients_2-default-conflict-farm-0.40",
+    "len_512-clients_8-conflict-farm-with-obliterate-2.3.0",
+]
+
+
+@pytest.mark.parametrize("fragment", ISSUER_FILES)
+def test_issuer_faithful_replay(fragment):
+    """Full client.replay.spec.ts semantics: each trace client catches up to
+    the op's recorded refSeq, re-issues it locally, the sequenced message
+    acks it; all replicas + a remote observer must match every group's
+    reference-recorded resultText."""
+    replay_trace(load_trace(_by_name(fragment)))
+
+
+@pytest.mark.parametrize(
+    "path", TRACE_FILES, ids=[os.path.basename(p) for p in TRACE_FILES]
+)
+def test_observer_replay_all_files(path):
+    """Every reference trace file, applied as a pure remote stream, must
+    converge to every group's reference resultText."""
+    replay_observer_only(load_trace(path))
+
+
+@pytest.mark.device
+@pytest.mark.parametrize("fragment", [
+    "len_1-clients_2-default-conflict-farm-0.40",
+    "len_4-clients_4-conflict-farm-with-obliterate-2.3.0",
+])
+def test_kernel_observer_replay(fragment):
+    """The TPU kernel behind the channel boundary consumes the reference's
+    sequenced stream and converges to the reference resultText."""
+    from fluidframework_tpu.dds.kernel_backend import KernelMergeTree
+
+    replay_observer_only(
+        load_trace(_by_name(fragment)),
+        backend_factory=lambda: KernelMergeTree(
+            max_segments=2048, remove_slots=6, prop_slots=4,
+            text_capacity=16384, max_insert_len=8, ob_slots=16,
+        ),
+        max_groups=24,
+    )
+
+
+# ---------------------------------------------------------------------------
+# snapshotV1 wire format
+# ---------------------------------------------------------------------------
+
+def _snapshot_after(path: str, n_groups: int):
+    """Replay ``n_groups`` of a trace on an observer, then V1-encode its
+    state.  Returns (groups, names, observer, blobs)."""
+    groups = load_trace(path)
+    names = trace_clients(groups)
+    observer = replay_observer_only(groups, max_groups=n_groups)
+    blobs = encode_snapshot_v1(
+        observer.backend, seq=observer.current_seq,
+        get_long_client_id=lambda s: names[s],
+    )
+    return groups, names, observer, blobs
+
+
+@pytest.mark.parametrize("fragment", [
+    "len_16-clients_4-default-conflict-farm-0.40",
+    "len_256-clients_2-default-conflict-farm-0.40",
+    "len_64-clients_2-conflict-farm-with-obliterate-2.3.0",
+])
+def test_snapshot_v1_roundtrip_mid_trace(fragment):
+    """V1 encode -> decode reproduces the exact converged text, and the
+    merge info above the MSN survives (insert/remove stamps)."""
+    groups, names, observer, blobs = _snapshot_after(_by_name(fragment), 24)
+    tree, seq, min_seq = decode_snapshot_v1(blobs, names.index)
+    assert seq == observer.current_seq
+    assert tree.visible_text(ALL_ACKED, -1) == groups[23]["resultText"]
+    # Re-encoding the loaded replica reproduces the same blobs byte-for-byte
+    # (encode depends only on V1-visible state, which decode preserves).
+    blobs2 = encode_snapshot_v1(
+        tree, seq=seq, get_long_client_id=lambda s: names[s]
+    )
+    assert blobs2 == blobs
+
+
+def test_snapshot_v1_loaded_replica_keeps_converging():
+    """A replica booted from the V1 snapshot applies the REST of the
+    reference trace remotely and matches every remaining group's
+    reference-recorded resultText — checkpoint/resume against the
+    reference's own stream."""
+    path = _by_name("len_128-clients_8-default-conflict-farm-0.40")
+    groups, names, observer, blobs = _snapshot_after(path, 32)
+    tree, seq, _min_seq = decode_snapshot_v1(blobs, names.index)
+    loaded = SharedString(client_id="__loaded__", backend=tree)
+    for join in _join_msgs(names):
+        loaded.process(join)
+    for gi, group in enumerate(groups[32:], start=32):
+        for raw in group["msgs"]:
+            loaded.process(SequencedMessage.from_json(json.dumps(raw)))
+        got = tree.visible_text(ALL_ACKED, loaded.short_client)
+        assert got == group["resultText"], f"group {gi} diverged after load"
+
+
+def test_snapshot_v1_chunk_shape():
+    """Exact reference field layout: header/body blob names, chunk fields,
+    headerMetadata keys, orderedChunkMetadata (snapshotChunks.ts:49)."""
+    _groups, _names, observer, blobs = _snapshot_after(
+        _by_name("len_512-clients_2-default"), 24
+    )
+    header = json.loads(blobs[HEADER_BLOB])
+    assert header["version"] == "1"
+    assert set(header) == {
+        "version", "segmentCount", "length", "segments", "startIndex",
+        "headerMetadata",
+    }
+    meta = header["headerMetadata"]
+    assert set(meta) == {
+        "minSequenceNumber", "sequenceNumber", "orderedChunkMetadata",
+        "totalLength", "totalSegmentCount",
+    }
+    assert meta["orderedChunkMetadata"][0] == {"id": HEADER_BLOB}
+    for i, entry in enumerate(meta["orderedChunkMetadata"][1:]):
+        assert entry == {"id": f"{BODY_BLOB}_{i}"}
+        body = json.loads(blobs[entry["id"]])
+        assert "headerMetadata" not in body
+        assert body["version"] == "1"
+    total = sum(
+        json.loads(blobs[e["id"]])["length"]
+        for e in meta["orderedChunkMetadata"]
+    )
+    assert total == meta["totalLength"]
+    assert meta["totalLength"] == len(
+        observer.backend.visible_text(ALL_ACKED, -1)
+    ) + sum(  # plus still-referenceable removed-above-MSN segments
+        len(s.text)
+        for s in observer.backend.segments
+        if s.removes and s.removes[0][0] > observer.backend.min_seq
+    )
+
+
+def test_snapshot_v1_loads_reference_shaped_blob():
+    """A hand-built V1 snapshot in the reference's own shape (including the
+    legacy singular removedClient field and a moved segment) loads into the
+    oracle with the right visibility."""
+    header = {
+        "version": "1",
+        "segmentCount": 4,
+        "length": 16,
+        "segments": [
+            "below msn ",                                  # bare string
+            {"json": {"text": "bold", "props": {"0": 1}},  # annotated
+             "seq": 7, "client": "B"},
+            {"json": "gone", "seq": 8, "client": "C",
+             "removedSeq": 9, "removedClient": "B"},       # legacy singular
+            {"json": "obbed", "seq": 6, "client": "B",
+             "movedSeq": 10, "movedSeqs": [10], "movedClientIds": ["C"]},
+        ],
+        "startIndex": 0,
+        "headerMetadata": {
+            "minSequenceNumber": 5,
+            "sequenceNumber": 10,
+            "orderedChunkMetadata": [{"id": "header"}],
+            "totalLength": 16,
+            "totalSegmentCount": 4,
+        },
+    }
+    names = ["A", "B", "C"]
+    tree, seq, min_seq = decode_snapshot_v1(
+        {"header": json.dumps(header)}, names.index
+    )
+    assert (seq, min_seq) == (10, 5)
+    assert tree.visible_text(ALL_ACKED, -1) == "below msn bold"
+    # Perspective BEFORE the remove was sequenced still sees "gone".
+    assert tree.visible_text(8, -1) == "below msn boldgoneobbed"
+    assert tree.slice_keys == {10}
+    bold = tree.segments[1]
+    assert bold.props == {0: (1, 0)} and bold.ins_key == 7
+    assert bold.ins_client == 1
+
+
+# ---------------------------------------------------------------------------
+# Literature corpus (pp.txt) farm + multi-chunk snapshots
+# ---------------------------------------------------------------------------
+
+def _pp_words(n_chars: int) -> list[str]:
+    with open(PP_TXT, encoding="utf-8") as f:
+        text = f.read(n_chars)
+    return [w for w in text.split() if w]
+
+
+def test_literature_corpus_farm_and_multichunk_snapshot():
+    """beastTest-style corpus run: seed a document with a pp.txt slice, have
+    4 clients make word-granular concurrent edits through the sequencer,
+    converge, then prove the multi-chunk (>10k chars) V1 snapshot
+    round-trips the full state."""
+    words = _pp_words(50_000)
+    seed_text = " ".join(words[:5200])
+    assert len(seed_text) > 25_000  # forces >=2 body chunks
+
+    doc = LocalDocument("pp")
+    clients = []
+    for i in range(4):
+        c = SharedString(client_id=f"w{i}")
+        doc.connect(c.client_id, c.process)
+        clients.append(c)
+    doc.process_all()
+    for rep in clients:
+        bootstrap_text(rep.backend, seed_text)
+
+    rng = random.Random(7)
+    for _round in range(12):
+        for c in clients:
+            n = len(c.text)
+            for _ in range(rng.randint(1, 3)):
+                kind = rng.random()
+                if kind < 0.55 or n < 64:
+                    w = rng.choice(words)
+                    pos = rng.randint(0, n)
+                    c.insert_text(pos, w + " ")
+                    n += len(w) + 1
+                elif kind < 0.85:
+                    p1 = rng.randint(0, n - 32)
+                    c.remove_range(p1, p1 + rng.randint(1, 24))
+                    n -= 0  # approximate; next op re-reads len
+                else:
+                    p1 = rng.randint(0, n - 32)
+                    c.annotate_range(p1, p1 + 16, 0, rng.randint(1, 9))
+                n = len(c.text)
+            for m in c.take_outbox():
+                doc.submit(m)
+        doc.process_all()
+    texts = {c.text for c in clients}
+    assert len(texts) == 1
+
+    src = clients[0]
+    blobs = encode_snapshot_v1(
+        src.backend, seq=src.current_seq,
+        get_long_client_id=lambda s: f"w{s}",
+    )
+    n_bodies = sum(1 for k in blobs if k.startswith(BODY_BLOB))
+    assert n_bodies >= 2, "corpus snapshot must overflow into body chunks"
+    # Each non-final chunk crossed the 10k threshold with its last segment.
+    for name, raw in blobs.items():
+        chunk = json.loads(raw)
+        if chunk["startIndex"] + chunk["segmentCount"] < json.loads(
+            blobs[HEADER_BLOB]
+        )["headerMetadata"]["totalSegmentCount"]:
+            assert chunk["length"] >= 10_000
+
+    tree, _seq, _min_seq = decode_snapshot_v1(
+        blobs, lambda name: int(name[1:])
+    )
+    assert tree.visible_text(ALL_ACKED, -1) == src.text
+    # Annotations survive (values; stamps are V1-dropped by design).
+    orig = src.backend.annotations(ALL_ACKED, src.short_client)
+    loaded = tree.annotations(ALL_ACKED, -1)
+    assert [sorted(d.items()) for d in orig] == [
+        sorted(d.items()) for d in loaded
+    ]

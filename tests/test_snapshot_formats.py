@@ -74,6 +74,19 @@ def test_current_format_has_not_drifted(name):
     )
 
 
+def test_v1_golden_upgrades_bit_exactly():
+    """The committed sharedString v1 file, lifted through the v1->v2
+    upgrader and loaded, re-summarizes BYTE-IDENTICALLY to the upgraded
+    payload: the upgrader output is exactly the current write format."""
+    path = os.path.join(SNAPSHOT_DIR, "sharedString.v1.json")
+    entry = json.load(open(path))
+    assert entry["format"] == 1
+    upgraded = upgrade("sharedString", entry["summary"], 1)
+    assert upgraded["sliceKeys"] == [2]  # recovered from the window table
+    ch = load_channel("sharedString", entry["summary"], 1)
+    assert canonical(ch.summarize()) == canonical(upgraded)
+
+
 def test_upgrade_contract():
     assert current_format("sharedMap") == 1
     # Current-format payloads pass through untouched (and the version never
@@ -125,7 +138,7 @@ def test_container_roundtrip_carries_format_stamps():
     doc.process_all()
     summary = c.summarize()
     entry = summary["datastores"]["root"]["channels"]["text"]
-    assert entry["fmt"] == 1
+    assert entry["fmt"] == current_format("sharedString") == 2
     assert FORMAT_KEY not in entry["summary"]
     c2 = ContainerRuntime(default_registry(), container_id="B")
     c2.load_snapshot(summary)
