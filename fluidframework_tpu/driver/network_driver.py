@@ -75,19 +75,24 @@ class NetworkDeltaConnection(DeltaConnection):
                     "signals": signal_listener is not None,
                 }
             )
-            # Handshake: block for the joined ack (the server sends it
-            # before any broadcast for this socket).
-            line = self._rfile.readline()
-            if not line:
-                raise DriverError("connection closed during handshake")
-            ack = json.loads(line)
-            if ack.get("t") == "error":
-                raise DriverError(
-                    f"connection rejected: {ack.get('reason')}",
-                    can_retry=bool(ack.get("canRetry", False)),
-                )
-            if ack.get("t") != "joined":
-                raise DriverError(f"unexpected handshake reply {ack}", can_retry=False)
+            # Handshake: block for the joined ack.  Broadcasts for this
+            # socket can land BEFORE it (e.g. our own audience clientJoin
+            # signal fans out during connect) — buffer them for dispatch
+            # after the handshake, the reference driver-base
+            # earlyOpHandler pattern (documentDeltaConnection.ts:54).
+            while True:
+                line = self._rfile.readline()
+                if not line:
+                    raise DriverError("connection closed during handshake")
+                ack = json.loads(line)
+                if ack.get("t") == "error":
+                    raise DriverError(
+                        f"connection rejected: {ack.get('reason')}",
+                        can_retry=bool(ack.get("canRetry", False)),
+                    )
+                if ack.get("t") == "joined":
+                    break
+                self._inbound.put(ack)  # early broadcast: deliver post-join
             self.join_msg = _seq_from_dict(ack["join"]) if ack.get("join") else None
             self.checkpoint_seq = ack["deliveredSeq"]
         except BaseException:

@@ -58,8 +58,25 @@ class Presence:
         self._unsub_member_left = _subscribe(
             container.runtime.member_left_listeners, self._drop_client
         )
+        # Loader containers expose the full Audience (read members
+        # included): attendee lifecycle keys off its membership events, so
+        # read-only clients that never op still join/leave the fabric
+        # (ref presence attendee status from audience removeMember).
+        audience = getattr(container, "audience", None)
+        self._unsub_audience: list[Callable[[], None]] = []
+        if audience is not None:
+            self._unsub_audience = [
+                audience.on_add_member(self._on_audience_add),
+                audience.on_remove_member(
+                    lambda cid, _d: self._drop_client(cid)
+                ),
+            ]
         # Join handshake: ask current members for their state.
         container.submit_signal({"presence": "join"})
+
+    def _on_audience_add(self, client_id: str, _details: dict) -> None:
+        if client_id != self._my_id():
+            self._saw(client_id)
 
     # ------------------------------------------------------------------ write
     def set(self, key: str, value: Any) -> None:
@@ -181,6 +198,9 @@ class Presence:
         local listeners — constructing Presence repeatedly on one container
         must not accumulate permanent registrations."""
         self._unsub_member_left()
+        for unsub in self._unsub_audience:
+            unsub()
+        self._unsub_audience = []
         self._listeners.clear()
         self._joined_listeners.clear()
         self._left_listeners.clear()

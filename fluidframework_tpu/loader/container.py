@@ -19,6 +19,7 @@ from typing import Any, Callable
 from ..driver.definitions import DocumentServiceFactory
 from ..protocol.messages import MessageType, SignalMessage
 from ..runtime.container_runtime import ContainerRuntime
+from .audience import Audience
 from .delta_manager import DeltaManager
 from .protocol import ProtocolHandler
 
@@ -36,6 +37,35 @@ class Container:
         self.attached = False
         self._stash: str | None = None
         self._mode = "write"
+        # Full connected-membership surface: write members from sequenced
+        # joins/leaves, read members from the service's clientJoin/
+        # clientLeave system signals (ref audience.ts; VERDICT r3 #3).
+        self.audience = Audience()
+
+    def _wire_audience(self) -> None:
+        self.protocol.on_member_change(
+            lambda kind, cid: (
+                self.audience.add_member(cid, {"mode": "write"})
+                if kind == "join"
+                else self.audience.remove_member(cid)
+            )
+        )
+        self.delta_manager.on_signal(self._audience_signal)
+
+    def _audience_signal(self, sig: SignalMessage) -> None:
+        # Membership events come ONLY from the service identity (empty
+        # sender — connects reject empty client ids, so app signals cannot
+        # spoof audience membership or crash dispatch via the duplicate-add
+        # assertion).
+        if sig.client_id != "":
+            return
+        c = sig.contents
+        if not isinstance(c, dict):
+            return
+        if c.get("type") == "clientJoin":
+            self.audience.add_member(c["clientId"], dict(c["details"]))
+        elif c.get("type") == "clientLeave":
+            self.audience.remove_member(c["clientId"])
 
     # ------------------------------------------------------------------ load
     @staticmethod
@@ -66,6 +96,10 @@ class Container:
         c.delta_manager = DeltaManager(
             service, protocol, base_client_id=client_id, last_processed_seq=base_seq
         )
+        # Members already in the snapshot's quorum predate our hooks.
+        for cid in protocol.quorum.members:
+            c.audience.add_member(cid, {"mode": "write"})
+        c._wire_audience()
         c.attached = True
         c._stash = stash
         c.connect(mode=mode)
@@ -112,6 +146,7 @@ class Container:
         self.delta_manager = DeltaManager(
             service, self.protocol, base_client_id=client_id, last_processed_seq=0
         )
+        self._wire_audience()
         self.attached = True
         self.connect()
 
@@ -123,6 +158,9 @@ class Container:
             raise RuntimeError("connect before attach")
         mode = self._mode if mode is None else mode
         self._mode = mode
+        self.audience.set_current_client_id(
+            self.delta_manager.connection_manager.next_client_id()
+        )
         if mode == "write":
             stash, self._stash = self._stash, None
             self.runtime.connect(

@@ -64,9 +64,16 @@ class ProtocolHandler:
         self.seq = 0
         self.min_seq = 0
         self._accept_listeners: list[Callable[[str, Any, int], None]] = []
+        self._member_listeners: list[Callable[[str, str], None]] = []
 
     def on_accept(self, listener: Callable[[str, Any, int], None]) -> None:
         self._accept_listeners.append(listener)
+
+    def on_member_change(self, listener: Callable[[str, str], None]) -> None:
+        """``listener(kind, client_id)`` with kind "join"/"leave" — fires on
+        sequenced quorum membership changes (the Audience's write-member
+        feed; container.ts wires audience off protocol the same way)."""
+        self._member_listeners.append(listener)
 
     # ------------------------------------------------------------------ apply
     def process_message(self, msg: SequencedMessage) -> None:
@@ -82,8 +89,12 @@ class ProtocolHandler:
                 short_client=msg.contents["short"],
                 join_seq=msg.seq,
             )
+            for fn in list(self._member_listeners):
+                fn("join", cid)
         elif msg.type == MessageType.LEAVE:
-            self.quorum.members.pop(msg.contents["clientId"], None)
+            if self.quorum.members.pop(msg.contents["clientId"], None) is not None:
+                for fn in list(self._member_listeners):
+                    fn("leave", msg.contents["clientId"])
         elif msg.type == MessageType.PROPOSE:
             self.quorum.pending.append(
                 PendingProposal(

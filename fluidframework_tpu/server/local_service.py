@@ -46,6 +46,12 @@ class LocalDocument:
         # Optional riddler-analog token validation (server/auth.py); set via
         # LocalService.enable_auth.
         self.token_manager = None
+        # Read-mode connections: audience membership WITHOUT quorum entry
+        # (ref nexus connect_document — read clients never produce a
+        # sequenced join; fronts broadcast their join/leave as system
+        # signals and hand new subscribers the current list, the
+        # "initialClients" of the connect handshake).
+        self._read_members: dict[str, dict] = {}
 
     def connect(
         self,
@@ -79,10 +85,24 @@ class LocalDocument:
         self._subscribers.pop(client_id, None)
         self._nack_handlers.pop(client_id, None)
         self._signal_subscribers.pop(client_id, None)
+        details = self._read_members.pop(client_id, None)
+        if details is not None:
+            self._broadcast_membership("clientLeave", client_id, details)
         # A client can bail out mid-catch-up, before its join was ticketed
         # (e.g. fork detection closes the container); nothing to leave then.
         if client_id in self.sequencer.clients():
             self._pending.append(self.sequencer.leave(client_id))
+
+    def _broadcast_membership(self, kind: str, client_id: str, details: dict) -> None:
+        # Sender "" is the SERVICE identity — connects reject empty client
+        # ids and submit_signal stamps the connection's id, so clients
+        # cannot forge membership events (the audience trusts only these).
+        sig = SignalMessage(
+            client_id="",
+            contents={"type": kind, "clientId": client_id, "details": details},
+        )
+        for sub in list(self._signal_subscribers.values()):
+            sub(sig)
 
     def submit(self, msg: UnsequencedMessage) -> SequencedMessage | Nack:
         """Ticket an op; queues the sequenced result for broadcast.
@@ -119,6 +139,8 @@ class LocalDocument:
         highest seq already broadcast — everything above it will arrive
         through this subscription.
         """
+        if not client_id:
+            raise ValueError("empty client id (reserved for the service)")
         if self.token_manager is not None:
             # Front-end admission control (riddler token validation).
             self.token_manager.validate(token, self.doc_id, client_id)
@@ -131,10 +153,27 @@ class LocalDocument:
         self._subscribers[client_id] = subscriber
         if on_nack is not None:
             self._nack_handlers[client_id] = on_nack
+        if mode != "write":
+            details = {"mode": "read"}
+            self._read_members[client_id] = details
+            self._broadcast_membership("clientJoin", client_id, details)
         return join, delivered_seq
 
     def subscribe_signals(self, client_id: str, subscriber: SignalSubscriber) -> None:
         self._signal_subscribers[client_id] = subscriber
+        # Audience catch-up: hand the new subscriber the current read
+        # membership, its own included (the connect handshake's
+        # "initialClients" — a client's audience contains itself,
+        # ref audience.ts getSelf).
+        for member_id, details in self._read_members.items():
+            subscriber(SignalMessage(
+                client_id="",
+                contents={
+                    "type": "clientJoin",
+                    "clientId": member_id,
+                    "details": details,
+                },
+            ))
 
     def submit_signal(self, client_id: str, contents) -> None:
         """Unsequenced broadcast (ref broadcaster signal path / nexus signal

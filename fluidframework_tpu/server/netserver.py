@@ -160,7 +160,7 @@ class NetworkServer:
                 join, delivered_seq = doc.connect_stream(
                     client_id, on_op, on_nack, mode=mode, token=req.get("token")
                 )
-            except AuthError as e:
+            except (AuthError, ValueError) as e:
                 session.send(
                     {"t": "error", "reason": f"connection rejected: {e}", "canRetry": False}
                 )
