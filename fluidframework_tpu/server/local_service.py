@@ -159,6 +159,12 @@ class LocalDocument:
             self._broadcast_membership("clientJoin", client_id, details)
         return join, delivered_seq
 
+    def subscribe_stream(self, consumer_id: str, subscriber: Subscriber) -> None:
+        """Raw sequenced-stream subscription: no quorum join, no audience
+        membership — the deltas-topic consumer seam used by server-side
+        lambdas and the device fleet consumer."""
+        self._subscribers[consumer_id] = subscriber
+
     def subscribe_signals(self, client_id: str, subscriber: SignalSubscriber) -> None:
         self._signal_subscribers[client_id] = subscriber
         # Audience catch-up: hand the new subscriber the current read

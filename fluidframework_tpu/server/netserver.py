@@ -27,6 +27,7 @@ import argparse
 import json
 import socketserver
 import threading
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -49,16 +50,60 @@ class _ClientSession:
         self.handler = handler
         self.doc_id: str | None = None
         self.client_id: str | None = None
+        self.consumer_writer: "_QueuedWriter | None" = None
         self._wlock = threading.Lock()
 
     def send(self, obj: dict) -> None:
-        data = (json.dumps(obj) + "\n").encode()
+        self.send_raw((json.dumps(obj) + "\n").encode())
+
+    def send_raw(self, data: bytes) -> None:
         try:
             with self._wlock:
                 self.handler.wfile.write(data)
                 self.handler.wfile.flush()
-        except OSError:
-            pass  # peer went away; the read loop will clean up
+        except (OSError, ValueError):
+            # Peer went away (or socketserver already closed wfile — the
+            # queued writer thread can flush after finish()); the read
+            # loop / drop_session clean up.
+            pass
+
+
+class _QueuedWriter:
+    """Unbounded outbound queue + writer thread for firehose consumers.
+
+    Broadcast fan-out runs under the service lock; a consumer draining
+    slower than the stream produces would otherwise block the whole plane
+    on a full socket buffer (the reference's socket.io fronts buffer
+    outbound the same way)."""
+
+    def __init__(self, session: "_ClientSession") -> None:
+        self._session = session
+        self._q: "deque[bytes]" = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def send_raw(self, data: bytes) -> None:
+        with self._cv:
+            self._q.append(data)
+            self._cv.notify()
+
+    def _drain(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._q:
+                    return
+                batch = b"".join(self._q)
+                self._q.clear()
+            self._session.send_raw(batch)
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify()
 
 
 class _NexusHandler(socketserver.StreamRequestHandler):
@@ -80,6 +125,8 @@ class _NexusHandler(socketserver.StreamRequestHandler):
                 kind = req.get("t")
                 if kind == "connect":
                     server.handle_connect(session, req)
+                elif kind == "consume":
+                    server.handle_consume(session, req)
                 elif kind == "submit":
                     server.handle_submit(session, req)
                 elif kind == "signal":
@@ -140,6 +187,13 @@ class NetworkServer:
         client_id = req["client"]
         mode = req.get("mode", "write")
         with self.lock:
+            if session.doc_id is not None:
+                session.send({
+                    "t": "error",
+                    "reason": "session already bound to a document",
+                    "canRetry": False,
+                })
+                return
             doc = self.service.document(doc_id)
 
             def on_op(msg: SequencedMessage, s=session) -> None:
@@ -183,6 +237,61 @@ class NetworkServer:
             )
             doc.process_all()  # broadcast the join immediately
 
+    def handle_consume(self, session: _ClientSession, req: dict) -> None:
+        """Firehose subscription: the sequenced stream as BARE message JSON
+        lines (SequencedMessage.to_json, one per line) — the deltas-topic
+        consumer seam (ref deli produce -> lambdas consume,
+        deli/lambda.ts:851).  No quorum join, no audience membership; the
+        bytes are exactly what native/ingest.cpp parses, so a device fleet
+        consumer forwards them without any per-op Python."""
+        from .auth import AuthError
+
+        doc_id = req["doc"]
+        from_seq = int(req.get("from", 0))
+        with self.lock:
+            if session.doc_id is not None:
+                session.send({
+                    "t": "error",
+                    "reason": "session already bound to a document",
+                    "canRetry": False,
+                })
+                return
+            doc = self.service.document(doc_id)
+            if doc.token_manager is not None:
+                # The firehose exposes the full op log: same riddler
+                # admission control as every other front.
+                try:
+                    doc.token_manager.validate(
+                        req.get("token"), doc_id, "__consumer__"
+                    )
+                except AuthError as e:
+                    session.send({
+                        "t": "error",
+                        "reason": f"consume rejected: {e}",
+                        "canRetry": False,
+                    })
+                    return
+            consumer_id = f"__consumer__{id(session)}"
+            session.doc_id = doc_id
+            session.client_id = consumer_id
+            # All consumer output rides an outbound queue: the broadcast
+            # path must never block on this socket's buffer.
+            writer = _QueuedWriter(session)
+            session.consumer_writer = writer
+            # Envelope ack first; everything after it on this socket is raw.
+            writer.send_raw((json.dumps({"t": "consuming", "doc": doc_id}) + "\n").encode())
+            # Catch-up: the already-delivered prefix (pending-delivery msgs
+            # arrive through the subscription, mirroring connect()).
+            log = doc.sequencer.log
+            delivered = len(log) - doc.pending_count
+            for msg in log[:delivered]:
+                if msg.seq > from_seq:
+                    writer.send_raw((msg.to_json() + "\n").encode())
+            doc.subscribe_stream(
+                consumer_id,
+                lambda msg, w=writer: w.send_raw((msg.to_json() + "\n").encode()),
+            )
+
     def handle_submit(self, session: _ClientSession, req: dict) -> None:
         with self.lock:
             if session.doc_id is None:
@@ -203,6 +312,8 @@ class NetworkServer:
 
     def drop_session(self, session: _ClientSession) -> None:
         with self.lock:
+            if session.consumer_writer is not None:
+                session.consumer_writer.close()
             if session.doc_id is not None and session.client_id is not None:
                 doc = self.service.document(session.doc_id)
                 doc.disconnect(session.client_id)

@@ -29,6 +29,7 @@ import pytest  # noqa: E402
 # lane (`pytest -m "not device"`).
 _DEVICE_MODULES = {
     "test_doc_batch_engine",
+    "test_fleet_consumer",
     "test_kernel_channel",
     "test_long_doc",
     "test_matrix_kernel",
