@@ -88,25 +88,58 @@ def materialize(node: dict, prev: dict | None, path: str = "") -> Any:
 
 
 class SummaryConfig:
-    """RunningSummarizer heuristics knobs (ref ISummaryConfiguration)."""
+    """RunningSummarizer heuristics knobs (ref ISummaryConfiguration,
+    runningSummarizer.ts):
 
-    def __init__(self, max_ops: int = 50) -> None:
+    - ``max_ops``: summarize once this many ops accumulate since the last
+      acked summary (ref maxOps);
+    - ``max_time_s``: also summarize after this much wall time, provided at
+      least ``min_ops`` ops accumulated (ref maxTime/minOpsForLastSummary);
+    - ``max_ack_wait_s``: an in-flight summary with no ack/nack after this
+      long counts as failed (ref maxAckWaitTime);
+    - ``retry_delays``: back-off ladder between failed attempts (ref the
+      regular/last-try retry schedule); the ladder caps at its final entry;
+    - ``reelection_ops``: with no summary ack for this many ops, election
+      rotates to the next client in join order
+      (ref summarizerClientElection.ts maxOpsSinceLastSummary).
+    """
+
+    def __init__(
+        self,
+        max_ops: int = 50,
+        max_time_s: float | None = None,
+        min_ops: int = 1,
+        max_ack_wait_s: float = 120.0,
+        retry_delays: tuple[float, ...] = (0.0, 5.0, 30.0),
+        reelection_ops: int | None = None,
+    ) -> None:
         self.max_ops = max_ops
+        self.max_time_s = max_time_s
+        self.min_ops = min_ops
+        self.max_ack_wait_s = max_ack_wait_s
+        self.retry_delays = retry_delays
+        self.reelection_ops = reelection_ops
 
 
 class SummaryManager:
     """Drives summarization for one container runtime.
 
-    Election (ref OrderedClientElection): the joined write client with the
-    LOWEST short id (earliest join order) is the summarizer; everyone runs
-    the same deterministic rule, so exactly one client acts. The reference
-    spawns a hidden summarizer client; here the elected interactive client
+    Election (ref OrderedClientElection + SummarizerClientElection): joined
+    write clients ordered by short id (join order) are the candidate ring;
+    normally the first candidate summarizes.  When no summary has been
+    acked for ``reelection_ops`` sequenced ops, every replica
+    deterministically advances the election to the next candidate — an
+    unresponsive summarizer is walked away from without any extra protocol
+    (the shared op counter IS the election clock; the reference encodes the
+    same advance in its serialized election state).  The reference spawns a
+    hidden summarizer client; here the elected interactive client
     summarizes directly at a moment with no local pending ops — same
     protocol, one process fewer.
 
-    Call ``tick()`` from the host loop (the reference wires this to op
-    events + timers); it submits at most one summary and then waits for the
-    ack/nack before trying again.
+    Call ``tick(now)`` from the host loop (the reference wires this to op
+    events + timers; tests inject ``now``); it submits at most one summary
+    and then waits for the ack/nack — or the ack-wait timeout — before
+    trying again, backing off through the retry ladder across failures.
     """
 
     def __init__(
@@ -121,18 +154,29 @@ class SummaryManager:
         self.config = config or SummaryConfig()
         self._protocol_summarize = protocol_summarize or (lambda: {})
         self._inflight_handle: str | None = None
+        self._inflight_since = 0.0
+        self._last_summary_time: float | None = None  # set on first tick
+        self._next_attempt_time = 0.0
+        self._now = 0.0  # last tick clock, for clock-less ack callbacks
         self.submitted = 0
         self.acked = 0
+        self.failures = 0  # consecutive failures (nack / ack timeout)
         runtime.on_summary_ack = self._on_ack
         runtime.on_summary_nack = self._on_nack
 
     # ------------------------------------------------------------------ state
     def elected_summarizer(self) -> str | None:
-        """client id of the current summarizer (lowest short id in quorum)."""
+        """client id of the current summarizer.
+
+        Deterministic on every replica: candidates in join order, rotated
+        once per ``reelection_ops`` window without an acked summary."""
         q = self._runtime.quorum_table
         if not q:
             return None
-        return min(q, key=lambda cid: q[cid])
+        candidates = sorted(q, key=lambda cid: q[cid])
+        r = self.config.reelection_ops
+        rounds = (self._runtime.ops_since_summary_ack // r) if r else 0
+        return candidates[rounds % len(candidates)]
 
     def is_elected(self) -> bool:
         return (
@@ -141,14 +185,34 @@ class SummaryManager:
         )
 
     # ------------------------------------------------------------------- tick
-    def tick(self) -> bool:
+    def tick(self, now: float | None = None) -> bool:
         """Summarize if warranted; returns True when a summary was submitted."""
+        import time as _time
+
+        now = _time.monotonic() if now is None else now
+        self._now = now
+        if self._last_summary_time is None:
+            self._last_summary_time = now
+        if self._inflight_handle is not None:
+            if now - self._inflight_since >= self.config.max_ack_wait_s:
+                # The ack never came (stalled scribe / dropped op): count a
+                # failure and retry through the ladder (ref maxAckWaitTime).
+                self._record_failure()
+            return False
         if (
             not self.is_elected()
-            or self._inflight_handle is not None
-            or self._runtime.ops_since_summary_ack < self.config.max_ops
             or self._runtime.pending_op_count > 0
+            or now < self._next_attempt_time
         ):
+            return False
+        ops = self._runtime.ops_since_summary_ack
+        due_ops = ops >= self.config.max_ops
+        due_time = (
+            self.config.max_time_s is not None
+            and ops >= self.config.min_ops
+            and now - self._last_summary_time >= self.config.max_time_s
+        )
+        if not (due_ops or due_time):
             return False
         root = tree(
             {
@@ -158,28 +222,39 @@ class SummaryManager:
         )
         h = self._storage.upload_summary(root)
         self._inflight_handle = h
+        self._inflight_since = now
         try:
             self._runtime.submit_protocol_message(
                 MessageType.SUMMARIZE, {"handle": h, "refSeq": self._runtime.ref_seq}
             )
         except RuntimeError:
             # Connection dropped during flush: the proposal never reached the
-            # stream, so no ack/nack will ever clear it — treat as a nack so
-            # the elected client can summarize again after reconnect.
-            self._inflight_handle = None
+            # stream, so no ack/nack will ever clear it — treat as a failure
+            # so the elected client can summarize again after reconnect.
+            self._record_failure()
             return False
         self.submitted += 1
         return True
+
+    def _record_failure(self) -> None:
+        self._inflight_handle = None
+        self.failures += 1
+        delays = self.config.retry_delays
+        delay = delays[min(self.failures - 1, len(delays) - 1)] if delays else 0.0
+        self._next_attempt_time = self._now + delay
+        # Retry WITHOUT handles: whatever failed to resolve against the
+        # previous snapshot will upload as a full blob next time (the
+        # reference's safe-retry after summary nack).
+        self._runtime.last_summary_ref_seq = None
 
     def _on_ack(self, contents: dict) -> None:
         if contents.get("handle") == self._inflight_handle:
             self._inflight_handle = None
             self.acked += 1
+            self.failures = 0
+            self._next_attempt_time = 0.0
+            self._last_summary_time = self._now
 
     def _on_nack(self, contents: dict) -> None:
         if contents.get("handle") == self._inflight_handle:
-            self._inflight_handle = None  # heuristics will retry next tick
-            # Retry WITHOUT handles: whatever failed to resolve against the
-            # previous snapshot will upload as a full blob next time (the
-            # reference's safe-retry after summary nack).
-            self._runtime.last_summary_ref_seq = None
+            self._record_failure()

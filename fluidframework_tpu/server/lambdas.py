@@ -209,12 +209,28 @@ class BroadcasterLambda:
 class ScribeLambda:
     """Summary handling: materialize + store snapshots, ack via ingestion."""
 
-    def __init__(self, deltas: Topic, rawdeltas: Topic, partition: int, uploads: dict):
+    def __init__(
+        self,
+        deltas: Topic,
+        rawdeltas: Topic,
+        partition: int,
+        uploads: dict,
+        snapshots: dict | None = None,
+    ):
         self._in = deltas.partition(partition)
         self._raw = rawdeltas
         self.offset = 0
         self._uploads = uploads  # handle -> summary tree (storage staging)
-        self.snapshots: dict[str, list[tuple[int, dict]]] = {}
+        # Snapshot store; pass a shared dict to make it external durable
+        # storage (the git/historian analog) that outlives this instance.
+        self.snapshots: dict[str, list[tuple[int, dict]]] = (
+            {} if snapshots is None else snapshots
+        )
+        # SUMMARIZE records fully processed by a previous incarnation
+        # (snapshot stored, response emitted) that this replay must skip —
+        # their upload handles are legitimately consumed and their
+        # responses already ride the logs (partition handoff arming).
+        self.replay_skip: set[tuple[str, str]] = set()
 
     def pump(self) -> int:
         from ..runtime.summary import materialize
@@ -224,6 +240,11 @@ class ScribeLambda:
             msg: SequencedMessage = rec.payload
             if msg.type == MessageType.SUMMARIZE:
                 handle = msg.contents.get("handle")
+                if (rec.doc_id, handle) in self.replay_skip:
+                    self.replay_skip.discard((rec.doc_id, handle))
+                    self.offset = rec.offset + 1
+                    n += 1
+                    continue
                 ref_seq = msg.contents.get("refSeq")
                 tree = self._uploads.pop(handle, None)
                 snaps = self.snapshots.setdefault(rec.doc_id, [])
@@ -463,6 +484,76 @@ class DurableUploads(dict):
         self._flush()
 
 
+def apply_replay_dedup(
+    deli, scribe_offset: int, rawdeltas, deltas, uploads, p: int,
+    arm_responses: bool = True,
+) -> set[tuple[str, str]]:
+    """Arm one partition's at-least-once dedup for a resume-by-replay.
+
+    Whatever already reached the deltas log (possibly beyond the
+    checkpoint) must not re-append; summary responses already ticketed must
+    not re-sequence when the replaying scribe re-emits them; and upload
+    handles consumed by SUMMARIZE ops the scribe is already past must not
+    resurrect.  Shared by the durable-restart path and partition-ownership
+    handoff (lambdas-driver partitionManager.ts analog).
+
+    Returns the (doc, handle) pairs whose SUMMARIZE was already FULLY
+    processed by the previous incarnation — its response is present in the
+    deltas log or still pending in rawdeltas — for ``ScribeLambda.
+    replay_skip``: re-processing one would find its consumed upload handle
+    missing and sequence a spurious nack after the real response."""
+    deli.dedup_until = deltas.partition(p).head
+    deli.replay_boundary = rawdeltas.partition(p).head
+    # Handles whose SUMMARIZE the resumed scribe WILL re-process (at/after
+    # its checkpoint offset) — only their responses can be re-emitted, so
+    # only those may be dropped as duplicates; a stale entry would swallow
+    # a live post-resume retry.
+    re_emittable: set[tuple[str, str]] = set()
+    for rec in deltas.partition(p).read(0):
+        msg: SequencedMessage = rec.payload
+        contents = msg.contents if isinstance(msg.contents, dict) else {}
+        handle = contents.get("handle")
+        if handle is None or msg.type != MessageType.SUMMARIZE:
+            continue
+        if rec.offset >= scribe_offset:
+            re_emittable.add((rec.doc_id, handle))
+        else:
+            uploads.pop(handle, None)
+    processed: set[tuple[str, str]] = set()
+    for rec in deltas.partition(p).read(0):
+        msg = rec.payload
+        contents = msg.contents if isinstance(msg.contents, dict) else {}
+        handle = contents.get("handle")
+        if (
+            handle is not None
+            and msg.type in (MessageType.SUMMARY_ACK, MessageType.SUMMARY_NACK)
+            and (rec.doc_id, handle) in re_emittable
+        ):
+            if arm_responses:
+                # Durable-restart path: the restored scribe re-emits these
+                # responses and deli must drop the duplicates.  A resume
+                # that instead arms ScribeLambda.replay_skip skips the
+                # re-emission entirely and passes arm_responses=False — a
+                # lingering drop entry could swallow a future live
+                # response for a reused handle.
+                deli.replay_responses.add((rec.doc_id, handle, msg.type))
+            processed.add((rec.doc_id, handle))
+    # Responses emitted but not yet ticketed ride rawdeltas (it survives
+    # the crash): their SUMMARIZE was fully processed too.
+    for rec in rawdeltas.partition(p).read(0):
+        kind, payload = rec.payload
+        if kind != "service":
+            continue
+        mtype, contents = payload
+        handle = contents.get("handle") if isinstance(contents, dict) else None
+        if handle is not None and mtype in (
+            MessageType.SUMMARY_ACK, MessageType.SUMMARY_NACK
+        ):
+            if (rec.doc_id, handle) in re_emittable:
+                processed.add((rec.doc_id, handle))
+    return processed
+
+
 class DurablePipelineService(PipelineService):
     """PipelineService over file-backed topics with checkpointed deli state
     (the reference's production shape: Kafka retains the log, deli rides a
@@ -552,41 +643,14 @@ class DurablePipelineService(PipelineService):
                 lam.offset = state.get("moira", {}).get(str(p), 0)
         # Whatever already reached the durable deltas log (possibly beyond
         # the checkpoint — flushes keep running between checkpoints) must
-        # not be appended twice during replay; likewise summary responses
-        # already ticketed must not re-sequence when a replaying scribe
-        # re-emits them, and upload handles consumed by SUMMARIZE ops the
-        # scribe is already past must not resurrect (a crash between the
-        # checkpoint write and the uploads compaction leaves them behind).
-        for p, lam in enumerate(self.deli):
-            lam.dedup_until = self.deltas.partition(p).head
-            lam.replay_boundary = self.rawdeltas.partition(p).head
+        # not replay with side effects twice (see apply_replay_dedup; a
+        # crash between the checkpoint write and the uploads compaction
+        # leaves consumed handles behind).
         for p in range(len(self.deli)):
-            scribe_offset = self.scribe[p].offset
-            # Handles whose SUMMARIZE the restarted scribe WILL re-process
-            # (at/after its checkpoint offset) — only their responses can
-            # be re-emitted, so only those may be dropped as duplicates;
-            # a stale entry would swallow a live post-restart retry.
-            re_emittable: set[tuple[str, str]] = set()
-            for rec in self.deltas.partition(p).read(0):
-                msg: SequencedMessage = rec.payload
-                contents = msg.contents if isinstance(msg.contents, dict) else {}
-                handle = contents.get("handle")
-                if handle is None or msg.type != MessageType.SUMMARIZE:
-                    continue
-                if rec.offset >= scribe_offset:
-                    re_emittable.add((rec.doc_id, handle))
-                else:
-                    self.uploads.pop(handle, None)
-            for rec in self.deltas.partition(p).read(0):
-                msg = rec.payload
-                contents = msg.contents if isinstance(msg.contents, dict) else {}
-                handle = contents.get("handle")
-                if (
-                    handle is not None
-                    and msg.type in (MessageType.SUMMARY_ACK, MessageType.SUMMARY_NACK)
-                    and (rec.doc_id, handle) in re_emittable
-                ):
-                    self.deli[p].replay_responses.add((rec.doc_id, handle, msg.type))
+            apply_replay_dedup(
+                self.deli[p], self.scribe[p].offset,
+                self.rawdeltas, self.deltas, self.uploads, p,
+            )
         # Scriptorium/broadcaster replay the durable deltas topic from zero
         # — deterministic rebuild of the op store; broadcaster has no
         # subscribers yet (stateless fronts re-register on reconnect).

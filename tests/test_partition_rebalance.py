@@ -1,0 +1,194 @@
+"""Partition rebalance: document ownership moves between workers with
+checkpoint handoff (VERDICT r3 missing #7; ref lambdas-driver
+partitionManager.ts).
+
+The kill tests pin the core contract: a partition's documents resume on a
+surviving worker from the last periodic checkpoint with NO op loss and NO
+duplication in the sequenced log, even when the dead worker had processed
+(and produced side effects for) records beyond that checkpoint.
+"""
+
+from __future__ import annotations
+
+
+
+from fluidframework_tpu.protocol.messages import (
+    MessageType,
+    SequencedMessage,
+    UnsequencedMessage,
+)
+from fluidframework_tpu.server.partition_manager import PartitionManager
+
+
+def op(client: str, cseq: int, ref: int = 1, body: str = "x") -> UnsequencedMessage:
+    return UnsequencedMessage(
+        client_id=client, client_seq=cseq, ref_seq=ref,
+        type=MessageType.OP, contents={"type": 0, "pos1": 0, "seg": body},
+    )
+
+
+DOCS = [f"doc{i}" for i in range(8)]
+
+
+def feed(pm: PartitionManager, start: int, count: int) -> None:
+    for doc in DOCS:
+        for i in range(start + 1, start + count + 1):  # clientSeq is 1-based
+            pm.submit_op(doc, op("w", i, ref=1))
+
+
+def seqs_of(pm: PartitionManager, doc: str) -> list[int]:
+    """Per-doc sequence numbers as recorded in the deltas LOG (the durable
+    truth a rebalance must never corrupt)."""
+    p = pm.deltas.partition_for(doc)
+    return [
+        rec.payload.seq
+        for rec in pm.deltas.partition(p).read(0)
+        if rec.doc_id == doc and rec.payload.type == MessageType.OP
+    ]
+
+
+def assert_no_loss_no_dup(pm: PartitionManager, expected_ops: int) -> None:
+    for doc in DOCS:
+        seqs = seqs_of(pm, doc)
+        assert len(seqs) == expected_ops, (doc, len(seqs))
+        assert seqs == sorted(seqs)
+        assert len(set(seqs)) == len(seqs), f"duplicated seqs in {doc}"
+
+
+def test_round_robin_assignment_and_join_rebalance():
+    pm = PartitionManager(n_partitions=4)
+    pm.add_worker("a")
+    assert pm.assignments() == {"a": [0, 1, 2, 3]}
+    pm.add_worker("b")
+    assert pm.assignments() == {"a": [0, 2], "b": [1, 3]}
+    pm.add_worker("c")
+    assert pm.assignments() == {"a": [0, 3], "b": [1], "c": [2]}
+
+
+def test_join_mid_stream_moves_partitions_without_disruption():
+    pm = PartitionManager(n_partitions=4)
+    pm.add_worker("a")
+    for doc in DOCS:
+        pm.join(doc, "w")
+    feed(pm, 0, 5)
+    pm.pump()
+    pm.add_worker("b")  # live move with checkpoint handoff
+    feed(pm, 5, 5)
+    pm.pump()
+    assert_no_loss_no_dup(pm, 10)
+
+
+def test_graceful_remove_resumes_seamlessly():
+    pm = PartitionManager(n_partitions=4)
+    pm.add_worker("a")
+    pm.add_worker("b")
+    for doc in DOCS:
+        pm.join(doc, "w")
+    feed(pm, 0, 4)
+    pm.pump()
+    pm.remove_worker("b")  # checkpoints its partitions on the way out
+    assert pm.assignments() == {"a": [0, 1, 2, 3]}
+    feed(pm, 4, 4)
+    pm.pump()
+    assert_no_loss_no_dup(pm, 8)
+
+
+def test_kill_mid_stream_no_loss_no_dup():
+    """THE rebalance contract: kill a worker whose partitions have both
+    unprocessed input AND side effects beyond the last checkpoint; the
+    successors replay from the checkpoint without losing or duplicating a
+    single sequenced op."""
+    pm = PartitionManager(n_partitions=4)
+    pm.add_worker("a")
+    pm.add_worker("b")
+    for doc in DOCS:
+        pm.join(doc, "w")
+    feed(pm, 0, 4)
+    pm.pump()  # processes + periodic checkpoint
+
+    # New input lands; the victim processes SOME of it directly (side
+    # effects hit the deltas log) but the manager never checkpoints again.
+    feed(pm, 4, 3)
+    for lams in pm.workers["b"].values():
+        lams.pump()  # beyond-checkpoint progress that will be replayed
+
+    pm.kill_worker("b")
+    assert pm.assignments() == {"a": [0, 1, 2, 3]}
+    feed(pm, 7, 3)
+    pm.pump()
+    assert_no_loss_no_dup(pm, 10)
+    # And the op stores converge with the log (deterministic rebuild).
+    for doc in DOCS:
+        assert [m.seq for m in pm.ops_of(doc) if m.type == MessageType.OP] == seqs_of(pm, doc)
+
+
+def test_kill_preserves_summary_state_and_never_reacks():
+    """Summaries processed before the kill survive the move, and replaying
+    the summarize op on the new owner does not re-emit its ack."""
+    pm = PartitionManager(n_partitions=2)
+    pm.add_worker("a")
+    pm.add_worker("b")
+    doc = "doc0"
+    pm.join(doc, "w")
+    pm.submit_op(doc, op("w", 1))
+    pm.pump()
+    h = pm.upload_summary({"type": "blob", "content": {"s": 1}})
+    pm.rawdeltas.produce(doc, ("service", (MessageType.SUMMARIZE, {"handle": h, "refSeq": 1})))
+    victim = pm.owner_of(pm.deltas.partition_for(doc))
+    # The victim processes the summarize (snapshot + ack into rawdeltas)
+    # and even sequences the ack — all beyond the last checkpoint.
+    for _ in range(4):
+        for lams in pm.workers[victim].values():
+            lams.pump()
+    pm.kill_worker(victim)
+    pm.pump()
+    assert len(pm.snapshots_of(doc)) == 1
+    responses = [
+        rec.payload.type
+        for rec in pm.deltas.partition(pm.deltas.partition_for(doc)).read(0)
+        if rec.payload.type in (MessageType.SUMMARY_ACK, MessageType.SUMMARY_NACK)
+    ]
+    # Exactly ONE response total: no re-sequenced ack, and no spurious
+    # nack from the replayed SUMMARIZE finding its consumed handle gone.
+    assert responses == [MessageType.SUMMARY_ACK], responses
+
+
+def test_subscribers_reattach_across_kill():
+    pm = PartitionManager(n_partitions=2)
+    pm.add_worker("a")
+    pm.add_worker("b")
+    doc = "doc0"
+    pm.join(doc, "w")
+    seen: list[int] = []
+    last = [0]
+
+    def on_msg(msg: SequencedMessage) -> None:
+        # Client-side at-least-once dedup by seq (the DeltaManager rule).
+        if msg.seq > last[0]:
+            last[0] = msg.seq
+            if msg.type == MessageType.OP:
+                seen.append(msg.seq)
+
+    pm.subscribe(doc, on_msg)
+    pm.submit_op(doc, op("w", 1))
+    pm.submit_op(doc, op("w", 2))
+    pm.pump()
+    victim = pm.owner_of(pm.deltas.partition_for(doc))
+    pm.submit_op(doc, op("w", 3))
+    for lams in pm.workers[victim].values():
+        lams.pump()  # broadcast beyond checkpoint, then die
+    pm.kill_worker(victim)
+    pm.submit_op(doc, op("w", 4))
+    pm.pump()
+    assert seen == sorted(set(seen))
+    assert len(seen) == 4, f"subscriber missed ops: {seen}"
+
+
+def test_no_workers_queues_until_one_joins():
+    pm = PartitionManager(n_partitions=2)
+    pm.join("doc0", "w")
+    pm.submit_op("doc0", op("w", 1))
+    assert pm.pump() == 0  # nothing owns the partitions yet
+    pm.add_worker("a")
+    pm.pump()
+    assert [m.seq for m in pm.ops_of("doc0") if m.type == MessageType.OP] == [2]

@@ -272,3 +272,123 @@ def test_summary_nack_retries_without_handles(env):
     assert sm.acked == 2
     _, snap = svc.document("doc").latest_snapshot()
     assert snap["runtime"]["datastores"]["root"]["channels"]["meta"]["summary"] is not None
+
+
+# --------------------------------------------------------------------------
+# heuristics + retry ladder + re-election (VERDICT r3 next #7)
+# --------------------------------------------------------------------------
+
+def test_time_trigger_summarizes_with_few_ops(env):
+    """max_time_s fires a summary even below max_ops, once min_ops exist
+    (ref ISummaryConfiguration maxTime/minOpsForLastSummary)."""
+    svc, factory, d = boot(env)
+    sm = d.make_summary_manager(SummaryConfig(max_ops=100, max_time_s=60.0))
+    text_of(d).insert_text(0, "a")
+    d.runtime.flush()
+    svc.process_all()
+    assert not sm.tick(now=10.0)     # neither ops nor time due
+    assert not sm.tick(now=69.0)     # still inside the window
+    assert sm.tick(now=70.5)         # window elapsed, min_ops satisfied
+    svc.process_all()
+    assert sm.acked == 1
+    # The clock baseline advances on ack: no immediate re-trigger.
+    assert not sm.tick(now=71.0)
+
+
+def test_time_trigger_requires_min_ops(env):
+    svc, factory, d = boot(env)
+    sm = d.make_summary_manager(SummaryConfig(max_ops=100, max_time_s=5.0))
+    assert not sm.tick(now=0.0)
+    assert not sm.tick(now=1000.0)  # no ops at all: nothing to summarize
+
+
+def test_ack_wait_timeout_counts_failure_and_backs_off(env):
+    """An in-flight summary whose ack never arrives (stalled scribe) frees
+    the manager after max_ack_wait_s and backs off through the ladder
+    (ref maxAckWaitTime + retry schedule)."""
+    svc, factory, d = boot(env)
+    sm = d.make_summary_manager(SummaryConfig(
+        max_ops=1, max_ack_wait_s=30.0, retry_delays=(0.0, 10.0, 60.0),
+    ))
+    text_of(d).insert_text(0, "a")
+    d.runtime.flush()
+    svc.process_all()
+    # Stall the scribe: the summarize op sequences but is never acked.
+    doc = svc.document("doc")
+    real_scribe = doc._scribe_process_summarize
+    doc._scribe_process_summarize = lambda msg: None
+    assert sm.tick(now=0.0)
+    svc.process_all()  # op delivered; no ack produced
+    doc._scribe_process_summarize = real_scribe
+    assert not sm.tick(now=10.0)          # still waiting inside ack window
+    assert sm.failures == 0
+    assert not sm.tick(now=31.0)          # timeout: failure #1, delay 0
+    assert sm.failures == 1
+    assert sm.tick(now=31.5)              # retries immediately (ladder[0])
+    svc.process_all()
+    assert sm.acked == 1
+    assert sm.failures == 0               # ack resets the ladder
+
+
+def test_nack_ladder_escalates_delays(env):
+    svc, factory, d = boot(env)
+    sm = d.make_summary_manager(SummaryConfig(
+        max_ops=1, retry_delays=(0.0, 10.0, 60.0),
+    ))
+    text_of(d).insert_text(0, "x")
+    map_of(d).set("k", 1)
+    d.runtime.flush()
+    svc.process_all()
+    assert sm.tick(now=0.0)
+    svc.process_all()
+    assert sm.acked == 1
+    doc = svc.document("doc")
+    text_of(d).insert_text(0, "y")
+    d.runtime.flush()
+    svc.process_all()
+    # Nack #1 (snapshot loss): immediate retry allowed (ladder[0] = 0).
+    doc._snapshots.clear()
+    assert sm.tick(now=100.0)
+    svc.process_all()
+    assert sm.failures == 1
+    # Nack #2: uploads full blobs... but sabotage the upload table so the
+    # scribe nacks again -> ladder[1] = 10s holds the next attempt.
+    real_upload = doc.upload_summary
+    doc.upload_summary = lambda tree_: "bogus-handle"
+    assert sm.tick(now=100.5)
+    svc.process_all()
+    assert sm.failures == 2
+    doc.upload_summary = real_upload
+    assert not sm.tick(now=105.0)         # inside the 10s back-off
+    assert sm.tick(now=110.6)             # ladder elapsed
+    svc.process_all()
+    assert sm.failures == 0 and sm.acked == 2
+
+
+def test_stalled_summarizer_reelection_takeover(env):
+    """The elected summarizer goes unresponsive; once reelection_ops ops
+    pass without an acked summary, every replica deterministically elects
+    the next client in join order, which summarizes without a missed
+    window (ref summarizerClientElection.ts maxOpsSinceLastSummary)."""
+    svc, factory, d = boot(env)
+    c2 = load(factory, "second")
+    svc.process_all()
+    cfg = dict(max_ops=4, reelection_ops=8)
+    sm1 = d.make_summary_manager(SummaryConfig(**cfg))
+    sm2 = c2.make_summary_manager(SummaryConfig(**cfg))
+    assert sm1.is_elected() and not sm2.is_elected()
+
+    # sm1 stalls (never ticks). Ops accumulate past the re-election window.
+    for i in range(9):
+        text_of(c2).insert_text(0, "z")
+        c2.runtime.flush()
+        svc.process_all()
+    assert not sm1.is_elected(), "stalled summarizer must lose election"
+    assert sm2.is_elected()
+    assert sm2.elected_summarizer() == "second"
+    assert sm2.tick(now=0.0)
+    svc.process_all()
+    assert sm2.acked == 1
+    # The ack resets the shared op counter: election returns to the ring
+    # head on every replica.
+    assert sm1.is_elected() and not sm2.is_elected()
