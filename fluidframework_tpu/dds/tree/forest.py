@@ -33,10 +33,18 @@ class Node:
         out: dict[str, Any] = {"t": self.type}
         if self.value is not None:
             out["v"] = self.value
-        if self.fields:
-            out["f"] = {
-                k: [c.to_json() for c in children] for k, children in self.fields.items()
-            }
+        # Canonical form: an EMPTIED sequence field is identical to one that
+        # never existed (the reference's forests prune empty fields the same
+        # way), so replicas that took different routes to the same tree
+        # serialize identically — and match the columnar materialization,
+        # which has no rows to represent an empty field with.
+        present = {
+            k: [c.to_json() for c in children]
+            for k, children in self.fields.items()
+            if children
+        }
+        if present:
+            out["f"] = present
         return out
 
     @staticmethod

@@ -8,16 +8,20 @@ Host/device split (the seam SURVEY §7 step 7 names):
 - host: per-doc EditManager runs the deterministic trunk translation
   (dds/tree/editmanager.py) — rebase is control-plane work over tiny mark
   lists; the result is a TRUNK-COORDINATE commit every replica agrees on.
-- device: the forest state — a uniform-chunk value column per document
-  (ref chunked-forest/uniformChunk.ts:42) — applies the trunk commits as
-  batched index-map gathers (ops/tree_kernel.py ForestState).
+- device: the forest state as NESTED columnar rows — (parent, field,
+  index) SoA beside the value column (ops/tree_kernel.py
+  NestedForestState; ref chunked-forest/uniformChunk.ts:42 generalized) —
+  applying trunk commits as masked column arithmetic with bounded-depth
+  path resolution.
 
-The device path covers the uniform-chunk shape: a flat root field of leaf
-values with insert/remove/set-value/contiguous-move edits.  Documents whose
-commits leave that shape (nested fields, non-leaf content, split moves)
-fall back to a host Forest replica — the same route-to-oracle policy as the
-string engine, keeping every document correct while the common case stays
-on device.
+The device path covers nested shapes end to end (VERDICT r3 next #3):
+inserts of arbitrary int-leaf content trees (decomposed parent-first into
+path-addressed inserts), nested Modify chains, value sets at depth,
+subtree removes, and contiguous single-field moves.  Only genuinely
+irregular commits fall back to a host Forest replica: paths deeper than
+the kernel's MAX_PATH, split/cross-field moves or moves mixed with other
+structural marks in one field, and non-int32 leaf values — the same
+route-to-oracle policy as the string engine.
 """
 
 from __future__ import annotations
@@ -39,13 +43,13 @@ from ..dds.tree.changeset import (
     commit_from_json,
 )
 from ..dds.tree.editmanager import EditManager
-from ..dds.tree.forest import Forest, Node
+from ..dds.tree.forest import ROOT_FIELD, Forest, Node
 from ..ops import tree_kernel as tk
 from ..protocol.messages import MessageType, SequencedMessage
 
 
 def _int32(v) -> bool:
-    return isinstance(v, int) and -(1 << 31) <= v < (1 << 31)
+    return isinstance(v, int) and not isinstance(v, bool) and -(1 << 31) <= v < (1 << 31)
 
 
 @dataclass
@@ -58,6 +62,8 @@ class _TreeHost:
     # CHECKPOINT_EVERY commits so host memory stays bounded.
     trunk_log: list[list] = field(default_factory=list)
     checkpoint: Forest = field(default_factory=Forest)
+    device_commits: int = 0
+    total_commits: int = 0
 
 
 class UnsupportedShape(Exception):
@@ -65,9 +71,10 @@ class UnsupportedShape(Exception):
 
 
 class TreeBatchEngine:
-    """A fleet of tree replicas: host EditManagers + device value columns."""
+    """A fleet of tree replicas: host EditManagers + nested device columns."""
 
     CHECKPOINT_EVERY = 64  # trunk-log fold threshold (bounds host memory)
+    COMPACT_FRACTION = 0.75  # row watermark that triggers a device compact
 
     def __init__(
         self,
@@ -84,10 +91,14 @@ class TreeBatchEngine:
         self.hosts = [_TreeHost() for _ in range(n_docs)]
         self.fallbacks: dict[int, Forest] = {}
         self.mesh = mesh
+        # Interning tables shared by the fleet; ROOT_FIELD must be id 0
+        # (the virtual root's field in the kernel's materializer).
+        self._fields: dict[str, int] = {ROOT_FIELD: 0}
+        self._types: dict[str, int] = {}
         if mesh is not None:
             n_shards = mesh.devices.size
             assert n_docs % n_shards == 0, "pad n_docs to a mesh multiple"
-        proto = tk.init_forest(capacity)
+        proto = tk.init_nested_forest(capacity)
         self.state = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (n_docs,) + x.shape), proto
         )
@@ -98,8 +109,31 @@ class TreeBatchEngine:
                 lambda x: jax.device_put(x, shard_docs(mesh)), self.state
             )
         self._step = jax.jit(
-            jax.vmap(tk.apply_forest_ops), donate_argnums=(0,)
+            jax.vmap(tk.apply_nested_ops), donate_argnums=(0,)
         )
+        self._compact = jax.jit(
+            jax.vmap(tk.compact_nested), donate_argnums=(0,)
+        )
+        # Host-side upper bound on each doc's row watermark (rows only grow
+        # on INSERT ops, whose counts the host knows at staging time) — the
+        # compaction trigger without a per-batch device readback.
+        self._rows_upper = np.zeros((n_docs,), np.int64)
+
+    # -------------------------------------------------------------- interning
+    def _field_id(self, key: str) -> int:
+        return self._fields.setdefault(key, len(self._fields))
+
+    def _type_id(self, t: str) -> int:
+        return self._types.setdefault(t, len(self._types))
+
+    @staticmethod
+    def _encode_value(v) -> tuple[int, int]:
+        """value -> (vkind, int payload); raises UnsupportedShape."""
+        if v is None:
+            return tk.VKIND_NONE, 0
+        if _int32(v):
+            return tk.VKIND_INT, v
+        raise UnsupportedShape(f"non-int32 leaf value {v!r}")
 
     # ------------------------------------------------------------------ ingest
     @staticmethod
@@ -139,6 +173,7 @@ class TreeBatchEngine:
             seq=msg.seq,
         )
         h.em.advance_min_seq(msg.min_seq)
+        h.total_commits += 1
         if doc_idx in self.fallbacks:
             # Fallback docs apply directly; their trunk log is dead weight
             # (they can never be re-replayed onto the device path).
@@ -156,66 +191,136 @@ class TreeBatchEngine:
         except UnsupportedShape:
             self._route_to_fallback(doc_idx)
             return
+        h.device_commits += 1
+        for r, _p in rows:
+            if r[0] == tk.NestedOpKind.INSERT:
+                self._rows_upper[doc_idx] += int(r[tk._TGT + 2])
         h.queue.extend(r for r, _p in rows)
         h.payloads.extend(p for _r, p in rows)
 
+    # --------------------------------------------------------------- flatten
     def _flatten(self, trunk_commit, seq: int) -> list[tuple[np.ndarray, np.ndarray]]:
-        """Trunk commit -> forest op rows.  Raises UnsupportedShape for
-        anything beyond the uniform-chunk edit grammar."""
+        """Trunk commit -> nested forest op rows.
+
+        Front-to-back walk in OUTPUT coordinates: every emitted op's
+        positions (and every path step's sibling index) are valid in the
+        state produced by the ops emitted before it, so sequential device
+        application reproduces the simultaneous mark semantics exactly —
+        including nested paths, which back-to-front emission could not
+        keep stable."""
         rows: list[tuple[np.ndarray, np.ndarray]] = []
         empty = np.zeros((self.max_insert_len,), np.int32)
 
-        def row(kind, pos=0, count=0, dst=0, value=0, payload=None):
-            op = np.array(
-                [kind, seq, pos, count, dst, value, 0, 0], np.int32
-            )
+        def emit(kind, steps, fld, pos=0, count=0, dst=0, value=0,
+                 vkind=0, ntype=0, payload=None):
+            if len(steps) > tk.MAX_PATH:
+                raise UnsupportedShape("path deeper than kernel MAX_PATH")
+            op = np.zeros((tk.NESTED_OP_FIELDS,), np.int32)
+            op[0], op[1], op[2] = kind, seq, len(steps)
+            for k, (f, i) in enumerate(steps):
+                op[3 + 2 * k], op[4 + 2 * k] = f, i
+            t = tk._TGT
+            op[t], op[t + 1], op[t + 2], op[t + 3] = fld, pos, count, dst
+            op[t + 4], op[t + 5], op[t + 6] = value, vkind, ntype
             rows.append((op, empty if payload is None else payload))
 
         for change in trunk_commit:
             if change.value is not None:
                 raise UnsupportedShape("value change on the virtual root")
             for key, marks in change.fields.items():
-                if key != "":
-                    raise UnsupportedShape(f"non-root field {key!r}")
-                self._flatten_marks(marks, row)
+                self._walk_marks(marks, (), self._field_id(key), emit)
         return rows
 
-    def _flatten_marks(self, marks, row) -> None:
-        """Mark list (simultaneous, input coordinates) -> sequential op rows.
+    def _walk_marks(self, marks, steps: tuple, fid: int, emit) -> None:
+        if any(isinstance(m, (MoveOut, MoveIn)) for m in marks):
+            self._emit_move_field(marks, steps, fid, emit)
+            return
+        out_pos = 0
+        for m in marks:
+            if isinstance(m, Skip):
+                out_pos += m.count
+            elif isinstance(m, Insert):
+                out_pos += self._insert_content(
+                    m.content, steps, fid, out_pos, emit
+                )
+            elif isinstance(m, Remove):
+                emit(tk.NestedOpKind.REMOVE, steps, fid, pos=out_pos,
+                     count=m.count)
+            elif isinstance(m, Modify):
+                ch = m.change
+                if ch.value is not None:
+                    vk, val = self._encode_value(ch.value[0])
+                    emit(tk.NestedOpKind.SET, steps, fid, pos=out_pos,
+                         value=val, vkind=vk)
+                if any(ch.fields.values()):
+                    child_steps = steps + ((fid, out_pos),)
+                    for key, nested in ch.fields.items():
+                        if nested:
+                            self._walk_marks(
+                                nested, child_steps, self._field_id(key), emit
+                            )
+                out_pos += 1
+            else:
+                raise UnsupportedShape(type(m).__name__)
 
-        All positions stay in INPUT coordinates and the ops are emitted
-        back-to-front (descending position): an op never shifts the
-        coordinates of ops below it, so sequential application reproduces
-        the simultaneous mark semantics exactly.  Moves flatten to one
-        contiguous (src, count, dst) op; split moves or moves mixed with
-        other structural marks fall back to the host."""
+    def _insert_content(
+        self, nodes: list[Node], steps: tuple, fid: int, start: int, emit
+    ) -> int:
+        """Decompose a content forest into path-addressed inserts,
+        parent-first; consecutive childless same-shape nodes batch into one
+        op row.  Returns the number of nodes inserted at this level."""
+        pos = start
+        run_vals: list[int] = []
+        run_shape: tuple[int, int] | None = None  # (vkind, ntype)
+
+        def flush() -> None:
+            nonlocal run_vals, run_shape
+            if run_vals:
+                payload = np.zeros((self.max_insert_len,), np.int32)
+                payload[: len(run_vals)] = run_vals
+                emit(tk.NestedOpKind.INSERT, steps, fid,
+                     pos=pos - len(run_vals), count=len(run_vals),
+                     vkind=run_shape[0], ntype=run_shape[1], payload=payload)
+            run_vals, run_shape = [], None
+
+        for node in nodes:
+            vk, val = self._encode_value(node.value)
+            nt = self._type_id(node.type)
+            if node.fields and any(node.fields.values()):
+                flush()
+                emit(tk.NestedOpKind.INSERT, steps, fid, pos=pos, count=1,
+                     value=0, vkind=vk, ntype=nt,
+                     payload=np.full((self.max_insert_len,), 0, np.int32)
+                     if vk == tk.VKIND_NONE
+                     else np.pad(np.array([val], np.int32),
+                                 (0, self.max_insert_len - 1)))
+                child_steps = steps + ((fid, pos),)
+                for key, kids in node.fields.items():
+                    if kids:
+                        self._insert_content(
+                            kids, child_steps, self._field_id(key), 0, emit
+                        )
+                pos += 1
+            else:
+                if run_shape not in (None, (vk, nt)) or len(run_vals) >= self.max_insert_len:
+                    flush()
+                run_shape = (vk, nt)
+                run_vals.append(val)
+                pos += 1
+        flush()
+        return pos - start
+
+    def _emit_move_field(self, marks, steps: tuple, fid: int, emit) -> None:
+        """A field containing a move: only the pure single-pair contiguous
+        form maps to one device op (input coordinates); anything else —
+        split moves, cross-field pairs, moves mixed with other structural
+        marks — is host-fallback territory."""
         move_out: dict[int, tuple[int, int]] = {}
         move_in: dict[int, int] = {}
         in_pos = 0
-        pending: list[tuple] = []
         for m in marks:
             if isinstance(m, Skip):
                 in_pos += m.count
-            elif isinstance(m, Insert):
-                vals = []
-                for node in m.content:
-                    if node.fields or not _int32(node.value):
-                        raise UnsupportedShape("non-int32-leaf insert content")
-                    vals.append(node.value)
-                if len(vals) > self.max_insert_len:
-                    raise UnsupportedShape("insert wider than payload row")
-                pending.append(("ins", in_pos, vals))
-            elif isinstance(m, Remove):
-                pending.append(("rm", in_pos, m.count))
-                in_pos += m.count
-            elif isinstance(m, Modify):
-                ch = m.change
-                if ch.fields or ch.value is None:
-                    raise UnsupportedShape("nested modify")
-                if not _int32(ch.value[0]):
-                    raise UnsupportedShape("non-int32 value")
-                pending.append(("set", in_pos, ch.value[0]))
-                in_pos += 1
             elif isinstance(m, MoveOut):
                 if m.id in move_out:
                     raise UnsupportedShape("split move")
@@ -226,22 +331,12 @@ class TreeBatchEngine:
                     raise UnsupportedShape("split move")
                 move_in[m.id] = in_pos
             else:
-                raise UnsupportedShape(type(m).__name__)
-        if move_out or move_in:
-            if len(move_out) != 1 or set(move_out) != set(move_in) or pending:
                 raise UnsupportedShape("mixed structural marks with move")
-            (mid, (src, count)), = move_out.items()
-            row(tk.ForestOpKind.MOVE, pos=src, count=count, dst=move_in[mid])
-            return
-        for kind, pos, arg in reversed(pending):
-            if kind == "ins":
-                payload = np.zeros((self.max_insert_len,), np.int32)
-                payload[: len(arg)] = arg
-                row(tk.ForestOpKind.INSERT, pos=pos, count=len(arg), payload=payload)
-            elif kind == "rm":
-                row(tk.ForestOpKind.REMOVE, pos=pos, count=arg)
-            else:
-                row(tk.ForestOpKind.SET, pos=pos, value=arg)
+        if len(move_out) != 1 or set(move_out) != set(move_in):
+            raise UnsupportedShape("non-single-pair move")
+        (mid, (src, count)), = move_out.items()
+        emit(tk.NestedOpKind.MOVE, steps, fid, pos=src, count=count,
+             dst=move_in[mid])
 
     # ---------------------------------------------------------------- routing
     def _route_to_fallback(self, doc_idx: int) -> None:
@@ -262,11 +357,25 @@ class TreeBatchEngine:
     def pending_ops(self) -> int:
         return sum(len(h.queue) for h in self.hosts)
 
+    def device_fraction(self) -> float:
+        """Fraction of ingested commits applied on the device path."""
+        total = sum(h.total_commits for h in self.hosts)
+        dev = sum(h.device_commits for h in self.hosts)
+        return dev / total if total else 1.0
+
     def step(self) -> int:
         steps = 0
         B = self.ops_per_step
         while any(h.queue for h in self.hosts):
-            ops = np.zeros((self.n_docs, B, tk.FOREST_OP_FIELDS), np.int32)
+            # Proactive compact: dead rows accumulate monotonically (stable
+            # rows never reuse slots) — reclaim before overflow.  The
+            # trigger is the host-side row UPPER BOUND (no per-batch device
+            # sync); the one readback after compacting re-syncs it to the
+            # true live counts.
+            if self._rows_upper.max() > self.capacity * self.COMPACT_FRACTION:
+                self.state = self._compact(self.state)
+                self._rows_upper = np.asarray(self.state.nrow).astype(np.int64)
+            ops = np.zeros((self.n_docs, B, tk.NESTED_OP_FIELDS), np.int32)
             payloads = np.zeros((self.n_docs, B, self.max_insert_len), np.int32)
             for d, h in enumerate(self.hosts):
                 take = min(B, len(h.queue))
@@ -290,12 +399,24 @@ class TreeBatchEngine:
         return steps
 
     # ------------------------------------------------------------------ views
-    def values(self, doc_idx: int) -> list[int]:
-        """The document's root-field leaf values."""
+    def _name_tables(self) -> tuple[dict[int, str], dict[int, str]]:
+        return (
+            {v: k for k, v in self._fields.items()},
+            {v: k for k, v in self._types.items()},
+        )
+
+    def tree_json(self, doc_idx: int) -> list[dict]:
+        """The document's root field as forest JSON (Node.to_json shape)."""
         if doc_idx in self.fallbacks:
-            return [n.value for n in self.fallbacks[doc_idx].root_field]
+            return [n.to_json() for n in self.fallbacks[doc_idx].root_field]
         st = jax.tree.map(lambda x: x[doc_idx], self.state)
-        return [int(v) for v in tk.forest_values(st)]
+        field_names, type_names = self._name_tables()
+        return tk.nested_to_json(st, field_names, type_names)
+
+    def values(self, doc_idx: int) -> list:
+        """The document's root-field node values (leaf ints, None for
+        interior/valueless nodes)."""
+        return [n.get("v") for n in self.tree_json(doc_idx)]
 
     def errors(self) -> np.ndarray:
         return np.asarray(self.state.error)

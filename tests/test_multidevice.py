@@ -93,7 +93,7 @@ def test_sharded_longdoc_collective_ops():
 def test_sharded_tree_fleet_converges_with_host_stack():
     n_docs = 8
     eng = TreeBatchEngine(n_docs, mesh=doc_mesh())
-    assert len(eng.state.values.sharding.device_set) == 8
+    assert len(eng.state.value.sharding.device_set) == 8
     svc, expected = drive_tree_docs(n_docs, seed=13, steps=20)
     for d in range(n_docs):
         for msg in svc.document(f"doc{d}").sequencer.log:

@@ -121,10 +121,13 @@ def test_engine_matches_with_more_seeds():
             assert eng.values(d) == expected[d], f"seed {seed} doc {d}"
 
 
-def test_nested_edits_route_to_fallback_and_stay_correct():
+def test_nested_edits_stay_on_device():
+    """Nested-field edits are first-class on the columnar path now
+    (VERDICT r3 next #3): no fallback, identical state."""
     svc, expected = drive_tree_docs(4, seed=7, nested_prob=2.0)
     eng = _feed(svc, 4)
-    assert eng.fallbacks, "nested edits should have routed docs to the host"
+    assert not eng.fallbacks, "nested edits must stay on the device path"
+    assert eng.device_fraction() == 1.0
     for d in range(4):
         assert eng.values(d) == expected[d], f"doc {d} diverged"
 
@@ -153,3 +156,143 @@ def test_forest_kernel_move_directions():
     s = tk.apply_forest_op(s, jnp.asarray(mv2), jnp.asarray(pay))
     assert list(tk.forest_values(s)) == [12, 11, 14, 13, 10]
     assert int(s.error) == 0
+
+
+# --------------------------------------------------------------------------
+# Nested-doc fuzz: deep shapes on device, full-tree equality (VERDICT #3)
+# --------------------------------------------------------------------------
+
+def _rand_content(rng, depth: int):
+    """A random content tree: int leaves, sometimes an interior node with
+    1-2 named child fields (bounded depth)."""
+    from fluidframework_tpu.dds.tree.forest import Node
+
+    if depth <= 0 or rng.random() < 0.55:
+        return leaf(rng.randrange(1000))
+    fields = {}
+    for key in rng.sample(["a", "b", "kids"], rng.randint(1, 2)):
+        fields[key] = [
+            _rand_content(rng, depth - 1) for _ in range(rng.randint(1, 2))
+        ]
+    return Node(type="obj", value=rng.randrange(100) if rng.random() < 0.5 else None,
+                fields=fields)
+
+
+def _descend(rng, forest, max_depth):
+    """Pick a random existing (path, field, n_children) location."""
+    node = forest.root
+    path = []
+    fld = ""
+    while True:
+        kids = node.fields.get(fld, [])
+        if not kids or len(path) >= max_depth or rng.random() < 0.5:
+            return path, fld, len(kids)
+        i = rng.randrange(len(kids))
+        child = kids[i]
+        path = path + [(fld, i)]
+        node = child
+        inner = [k for k, v in child.fields.items() if v]
+        fld = rng.choice(inner) if inner and rng.random() < 0.7 else rng.choice(["a", "b", "kids"])
+
+
+def drive_nested_docs(n_docs, seed, steps=40, clients_per_doc=2, deep_prob=0.05):
+    """Rich nested concurrent sessions; ``deep_prob`` controls edits beyond
+    the kernel's MAX_PATH (genuinely rare shapes that must fall back)."""
+    rng = random.Random(seed)
+    svc = LocalService()
+    fleets = {}
+    for d in range(n_docs):
+        doc = svc.document(f"doc{d}")
+        rts = []
+        for i in range(clients_per_doc):
+            rt = ContainerRuntime(default_registry(), container_id=f"d{d}c{i}")
+            rt.create_datastore("root").create_channel("sharedTree", "t")
+            rt.connect(doc, f"d{d}c{i}")
+            rts.append(rt)
+        doc.process_all()
+        fleets[d] = rts
+    tree = lambda rt: rt.datastore("root").get_channel("t")
+    for _step in range(steps):
+        for d in range(n_docs):
+            doc = svc.document(f"doc{d}")
+            rt = fleets[d][rng.randrange(clients_per_doc)]
+            t = tree(rt)
+            deep = rng.random() < deep_prob
+            path, fld, n = _descend(rng, t.forest, max_depth=8 if deep else 4)
+            kind = rng.choices(
+                ["ins", "rm", "set", "move"], [6, 2, 3, 2]
+            )[0]
+            if kind == "ins" or n == 0:
+                t.submit_change(make_insert(
+                    path, fld, rng.randint(0, n),
+                    [_rand_content(rng, rng.randint(0, 2))],
+                ))
+            elif kind == "rm":
+                i = rng.randrange(n)
+                t.submit_change(make_remove(
+                    path, fld, i, rng.randint(1, min(2, n - i))
+                ))
+            elif kind == "set":
+                t.submit_change(make_set_value(
+                    path + [(fld, rng.randrange(n))], rng.randrange(1000)
+                ))
+            else:
+                s = rng.randrange(n)
+                c = rng.randint(1, min(2, n - s))
+                t.submit_change(make_move(path, fld, s, c, rng.randint(0, n)))
+            if rng.random() < 0.5:
+                rt.flush()
+            if rng.random() < 0.4:
+                doc.process_some(rng.randint(0, doc.pending_count))
+    for d in range(n_docs):
+        for rt in fleets[d]:
+            rt.flush()
+        svc.document(f"doc{d}").process_all()
+    expected = {
+        d: [nd.to_json() for nd in tree(fleets[d][0]).forest.root_field]
+        for d in range(n_docs)
+    }
+    return svc, expected
+
+
+def test_nested_fuzz_full_tree_equality_and_device_fraction():
+    """Deep concurrent nested editing: >90% of commits apply on device and
+    every document's FULL tree (values, types, nested fields, order)
+    matches the host stack exactly — fallback docs included."""
+    svc, expected = drive_nested_docs(6, seed=11, steps=40)
+    eng = _feed(svc, 6)
+    for d in range(6):
+        assert eng.tree_json(d) == expected[d], f"doc {d} diverged"
+    assert eng.device_fraction() > 0.9, eng.device_fraction()
+
+
+def test_nested_fuzz_more_seeds():
+    for seed in (23, 37):
+        svc, expected = drive_nested_docs(4, seed=seed, steps=30)
+        eng = _feed(svc, 4)
+        for d in range(4):
+            assert eng.tree_json(d) == expected[d], (seed, d)
+
+
+def test_device_compaction_under_churn():
+    """Insert/remove churn far beyond capacity-in-dead-rows: proactive
+    compaction keeps the fleet on device."""
+    rng = random.Random(5)
+    svc = LocalService()
+    doc = svc.document("doc0")
+    rt = ContainerRuntime(default_registry(), container_id="c0")
+    rt.create_datastore("root").create_channel("sharedTree", "t")
+    rt.connect(doc, "c0")
+    doc.process_all()
+    t = rt.datastore("root").get_channel("t")
+    for i in range(120):
+        n = len(t.forest.root_field)
+        if n < 4 or rng.random() < 0.55:
+            t.submit_change(make_insert([], "", rng.randint(0, n), [leaf(i)]))
+        else:
+            t.submit_change(make_remove([], "", rng.randrange(n - 1), 1))
+        rt.flush()
+        doc.process_all()
+    eng = _feed(svc, 1, capacity=64)
+    assert not eng.fallbacks and not eng.errors().any()
+    assert eng.values(0) == [nd.value for nd in t.forest.root_field]
