@@ -50,8 +50,29 @@ from .schema import (
 )
 from .shared_tree import SharedTreeChannel, SharedTreeFactory
 
+from .simple_tree import (
+    SchemaFactory,
+    SimpleTreeView,
+    Tree,
+    TreeArrayNode,
+    TreeNodeSchema,
+    TreeObjectNode,
+    TreeViewConfiguration,
+    optional,
+    required,
+)
+
 __all__ = [
     "EditManager",
+    "SchemaFactory",
+    "SimpleTreeView",
+    "Tree",
+    "TreeArrayNode",
+    "TreeNodeSchema",
+    "TreeObjectNode",
+    "TreeViewConfiguration",
+    "optional",
+    "required",
     "SchemaCompatibility",
     "SchemaView",
     "TreeBranch",

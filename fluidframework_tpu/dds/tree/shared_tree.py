@@ -64,6 +64,8 @@ class SharedTreeChannel(Channel):
         self._local_pending: list[tuple[Any, Commit]] = []
         self._txn: list[NodeChange] | None = None
         self.on_change: Callable[[], None] | None = None  # view invalidation
+        # Multiplexed change listeners (simple-tree node events ride these).
+        self._change_listeners: list[Callable[[], None]] = []
         # Every change applied to the forest, in application order (local
         # edits and bridged remote commits alike) — the coordinate trail
         # undo-redo revertibles rebase their inverses over.
@@ -145,6 +147,13 @@ class SharedTreeChannel(Channel):
             {"type": "schema", "schema": registry.to_json()}, {"rev": None}
         )
 
+    def typed_view(self, config) -> "SimpleTreeView":
+        """The declarative typed API (ref ITree.viewWith over simple-tree
+        schema classes; dds/tree/simple_tree.py SchemaFactory)."""
+        from .simple_tree import SimpleTreeView
+
+        return SimpleTreeView(self, config)
+
     def view_with(self, view_schema: SchemaRegistry):
         """Open the document under the CLIENT's schema (ref ITree.viewWith):
         returns a SchemaView whose .compatibility reports
@@ -168,9 +177,22 @@ class SharedTreeChannel(Channel):
     def view(self) -> TreeView:
         return TreeView(self.forest, self.submit_change, self.schema)
 
+    def add_change_listener(self, fn: Callable[[], None]) -> Callable[[], None]:
+        """Subscribe to every forest change (local or remote); returns the
+        unsubscribe handle."""
+        self._change_listeners.append(fn)
+
+        def unsubscribe() -> None:  # idempotent (double-off is a no-op)
+            if fn in self._change_listeners:
+                self._change_listeners.remove(fn)
+
+        return unsubscribe
+
     def _notify(self) -> None:
         if self.on_change is not None:
             self.on_change()
+        for fn in list(self._change_listeners):
+            fn()
 
     # ---------------------------------------------------------------- inbound
     def _finalize_ids(self, c: dict) -> None:

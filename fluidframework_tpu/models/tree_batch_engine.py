@@ -374,7 +374,21 @@ class TreeBatchEngine:
             # true live counts.
             if self._rows_upper.max() > self.capacity * self.COMPACT_FRACTION:
                 self.state = self._compact(self.state)
-                self._rows_upper = np.asarray(self.state.nrow).astype(np.int64)
+                # Resync = live rows (applied) + the insert counts still in
+                # each doc's queue (unapplied) — dropping the queued part
+                # would let a long churn stream overflow mid-step without
+                # ever re-triggering compaction.
+                queued = np.array([
+                    sum(
+                        int(r[tk._TGT + 2])
+                        for r in h.queue
+                        if r[0] == tk.NestedOpKind.INSERT
+                    )
+                    for h in self.hosts
+                ], np.int64)
+                self._rows_upper = (
+                    np.asarray(self.state.nrow).astype(np.int64) + queued
+                )
             ops = np.zeros((self.n_docs, B, tk.NESTED_OP_FIELDS), np.int32)
             payloads = np.zeros((self.n_docs, B, self.max_insert_len), np.int32)
             for d, h in enumerate(self.hosts):

@@ -1,0 +1,271 @@
+"""simple-tree typed public API (ref tree/src/simple-tree/).
+
+SchemaFactory-declared schemas, typed reads/writes over live paths,
+implicit plain-data construction, identity-preserving array moves, the
+Tree helper namespace, node events, and the schematize gate — driven
+through real two-client collaboration over the sequencer.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.dds.tree import (
+    SchemaFactory,
+    Tree,
+    TreeViewConfiguration,
+    optional,
+)
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+
+def host(n_clients: int = 1):
+    svc = LocalService()
+    doc = svc.document("d")
+    rts = []
+    for i in range(n_clients):
+        rt = ContainerRuntime(default_registry(), container_id=f"c{i}")
+        rt.create_datastore("root").create_channel("sharedTree", "t")
+        rt.connect(doc, f"c{i}")
+        rts.append(rt)
+    doc.process_all()
+    chans = [rt.datastore("root").get_channel("t") for rt in rts]
+
+    def settle():
+        for rt in rts:
+            rt.flush()
+        doc.process_all()
+
+    return chans, settle
+
+
+def make_app_schema():
+    sf = SchemaFactory("com.example.todo")
+    Item = sf.object(
+        "Item", title=sf.string, done=sf.boolean, priority=optional(sf.number)
+    )
+    Items = sf.array("Items", Item)
+    List_ = sf.object("List", name=sf.string, items=Items)
+    return sf, Item, Items, List_
+
+
+def test_declarative_authoring_end_to_end():
+    chans, settle = host(2)
+    a, b = chans
+    sf, Item, Items, List_ = make_app_schema()
+
+    va = a.typed_view(TreeViewConfiguration(List_))
+    va.initialize(List_(
+        name="groceries",
+        items=Items([Item(title="milk", done=False)]),
+    ))
+    settle()
+
+    # The second client views with an equivalently-declared schema.
+    _sf2, _i2, _is2, List2 = make_app_schema()
+    vb = b.typed_view(TreeViewConfiguration(List2))
+    assert vb.compatibility.can_view and vb.compatibility.is_equivalent
+
+    root_b = vb.root
+    assert root_b.name == "groceries"
+    assert len(root_b.items) == 1
+    assert root_b.items[0].title == "milk"
+    assert root_b.items[0].done is False
+    assert root_b.items[0].priority is None
+
+    # Typed writes from both sides converge.
+    va.root.items[0].done = True
+    root_b.items.insert_at_end(Item(title="eggs", done=False, priority=2))
+    settle()
+    for v in (va, vb):
+        items = v.root.items
+        assert [i.title for i in items] == ["milk", "eggs"]
+        assert items[0].done is True
+        assert items[1].priority == 2
+
+
+def test_plain_data_implicit_construction():
+    chans, settle = host(1)
+    (a,) = chans
+    _sf, _Item, _Items, List_ = make_app_schema()
+    v = a.typed_view(TreeViewConfiguration(List_))
+    # Dicts/lists hydrate through the schema (ref insertable content).
+    v.initialize({
+        "name": "trip",
+        "items": [{"title": "pack", "done": False}],
+    })
+    settle()
+    assert v.root.name == "trip"
+    assert v.root.items[0].title == "pack"
+    v.root.items.insert_at_end({"title": "drive", "done": False})
+    assert [i.title for i in v.root.items] == ["pack", "drive"]
+
+
+def test_required_field_enforced_at_construction():
+    _sf, Item, _Items, _List = make_app_schema()
+    with pytest.raises(TypeError, match="missing required field"):
+        Item(title="x")  # done missing
+    with pytest.raises(TypeError, match="unknown fields"):
+        Item(title="x", done=True, color="red")
+
+
+def test_array_moves_preserve_identity_under_concurrency():
+    """move_to_index is a real move: a concurrent value edit on the moved
+    node lands on it at its new position (remove+insert would lose it)."""
+    chans, settle = host(2)
+    a, b = chans
+    sf = SchemaFactory("m")
+    Row = sf.object("Row", v=sf.number)
+    Rows = sf.array("Rows", Row)
+    va = a.typed_view(TreeViewConfiguration(Rows))
+    va.initialize([Row(v=1), Row(v=2), Row(v=3)])
+    settle()
+    vb = b.typed_view(TreeViewConfiguration(Rows))
+
+    # a moves row 0 to the end while b concurrently edits row 0's value.
+    va.root.move_to_end(0)
+    vb.root[0].v = 99
+    settle()
+    for v in (va, vb):
+        assert [r.v for r in v.root] == [2, 3, 99]
+
+
+def test_tree_helpers_and_status():
+    chans, settle = host(1)
+    (a,) = chans
+    _sf, Item, Items, List_ = make_app_schema()
+    v = a.typed_view(TreeViewConfiguration(List_))
+    v.initialize(List_(name="n", items=Items([Item(title="t", done=False)])))
+    settle()
+    root = v.root
+    item = root.items[0]
+    assert Tree.is_(root, List_) and Tree.is_(item, Item)
+    assert Tree.schema(item) is Item
+    assert Tree.key(item) == 0            # index within the array
+    assert Tree.key(root.items) == "items"
+    assert Tree.key(root) == 0            # root-field position
+    assert Tree.parent(root) is None
+    arr = Tree.parent(item)
+    assert Tree.is_(Tree.parent(arr), List_)
+    assert Tree.status(item) == "inDocument"
+    root.items.remove_at(0)
+    assert Tree.status(item) == "removed"
+
+
+def test_node_events_fire_on_local_and_remote_changes():
+    chans, settle = host(2)
+    a, b = chans
+    sf = SchemaFactory("e")
+    Box = sf.object("Box", n=sf.number)
+    Boxes = sf.array("Boxes", Box)
+    va = a.typed_view(TreeViewConfiguration(Boxes))
+    va.initialize([Box(n=1), Box(n=2)])
+    settle()
+    vb = b.typed_view(TreeViewConfiguration(Boxes))
+
+    node_hits, tree_hits = [], []
+    un1 = Tree.on(vb.root[0], "nodeChanged", lambda: node_hits.append(1))
+    un2 = Tree.on(vb.root, "treeChanged", lambda: tree_hits.append(1))
+
+    va.root[0].n = 5          # remote (from b's perspective) node change
+    settle()
+    assert node_hits and tree_hits
+    n_node = len(node_hits)
+    va.root[1].n = 7          # sibling change: subtree yes, node no
+    settle()
+    assert len(node_hits) == n_node
+    assert len(tree_hits) > 1
+    un1()
+    un2()
+    va.root[0].n = 9
+    settle()
+    assert len(node_hits) == n_node  # unsubscribed
+
+
+def test_schematize_gate_blocks_incompatible_views():
+    chans, settle = host(2)
+    a, b = chans
+    sf = SchemaFactory("g")
+    Point = sf.object("Point", x=sf.number)
+    Points = sf.array("Points", Point)
+    va = a.typed_view(TreeViewConfiguration(Points))
+    va.initialize([Point(x=1)])
+    settle()
+
+    sf2 = SchemaFactory("g")
+    Other = sf2.object("Other", y=sf2.string)
+    Others = sf2.array("Others", Other)
+    vb = b.typed_view(TreeViewConfiguration(Others))
+    assert not vb.compatibility.can_view
+    with pytest.raises(RuntimeError, match="cannot read"):
+        _ = vb.root
+    with pytest.raises(RuntimeError, match="cannot upgrade"):
+        vb.upgrade_schema()
+
+    # A WIDENED schema can upgrade but not view pre-upgrade (ref
+    # SchemaCompatibilityStatus canUpgrade without canView).
+    sf3 = SchemaFactory("g")
+    P3 = sf3.object("Point", x=sf3.number, label=optional(sf3.string))
+    Ps3 = sf3.array("Points", P3)
+    vc = b.typed_view(TreeViewConfiguration(Ps3))
+    assert vc.compatibility.can_upgrade and not vc.compatibility.can_view
+    vc.upgrade_schema()
+    settle()
+    assert vc.compatibility.can_view
+    vc.root[0].label = "origin"
+    settle()
+    assert vc.root[0].label == "origin"
+
+
+def test_optional_field_clear_and_set():
+    chans, settle = host(1)
+    (a,) = chans
+    _sf, Item, Items, List_ = make_app_schema()
+    v = a.typed_view(TreeViewConfiguration(List_))
+    v.initialize(List_(name="n", items=Items([Item(title="t", done=False)])))
+    settle()
+    item = v.root.items[0]
+    item.priority = 3
+    assert item.priority == 3
+    item.priority = None  # optional clears
+    assert item.priority is None
+    with pytest.raises(ValueError, match="required field"):
+        item.title = None
+
+
+def test_handles_are_identity_stable_across_sibling_edits():
+    """A handle follows ITS node when siblings are removed/moved — never
+    silently rebinding to whatever sits at the old coordinates (ref
+    treeNodeKernel anchors)."""
+    chans, settle = host(1)
+    (a,) = chans
+    sf = SchemaFactory("i")
+    Row = sf.object("Row", v=sf.number)
+    Rows = sf.array("Rows", Row)
+    v = a.typed_view(TreeViewConfiguration(Rows))
+    v.initialize([Row(v=10), Row(v=20), Row(v=30)])
+    settle()
+    second = v.root[1]
+    v.root.remove_at(0)        # sibling BEFORE the handle vanishes
+    assert Tree.status(second) == "inDocument"
+    assert second.v == 20      # still the same node, now at index 0
+    assert Tree.key(second) == 0
+    v.root.move_to_end(0)      # move it; handle follows
+    assert second.v == 20 and Tree.key(second) == 1
+    v.root.remove_at(1)        # now remove IT
+    assert Tree.status(second) == "removed"
+
+
+def test_failed_required_clear_leaves_no_edit():
+    chans, settle = host(1)
+    (a,) = chans
+    _sf, Item, Items, List_ = make_app_schema()
+    v = a.typed_view(TreeViewConfiguration(List_))
+    v.initialize(List_(name="n", items=Items([Item(title="t", done=False)])))
+    settle()
+    before = v.root.to_json()
+    with pytest.raises(ValueError):
+        v.root.items[0].title = None
+    assert v.root.to_json() == before  # no partial removal leaked

@@ -296,3 +296,28 @@ def test_device_compaction_under_churn():
     eng = _feed(svc, 1, capacity=64)
     assert not eng.fallbacks and not eng.errors().any()
     assert eng.values(0) == [nd.value for nd in t.forest.root_field]
+
+
+def test_compaction_retriggers_through_long_churn_queue():
+    """A churn stream far beyond capacity staged in ONE step: the row
+    bound must keep re-triggering compaction mid-step (a one-shot resync
+    would overflow and silently fall back)."""
+    svc = LocalService()
+    doc = svc.document("doc0")
+    rt = ContainerRuntime(default_registry(), container_id="c0")
+    rt.create_datastore("root").create_channel("sharedTree", "t")
+    rt.connect(doc, "c0")
+    doc.process_all()
+    t = rt.datastore("root").get_channel("t")
+    for i in range(100):  # live size stays 1; dead rows pile up
+        t.submit_change(make_insert([], "", 0, [leaf(i)]))
+        if len(t.forest.root_field) > 1:
+            t.submit_change(make_remove([], "", 1, 1))
+        rt.flush()
+        doc.process_all()
+    eng = TreeBatchEngine(1, capacity=64, ops_per_step=8)
+    for msg in doc.sequencer.log:
+        eng.ingest(0, msg)
+    eng.step()
+    assert not eng.fallbacks and not eng.errors().any()
+    assert eng.values(0) == [nd.value for nd in t.forest.root_field]
