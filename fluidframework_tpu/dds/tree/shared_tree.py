@@ -66,6 +66,12 @@ class SharedTreeChannel(Channel):
         self.on_change: Callable[[], None] | None = None  # view invalidation
         # Multiplexed change listeners (simple-tree node events ride these).
         self._change_listeners: list[Callable[[], None]] = []
+        # Incremental forest summarization (ref feature-libraries/
+        # incrementalSummarizationUtils.ts): the root field summarizes in
+        # fixed-size chunks of CHUNK_ROOTS subtrees; this maps chunk index
+        # -> seq of the last sequenced change touching it, so summary_tree
+        # can emit handles for chunks unchanged since the covered summary.
+        self._chunk_seqs: dict[int, int] = {}
         # Every change applied to the forest, in application order (local
         # edits and bridged remote commits alike) — the coordinate trail
         # undo-redo revertibles rebase their inverses over.
@@ -253,6 +259,11 @@ class SharedTreeChannel(Channel):
                 )
                 apply_commit(self.forest.root, x)
                 self.applied_log.extend(x)
+            # Mark AFTER the forest apply: the dirty range must span the
+            # POST-change chunk count (a remote append growing the domain
+            # past a chunk boundary must dirty the new tail chunk, or the
+            # next summary emits a dangling handle).
+            self._mark_chunks_dirty(trunk_change, env.seq)
         self.em.advance_min_seq(env.min_seq)
         self._notify()
 
@@ -316,15 +327,161 @@ class SharedTreeChannel(Channel):
         self._notify()
 
     # ------------------------------------------------------------ checkpoint
+    CHUNK_ROOTS = 8  # chunk-domain subtrees per incremental summary chunk
+
+    def _spine(self) -> tuple[list[str], Node]:
+        """The incremental chunk DOMAIN: descend from the root field while
+        there is exactly one child with exactly one non-empty field — so a
+        document shaped as one root array node chunks over its ITEMS, not
+        over the single root (the common app shape).  Returns
+        (spine field keys, holder): holder.fields[fields[-1]] is the
+        chunked children list."""
+        holder = self.forest.root
+        fields = [ROOT_FIELD]
+        while True:
+            children = holder.fields.get(fields[-1], [])
+            if len(children) != 1:
+                return fields, holder
+            node = children[0]
+            nonempty = [k for k, v in node.fields.items() if v]
+            if len(nonempty) != 1:
+                return fields, holder
+            holder = node
+            fields.append(nonempty[0])
+
+    def _mark_chunks_dirty(self, trunk_commit, seq: int) -> None:
+        """Chunk-level dirtiness from a sequenced trunk commit, walked down
+        the chunk-domain spine: structural marks at a spine level (they can
+        reshape the domain) dirty everything; a Modify descends; at the
+        final level a Modify dirties its chunk and a structural mark
+        dirties its chunk and every one after it (index shifts)."""
+        from .changeset import Insert, Modify, Remove, Skip
+
+        fields, holder = self._spine()
+        if fields != getattr(self, "_domain_fields", None):
+            # Domain reshaped since the last marking: previous chunk
+            # indices are meaningless — every chunk re-uploads once.
+            self._domain_fields = list(fields)
+            self._chunk_seqs = {0: seq}
+            dirty_all = True
+        else:
+            dirty_all = False
+        K = self.CHUNK_ROOTS
+        n_chunks = max(1, -(-len(holder.fields.get(fields[-1], [])) // K))
+
+        def final_walk(marks) -> tuple[list[int], int | None]:
+            pos, points, floor = 0, [], None
+            for mk in marks:
+                if isinstance(mk, Skip):
+                    pos += mk.count
+                elif isinstance(mk, Modify):
+                    points.append(pos)
+                    pos += 1
+                elif isinstance(mk, Insert):
+                    floor = pos if floor is None else min(floor, pos)
+                elif isinstance(mk, Remove):
+                    floor = pos if floor is None else min(floor, pos)
+                    pos += mk.count
+                else:  # MoveOut/MoveIn and anything irregular
+                    floor = 0
+            return points, floor
+
+        changes = list(trunk_commit)
+        for level, fkey in enumerate(fields):
+            last = level == len(fields) - 1
+            next_changes = []
+            for change in changes:
+                for key, marks in change.fields.items():
+                    if key != fkey:
+                        if marks:
+                            dirty_all = True  # off-spine edit reshapes domain
+                        continue
+                    if last:
+                        points, floor = final_walk(marks)
+                        for p in points:
+                            self._chunk_seqs[p // K] = seq
+                        if floor is not None:
+                            for k in range(floor // K, n_chunks):
+                                self._chunk_seqs[k] = seq
+                    else:
+                        for mk in marks:
+                            if isinstance(mk, Modify):
+                                next_changes.append(mk.change)
+                            elif not isinstance(mk, Skip):
+                                dirty_all = True  # spine structure changed
+            if last or dirty_all:
+                break
+            changes = next_changes
+        if dirty_all:
+            for k in range(n_chunks):
+                self._chunk_seqs[k] = seq
+
+    def _meta_summary(self) -> dict[str, Any]:
+        """Everything but the forest — shared by the flat and incremental
+        summary paths so the two can never skew."""
+        return {
+            "editManager": self.em.summarize(),
+            "schema": self.schema.to_json(),
+            "idCompressor": self.idc.serialize(with_session=False),
+        }
+
     def summarize(self) -> dict[str, Any]:
         if self._local_pending:
             raise RuntimeError("summarize with pending tree edits")
         return {
             "forest": encode_field_chunked(self.forest.root_field),
-            "editManager": self.em.summarize(),
-            "schema": self.schema.to_json(),
-            "idCompressor": self.idc.serialize(with_session=False),
+            **self._meta_summary(),
         }
+
+    def summary_tree(self, covered_seq: int | None, path: str) -> dict[str, Any]:
+        """Incremental channel summary (ref incrementalSummarizationUtils):
+        the forest splits into root-subtree chunks; chunks unchanged since
+        the covered summary emit HANDLES into the previous snapshot instead
+        of content.  Safe because any structural root change dirties every
+        chunk at/after it, so a clean chunk held identical content at the
+        same chunk index in the covered summary."""
+        from ...runtime.snapshot_formats import current_format
+        from ...runtime.summary import blob, handle, tree
+
+        if self._local_pending:
+            raise RuntimeError("summarize with pending tree edits")
+        K = self.CHUNK_ROOTS
+        fields, holder = self._spine()
+        domain = holder.fields.get(fields[-1], [])
+        n_chunks = max(1, -(-len(domain) // K))
+        # The OUTER forest: the root field with the chunk-domain children
+        # removed (spliced back on load) — tiny, rides in the meta blob.
+        holder.fields[fields[-1]] = []
+        try:
+            outer = encode_field_chunked(self.forest.root_field)
+        finally:
+            holder.fields[fields[-1]] = domain
+        if fields != getattr(self, "_domain_fields", None):
+            # The domain differs from the one _chunk_seqs was tracked
+            # against (e.g. first summary after load): no handle is safe.
+            covered_seq = None
+        meta = {
+            "type": self.channel_type,
+            "fmt": current_format(self.channel_type),
+            "summary": {
+                **self._meta_summary(),
+                "spine": fields,
+                "outer": outer,
+            },
+        }
+        chunks: dict[str, Any] = {}
+        for k in range(n_chunks):
+            chunk_path = f"{path}/forest/{k}"
+            if (
+                covered_seq is not None
+                and self._chunk_seqs.get(k, 0) <= covered_seq
+            ):
+                chunks[str(k)] = handle(chunk_path)
+            else:
+                chunks[str(k)] = blob(
+                    encode_field_chunked(domain[k * K : (k + 1) * K])
+                )
+        return tree({"meta": blob(meta), "forest": tree(chunks)})
 
     def load(self, summary: dict[str, Any]) -> None:
         self.forest.root = Node(type="__root__")
@@ -339,8 +496,35 @@ class SharedTreeChannel(Channel):
         self._notify()
 
 
+def assemble_incremental_summary(
+    meta_summary: dict[str, Any], chunk_lists: list[list]
+) -> dict[str, Any]:
+    """Reassemble a flat channel summary from a MATERIALIZED incremental
+    tree: splice the concatenated chunk-domain children back into the
+    outer forest at the spine's end (inverse of summary_tree's split)."""
+    from .forest import decode_field_chunked, encode_field_chunked
+
+    out = dict(meta_summary)
+    spine = out.pop("spine")
+    outer = out.pop("outer")
+    pieces = [piece for chunk in chunk_lists for piece in chunk]
+    if len(spine) == 1:
+        out["forest"] = pieces  # the domain IS the root field
+        return out
+    outer_nodes = decode_field_chunked(outer)
+    holder = outer_nodes[0]
+    for f in spine[1:-1]:
+        holder = holder.fields[f][0]
+    holder.fields[spine[-1]] = decode_field_chunked(pieces)
+    out["forest"] = encode_field_chunked(outer_nodes)
+    return out
+
+
 class _Factory:
     channel_type = SharedTreeChannel.channel_type
+    # Registry hook: reassembles a materialized incremental summary into
+    # the flat form (datastore dispatches by type, never by shape-sniff).
+    assemble_incremental = staticmethod(assemble_incremental_summary)
 
     def create(self, channel_id: str) -> SharedTreeChannel:
         return SharedTreeChannel(channel_id)

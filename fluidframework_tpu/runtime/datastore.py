@@ -177,6 +177,29 @@ class DataStoreRuntime:
 
         self.is_root = summary.get("root", True)
         for cid, entry in summary["channels"].items():
+            if "meta" in entry:
+                # Materialized incremental channel tree ({"meta", "forest"}):
+                # the channel FACTORY reassembles the flat summary from the
+                # per-chunk pieces (the load-side mirror of the generic
+                # summary_tree emit hook — symmetric, no DDS import here).
+                meta = entry["meta"]
+                factory = self._registry.get(meta["type"])
+                if factory is None or not hasattr(factory, "assemble_incremental"):
+                    raise KeyError(
+                        f"channel type {meta['type']!r} wrote an incremental "
+                        "summary but its factory has no assemble_incremental"
+                    )
+                entry = {
+                    "type": meta["type"],
+                    "fmt": meta.get("fmt", 1),
+                    "summary": factory.assemble_incremental(
+                        meta["summary"],
+                        [
+                            entry["forest"][k]
+                            for k in sorted(entry["forest"], key=int)
+                        ],
+                    ),
+                }
             # _create_channel: snapshot-loaded channels are covered by that
             # snapshot, not dirty.
             channel = self._create_channel(entry["type"], cid)
@@ -200,6 +223,11 @@ class DataStoreRuntime:
             path = f"{prefix}/channels/{cid}"
             if covered_seq is not None and self.changed_seqs.get(cid, 0) <= covered_seq:
                 channels[cid] = handle(path)
+            elif hasattr(ch, "summary_tree"):
+                # WITHIN-channel incrementality (SharedTree chunked forest,
+                # ref incrementalSummarizationUtils): the channel emits its
+                # own tree of blobs + handles.
+                channels[cid] = ch.summary_tree(covered_seq, path)
             else:
                 channels[cid] = blob(
                     {

@@ -392,3 +392,132 @@ def test_stalled_summarizer_reelection_takeover(env):
     # The ack resets the shared op counter: election returns to the ring
     # head on every replica.
     assert sm1.is_elected() and not sm2.is_elected()
+
+
+# --------------------------------------------------------------------------
+# Incremental forest summarization (ref incrementalSummarizationUtils.ts)
+# --------------------------------------------------------------------------
+
+def _tree_of(c):
+    return c.runtime.datastore("root").get_channel("jsontree")
+
+
+def _tree_summary_node(summary_tree):
+    return summary_tree["entries"]["datastores"]["entries"]["root"][
+        "entries"]["channels"]["entries"]["jsontree"]
+
+
+def test_tree_incremental_summary_reuses_clean_chunks(env):
+    """A 4-chunk tree document: after a deep edit to ONE subtree, the next
+    summary re-uploads only that chunk; the other three ride handles — and
+    a late joiner loads the materialized snapshot exactly."""
+    from fluidframework_tpu.dds.tree.changeset import make_insert, make_set_value
+    from fluidframework_tpu.dds.tree.schema import build_node, leaf
+
+    svc, factory, d = boot(env, extra_channels=[("sharedTree", "jsontree")])
+    sm = d.make_summary_manager(SummaryConfig(max_ops=1))
+    t = _tree_of(d)
+    K = t.CHUNK_ROOTS
+    for i in range(4 * K):  # 32 root subtrees = 4 chunks
+        t.submit_change(make_insert([], "", i, [
+            build_node("row", cells=[leaf(i), leaf(i * 10)])
+        ]))
+    d.runtime.flush()
+    svc.process_all()
+    assert sm.tick(now=0.0)
+    svc.process_all()
+    assert sm.acked == 1
+
+    # Deep value edit inside chunk 2 only.
+    t.submit_change(make_set_value([("", 2 * K + 3), ("cells", 1)], 777))
+    d.runtime.flush()
+    svc.process_all()
+    node = _tree_summary_node(d.runtime.build_summary_tree())
+    forest = node["entries"]["forest"]["entries"]
+    kinds = {k: forest[k]["type"] for k in sorted(forest)}
+    assert kinds == {"0": "handle", "1": "handle", "2": "blob", "3": "handle"}
+
+    assert sm.tick(now=1.0)
+    svc.process_all()
+    assert sm.acked == 2
+
+    # The scribe-materialized snapshot round-trips into a fresh client.
+    late = load(factory, "late")
+    svc.process_all()
+    lt = _tree_of(late)
+    assert [n.to_json() for n in lt.forest.root_field] == [
+        n.to_json() for n in t.forest.root_field
+    ]
+    assert lt.forest.root_field[2 * K + 3].fields["cells"][1].value == 777
+
+
+def test_tree_structural_change_dirties_suffix_chunks(env):
+    from fluidframework_tpu.dds.tree.changeset import make_insert, make_remove
+    from fluidframework_tpu.dds.tree.schema import leaf
+
+    svc, factory, d = boot(env, extra_channels=[("sharedTree", "jsontree")])
+    sm = d.make_summary_manager(SummaryConfig(max_ops=1))
+    t = _tree_of(d)
+    K = t.CHUNK_ROOTS
+    for i in range(3 * K):
+        t.submit_change(make_insert([], "", i, [leaf(i)]))
+    d.runtime.flush()
+    svc.process_all()
+    assert sm.tick(now=0.0)
+    svc.process_all()
+
+    # Remove in chunk 1: indices shift from there on -> chunks 1..2 dirty,
+    # chunk 0 rides a handle.
+    t.submit_change(make_remove([], "", K + 1, 1))
+    d.runtime.flush()
+    svc.process_all()
+    node = _tree_summary_node(d.runtime.build_summary_tree())
+    forest = node["entries"]["forest"]["entries"]
+    kinds = {k: forest[k]["type"] for k in sorted(forest)}
+    assert kinds == {"0": "handle", "1": "blob", "2": "blob"}
+    assert sm.tick(now=1.0)
+    svc.process_all()
+    assert sm.acked == 2
+    late = load(factory, "late2")
+    svc.process_all()
+    assert [n.value for n in _tree_of(late).forest.root_field] == [
+        n.value for n in t.forest.root_field
+    ]
+
+
+def test_tree_remote_growth_never_dangles_chunk_handles(env):
+    """A REMOTE append that grows the chunk domain past a chunk boundary
+    must dirty the new tail chunk: the next summary may not reference a
+    chunk the previous snapshot never had (review repro: pre-apply
+    marking left chunk 2 clean and the scribe nacked the summary)."""
+    from fluidframework_tpu.dds.tree.changeset import make_insert
+    from fluidframework_tpu.dds.tree.schema import leaf
+
+    svc, factory, d = boot(env, extra_channels=[("sharedTree", "jsontree")])
+    sm = d.make_summary_manager(SummaryConfig(max_ops=1))
+    t = _tree_of(d)
+    K = t.CHUNK_ROOTS
+    for i in range(2 * K):
+        t.submit_change(make_insert([], "", i, [leaf(i)]))
+    d.runtime.flush()
+    svc.process_all()
+    assert sm.tick(now=0.0)
+    svc.process_all()
+    assert sm.acked == 1
+
+    other = load(factory, "other")
+    svc.process_all()
+    ot = _tree_of(other)
+    ot.submit_change(make_insert([], "", 2 * K, [leaf(999)]))  # new chunk 2
+    other.runtime.flush()
+    svc.process_all()
+
+    assert sm.tick(now=1.0)
+    svc.process_all()
+    assert sm.failures == 0, "summary nacked: dangling chunk handle"
+    assert sm.acked == 2
+    late = load(factory, "late3")
+    svc.process_all()
+    assert [n.value for n in _tree_of(late).forest.root_field] == [
+        n.value for n in t.forest.root_field
+    ]
