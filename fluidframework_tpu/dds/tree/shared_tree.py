@@ -362,8 +362,8 @@ class SharedTreeChannel(Channel):
             # Domain reshaped since the last marking: previous chunk
             # indices are meaningless — every chunk re-uploads once.
             self._domain_fields = list(fields)
-            self._chunk_seqs = {0: seq}
-            dirty_all = True
+            self._chunk_seqs = {}
+            dirty_all = True  # the loop below marks every current chunk
         else:
             dirty_all = False
         K = self.CHUNK_ROOTS
@@ -497,11 +497,20 @@ class SharedTreeChannel(Channel):
 
 
 def assemble_incremental_summary(
-    meta_summary: dict[str, Any], chunk_lists: list[list]
+    meta_summary: dict[str, Any], chunk_lists: list[list], fmt: int = 1
 ) -> dict[str, Any]:
     """Reassemble a flat channel summary from a MATERIALIZED incremental
     tree: splice the concatenated chunk-domain children back into the
-    outer forest at the spine's end (inverse of summary_tree's split)."""
+    outer forest at the spine's end (inverse of summary_tree's split).
+
+    ``fmt`` is the snapshot format the summary was WRITTEN at; assembly is
+    format-aware (it runs before the generic upgrade step, which only sees
+    flat summaries) and must return the flat summary at that same format.
+    Every shipped format so far shares this layout."""
+    if fmt > 1:
+        raise ValueError(
+            f"unknown incremental sharedTree summary format {fmt}"
+        )
     from .forest import decode_field_chunked, encode_field_chunked
 
     out = dict(meta_summary)

@@ -37,6 +37,9 @@ class Container:
         self.attached = False
         self._stash: str | None = None
         self._mode = "write"
+        # Cleanups run at close (e.g. a spawned hidden summarizer must
+        # leave when its parent does, or it pins the MSN forever).
+        self._close_hooks: list[Callable[[], None]] = []
         # Full connected-membership surface: write members from sequenced
         # joins/leaves, read members from the service's clientJoin/
         # clientLeave system signals (ref audience.ts; VERDICT r3 #3).
@@ -76,9 +79,20 @@ class Container:
         client_id: str,
         stash: str | None = None,
         mode: str = "write",
+        _summarizer: bool = False,
     ) -> "Container":
         """Boot from the service: latest snapshot + trailing ops + live
         stream (call stack SURVEY §3.1)."""
+        from ..runtime.summary import SUMMARIZER_SUFFIX
+
+        if client_id.endswith(SUMMARIZER_SUFFIX) and not _summarizer:
+            # The suffix IS the non-interactive marker every replica's
+            # election trusts; an interactive client wearing it would be
+            # silently unelectable (and a lone one would never summarize).
+            raise ValueError(
+                f"client id suffix {SUMMARIZER_SUFFIX!r} is reserved for "
+                "spawned summarizer clients"
+            )
         service = service_factory.create_document_service(doc_id)
         storage = service.connect_to_storage()
         runtime = ContainerRuntime(registry, container_id=client_id)
@@ -201,6 +215,9 @@ class Container:
         return self.runtime.joined
 
     def close(self, error: Exception | None = None) -> None:
+        for hook in list(self._close_hooks):
+            hook()
+        self._close_hooks.clear()
         if self.delta_manager is not None:
             self.delta_manager.connection_manager.close()
         self.runtime.close(error)
@@ -247,6 +264,19 @@ class Container:
             config=config,
             protocol_summarize=self.protocol.summarize,
         )
+
+    def make_hidden_summarizer(self, doc_id: str, service_factory, config=None):
+        """Summarize through a spawned hidden client while this interactive
+        client holds the election (ref summaryManager.ts:95 +
+        summarizer.ts:89 — the summarizer is its own non-interactive
+        container, so interactive pending edits never block a summary)."""
+        from ..runtime.summary import HiddenSummaryManager
+
+        hs = HiddenSummaryManager(
+            self, doc_id, service_factory, self._registry, config=config
+        )
+        self._close_hooks.append(hs.stop)
+        return hs
 
     # ------------------------------------------------------------------ stash
     def get_pending_local_state(self) -> str:

@@ -198,6 +198,7 @@ class DataStoreRuntime:
                             entry["forest"][k]
                             for k in sorted(entry["forest"], key=int)
                         ],
+                        meta.get("fmt", 1),
                     ),
                 }
             # _create_channel: snapshot-loaded channels are covered by that

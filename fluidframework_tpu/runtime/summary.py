@@ -121,6 +121,29 @@ class SummaryConfig:
         self.reelection_ops = reelection_ops
 
 
+# Client-id suffix marking a non-interactive summarizer client: excluded
+# from election on every replica (the reference distinguishes summarizer
+# clients via IClient.details.capabilities.interactive; a wire-visible id
+# suffix is this host plane's deterministic equivalent).
+SUMMARIZER_SUFFIX = "/summarizer"
+
+
+def elected_summarizer(runtime, config: "SummaryConfig") -> str | None:
+    """The deterministic election rule every replica runs: interactive
+    candidates (summarizer clients excluded) in join order, rotated once
+    per reelection window without an acked summary."""
+    q = runtime.quorum_table
+    candidates = sorted(
+        (cid for cid in q if not cid.endswith(SUMMARIZER_SUFFIX)),
+        key=lambda cid: q[cid],
+    )
+    if not candidates:
+        return None
+    r = config.reelection_ops
+    rounds = (runtime.ops_since_summary_ack // r) if r else 0
+    return candidates[rounds % len(candidates)]
+
+
 class SummaryManager:
     """Drives summarization for one container runtime.
 
@@ -148,10 +171,15 @@ class SummaryManager:
         storage,
         config: SummaryConfig | None = None,
         protocol_summarize=None,
+        act_as_summarizer: bool = False,
     ) -> None:
         self._runtime = runtime
         self._storage = storage
         self.config = config or SummaryConfig()
+        # A spawned hidden summarizer client acts without winning election
+        # itself — its PARENT interactive client was elected and delegates
+        # (ref summaryManager.ts spawn -> summarizer.ts run).
+        self._act_as_summarizer = act_as_summarizer
         self._protocol_summarize = protocol_summarize or (lambda: {})
         self._inflight_handle: str | None = None
         self._inflight_since = 0.0
@@ -170,15 +198,11 @@ class SummaryManager:
 
         Deterministic on every replica: candidates in join order, rotated
         once per ``reelection_ops`` window without an acked summary."""
-        q = self._runtime.quorum_table
-        if not q:
-            return None
-        candidates = sorted(q, key=lambda cid: q[cid])
-        r = self.config.reelection_ops
-        rounds = (self._runtime.ops_since_summary_ack // r) if r else 0
-        return candidates[rounds % len(candidates)]
+        return elected_summarizer(self._runtime, self.config)
 
     def is_elected(self) -> bool:
+        if self._act_as_summarizer:
+            return self._runtime.joined
         return (
             self._runtime.joined
             and self.elected_summarizer() == self._runtime.client_id
@@ -258,3 +282,68 @@ class SummaryManager:
     def _on_nack(self, contents: dict) -> None:
         if contents.get("handle") == self._inflight_handle:
             self._record_failure()
+
+
+class HiddenSummaryManager:
+    """Summarization through a SPAWNED non-interactive client (ref
+    summaryManager.ts:95 spawning the hidden summarizer container,
+    summarizer.ts:89).
+
+    The interactive parent watches election; while elected, it keeps a
+    second container alive under ``<client>/summarizer`` that does the
+    actual summarizing.  The hidden client never carries local pending ops
+    — the parent can keep editing (even with unflushed changes) without
+    ever blocking a summary, the property the reference spawns a separate
+    client for.  Losing election closes the hidden client (its leave
+    sequences, releasing its MSN hold)."""
+
+    def __init__(self, parent, doc_id: str, service_factory, registry,
+                 config: SummaryConfig | None = None) -> None:
+        self._parent = parent
+        self._doc_id = doc_id
+        self._factory = service_factory
+        self._registry = registry
+        self.config = config or SummaryConfig()
+        self.summarizer = None           # the hidden Container, when alive
+        self._inner: SummaryManager | None = None
+
+    # ------------------------------------------------------------------ state
+    def parent_elected(self) -> bool:
+        rt = self._parent.runtime
+        return rt.joined and elected_summarizer(rt, self.config) == rt.client_id
+
+    @property
+    def submitted(self) -> int:
+        return self._inner.submitted if self._inner else 0
+
+    @property
+    def acked(self) -> int:
+        return self._inner.acked if self._inner else 0
+
+    # ------------------------------------------------------------------- tick
+    def tick(self, now: float | None = None) -> bool:
+        from ..loader.container import Container
+
+        if not self.parent_elected():
+            self.stop()
+            return False
+        if self.summarizer is None:
+            self.summarizer = Container.load(
+                self._doc_id, self._factory, self._registry,
+                f"{self._parent.runtime.client_id}{SUMMARIZER_SUFFIX}",
+                _summarizer=True,
+            )
+            self._inner = SummaryManager(
+                self.summarizer.runtime,
+                self.summarizer._storage,
+                config=self.config,
+                protocol_summarize=self.summarizer.protocol.summarize,
+                act_as_summarizer=True,
+            )
+        return self._inner.tick(now)
+
+    def stop(self) -> None:
+        if self.summarizer is not None:
+            self.summarizer.close()
+            self.summarizer = None
+            self._inner = None

@@ -521,3 +521,138 @@ def test_tree_remote_growth_never_dangles_chunk_handles(env):
     assert [n.value for n in _tree_of(late).forest.root_field] == [
         n.value for n in t.forest.root_field
     ]
+
+
+# --------------------------------------------------------------------------
+# Hidden summarizer client (ref summaryManager.ts:95 + summarizer.ts:89)
+# --------------------------------------------------------------------------
+
+def test_hidden_summarizer_summarizes_despite_parent_pending_ops(env):
+    """The elected interactive client spawns a hidden summarizer client;
+    summaries flow even while the parent holds UNFLUSHED local edits (the
+    exact property the reference spawns a separate client for), and the
+    hidden client never appears in the election."""
+    svc, factory, d = boot(env)
+    hs = d.make_hidden_summarizer("doc", factory, SummaryConfig(max_ops=1))
+    text_of(d).insert_text(0, "acked")
+    d.runtime.flush()
+    svc.process_all()
+    assert hs.tick(now=0.0) is False  # spawns; hidden join still in flight
+    svc.process_all()                 # hidden client joins
+    # Parent now holds a PENDING (in-flight, unacked) local edit.
+    text_of(d).insert_text(0, "pending-")
+    d.runtime.flush()
+    assert d.runtime.pending_op_count > 0
+    # The parent itself REFUSES to summarize with pending ops...
+    sm_direct = d.make_summary_manager(SummaryConfig(max_ops=1))
+    assert sm_direct.tick(now=0.0) is False
+    # ...but the hidden client has none and summarizes regardless.
+    assert hs.tick(now=0.0)
+    svc.process_all()
+    assert hs.acked == 1
+    # The summarize op came from the hidden identity...
+    _, snap = svc.document("doc").latest_snapshot()
+    assert snap["runtime"]["datastores"]["root"]["channels"]["text"] is not None
+    assert any(
+        cid.endswith("/summarizer") for cid in d.runtime.quorum_table
+    )
+    # ...which no replica's election ever counts.
+    sm_watch = d.make_summary_manager(SummaryConfig(max_ops=1))
+    assert sm_watch.elected_summarizer() == "creator"
+    late = load(factory, "late-h")
+    svc.process_all()
+    assert text_of(late).text == text_of(d).text == "pending-acked"
+
+
+def test_hidden_summarizer_closes_on_lost_election(env):
+    svc, factory, d = boot(env)
+    c2 = load(factory, "second")
+    svc.process_all()
+    hs = d.make_hidden_summarizer("doc", factory, SummaryConfig(max_ops=1))
+    text_of(d).insert_text(0, "x")
+    d.runtime.flush()
+    svc.process_all()
+    assert hs.tick(now=0.0) is False  # spawn; join in flight
+    svc.process_all()
+    assert hs.tick(now=0.0)
+    svc.process_all()
+    assert hs.acked == 1 and hs.summarizer is not None
+    # The parent leaves: election moves to "second"; the hidden client
+    # shuts down on the next tick and its leave sequences.
+    d.disconnect()
+    svc.process_all()
+    assert not hs.parent_elected()
+    assert hs.tick(now=1.0) is False
+    assert hs.summarizer is None
+    svc.process_all()
+    assert not any(
+        cid.endswith("/summarizer")
+        for cid in c2.runtime.quorum_table
+    )
+    sm2 = c2.make_summary_manager(SummaryConfig(max_ops=1))
+    assert sm2.is_elected()
+
+
+def test_deep_spine_incremental_summary_single_root_array(env):
+    """THE common app shape — one root array node holding the items: the
+    chunk domain descends the spine, items chunk, deep value edits leave
+    clean chunks riding handles, and late joiners load the spliced
+    snapshot across generations."""
+    from fluidframework_tpu.dds.tree import SchemaFactory, TreeViewConfiguration
+
+    svc, factory, d = boot(env, extra_channels=[("sharedTree", "jsontree")])
+    sm = d.make_summary_manager(SummaryConfig(max_ops=1))
+    sf = SchemaFactory("ds")
+    Cell = sf.object("Cell", v=sf.number)
+    Cells = sf.array("Cells", Cell)
+    t = _tree_of(d)
+    view = t.typed_view(TreeViewConfiguration(Cells))
+    view.initialize([Cell(v=i) for i in range(3 * t.CHUNK_ROOTS)])
+    d.runtime.flush()
+    svc.process_all()
+    assert sm.tick(now=0.0)
+    svc.process_all()
+    assert sm.acked == 1
+
+    view.root[2 * t.CHUNK_ROOTS + 1].v = 777  # dirty chunk 2 only
+    d.runtime.flush()
+    svc.process_all()
+    node = _tree_summary_node(d.runtime.build_summary_tree())
+    forest = node["entries"]["forest"]["entries"]
+    kinds = {k: forest[k]["type"] for k in sorted(forest)}
+    assert kinds == {"0": "handle", "1": "handle", "2": "blob"}
+    assert sm.tick(now=1.0)
+    svc.process_all()
+    assert sm.acked == 2
+
+    late = load(factory, "late-spine")
+    svc.process_all()
+    lv = _tree_of(late).typed_view(TreeViewConfiguration(Cells))
+    vals = [c.v for c in lv.root]
+    assert vals[2 * t.CHUNK_ROOTS + 1] == 777 and vals[5] == 5
+
+
+def test_reserved_summarizer_suffix_rejected(env):
+    svc, factory, d = boot(env)
+    with pytest.raises(ValueError, match="reserved"):
+        load(factory, "sneaky/summarizer")
+
+
+def test_parent_close_stops_hidden_summarizer(env):
+    svc, factory, d = boot(env)
+    hs = d.make_hidden_summarizer("doc", factory, SummaryConfig(max_ops=1))
+    text_of(d).insert_text(0, "x")
+    d.runtime.flush()
+    svc.process_all()
+    hs.tick(now=0.0)
+    svc.process_all()
+    hs.tick(now=0.0)
+    svc.process_all()
+    assert hs.summarizer is not None
+    d.close()  # parent lifecycle tears the hidden client down too
+    assert hs.summarizer is None
+    svc.process_all()
+    assert not any(
+        cid.endswith("/summarizer")
+        for cid in svc.document("doc").sequencer.clients()
+    )
