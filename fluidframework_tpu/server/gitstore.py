@@ -1,0 +1,166 @@
+"""Git-tree summary storage: content-addressed blobs/trees with structural
+sharing (the gitrest/historian storage model).
+
+Reference parity: the reference stores summaries as GIT TREES via
+historian -> gitrest (server/gitrest/packages/gitrest-base/src/; SURVEY
+§2.5 "summaries stored as git trees"): every blob and tree object is
+addressed by the hash of its content, so consecutive snapshots share every
+unchanged subtree physically — version N+1 costs only its changed spine.
+This pairs with the client's incremental summaries (handles reference
+unchanged subtrees logically; the store dedups them physically even when a
+client re-uploads identical content).
+
+Objects (each keyed by sha256 of its canonical encoding):
+
+- blob: canonical JSON of a leaf value;
+- tree: sorted {name: child_sha} mapping — identical subtrees collapse to
+  one object regardless of where (or in which version) they appear;
+- commit: {tree, seq, parent} — the VERSION identity.  Two versions with
+  identical content still get distinct commits (seq/parent differ), which
+  is exactly why git has commit objects: refs stay 1:1 with versions.
+
+``GitSnapshotStore`` is the per-document version chain (gitrest's refs):
+``(seq, commit_sha)`` entries over one shared object store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any
+
+
+def _canon(obj: Any) -> bytes:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+class GitStore:
+    """One content-addressed object store (may back many documents)."""
+
+    def __init__(self) -> None:
+        self._objects: dict[str, tuple[str, Any]] = {}  # sha -> (kind, payload)
+        self.writes = 0       # put calls
+        self.stored = 0       # objects actually created
+        self.bytes_stored = 0
+
+    # ------------------------------------------------------------- primitives
+    def _put(self, kind: str, payload: Any) -> str:
+        raw = _canon([kind, payload])
+        sha = hashlib.sha256(raw).hexdigest()
+        self.writes += 1
+        if sha not in self._objects:
+            # Store the canonical COPY: objects must be immutable — a
+            # caller mutating its input (or a read result) must never
+            # reach the shared stored structure, or every version sharing
+            # the object would silently corrupt.
+            self._objects[sha] = (kind, json.loads(raw.decode())[1])
+            self.stored += 1
+            self.bytes_stored += len(raw)
+        return sha
+
+    def put_blob(self, content: Any) -> str:
+        return self._put("blob", content)
+
+    def put_tree(self, entries: dict[str, str]) -> str:
+        """entries: name -> child sha (every child must already exist)."""
+        for name, sha in entries.items():
+            if sha not in self._objects:
+                raise KeyError(f"tree entry {name!r} references unknown {sha}")
+        return self._put("tree", dict(sorted(entries.items())))
+
+    def put_commit(self, tree_sha: str, seq: int, parent: str | None) -> str:
+        if tree_sha not in self._objects:
+            raise KeyError(f"commit references unknown tree {tree_sha}")
+        return self._put(
+            "commit", {"tree": tree_sha, "seq": seq, "parent": parent}
+        )
+
+    def get(self, sha: str) -> tuple[str, Any]:
+        """(kind, deep-copied payload); raises KeyError when unknown."""
+        kind, payload = self._objects[sha]
+        return kind, json.loads(_canon(payload).decode())
+
+    def __contains__(self, sha: str) -> bool:
+        return sha in self._objects
+
+    def __len__(self) -> int:
+        return len(self._objects)
+
+    # ----------------------------------------------------------- snapshot IO
+    def write_snapshot(self, plain: dict) -> str:
+        """Recursively store a materialized summary: dicts become tree
+        objects, everything else a blob.  Returns the root tree sha.
+        Unchanged subtrees hash identically and dedup to existing objects."""
+        def walk(node: Any) -> str:
+            if isinstance(node, dict):
+                return self.put_tree({k: walk(v) for k, v in node.items()})
+            return self.put_blob(node)
+
+        return walk(plain)
+
+    def read_snapshot(self, sha: str) -> Any:
+        kind, payload = self.get(sha)
+        if kind == "blob":
+            return payload
+        return {name: self.read_snapshot(child) for name, child in payload.items()}
+
+    def read_path(self, sha: str, path: str) -> Any:
+        """Resolve a '/'-separated path from a root tree — the virtualized
+        partial read (fetch one subtree without the whole snapshot; ref
+        gitrest tree reads feeding odsp-style snapshot virtualization)."""
+        cur = sha
+        for part in [p for p in path.split("/") if p]:
+            kind, payload = self.get(cur)
+            if kind != "tree" or part not in payload:
+                raise KeyError(f"path {path!r} not found under {sha[:12]}")
+            cur = payload[part]
+        return self.read_snapshot(cur)
+
+
+class GitSnapshotStore:
+    """Per-document version chain over a shared GitStore (gitrest refs):
+    ``(seq, commit_sha)`` entries, newest last."""
+
+    def __init__(self, store: GitStore | None = None) -> None:
+        self.store = store if store is not None else GitStore()
+        self.versions: list[tuple[int, str]] = []
+
+    def save(self, seq: int, plain: dict) -> str:
+        root = self.store.write_snapshot(plain)
+        parent = self.versions[-1][1] if self.versions else None
+        commit = self.store.put_commit(root, seq, parent)
+        self.versions.append((seq, commit))
+        return commit
+
+    def _read_commit(self, commit_sha: str) -> tuple[int, dict]:
+        kind, payload = self.store.get(commit_sha)
+        if kind != "commit":
+            raise KeyError(f"{commit_sha[:12]} is a {kind}, not a commit")
+        return payload["seq"], self.store.read_snapshot(payload["tree"])
+
+    def latest(self) -> tuple[int, dict] | None:
+        if not self.versions:
+            return None
+        return self._read_commit(self.versions[-1][1])
+
+    def at(self, commit_sha: str) -> tuple[int, dict] | None:
+        for _seq, commit in reversed(self.versions):
+            if commit == commit_sha:
+                return self._read_commit(commit)
+        return None
+
+    def version_ids(self, max_count: int = 5) -> list[dict]:
+        if max_count <= 0:
+            return []
+        return [
+            {"id": commit, "seq": seq}
+            for seq, commit in reversed(self.versions[-max_count:])
+        ]
+
+    # ----------------------------------------------------------- diagnostics
+    def sharing_ratio(self) -> float:
+        """Fraction of object writes that dedup'd to an existing object —
+        the structural-sharing measure across the version chain."""
+        if not self.store.writes:
+            return 0.0
+        return 1.0 - self.store.stored / self.store.writes

@@ -13,13 +13,43 @@ DDS tests use to control interleaving.
 from __future__ import annotations
 
 from collections import deque
-from typing import Callable
+from typing import Any, Callable
 
 from ..protocol.messages import MessageType, Nack, SequencedMessage, SignalMessage, UnsequencedMessage
 from .sequencer import Sequencer
 
 Subscriber = Callable[[SequencedMessage], None]
 SignalSubscriber = Callable[[SignalMessage], None]
+
+
+class _SnapshotChain:
+    """Thin facade over the git-tree snapshot store (gitstore.py): the
+    service (and tests) keep appending/clearing/tail-indexing it like the
+    old plain list, while every saved version physically shares unchanged
+    subtrees.  Only the surface actually used exists — indexing
+    materializes a full snapshot, so nothing here invites iteration."""
+
+    def __init__(self) -> None:
+        from .gitstore import GitSnapshotStore
+
+        self.git = GitSnapshotStore()
+
+    def append(self, entry: tuple[int, dict]) -> None:
+        self.git.save(entry[0], entry[1])
+
+    def clear(self) -> None:
+        self.git.versions.clear()  # refs only; objects are immutable
+
+    def __bool__(self) -> bool:
+        return bool(self.git.versions)
+
+    def __getitem__(self, i: int) -> tuple[int, dict]:
+        seq, commit = self.git.versions[i]
+        return seq, self.git._read_commit(commit)[1]
+
+    @property
+    def last_seq(self) -> int:
+        return self.git.versions[-1][0]
 
 
 class LocalDocument:
@@ -32,9 +62,10 @@ class LocalDocument:
         self._nack_handlers: dict[str, Callable[[Nack], None]] = {}
         self._pending: deque[SequencedMessage] = deque()
         self.nacks: list[Nack] = []
-        # Snapshot store (historian/gitrest analog): newest-last list of
-        # (seq, summary) checkpoints; the driver storage service reads these.
-        self._snapshots: list[tuple[int, dict]] = []
+        # Snapshot store: the GIT-TREE storage model (historian -> gitrest;
+        # server/gitstore.py) — every version is a content-addressed tree,
+        # unchanged subtrees share objects physically across versions.
+        self._snapshots = _SnapshotChain()
         self._signal_subscribers: dict[str, SignalSubscriber] = {}
         # Staged summary uploads awaiting their summarize op (the reference
         # uploads the ISummaryTree to storage, then the op carries a handle).
@@ -202,28 +233,35 @@ class LocalDocument:
         return log[lo:hi] if lo < hi else []
 
     def save_snapshot(self, seq: int, summary: dict) -> None:
-        if self._snapshots and seq < self._snapshots[-1][0]:
+        if self._snapshots and seq < self._snapshots.last_seq:
             raise ValueError("snapshot seq regression")
         self._snapshots.append((seq, summary))
 
     def latest_snapshot(self) -> tuple[int, dict] | None:
-        return self._snapshots[-1] if self._snapshots else None
+        return self._snapshots.git.latest()
 
     def snapshot_versions(self, max_count: int = 5) -> list[dict]:
         """Newest-first version descriptors (ref AzureClient
-        getContainerVersions over historian's version listing)."""
-        if max_count <= 0:
-            return []
-        return [
-            {"id": str(seq), "seq": seq}
-            for seq, _s in reversed(self._snapshots[-max_count:])
-        ]
+        getContainerVersions over historian's version listing).  Version
+        ids are git COMMIT shas (unique per version even for identical
+        content — the reason git has commit objects)."""
+        return self._snapshots.git.version_ids(max_count)
 
     def snapshot_at(self, version_id: str) -> tuple[int, dict] | None:
-        for seq, summary in self._snapshots:
+        found = self._snapshots.git.at(version_id)
+        if found is not None:
+            return found
+        # Legacy str(seq) ids still resolve for pinned callers (newest
+        # matching version wins).
+        for seq, commit in reversed(self._snapshots.git.versions):
             if str(seq) == version_id:
-                return seq, summary
+                return self._snapshots.git._read_commit(commit)
         return None
+
+    def read_git_object(self, sha: str) -> tuple[str, Any]:
+        """Raw object read from the snapshot store (historian's git object
+        surface; feeds virtualized partial snapshot fetches)."""
+        return self._snapshots.git.store.get(sha)
 
     # ------------------------------------------------------------------ blobs
     def upload_blob(self, content: str) -> str:

@@ -371,7 +371,7 @@ class _AlfredHandler(BaseHTTPRequestHandler):
             if (
                 parts[:1] != ["doc"]
                 or len(parts) < 3
-                or (len(parts) == 4 and parts[2] != "blob")
+                or (len(parts) == 4 and parts[2] not in ("blob", "git"))
                 or len(parts) > 4
             ):
                 self._json(404, {"error": "bad route"})
@@ -379,7 +379,17 @@ class _AlfredHandler(BaseHTTPRequestHandler):
             doc = self._doc(server, parts[1])
             if doc is None:
                 return
-            if len(parts) == 4:  # /doc/<id>/blob/<blobId>
+            if len(parts) == 4 and parts[2] == "git":
+                # /doc/<id>/git/<sha>: raw git object read (historian's
+                # object surface; tree entries are child shas, so a client
+                # can walk subtrees without fetching the whole snapshot).
+                try:
+                    kind, payload = doc.read_git_object(parts[3])
+                except KeyError:
+                    self._json(404, {"error": "no such object"})
+                    return
+                self._json(200, {"kind": kind, "payload": payload})
+            elif len(parts) == 4:  # /doc/<id>/blob/<blobId>
                 try:
                     self._json(200, {"content": doc.read_blob(parts[3])})
                 except KeyError:

@@ -1,0 +1,135 @@
+"""Git-tree summary storage (ref historian -> gitrest; SURVEY §2.5
+"summaries stored as git trees"): content-addressed blobs/trees, physical
+structural sharing across versions, partial subtree reads, and the HTTP
+object surface."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fluidframework_tpu.server.gitstore import GitSnapshotStore, GitStore
+
+
+def test_content_addressing_and_dedup():
+    g = GitStore()
+    a = g.put_blob({"x": 1})
+    b = g.put_blob({"x": 1})
+    assert a == b and len(g) == 1
+    t1 = g.put_tree({"left": a})
+    t2 = g.put_tree({"left": b})
+    assert t1 == t2 and len(g) == 2
+    with pytest.raises(KeyError):
+        g.put_tree({"child": "0" * 64})  # dangling reference rejected
+
+
+def test_snapshot_roundtrip_and_partial_read():
+    g = GitStore()
+    plain = {"runtime": {"datastores": {"root": {"text": "hello"}},
+                         "seq": 7},
+             "protocol": {"members": []}}
+    root = g.write_snapshot(plain)
+    assert g.read_snapshot(root) == plain
+    # Virtualized partial fetch: one subtree, not the whole snapshot.
+    assert g.read_path(root, "runtime/datastores/root") == {"text": "hello"}
+    assert g.read_path(root, "runtime/seq") == 7
+    with pytest.raises(KeyError):
+        g.read_path(root, "runtime/nope")
+
+
+def test_structural_sharing_across_versions():
+    """Version N+1 changing one leaf stores only the changed spine; every
+    untouched subtree is the SAME object."""
+    chain = GitSnapshotStore()
+    base = {
+        "datastores": {
+            f"ds{i}": {"channels": {"c": {"data": list(range(20))}}}
+            for i in range(8)
+        },
+        "seq": 1,
+    }
+    chain.save(1, base)
+    stored_v1 = chain.store.stored
+    v2 = json.loads(json.dumps(base))
+    v2["seq"] = 2
+    v2["datastores"]["ds3"]["channels"]["c"]["data"][0] = 999
+    chain.save(2, v2)
+    new_objects = chain.store.stored - stored_v1
+    # Changed: seq blob, ds3 leaf+channel+datastore trees, datastores tree,
+    # root tree, the commit — a handful, NOT all 8 datastores re-uploaded.
+    assert new_objects <= 8, new_objects
+    assert chain.sharing_ratio() > 0.4
+    assert chain.latest() == (2, v2)
+    v1_commit = chain.versions[0][1]
+    assert chain.at(v1_commit) == (1, base)
+
+
+def test_local_document_versions_are_git_refs():
+    from fluidframework_tpu.server import LocalService
+
+    svc = LocalService()
+    doc = svc.document("d")
+    doc.save_snapshot(1, {"a": {"b": 1}, "c": 2})
+    doc.save_snapshot(2, {"a": {"b": 1}, "c": 3})  # "a" shared physically
+    versions = doc.snapshot_versions()
+    assert len(versions) == 2 and versions[0]["seq"] == 2
+    sha = versions[1]["id"]
+    assert len(sha) == 64  # git ref = COMMIT sha (unique per version)
+    assert sha != versions[0]["id"]
+    assert doc.snapshot_at(sha) == (1, {"a": {"b": 1}, "c": 2})
+    # The shared subtree is literally one object across both versions.
+    _k2, commit2 = doc.read_git_object(versions[0]["id"])
+    _k1, commit1 = doc.read_git_object(sha)
+    assert commit2["parent"] == sha and commit1["seq"] == 1
+    _t, tree2 = doc.read_git_object(commit2["tree"])
+    _t, tree1 = doc.read_git_object(commit1["tree"])
+    assert tree1["a"] == tree2["a"]
+    assert doc._snapshots.git.sharing_ratio() > 0
+
+
+def test_http_git_object_surface():
+    """historian object reads over real HTTP: walk the root tree to a
+    subtree without fetching the whole snapshot."""
+    from fluidframework_tpu.server.netserver import ServicePlane
+
+    plane = ServicePlane().start()
+    try:
+        with plane.nexus.lock:
+            doc = plane.service.document("d")
+            doc.save_snapshot(1, {"runtime": {"x": 41}, "protocol": {}})
+        root = doc.snapshot_versions()[0]["id"]
+        base = f"http://127.0.0.1:{plane.http.port}/doc/d/git"
+
+        def fetch(sha):
+            with urllib.request.urlopen(f"{base}/{sha}") as r:
+                return json.load(r)
+
+        commit = fetch(root)
+        assert commit["kind"] == "commit" and commit["payload"]["seq"] == 1
+        obj = fetch(commit["payload"]["tree"])
+        assert obj["kind"] == "tree" and set(obj["payload"]) == {"runtime", "protocol"}
+        rt = fetch(obj["payload"]["runtime"])
+        leaf = fetch(rt["payload"]["x"])
+        assert leaf == {"kind": "blob", "payload": 41}
+        # Unknown object: 404.
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/{'0' * 64}")
+    finally:
+        plane.stop()
+
+
+def test_read_results_are_isolated_from_the_store():
+    """Mutating a read snapshot (or the input after save) must never reach
+    the shared immutable objects other versions alias."""
+    chain = GitSnapshotStore()
+    original = {"a": {"items": [1, 2]}}
+    chain.save(1, original)
+    original["a"]["items"].append(99)  # caller mutates its input post-save
+    chain.save(2, {"a": {"items": [1, 2]}})  # identical content as v1
+    got_seq, got = chain.latest()
+    got["a"]["items"].append(777)      # caller mutates a read result
+    assert chain.at(chain.versions[0][1]) == (1, {"a": {"items": [1, 2]}})
+    assert chain.latest() == (2, {"a": {"items": [1, 2]}})
