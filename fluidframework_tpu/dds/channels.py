@@ -667,6 +667,7 @@ def default_registry() -> dict[str, Any]:
     """Type string -> factory map covering the full DDS family (ref
     ISharedObjectRegistry + the fluid-framework re-export surface)."""
     from .extras import EXTRA_DDS_FACTORIES
+    from .ot import SharedJsonOTFactory
     from .shared_matrix import SharedMatrixFactory
     from .small import SMALL_DDS_FACTORIES
     from .tree import SharedTreeFactory
@@ -679,4 +680,5 @@ def default_registry() -> dict[str, Any]:
     out.update(SMALL_DDS_FACTORIES)
     out.update(EXTRA_DDS_FACTORIES)
     out[SharedMatrixFactory.channel_type] = SharedMatrixFactory
+    out[SharedJsonOTFactory.channel_type] = SharedJsonOTFactory
     return out

@@ -71,6 +71,9 @@ class ContainerRuntime:
         # (client_id) per sequenced LEAVE — audience-departure consumers
         # (presence attendee tracking) that aren't channels.
         self.member_left_listeners: list = []
+        # listener(touched: set[(datastore_id, channel_id)]) after each
+        # processed inbound batch — the view-binding invalidation feed.
+        self.op_processed_listeners: list = []
         self.rejected_proposals: list[dict] = []
         # Summarization state (runtime/summary.py): ops since the last acked
         # summary drive the RunningSummarizer heuristics; last_summary_ref_seq
@@ -527,6 +530,7 @@ class ContainerRuntime:
 
         # Bunch contiguous same-datastore messages (containerRuntime.ts:3428).
         self._processing_inbound = True
+        touched: set[tuple[str, str]] = set()
         try:
             env = MessageEnvelope(
                 client_id=msg.client_id,
@@ -534,23 +538,33 @@ class ContainerRuntime:
                 min_seq=msg.min_seq,
                 ref_seq=msg.ref_seq,
             )
+
+            def dispatch(addr, run):
+                if addr == RUNTIME_ADDRESS:
+                    self._handle_runtime_messages(env, run)
+                    return
+                if addr in self.gc_state.tombstoned:
+                    # Tombstone drop (ref GC tombstone routing): ops from a
+                    # stale client to a swept datastore are discarded.
+                    return
+                for contents, _local, _md in run:
+                    touched.add((addr, contents.get("address", "")))
+                self._datastores[addr].process_messages(env, run)
+
             bunch_contiguous(
                 (
                     (m.contents["address"], (m.contents["contents"], local, md))
                     for m, md in zipped
                 ),
-                lambda addr, run: (
-                    self._handle_runtime_messages(env, run)
-                    if addr == RUNTIME_ADDRESS
-                    else None
-                    if addr in self.gc_state.tombstoned
-                    # Tombstone drop (ref GC tombstone routing): ops from a
-                    # stale client to a swept datastore are discarded.
-                    else self._datastores[addr].process_messages(env, run)
-                ),
+                dispatch,
             )
         finally:
             self._processing_inbound = False
+        if touched:
+            # View-binding invalidation (framework/bindings.py): which
+            # (datastore, channel) addresses this batch changed.
+            for fn in list(self.op_processed_listeners):
+                fn(touched)
 
     # --------------------------------------------------------------- reconnect
     def _replay_pending(self) -> None:

@@ -132,7 +132,7 @@ class GitSnapshotStore:
         self.versions.append((seq, commit))
         return commit
 
-    def _read_commit(self, commit_sha: str) -> tuple[int, dict]:
+    def read_commit(self, commit_sha: str) -> tuple[int, dict]:
         kind, payload = self.store.get(commit_sha)
         if kind != "commit":
             raise KeyError(f"{commit_sha[:12]} is a {kind}, not a commit")
@@ -141,12 +141,12 @@ class GitSnapshotStore:
     def latest(self) -> tuple[int, dict] | None:
         if not self.versions:
             return None
-        return self._read_commit(self.versions[-1][1])
+        return self.read_commit(self.versions[-1][1])
 
     def at(self, commit_sha: str) -> tuple[int, dict] | None:
         for _seq, commit in reversed(self.versions):
             if commit == commit_sha:
-                return self._read_commit(commit)
+                return self.read_commit(commit)
         return None
 
     def version_ids(self, max_count: int = 5) -> list[dict]:

@@ -45,7 +45,7 @@ class _SnapshotChain:
 
     def __getitem__(self, i: int) -> tuple[int, dict]:
         seq, commit = self.git.versions[i]
-        return seq, self.git._read_commit(commit)[1]
+        return seq, self.git.read_commit(commit)[1]
 
     @property
     def last_seq(self) -> int:
@@ -255,7 +255,7 @@ class LocalDocument:
         # matching version wins).
         for seq, commit in reversed(self._snapshots.git.versions):
             if str(seq) == version_id:
-                return self._snapshots.git._read_commit(commit)
+                return self._snapshots.git.read_commit(commit)
         return None
 
     def read_git_object(self, sha: str) -> tuple[str, Any]:
@@ -323,7 +323,10 @@ class LocalDocument:
         try:
             plain = materialize(tree, prev)
             self.save_snapshot(ref_seq, plain)
-        except ValueError as e:
+        except (ValueError, TypeError) as e:
+            # TypeError: the git store canonicalizes to JSON — a summary
+            # carrying non-serializable content must NACK, never crash the
+            # delivery loop.
             self._pending.append(
                 self.sequencer.mint_service(
                     MessageType.SUMMARY_NACK, {"handle": handle, "error": str(e)}

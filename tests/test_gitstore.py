@@ -133,3 +133,25 @@ def test_read_results_are_isolated_from_the_store():
     got["a"]["items"].append(777)      # caller mutates a read result
     assert chain.at(chain.versions[0][1]) == (1, {"a": {"items": [1, 2]}})
     assert chain.latest() == (2, {"a": {"items": [1, 2]}})
+
+
+def test_scribe_nacks_non_serializable_summary():
+    """A summary whose materialized content cannot canonicalize to JSON
+    must NACK, never crash delivery (the git store's TypeError path)."""
+    from fluidframework_tpu.server import LocalService
+
+    svc = LocalService()
+    doc = svc.document("d")
+    seen = []
+    doc.connect("w", seen.append)
+    doc.process_all()
+    h = doc.upload_summary({"type": "blob", "content": {1: "a", "b": 2}})
+    from fluidframework_tpu.protocol.messages import MessageType, UnsequencedMessage
+
+    doc.submit(UnsequencedMessage(
+        client_id="w", client_seq=1, ref_seq=1,
+        type=MessageType.SUMMARIZE, contents={"handle": h, "refSeq": 1},
+    ))
+    doc.process_all()  # must not raise
+    assert any(m.type == MessageType.SUMMARY_NACK for m in seen)
+    assert doc.latest_snapshot() is None

@@ -1,0 +1,159 @@
+"""OT DDS family (ref experimental/dds/ot: SharedOT + SharedJson1).
+
+The other merge model: transform-based integration over a sequenced-op
+window.  Directed transform semantics plus randomized multi-client
+convergence fuzz through the full container stack.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.dds.ot import _transform_json
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+
+def host(n_clients: int):
+    svc = LocalService()
+    doc = svc.document("d")
+    rts = []
+    for i in range(n_clients):
+        rt = ContainerRuntime(default_registry(), container_id=f"c{i}")
+        rt.create_datastore("root").create_channel("sharedJsonOT", "j")
+        rt.connect(doc, f"c{i}")
+        rts.append(rt)
+    doc.process_all()
+    chans = [rt.datastore("root").get_channel("j") for rt in rts]
+
+    def settle():
+        for rt in rts:
+            rt.flush()
+        doc.process_all()
+
+    return doc, rts, chans, settle
+
+
+# ------------------------------------------------------------- transform unit
+
+def T(t, p, v=None):
+    op = {"t": t, "p": p}
+    if v is not None:
+        op["v"] = v
+    return op
+
+
+def test_transform_list_index_shifts():
+    # Earlier insert below -> shift right.
+    assert _transform_json(T("replace", [2], 9), T("insert", [0], 5))["p"] == [3]
+    # Earlier remove below -> shift left.
+    assert _transform_json(T("replace", [2], 9), T("remove", [0]))["p"] == [1]
+    # Earlier insert at SAME index: left priority, input lands after.
+    assert _transform_json(T("insert", [1], 9), T("insert", [1], 5))["p"] == [2]
+    # Earlier ops above the index: untouched.
+    assert _transform_json(T("replace", [2], 9), T("insert", [5], 5))["p"] == [2]
+
+
+def test_transform_subtree_annihilation():
+    # Edit inside a removed subtree dies.
+    assert _transform_json(T("replace", [1, "x"], 9), T("remove", [1])) is None
+    # Remove of the removed element dies too.
+    assert _transform_json(T("remove", [1]), T("remove", [1])) is None
+    # Insert at the removed SLOT survives (names a gap, not the element).
+    assert _transform_json(T("insert", [1], 9), T("remove", [1]))["p"] == [1]
+    # Edit inside a REPLACED subtree dies; replace of same path survives
+    # (later sequencing wins).
+    assert _transform_json(T("replace", [1, "x"], 9), T("replace", [1], {})) is None
+    assert _transform_json(T("replace", [1], 9), T("replace", [1], 0))["p"] == [1]
+
+
+# --------------------------------------------------------------- end to end
+
+def test_concurrent_list_inserts_converge():
+    doc, rts, (a, b, c), settle = host(3)
+    a.replace([], [])           # document = []
+    settle()
+    a.insert([0], "a0")
+    b.insert([0], "b0")
+    c.insert([0], "c0")
+    settle()
+    assert a.get() == b.get() == c.get()
+    assert sorted(a.get()) == ["a0", "b0", "c0"]
+
+
+def test_concurrent_remove_and_edit():
+    doc, rts, (a, b), settle = host(2)
+    a.replace([], {"items": [1, 2, 3], "meta": {"n": 0}})
+    settle()
+    a.remove(["items", 1])          # drop the 2
+    b.replace(["items", 1], 22)     # concurrently edit it
+    settle()
+    # The edit targeted a concurrently removed element: annihilated.
+    assert a.get() == b.get() == {"items": [1, 3], "meta": {"n": 0}}
+
+
+def test_pending_ops_transform_over_remote():
+    doc, rts, (a, b), settle = host(2)
+    a.replace([], ["x", "y"])
+    settle()
+    # b holds a PENDING edit of index 1 while a's insert at 0 sequences.
+    b.replace([1], "Y")   # pending
+    a.insert([0], "w")
+    rts[0].flush()
+    doc.process_all()      # a's op arrives at b; b's op still pending
+    assert b.get()[2] == "Y"  # optimistic view already re-targeted
+    settle()
+    assert a.get() == b.get() == ["w", "x", "Y"]
+
+
+def test_summary_roundtrip_and_late_joiner():
+    doc, rts, (a,), settle = host(1)
+    a.replace([], {"k": [1, 2]})
+    settle()
+    summary = rts[0].summarize()
+    late = ContainerRuntime(default_registry(), container_id="late")
+    late.load_snapshot(summary)
+    lc = late.datastore("root").get_channel("j")
+    assert lc.get() == {"k": [1, 2]}
+    late.connect(doc, "late")
+    doc.process_all()
+    a.insert(["k", 0], 0)
+    settle()
+    assert lc.get() == a.get() == {"k": [0, 1, 2]}
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_ot_convergence_fuzz(seed):
+    """Random concurrent list/object edits with partial delivery: every
+    replica converges (TP1 exercised across the sequenced window)."""
+    rng = random.Random(seed)
+    doc, rts, chans, settle = host(3)
+    chans[0].replace([], {"list": [0], "obj": {}})
+    settle()
+
+    def random_op(ch):
+        state = ch.get()
+        lst = state["list"]
+        kind = rng.random()
+        if kind < 0.45:
+            ch.insert(["list", rng.randint(0, len(lst))], rng.randrange(100))
+        elif kind < 0.6 and len(lst) > 1:
+            ch.remove(["list", rng.randrange(len(lst))])
+        elif kind < 0.8 and lst:
+            ch.replace(["list", rng.randrange(len(lst))], rng.randrange(100))
+        else:
+            ch.replace(["obj", rng.choice("abc")], rng.randrange(100))
+
+    for _round in range(10):
+        for i, ch in enumerate(chans):
+            for _ in range(rng.randint(0, 2)):
+                random_op(ch)
+            if rng.random() < 0.6:
+                rts[i].flush()
+        doc.process_some(rng.randint(0, doc.pending_count))
+    settle()
+    states = [ch.get() for ch in chans]
+    assert states[0] == states[1] == states[2], states
