@@ -38,13 +38,19 @@ def _subscribe(listeners: list, fn) -> Callable[[], None]:
 class Presence:
     """One client's view of a presence workspace on a container."""
 
-    def __init__(self, container) -> None:
+    def __init__(self, container, clock=None) -> None:
+        import time
+
         self._container = container
+        # One clock domain per instance (tests inject a simulated clock).
+        self._clock = clock if clock is not None else time.monotonic
         self._client_id = container.runtime.client_id
         # state key -> client id -> value (latest received wins)
         self._remote: dict[str, dict[str, Any]] = {}
         self._local: dict[str, Any] = {}
         self._queue: dict[str, Any] = {}  # batched unflushed local sets
+        # Tightest queued update's flush-by time (allowableUpdateLatency).
+        self._flush_deadline: float | None = None
         self._listeners: list[Callable[[str, str, Any], None]] = []
         # Attendees: client ids seen on the presence fabric.
         self._attendees: set[str] = set()
@@ -79,13 +85,39 @@ class Presence:
             self._saw(client_id)
 
     # ------------------------------------------------------------------ write
-    def set(self, key: str, value: Any) -> None:
-        """Queue a local state update (batched; ref queued signal sends)."""
+    def set(self, key: str, value: Any,
+            allowed_latency_s: float | None = None,
+            now: float | None = None) -> None:
+        """Queue a local state update (batched; ref queued signal sends).
+
+        ``allowed_latency_s`` is the reference's allowableUpdateLatency
+        (presenceDatastoreManager.ts:473): the update may coalesce with
+        later ones, but must be on the wire within that window — ``tick``
+        flushes once the TIGHTEST queued deadline passes.  None = wait for
+        an explicit flush (or a tighter co-queued update's deadline).
+        ``now`` defaults to the presence CLOCK (constructor-injectable) so
+        simulated and wall clocks never mix within one instance."""
         self._local[key] = value
         self._queue[key] = value
+        if allowed_latency_s is not None:
+            now = self._clock() if now is None else now
+            deadline = now + allowed_latency_s
+            if self._flush_deadline is None or deadline < self._flush_deadline:
+                self._flush_deadline = deadline
+
+    def tick(self, now: float | None = None) -> bool:
+        """Flush iff a queued update's latency window has lapsed; returns
+        whether a signal went out (the host loop's timer hook)."""
+        now = self._clock() if now is None else now
+        if self._flush_deadline is not None and now >= self._flush_deadline:
+            had_updates = bool(self._queue)
+            self.flush()
+            return had_updates
+        return False
 
     def flush(self) -> None:
         """Broadcast queued updates as ONE signal (ref batch queue :473)."""
+        self._flush_deadline = None
         if not self._queue:
             return
         updates, self._queue = self._queue, {}
@@ -192,6 +224,7 @@ class Presence:
         """Announce departure (ref disconnect cleanup): peers drop our state."""
         self._container.submit_signal({"presence": "leave"})
         self._queue.clear()
+        self._flush_deadline = None  # nothing left to flush: no phantom tick
 
     def dispose(self) -> None:
         """Detach from the runtime (unregisters the LEAVE listener) and drop
@@ -226,11 +259,14 @@ class Latest:
     """One value per attendee (ref LatestRaw, latestTypes.ts): ``local``
     get/set, per-attendee remote reads, update events."""
 
-    def __init__(self, ws: "StatesWorkspace", key: str, initial: Any = None) -> None:
+    def __init__(self, ws: "StatesWorkspace", key: str, initial: Any = None,
+                 allowed_latency_s: float | None = None) -> None:
         self._p = ws._presence
         self._key = f"{_esc(ws.workspace_id)}:{_esc(key)}"
+        # Per-manager allowableUpdateLatency (ref latestTypes.ts settings).
+        self.allowed_latency_s = allowed_latency_s
         if initial is not None:
-            self._p.set(self._key, initial)
+            self._p.set(self._key, initial, allowed_latency_s)
 
     @property
     def local(self) -> Any:
@@ -238,7 +274,7 @@ class Latest:
 
     @local.setter
     def local(self, value: Any) -> None:
-        self._p.set(self._key, value)
+        self._p.set(self._key, value, self.allowed_latency_s)
 
     def get_remote(self, client_id: str) -> Any:
         return self._p.remote_states(self._key).get(client_id)
@@ -292,8 +328,9 @@ class StatesWorkspace:
         self._presence = presence
         self.workspace_id = workspace_id
 
-    def latest(self, key: str, initial: Any = None) -> Latest:
-        return Latest(self, key, initial)
+    def latest(self, key: str, initial: Any = None,
+               allowed_latency_s: float | None = None) -> Latest:
+        return Latest(self, key, initial, allowed_latency_s)
 
     def latest_map(self, key: str) -> LatestMap:
         return LatestMap(self, key)

@@ -391,3 +391,33 @@ def test_undo_manager_releases_listeners(client):
         fc.flush(); process(client)
     ur.dispose()
     assert t._converged_listeners == []
+
+
+def test_presence_latency_window_coalesces_updates(client):
+    """allowableUpdateLatency (ref presenceDatastoreManager.ts:473): rapid
+    updates coalesce into ONE signal, flushed when the tightest queued
+    deadline lapses — never before, never manually."""
+    fc1, _ = client.create_container(SCHEMA, "doc1")
+    process(client)
+    fc2, _ = client.get_container("doc1", SCHEMA)
+    process(client)
+    pa = Presence(fc1.container)
+    pb = Presence(fc2.container)
+    signals = []
+    fc2.container.on_signal(
+        lambda s: signals.append(s.contents)
+        if isinstance(s.contents, dict) and s.contents.get("presence") == "update"
+        else None
+    )
+    base = len(signals)
+    # Three rapid cursor moves within a 100ms window + one looser update.
+    pa.set("cursor", [1, 1], allowed_latency_s=0.1, now=0.0)
+    pa.set("cursor", [2, 2], allowed_latency_s=0.1, now=0.01)
+    pa.set("color", "red", allowed_latency_s=5.0, now=0.02)
+    assert not pa.tick(now=0.05)          # inside every window: no signal
+    assert len(signals) == base
+    assert pa.tick(now=0.11)              # cursor window lapsed: ONE signal
+    assert len(signals) == base + 1
+    assert signals[-1]["states"] == {"cursor": [2, 2], "color": "red"}
+    assert pb.states("cursor")[pa._my_id()] == [2, 2]
+    assert not pa.tick(now=10.0)          # queue drained: nothing more
