@@ -18,12 +18,14 @@ class OpStreamAttributor:
     """seq -> {client, timestamp} for every sequenced op observed."""
 
     def __init__(self) -> None:
-        self._entries: dict[int, tuple[str, float]] = {}
+        # seq -> (client, timestamp in INTEGER ms): one quantization, done
+        # at record time — re-deriving ms from a float at summarize time
+        # can disagree with the stored value by 1ms (float truncation), so
+        # the integer IS the stored truth everywhere.
+        self._entries: dict[int, tuple[str, int]] = {}
 
     def record(self, seq: int, client_id: str, timestamp: float) -> None:
-        # Quantize to milliseconds up front: the summary codec stores ms
-        # deltas, so reads stay identical across a summary roundtrip.
-        self._entries[seq] = (client_id, int(timestamp * 1000) / 1000)
+        self._entries[seq] = (client_id, int(timestamp * 1000))
 
     def observe(self, msg) -> None:
         """Feed one SequencedMessage (wire shape)."""
@@ -31,7 +33,7 @@ class OpStreamAttributor:
 
     def get(self, seq: int) -> dict[str, Any] | None:
         e = self._entries.get(seq)
-        return {"client": e[0], "timestamp": e[1]} if e else None
+        return {"client": e[0], "timestamp": e[1] / 1000} if e else None
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -50,13 +52,12 @@ class OpStreamAttributor:
         prev_seq = 0
         prev_ts = 0
         for s in seqs:
-            client, ts = self._entries[s]
+            client, ts_ms = self._entries[s]
             if client not in index:
                 index[client] = len(clients)
                 clients.append(client)
             seq_deltas.append(s - prev_seq)
             prev_seq = s
-            ts_ms = int(ts * 1000)
             ts_deltas.append(ts_ms - prev_ts)
             prev_ts = ts_ms
             client_ids.append(index[client])
@@ -76,9 +77,13 @@ class OpStreamAttributor:
         ):
             seq += d_seq
             ts_ms += d_ts
-            self._entries[seq] = (data["clients"][ci], ts_ms / 1000)
+            self._entries[seq] = (data["clients"][ci], ts_ms)
 
     def trim(self, min_seq: int) -> None:
-        """Drop entries at or below the collab-window floor (long sessions
-        keep attribution for summarized state via the summary roundtrip)."""
+        """Drop entries at or below the collab-window floor — a HOST POLICY
+        hook, deliberately not automatic: attribution keys on long-lived
+        content reference arbitrarily old seqs, so the default (like the
+        reference's attributor) retains the full table and lets summaries
+        carry it; hosts that only need in-window attribution bound memory
+        here."""
         self._entries = {s: e for s, e in self._entries.items() if s > min_seq}

@@ -79,6 +79,7 @@ class Container:
         client_id: str,
         stash: str | None = None,
         mode: str = "write",
+        track_attribution: bool = False,
         _summarizer: bool = False,
     ) -> "Container":
         """Boot from the service: latest snapshot + trailing ops + live
@@ -95,7 +96,14 @@ class Container:
             )
         service = service_factory.create_document_service(doc_id)
         storage = service.connect_to_storage()
-        runtime = ContainerRuntime(registry, container_id=client_id)
+        # Like the reference's mixinAttributor, attribution tracking is a
+        # runtime OPTION that must be configured uniformly across a
+        # document's clients; snapshots carrying an attribution table also
+        # enable it on loaders regardless of their own option.
+        runtime = ContainerRuntime(
+            registry, container_id=client_id,
+            track_attribution=track_attribution,
+        )
         protocol = ProtocolHandler()
         snap = storage.get_latest_snapshot()
         base_seq = 0
@@ -121,10 +129,20 @@ class Container:
 
     # ------------------------------------------------- detached create/attach
     @staticmethod
-    def create_detached(registry: dict[str, Any], container_id: str = "detached") -> "Container":
+    def create_detached(
+        registry: dict[str, Any],
+        container_id: str = "detached",
+        track_attribution: bool = False,
+    ) -> "Container":
         """A container with no service: build structure + edit locally;
         everything parks as pending until attach (ref createDetached :382)."""
-        return Container(ContainerRuntime(registry, container_id=container_id), registry)
+        return Container(
+            ContainerRuntime(
+                registry, container_id=container_id,
+                track_attribution=track_attribution,
+            ),
+            registry,
+        )
 
     def attach(
         self,

@@ -44,7 +44,12 @@ RUNTIME_ADDRESS = "__runtime__"
 class ContainerRuntime:
     """One collaborative container: datastores + op lifecycle + connection."""
 
-    def __init__(self, registry: dict[str, Any], container_id: str = "container") -> None:
+    def __init__(
+        self,
+        registry: dict[str, Any],
+        container_id: str = "container",
+        track_attribution: bool = False,
+    ) -> None:
         self.id = container_id
         self._registry = registry
         self._datastores: dict[str, DataStoreRuntime] = {}
@@ -74,6 +79,15 @@ class ContainerRuntime:
         # listener(touched: set[(datastore_id, channel_id)]) after each
         # processed inbound batch — the view-binding invalidation feed.
         self.op_processed_listeners: list = []
+        # Runtime attributor (ref framework/attributor mixinAttributor):
+        # seq -> {client, timestamp} recorded from the sequenced stream,
+        # summarized interned+delta-encoded, restored on load.
+        if track_attribution:
+            from ..framework.attributor import OpStreamAttributor
+
+            self.attributor = OpStreamAttributor()
+        else:
+            self.attributor = None
         self.rejected_proposals: list[dict] = []
         # Summarization state (runtime/summary.py): ops since the last acked
         # summary drive the RunningSummarizer heuristics; last_summary_ref_seq
@@ -425,6 +439,11 @@ class ContainerRuntime:
             self.flush()
         if self._stash is not None and msg.seq > self._stash["refSeq"]:
             self._maybe_apply_stash(catch_up_done=False)
+        if self.attributor is not None and msg.type == MessageType.OP:
+            # Runtime attribution (ref mixinAttributor/runtimeAttributor):
+            # every sequenced op records {client, timestamp}; DDS-level
+            # attribution keys (seqs) resolve through this table.
+            self.attributor.observe(msg)
         self.ref_seq = msg.seq
         new_min = msg.min_seq > self.min_seq
         self.min_seq = max(self.min_seq, msg.min_seq)
@@ -653,7 +672,7 @@ class ContainerRuntime:
         """Runtime state checkpoint: quorum short-id table + every datastore
         (ref ContainerRuntime.summarize; incremental tree walk lives in
         runtime/summary.py)."""
-        return {
+        out = {
             "seq": self.ref_seq,
             "minSeq": self.min_seq,
             "quorum": dict(self._quorum),
@@ -661,6 +680,9 @@ class ContainerRuntime:
             "blobs": self.blobs.summarize(),
             "gc": self.gc_state.to_json(),
         }
+        if self.attributor is not None:
+            out["attribution"] = self.attributor.summarize()
+        return out
 
     def load_snapshot(self, summary: dict[str, Any]) -> None:
         """Boot from a checkpoint (ref Container.load snapshot path). Must be
@@ -675,6 +697,13 @@ class ContainerRuntime:
         self._quorum = dict(summary["quorum"])
         self.blobs.load(summary.get("blobs", {}))
         self.gc_state = GCState.from_json(summary.get("gc", {}))
+        if "attribution" in summary:
+            # A snapshot carrying attribution implies the document tracks
+            # it: enable and restore regardless of this client's option.
+            from ..framework.attributor import OpStreamAttributor
+
+            self.attributor = OpStreamAttributor()
+            self.attributor.load(summary["attribution"])
         for ds_id, ds_summary in summary["datastores"].items():
             self.create_datastore(ds_id).load(ds_summary)
 
@@ -690,23 +719,24 @@ class ContainerRuntime:
         from .summary import blob, tree
 
         covered = self.last_summary_ref_seq
-        return tree(
-            {
-                "seq": blob(self.ref_seq),
-                "minSeq": blob(self.min_seq),
-                "quorum": blob(dict(self._quorum)),
-                "blobs": blob(self.blobs.summarize()),
-                "gc": blob(self.gc_state.to_json()),
-                "datastores": tree(
-                    {
-                        ds_id: ds.summary_tree(
-                            covered, f"runtime/datastores/{ds_id}"
-                        )
-                        for ds_id, ds in self._datastores.items()
-                    }
-                ),
-            }
-        )
+        entries = {
+            "seq": blob(self.ref_seq),
+            "minSeq": blob(self.min_seq),
+            "quorum": blob(dict(self._quorum)),
+            "blobs": blob(self.blobs.summarize()),
+            "gc": blob(self.gc_state.to_json()),
+            "datastores": tree(
+                {
+                    ds_id: ds.summary_tree(
+                        covered, f"runtime/datastores/{ds_id}"
+                    )
+                    for ds_id, ds in self._datastores.items()
+                }
+            ),
+        }
+        if self.attributor is not None:
+            entries["attribution"] = blob(self.attributor.summarize())
+        return tree(entries)
 
     # ------------------------------------------------------------------- stash
     def get_pending_local_state(self) -> str:

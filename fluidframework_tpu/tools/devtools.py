@@ -46,6 +46,9 @@ def visualize_channel(channel) -> dict[str, Any]:
             out["forest"] = channel.forest.to_json()
         elif ctype == "sharedCell":
             out["value"] = channel.get()
+        elif ctype == "sharedJsonOT":
+            out["doc"] = channel.get()
+            out["pendingOps"] = len(channel._pending)
         elif ctype == "sharedDirectory":
             def walk(path: str) -> dict:
                 node: dict[str, Any] = {

@@ -225,3 +225,47 @@ def test_attribution_oracle_kernel_agreement_under_fuzz():
         assert len(runs) == 1, f"attribution divergence: {runs}"
     finally:
         ch_mod.set_string_backend_factory(None)
+
+
+def test_runtime_attributor_rides_summary_cycle():
+    """The container-level attributor (ref mixinAttributor): sequenced ops
+    record {client, timestamp}; the table rides summaries interned, late
+    joiners restore it and resolve per-segment attribution keys to users
+    — without opting in themselves."""
+    from fluidframework_tpu.driver import LocalDocumentServiceFactory
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.runtime.summary import SummaryConfig
+
+    svc = LocalService()
+    factory = LocalDocumentServiceFactory(svc)
+    d = Container.create_detached(
+        default_registry(), container_id="alice", track_attribution=True
+    )
+    d.runtime.create_datastore("root").create_channel("sharedString", "text")
+    d.attach("doc", factory, "alice")
+    svc.process_all()
+    sm = d.make_summary_manager(SummaryConfig(max_ops=1))
+
+    ch = d.runtime.datastore("root").get_channel("text")
+    ch.insert_text(0, "hers")
+    d.runtime.flush()
+    svc.process_all()
+    b = Container.load("doc", factory, default_registry(), "bob")
+    svc.process_all()
+    bch = b.runtime.datastore("root").get_channel("text")
+    bch.insert_text(0, "his-")
+    b.runtime.flush()
+    svc.process_all()
+    assert sm.tick(now=0.0)
+    svc.process_all()
+    assert sm.acked == 1
+
+    late = Container.load("doc", factory, default_registry(), "carol")
+    svc.process_all()
+    assert late.runtime.attributor is not None  # restored from the snapshot
+    lch = late.runtime.datastore("root").get_channel("text")
+    assert lch.text == "his-hers"
+    who = lambda pos: late.runtime.attributor.get(
+        lch.attribution_at(pos)["seq"]
+    )["client"]
+    assert who(0) == "bob" and who(4) == "alice"
