@@ -42,6 +42,10 @@ class FleetConsumer:
         self._tails: list[bytes] = [b"" for _ in doc_ids]
         self.rows_staged = 0
         self.bytes_consumed = 0
+        # Doc indices whose firehose socket the SERVER closed (shard
+        # restart/shutdown): the consumer is dead for those docs and its
+        # supervisor should restart it.
+        self.dead_socks: set[int] = set()
         try:
             for doc_id in doc_ids:
                 s = socket.create_connection((host, port), timeout=30)
@@ -78,7 +82,11 @@ class FleetConsumer:
                     data = sock.recv(self._recv_bytes)
                 except (TimeoutError, socket.timeout):
                     break
-                if not data:
+                except OSError:
+                    self.dead_socks.add(idx)
+                    break
+                if not data:  # orderly close: the shard went away
+                    self.dead_socks.add(idx)
                     break
                 chunks.append(data)
                 if len(data) < self._recv_bytes:

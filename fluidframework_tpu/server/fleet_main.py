@@ -1,0 +1,104 @@
+"""Runnable device-fleet consumer: the deployable TPU application tier.
+
+One process per shard in a deployment (deploy/compose.yaml): consumes the
+netserver firehose for a document set into a batched device engine and
+steps it continuously — wire bytes to device with no per-op Python
+(server/fleet_consumer.py over models/doc_batch_engine.py).
+
+    python -m fluidframework_tpu.server.fleet_main \
+        --host 127.0.0.1 --port 7070 --docs doc0,doc1,doc2
+
+Emits one JSON status line per --status-every seconds (rows applied,
+bytes consumed, per-doc error flags) for process supervisors.
+``--exit-after-rows`` bounds the run (tests / draining restarts).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--docs", required=True, help="comma-separated doc ids")
+    p.add_argument("--capacity", type=int, default=4096)
+    p.add_argument("--text-capacity", type=int, default=65536)
+    p.add_argument("--ops-per-step", type=int, default=32)
+    p.add_argument("--max-insert-len", type=int, default=8)
+    p.add_argument("--idle-sleep", type=float, default=0.02)
+    p.add_argument("--status-every", type=float, default=10.0)
+    p.add_argument("--exit-after-rows", type=int, default=0)
+    p.add_argument("--recovery", choices=("grow", "oracle", "off"),
+                   default="grow")
+    args = p.parse_args(argv)
+
+    from ..models.doc_batch_engine import DocBatchEngine
+    from .fleet_consumer import FleetConsumer
+
+    doc_ids = [d for d in args.docs.split(",") if d]
+    eng = DocBatchEngine(
+        len(doc_ids),
+        max_segments=args.capacity,
+        text_capacity=args.text_capacity,
+        max_insert_len=args.max_insert_len,
+        ops_per_step=args.ops_per_step,
+        use_mesh=False,
+        recovery=args.recovery,
+    )
+    fc = FleetConsumer(args.host, args.port, eng, doc_ids)
+
+    def status(**extra) -> None:
+        errs = eng.errors()
+        out = {
+            "rows": fc.rows_staged,
+            "bytes": fc.bytes_consumed,
+            "errors": int(errs.sum()),
+            **extra,
+        }
+        if errs.any():
+            out["errorDocs"] = [
+                doc_ids[i] for i in range(len(doc_ids)) if errs[i]
+            ]
+        print(json.dumps(out), flush=True)
+
+    last_status = time.monotonic()
+    try:
+        while True:
+            staged = fc.pump()
+            if fc.dead_socks:
+                # A shard closed our firehose (restart/shutdown): exit
+                # nonzero so the supervisor restarts this tier — sleeping
+                # on dead sockets would look healthy while applying
+                # nothing forever.
+                fc.step()
+                status(disconnected=sorted(
+                    doc_ids[i] for i in fc.dead_socks
+                ))
+                return 1
+            if staged:
+                fc.step()
+            else:
+                time.sleep(args.idle_sleep)
+            now = time.monotonic()
+            if now - last_status >= args.status_every:
+                last_status = now
+                status()
+            if args.exit_after_rows and fc.rows_staged >= args.exit_after_rows:
+                status(
+                    texts={d: eng.text(i) for i, d in enumerate(doc_ids)},
+                    done=True,
+                )
+                return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        fc.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

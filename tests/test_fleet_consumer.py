@@ -124,3 +124,72 @@ def test_wire_to_device_fleet_with_live_tail(server):
         assert not eng.errors().any()
     finally:
         fc.close()
+
+
+def test_fleet_main_entry_cross_process(server):
+    """The deployable fleet entry (deploy/compose.yaml fleet tier): spawn
+    fleet_main as its OWN process against the TCP front; it consumes,
+    applies on device, reports status JSON, and exits at the row bound."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    writers = _writers(server, "dm", 2)
+    a, _b = writers
+    a.insert_text(0, "compose")
+    rows = _flush(server, "dm", writers)
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu');"
+         "from fluidframework_tpu.server.fleet_main import main;"
+         f"raise SystemExit(main(['--port', '{server.port}',"
+         f" '--docs', 'dm', '--exit-after-rows', '{rows}']))"],
+        capture_output=True, text=True, timeout=180, env=dict(os.environ),
+        cwd=repo_root,
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    status = json.loads(out.stdout.strip().splitlines()[-1])
+    assert status["done"] and status["errors"] == 0
+    assert status["texts"]["dm"] == "compose"
+
+
+def test_fleet_consumer_reports_dead_sockets_on_shard_close():
+    """The shard closing the firehose must surface as dead_socks (the
+    supervisor-restart signal), never as a silent healthy-looking idle.
+    Modeled with a minimal shard that closes right after the handshake —
+    the socket state a dying shard PROCESS leaves behind."""
+    import json as _json
+    import socket as _socket
+
+    lsock = _socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def serve():
+        conn, _ = lsock.accept()
+        conn.recv(4096)  # the consume request
+        conn.sendall(
+            (_json.dumps({"t": "consuming", "doc": "dx"}) + "\n").encode()
+        )
+        conn.close()  # shard dies
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    eng = DocBatchEngine(1, max_segments=64, text_capacity=512,
+                         max_insert_len=8, ops_per_step=4, use_mesh=False,
+                         recovery="off")
+    fc = FleetConsumer("127.0.0.1", port, eng, ["dx"])
+    try:
+        assert not fc.dead_socks
+        for _ in range(100):
+            fc.pump()
+            if fc.dead_socks:
+                break
+        assert fc.dead_socks == {0}
+    finally:
+        fc.close()
+        lsock.close()

@@ -322,3 +322,16 @@ def test_literature_corpus_farm_and_multichunk_snapshot():
     assert [sorted(d.items()) for d in orig] == [
         sorted(d.items()) for d in loaded
     ]
+
+
+@pytest.mark.skipif(
+    not os.environ.get("FFTPU_ALL_TRACES"),
+    reason="full issuer-faithful sweep is opt-in (FFTPU_ALL_TRACES=1)",
+)
+@pytest.mark.parametrize(
+    "path", TRACE_FILES, ids=[os.path.basename(p) for p in TRACE_FILES]
+)
+def test_issuer_faithful_replay_all_files(path):
+    """Opt-in exhaustive form of the issuer-faithful replay: every one of
+    the reference's 60 recorded files, full length (~75s total)."""
+    replay_trace(load_trace(path))
