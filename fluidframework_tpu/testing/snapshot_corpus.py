@@ -126,6 +126,16 @@ def _directory():
     return ch
 
 
+def _json_ot():
+    svc, doc, c, ch = _host("sharedJsonOT", "jdoc")
+    ch.replace([], {"items": [1, 2, 3], "meta": {"title": "pinned"}})
+    ch.insert(["items", 1], 99)
+    ch.remove(["items", 3])
+    ch.replace(["meta", "title"], "golden")
+    _settle(doc, c)
+    return ch
+
+
 SCRIPTS: dict[str, Callable[[], Any]] = {
     "sharedString": _string,
     "sharedMap": _map,
@@ -134,6 +144,7 @@ SCRIPTS: dict[str, Callable[[], Any]] = {
     "sharedCell": _cell,
     "sharedCounter": _counter,
     "sharedDirectory": _directory,
+    "sharedJsonOT": _json_ot,
 }
 
 
@@ -172,6 +183,8 @@ def extract_state(name: str, ch) -> dict:
             "top": {k: ch.get("", k) for k in sorted(ch.keys(""))},
             "sub": {k: ch.get("sub", k) for k in sorted(ch.keys("sub"))},
         }
+    if name == "sharedJsonOT":
+        return {"doc": ch.get()}
     raise KeyError(name)
 
 
