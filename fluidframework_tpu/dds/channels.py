@@ -14,6 +14,14 @@ import json
 from typing import Any
 
 from ..protocol.stamps import ALL_ACKED, encode_stamp
+from .markers import (
+    MARKER_ID_KEY,
+    REF_TILE,
+    TILE_LABELS_KEY,
+    assert_no_marker_plane,
+    marker_char,
+    marker_json,
+)
 from .mergetree_ref import SIDE_AFTER, SIDE_BEFORE, RefMergeTree
 from .sequence_intervals import (
     SENTINEL_POS,
@@ -110,6 +118,7 @@ class SharedStringChannel(Channel):
 
     def insert_text(self, pos: int, text: str) -> int:
         assert text
+        assert_no_marker_plane(text)
         ls = self._next_local_seq()
         self.backend.apply_insert(
             pos, text, encode_stamp(-1, ls), self.backend.local_client, ALL_ACKED
@@ -118,6 +127,49 @@ class SharedStringChannel(Channel):
             {"type": 0, "pos1": pos, "seg": text}, {"localSeq": ls}
         )
         return ls
+
+    def insert_marker(
+        self, pos: int, ref_type: int = REF_TILE, props: dict | None = None
+    ) -> int:
+        """Insert a length-1 marker segment (ref sharedString.ts:42
+        insertMarker, mergeTreeNodes.ts:495 Marker).  The marker and its
+        initial properties apply under ONE stamp, so ack/resubmit treat
+        them as the single op they are on the wire."""
+        ls = self._next_local_seq()
+        key = encode_stamp(-1, ls)
+        self._apply_insert_spec(
+            marker_json(ref_type, props), pos, key,
+            self.backend.local_client, ALL_ACKED,
+        )
+        self.submit_local_message(
+            {"type": 0, "pos1": pos, "seg": marker_json(ref_type, props)},
+            {"localSeq": ls},
+        )
+        return ls
+
+    def _apply_insert_spec(
+        self, seg, pos: int, key: int, client: int, ref_seq: int
+    ) -> list:
+        """Apply one wire insert spec (IJSONSegment: bare text, annotated
+        {text, props}, or marker {marker:{refType}, props}) to the backend.
+        Properties apply as (pos, pos+1) annotates in the SAME perspective:
+        the op's own segment is visible to (ref_seq, sender) — own ops have
+        occurred — so the range lands exactly on the inserted segment."""
+        if isinstance(seg, str):
+            text, props = seg, None
+        elif "marker" in seg:
+            text = marker_char(seg["marker"]["refType"])
+            props = seg.get("props")
+        else:
+            text, props = seg["text"], seg.get("props")
+        ins = self.backend.apply_insert(pos, text, key, client, ref_seq)
+        for name, value in (props or {}).items():
+            self.backend.apply_annotate(
+                pos, pos + len(text),
+                self._prop_id(name), self._val_id(value),
+                key, client, ref_seq,
+            )
+        return [ins]
 
     def remove_range(self, pos1: int, pos2: int) -> int:
         assert pos1 < pos2
@@ -155,7 +207,9 @@ class SharedStringChannel(Channel):
 
         s1 = SIDE_BEFORE if start[1] else SIDE_AFTER
         s2 = SIDE_BEFORE if end[1] else SIDE_AFTER
-        validate_obliterate_places(start[0], s1, end[0], s2, len(self.text))
+        validate_obliterate_places(
+            start[0], s1, end[0], s2, self.backend.visible_length()
+        )
         ls = self._next_local_seq()
         self.backend.apply_obliterate(
             start[0], s1, end[0], s2,
@@ -206,7 +260,11 @@ class SharedStringChannel(Channel):
         )
 
     def annotations(self) -> list[dict]:
-        """Per local-view character: resolved {key: value} property maps."""
+        """Per local-view POSITION: resolved {key: value} property maps.
+        Positions include markers (whose entry is the marker's own props),
+        so this list aligns with visible_length / insert positions, NOT
+        with ``text`` (which excludes markers) — the reference's
+        getPropertiesAtPosition is position-based the same way."""
         out = []
         for d in self.backend.annotations(
             ALL_ACKED, self.backend.local_client
@@ -215,6 +273,64 @@ class SharedStringChannel(Channel):
                 {self._prop_names[p]: self._val_raw[v] for p, v in d.items()}
             )
         return out
+
+    # --------------------------------------------------------------- markers
+    def _resolve_marker(self, pos: int, rt: int, props: dict) -> dict:
+        return {
+            "position": pos,
+            "refType": rt,
+            "props": {
+                self._prop_names[p]: self._val_raw[v]
+                for p, v in props.items()
+            },
+        }
+
+    def _raw_marker_prop(self, props: dict, name: str):
+        """One resolved property off a raw scan entry without materializing
+        the rest (queries over marker-heavy documents stay cheap)."""
+        pid = self._prop_ids.get(name)
+        return self._val_raw[props[pid]] if pid in props else None
+
+    def markers(self) -> list[dict]:
+        """Visible markers in the local view:
+        [{"position", "refType", "props"}] (resolved property maps)."""
+        return [
+            self._resolve_marker(pos, rt, props)
+            for pos, rt, props in self.backend.marker_scan(
+                ALL_ACKED, self.backend.local_client
+            )
+        ]
+
+    def get_marker_from_id(self, marker_id: str) -> dict | None:
+        """Marker with props[markerId] == id, or None (ref client.ts
+        getMarkerFromId via the marker-id hash)."""
+        for pos, rt, props in self.backend.marker_scan(
+            ALL_ACKED, self.backend.local_client
+        ):
+            if self._raw_marker_prop(props, MARKER_ID_KEY) == marker_id:
+                return self._resolve_marker(pos, rt, props)
+        return None
+
+    def search_for_marker(
+        self, pos: int, label: str, forwards: bool = True
+    ) -> dict | None:
+        """Nearest marker at-or-after (forwards) / at-or-before pos whose
+        referenceTileLabels include ``label`` — the reference's tile search
+        (client.ts searchForMarker / mergeTree searchForMarker)."""
+        best = None
+        for m in self.backend.marker_scan(
+            ALL_ACKED, self.backend.local_client
+        ):
+            if label not in (self._raw_marker_prop(m[2], TILE_LABELS_KEY) or []):
+                continue
+            if forwards:
+                if m[0] >= pos:
+                    return self._resolve_marker(*m)  # scan is position-ordered
+            elif m[0] <= pos:
+                best = m
+            else:
+                break
+        return self._resolve_marker(*best) if best is not None else None
 
     # ------------------------------------------------------- local references
     def create_local_reference(self, pos: int) -> LocalReference:
@@ -239,8 +355,10 @@ class SharedStringChannel(Channel):
         the LOCAL view (what the author sees when adding); converged-space
         lengths are passed explicitly at sequencing time."""
         if label not in self._collections:
+            # Length in POSITIONS (markers count), not text chars.
             self._collections[label] = IntervalCollection(
-                label, self._submit_interval_op, lambda: len(self.text)
+                label, self._submit_interval_op,
+                lambda: self.backend.visible_length(),
             )
         return self._collections[label]
 
@@ -322,11 +440,9 @@ class SharedStringChannel(Channel):
                     ref_seq=env.ref_seq,
                 )
             elif c["type"] == 0:
-                ins_segs = [
-                    self.backend.apply_insert(
-                        c["pos1"], c["seg"], env.seq, sender, env.ref_seq
-                    )
-                ]
+                ins_segs = self._apply_insert_spec(
+                    c["seg"], c["pos1"], env.seq, sender, env.ref_seq
+                )
             elif c["type"] == 1:
                 rem_segs = self.backend.apply_remove(
                     c["pos1"], c["pos2"], env.seq, sender, env.ref_seq
@@ -377,7 +493,7 @@ class SharedStringChannel(Channel):
             # genuine forward slide off a removed suffix still degrades to
             # the "end" sentinel exactly like finalize_op on connected
             # replicas.
-            n_local = len(self.text) if sided else 0
+            n_local = self.backend.visible_length() if sided else 0
             for k, sk in (("start", "startSide"), ("end", "endSide")):
                 if op.get(k) is None:
                     continue
@@ -417,6 +533,15 @@ class SharedStringChannel(Channel):
                     self._prop_names[int(p)]: self._val_raw[v]
                     for p, v in op["props"].items()
                 }
+            elif op.get("type") == 0 and isinstance(op.get("seg"), dict):
+                # Marker / annotated-insert spec: resolve its prop ids too.
+                op = dict(op)
+                seg = dict(op["seg"])
+                seg["props"] = {
+                    self._prop_names[int(p)]: self._val_raw[v]
+                    for p, v in seg.get("props", {}).items()
+                }
+                op["seg"] = seg
             self.submit_local_message(op, {"localSeq": fresh_ls})
 
     def apply_stashed(self, contents: Any) -> Any:
@@ -432,7 +557,7 @@ class SharedStringChannel(Channel):
         key = encode_stamp(-1, ls)
         short = self.backend.local_client
         if c["type"] == 0:
-            self.backend.apply_insert(c["pos1"], c["seg"], key, short, ALL_ACKED)
+            self._apply_insert_spec(c["seg"], c["pos1"], key, short, ALL_ACKED)
         elif c["type"] == 1:
             self.backend.apply_remove(c["pos1"], c["pos2"], key, short, ALL_ACKED)
         elif c["type"] == 2:

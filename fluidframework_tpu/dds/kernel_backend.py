@@ -317,6 +317,39 @@ class KernelMergeTree:
         inv = {v: k for k, v in self._prop_slot.items()}
         return [{inv[p]: v for p, v in d.items()} for d in raw]
 
+    def marker_scan(
+        self, ref_seq: int = ALL_ACKED, view_client: int | None = None
+    ) -> list[tuple[int, int, dict]]:
+        """Visible markers as (position, refType, {prop_id: value_id}) —
+        same shape as RefMergeTree.marker_scan (markers are ordinary
+        1-char segments in the columns; only this host query decodes
+        them).  The device readback is cached per state object — state is
+        replaced on every mutation, so repeated queries against an
+        unchanged replica (id lookup, tile search) cost one readback."""
+        from .markers import is_marker_text, marker_ref_type
+
+        vc = self.local_client if view_client is None else view_client
+        cached = getattr(self, "_marker_cache", None)
+        if cached is not None and cached[0] is self.state and cached[1] == (
+            ref_seq, vc,
+        ):
+            return cached[2]
+        inv = {v: k for k, v in self._prop_slot.items()}
+        out: list[tuple[int, int, dict]] = []
+        pos = 0
+        for seg in self._segs(with_text=True):
+            if not seg.visible(ref_seq, vc):
+                continue
+            if is_marker_text(seg.text):
+                out.append((
+                    pos,
+                    marker_ref_type(seg.text),
+                    {inv[p]: v for p, (v, _k) in seg.props.items()},
+                ))
+            pos += seg.length
+        self._marker_cache = (self.state, (ref_seq, vc), out)
+        return out
+
     def attribution_runs(
         self, ref_seq: int = ALL_ACKED, view_client: int | None = None
     ):
@@ -525,10 +558,17 @@ class KernelMergeTree:
             if self._visible_at_prefix(seg, key, exclude_key=-1, squash=squash):
                 pos += seg.length
         if ins_pos >= 0:
-            plans.append(
-                (0, ins_pos, -1, "".join(s.text for s in ins_segs),
-                 {s.uid for s in ins_segs})
-            )
+            from .markers import regenerated_insert_spec
+
+            spec = regenerated_insert_spec([
+                (s.text, {
+                    str(inv_prop[p]): v
+                    for p, (v, k) in s.props.items()
+                    if k == key
+                })
+                for s in ins_segs
+            ])
+            plans.append((0, ins_pos, -1, spec, {s.uid for s in ins_segs}))
 
         # Pending remove / annotate: maximal visible runs carrying the stamp.
         pos = 0
@@ -585,6 +625,8 @@ class KernelMergeTree:
             self._regenerated_keys.add(fresh_key)
             if kind == 0:
                 self._restamp(uids, key, fresh_key, new_client, "ins")
+                # Same-op props (insertMarker) re-mint with the insert.
+                self._restamp(uids, key, fresh_key, None, "prop")
                 out.append((fresh, {"type": 0, "pos1": pos1, "seg": payload}))
             elif kind == 1:
                 self._restamp(uids, key, fresh_key, new_client, "rem")

@@ -1,0 +1,102 @@
+"""Marker segments: zero-text, length-1 position anchors in a sequence.
+
+Reference parity: ``Marker`` (merge-tree/src/mergeTreeNodes.ts:495) is a
+length-1 segment carrying a ``ReferenceType`` bitmask and properties
+(``markerId``, ``referenceTileLabels``, ...); SharedString inserts them via
+``insertMarker`` (sequence/src/sharedString.ts:42) and queries them with
+``getMarkerFromId`` / ``searchForMarker``.  Markers occupy one POSITION in
+the sequence (getLength counts them) but contribute no TEXT (getText skips
+them) — they are how real documents express paragraph/table structure.
+
+TPU-first design: a marker is encoded as ONE CODEPOINT in the Unicode
+private-use plane — ``chr(0xE000 + refType)``.  That single decision makes
+markers first-class across the whole stack with no new columns anywhere:
+
+- the columnar kernel stores the codepoint in its text pool like any other
+  char; every position/visibility/tie-break/obliterate rule applies
+  unchanged (a marker IS a 1-char segment);
+- marker-ness survives summaries, reconnect regeneration and squash,
+  because it lives in the content itself, not in side metadata;
+- text materialization filters the plane (``strip_markers``), so getText
+  semantics match the reference exactly while getLength still counts them.
+
+The plane U+E000..U+F8FF is therefore RESERVED: user text may not contain
+it (SharedString.insert_text asserts).  ReferenceType bitmasks
+(ops.ts ReferenceType: Simple=0, Tile=1, ...) fit comfortably.
+
+Marker properties ride the ordinary annotate machinery: an insertMarker op
+applies the marker segment insert and its initial properties under ONE
+stamp, so LWW/resubmit/summary paths need no marker-specific handling.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+MARKER_CP_BASE = 0xE000
+MARKER_CP_END = 0xF900  # exclusive
+
+# ReferenceType bitmask (ref merge-tree/src/ops.ts ReferenceType).
+REF_SIMPLE = 0x0
+REF_TILE = 0x1
+
+# Reserved property keys (ref merge-tree/src/referencePositions.ts).
+MARKER_ID_KEY = "markerId"
+TILE_LABELS_KEY = "referenceTileLabels"
+
+
+def marker_char(ref_type: int) -> str:
+    assert 0 <= ref_type < MARKER_CP_END - MARKER_CP_BASE
+    return chr(MARKER_CP_BASE + ref_type)
+
+
+def is_marker_char(ch: str) -> bool:
+    return MARKER_CP_BASE <= ord(ch) < MARKER_CP_END
+
+
+def marker_ref_type(ch: str) -> int:
+    return ord(ch) - MARKER_CP_BASE
+
+
+def is_marker_text(text: str) -> bool:
+    """True iff this segment text is a marker (length-1, reserved plane)."""
+    return len(text) == 1 and is_marker_char(text)
+
+
+def strip_markers(text: str) -> str:
+    """Drop marker codepoints — the getText view of a char run."""
+    return "".join(c for c in text if not is_marker_char(c))
+
+
+def assert_no_marker_plane(text: str) -> None:
+    """User text may not use the reserved plane (insert_text guard)."""
+    if any(is_marker_char(c) for c in text):
+        raise ValueError(
+            "text may not contain U+E000..U+F8FF (reserved for markers)"
+        )
+
+
+def marker_json(ref_type: int, props: dict[str, Any] | None) -> dict:
+    """The reference IJSONSegment marker shape (textSegment/marker
+    toJSONObject): {"marker": {"refType": n}, "props": {...}}."""
+    out: dict[str, Any] = {"marker": {"refType": ref_type}}
+    if props:
+        out["props"] = props
+    return out
+
+
+def regenerated_insert_spec(parts: list[tuple[str, dict]]) -> Any:
+    """Wire spec for a regenerated pending insert, shared by both merge-tree
+    backends.  ``parts`` = [(segment text, props applied by the SAME op)].
+    Props ride ON the insert spec (the original insertMarker shape) because
+    the regeneration annotate scan cannot see the op's own segments; values
+    are interned ids the channel resolves at the wire boundary."""
+    text = "".join(t for t, _p in parts)
+    props = parts[0][1] if parts and all(
+        p == parts[0][1] for _t, p in parts
+    ) else {}
+    if not props:
+        return text
+    if is_marker_text(text):
+        return {"marker": {"refType": marker_ref_type(text)}, "props": props}
+    return {"text": text, "props": props}

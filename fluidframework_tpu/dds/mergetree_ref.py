@@ -175,10 +175,37 @@ class RefMergeTree:
 
     # ------------------------------------------------------------------ views
     def visible_text(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> str:
+        """Perspective text — EXCLUDES markers (ref getText gathers only
+        TextSegments); they still occupy positions (visible_length)."""
+        from .markers import strip_markers
+
         vc = self.local_client if view_client is None else view_client
         return "".join(
-            s.text for s in self.segments if s.visible(ref_seq, vc)
+            strip_markers(s.text) for s in self.segments if s.visible(ref_seq, vc)
         )
+
+    def marker_scan(
+        self, ref_seq: int = ALL_ACKED, view_client: int | None = None
+    ) -> list[tuple[int, int, dict]]:
+        """Visible markers as (position, refType, {prop_id: value_id}) —
+        the host query surface behind getMarkerFromId / searchForMarker
+        (ref mergeTreeNodes.ts Marker, sharedString.ts:42)."""
+        from .markers import is_marker_text, marker_ref_type
+
+        vc = self.local_client if view_client is None else view_client
+        out: list[tuple[int, int, dict]] = []
+        pos = 0
+        for s in self.segments:
+            if not s.visible(ref_seq, vc):
+                continue
+            if is_marker_text(s.text):
+                out.append((
+                    pos,
+                    marker_ref_type(s.text),
+                    {p: v for p, (v, _k) in s.props.items()},
+                ))
+            pos += len(s.text)
+        return out
 
     def visible_length(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> int:
         vc = self.local_client if view_client is None else view_client
@@ -797,7 +824,13 @@ class RefMergeTree:
             if self._visible_at_prefix(seg, key, exclude_key=-1, squash=squash):
                 pos += len(seg.text)
         if ins_pos >= 0:
-            plans.append((0, ins_pos, -1, "".join(s.text for s in ins_segs), ins_segs))
+            from .markers import regenerated_insert_spec
+
+            spec = regenerated_insert_spec([
+                (s.text, {str(p): v for p, (v, k) in s.props.items() if k == key})
+                for s in ins_segs
+            ])
+            plans.append((0, ins_pos, -1, spec, ins_segs))
 
         # Pending remove / annotate: maximal visible runs carrying the stamp.
         pos = 0
@@ -865,6 +898,10 @@ class RefMergeTree:
                         # Resubmission happens under a new connection identity;
                         # remote replicas will stamp the new short id.
                         s.ins_client = new_client
+                    # Same-op props (insertMarker) re-mint with the insert.
+                    for p, (v, k2) in list(s.props.items()):
+                        if k2 == key:
+                            s.props[p] = (v, fresh_key)
                 out.append((fresh, {"type": 0, "pos1": pos1, "seg": payload}))
             elif kind == 1:
                 for s in segs:

@@ -40,9 +40,18 @@ class _Perm:
         self.next_prov = PROV_BASE
 
     def alloc(self, n: int) -> str:
-        h = self.next_handle
-        self.next_handle += n
-        return "".join(chr(h + i) for i in range(n))
+        # Handles are codepoints; the marker plane (U+E000..U+F8FF,
+        # dds/markers.py) is reserved and stripped by visible_text, so
+        # allocation skips it — handles are opaque, gaps are free.
+        from .markers import MARKER_CP_BASE, MARKER_CP_END
+
+        out = []
+        for _ in range(n):
+            if MARKER_CP_BASE <= self.next_handle < MARKER_CP_END:
+                self.next_handle = MARKER_CP_END
+            out.append(chr(self.next_handle))
+            self.next_handle += 1
+        return "".join(out)
 
     def alloc_prov(self, n: int) -> str:
         h = self.next_prov

@@ -48,6 +48,7 @@ import json
 from typing import Any, Callable
 
 from ..protocol.stamps import NON_COLLAB_CLIENT, NO_REMOVE, UNIVERSAL_SEQ, acked
+from .markers import is_marker_text, marker_char, marker_ref_type
 from .mergetree_ref import RefMergeTree, Segment
 
 CHUNK_SIZE = 10000          # chars per chunk (snapshotV1.ts:49)
@@ -58,7 +59,10 @@ BODY_BLOB = "body"          # snapshotlegacy.ts:46
 
 def _can_append(a_text: str, b_text: str) -> bool:
     """textSegment.ts canAppend:77 — no newline at the join point, and at
-    least one side within the granularity."""
+    least one side within the granularity.  Markers NEVER coalesce
+    (Marker.canAppend is constant false, mergeTreeNodes.ts:495)."""
+    if is_marker_text(a_text) or is_marker_text(b_text):
+        return False
     return not a_text.endswith("\n") and (
         len(a_text) <= TEXT_GRANULARITY or len(b_text) <= TEXT_GRANULARITY
     )
@@ -73,8 +77,24 @@ def _props_json(seg: Segment) -> dict[str, Any] | None:
 
 
 def _json_segment(text: str, props: dict[str, Any] | None) -> Any:
-    """IJSONSegment: bare string, or {text, props} when annotated."""
+    """IJSONSegment: bare string, {text, props} when annotated, or
+    {marker: {refType}, props} for a marker segment (marker/textSegment
+    toJSONObject)."""
+    if is_marker_text(text):
+        out: dict[str, Any] = {"marker": {"refType": marker_ref_type(text)}}
+        if props:
+            out["props"] = props
+        return out
     return {"text": text, "props": props} if props else text
+
+
+def _spec_text_props(j: Any) -> tuple[str, dict[str, Any] | None]:
+    """Inverse of _json_segment (snapshotLoader.ts specToSegment:107)."""
+    if isinstance(j, str):
+        return j, None
+    if "marker" in j:
+        return marker_char(j["marker"]["refType"]), j.get("props")
+    return j["text"], j.get("props")
 
 
 def encode_snapshot_v1(
@@ -303,10 +323,7 @@ def decode_snapshot_v1(
         chunk_segs: list[Segment] = []
         for spec in chunk["segments"]:
             if isinstance(spec, dict) and "json" in spec:
-                j = spec["json"]
-                text, props = (j, None) if isinstance(j, str) else (
-                    j["text"], j.get("props")
-                )
+                text, props = _spec_text_props(spec["json"])
                 ins_seq = spec.get("seq", UNIVERSAL_SEQ)
                 client = (
                     get_short_client_id(spec["client"])
@@ -328,9 +345,7 @@ def decode_snapshot_v1(
                         slice_keys.add(k)
                 removes.sort()
             else:
-                text, props = (spec, None) if isinstance(spec, str) else (
-                    spec["text"], spec.get("props")
-                )
+                text, props = _spec_text_props(spec)
                 ins_seq, client, removes = UNIVERSAL_SEQ, NON_COLLAB_CLIENT, []
             chunk_segs.append(Segment(
                 text=text,

@@ -898,13 +898,22 @@ def _host_vis(s: DocState, ref_seq: int, view_client: int):
 
 
 def visible_text(s: DocState, ref_seq: int = ALL_ACKED, view_client: int = -3) -> str:
-    """Materialize the perspective-visible text on the host."""
+    """Materialize the perspective-visible text on the host.  Marker
+    codepoints (the reserved U+E000..U+F8FF plane, dds/markers.py) are
+    filtered here — markers hold positions but contribute no text, the
+    reference's getText/getLength split."""
+    from ..dds.markers import MARKER_CP_BASE, MARKER_CP_END
+
     nseg, vis = _host_vis(s, ref_seq, view_client)
     text = np.asarray(s.text)
     start = np.asarray(s.seg_start)[:nseg]
     length = np.asarray(s.seg_len)[:nseg]
     parts = [
-        "".join(chr(c) for c in text[start[i] : start[i] + length[i]])
+        "".join(
+            chr(c)
+            for c in text[start[i] : start[i] + length[i]]
+            if not MARKER_CP_BASE <= c < MARKER_CP_END
+        )
         for i in range(nseg)
         if vis[i]
     ]

@@ -360,6 +360,22 @@ NESTED_OP_FIELDS = _TGT + 7
 
 VKIND_NONE = 0
 VKIND_INT = 1
+# Pooled kinds: the row's value column is an OFFSET into the per-doc
+# word pool and a new vlen column holds the span length — the exact
+# text-pool pattern of the merge-tree kernel (text/seg_start/seg_len),
+# generalized to arbitrary leaf values (ref chunked-forest/
+# uniformChunk.ts:42 stores arbitrary values columnar the same way).
+# For pooled INSERT/SET ops the op's `value` slot carries the word count
+# and the payload row carries the words themselves.
+VKIND_STR = 2    # words = codepoints
+VKIND_F64 = 3    # words = the two int32 halves of the float64 bit pattern
+VKIND_BOOL = 4   # inline like INT (value column is 0/1)
+
+_POOLED = (VKIND_STR, VKIND_F64)
+
+
+def _is_pooled(vkind):
+    return (vkind == VKIND_STR) | (vkind == VKIND_F64)
 
 
 class NestedOpKind:
@@ -375,20 +391,30 @@ class NestedForestState(NamedTuple):
     field_id: jnp.ndarray # int32[N] interned field key
     index: jnp.ndarray    # int32[N] sibling index within (parent, field)
     ntype: jnp.ndarray    # int32[N] interned node type
-    value: jnp.ndarray    # int32[N]
+    value: jnp.ndarray    # int32[N] inline value, or pool offset (pooled)
     vkind: jnp.ndarray    # int32[N] VKIND_*
+    vlen: jnp.ndarray     # int32[N] pool span length (pooled kinds only)
     val_seq: jnp.ndarray  # int32[N] seq of winning value write
     alive: jnp.ndarray    # int32[N] 0/1
+    pool: jnp.ndarray     # int32[P] append-only word pool (str/f64 values)
+    pool_end: jnp.ndarray # int32 scalar pool watermark
     nrow: jnp.ndarray     # int32 scalar allocation watermark
     error: jnp.ndarray    # int32 scalar bitmask
 
 
-def init_nested_forest(capacity: int = 1024) -> NestedForestState:
+ERR_POOL_OVERFLOW = 4
+
+
+def init_nested_forest(
+    capacity: int = 1024, pool_capacity: int = 4096
+) -> NestedForestState:
     z = jnp.zeros((capacity,), I32)
     return NestedForestState(
         parent=jnp.full((capacity,), -1, I32),
-        field_id=z, index=z, ntype=z, value=z, vkind=z, val_seq=z,
+        field_id=z, index=z, ntype=z, value=z, vkind=z, vlen=z, val_seq=z,
         alive=z,
+        pool=jnp.zeros((pool_capacity,), I32),
+        pool_end=jnp.zeros((), I32),
         nrow=jnp.zeros((), I32),
         error=jnp.zeros((), I32),
     )
@@ -432,12 +458,27 @@ def apply_nested_op(
     sib = _sibling_mask(s, parent, fld)
     n_sib = jnp.sum(sib.astype(I32))
 
-    def fail(s, over, bad):
+    def fail(s, over, bad, pool_over=False):
         return s._replace(
             error=s.error
             | jnp.where(over, ERR_NODE_OVERFLOW, 0)
             | jnp.where(bad, ERR_FOREST_RANGE, 0)
+            | jnp.where(pool_over, ERR_POOL_OVERFLOW, 0)
         )
+
+    pooled = _is_pooled(vkind)
+    # For pooled INSERT/SET the op's value slot is the word count; the
+    # payload row holds the words destined for the pool.
+    wlen = jnp.where(pooled, value, 0)
+    P = s.pool.shape[0]
+    W = payload.shape[0]
+
+    def _pool_append(s):
+        """Append payload[:wlen] to the pool; returns (pool, over)."""
+        over = s.pool_end + wlen > P
+        tpos = jnp.arange(W, dtype=I32)
+        dst = jnp.where((tpos < wlen) & ~over, s.pool_end + tpos, P)
+        return s.pool.at[dst].set(payload, mode="drop"), over
 
     def do_noop(s):
         return s
@@ -445,23 +486,32 @@ def apply_nested_op(
     def do_insert(s):
         over = s.nrow + count > N
         bad = ~okp | (pos > n_sib)
+        pool, pool_over = _pool_append(s)
         shifted = jnp.where(sib & (s.index >= pos), s.index + count, s.index)
         fresh = (idx >= s.nrow) & (idx < s.nrow + count)
         j = idx - s.nrow
         pay = payload[jnp.clip(j, 0, payload.shape[0] - 1)]
+        inline = (vkind == VKIND_INT) | (vkind == VKIND_BOOL)
+        row_val = jnp.where(pooled, s.pool_end, jnp.where(inline, pay, 0))
         out = s._replace(
             parent=jnp.where(fresh, parent, s.parent),
             field_id=jnp.where(fresh, fld, s.field_id),
             index=jnp.where(fresh, pos + j, shifted),
             ntype=jnp.where(fresh, ntype, s.ntype),
-            value=jnp.where(fresh, jnp.where(vkind == VKIND_INT, pay, 0), s.value),
+            value=jnp.where(fresh, row_val, s.value),
             vkind=jnp.where(fresh, vkind, s.vkind),
+            vlen=jnp.where(fresh, wlen, s.vlen),
             val_seq=jnp.where(fresh, seq, s.val_seq),
             alive=jnp.where(fresh, 1, s.alive),
+            pool=pool,
+            pool_end=s.pool_end + wlen,
             nrow=s.nrow + count,
         )
         return jax.lax.cond(
-            okp & ~over & ~bad, lambda _: out, lambda _: fail(s, over, bad), None
+            okp & ~over & ~bad & ~pool_over,
+            lambda _: out,
+            lambda _: fail(s, over, bad, pool_over),
+            None,
         )
 
     def do_remove(s):
@@ -484,13 +534,21 @@ def apply_nested_op(
     def do_set(s):
         hit = sib & (s.index == pos)
         bad = ~okp | ~jnp.any(hit)
+        pool, pool_over = _pool_append(s)
+        new_val = jnp.where(pooled, s.pool_end, value)
         out = s._replace(
-            value=jnp.where(hit, value, s.value),
+            value=jnp.where(hit, new_val, s.value),
             vkind=jnp.where(hit, vkind, s.vkind),
+            vlen=jnp.where(hit, wlen, s.vlen),
             val_seq=jnp.where(hit, seq, s.val_seq),
+            pool=pool,
+            pool_end=s.pool_end + wlen,
         )
         return jax.lax.cond(
-            ~bad, lambda _: out, lambda _: fail(s, False, bad), None
+            ~bad & ~pool_over,
+            lambda _: out,
+            lambda _: fail(s, False, bad, pool_over),
+            None,
         )
 
     def do_move(s):
@@ -532,7 +590,10 @@ def apply_nested_ops(
 def compact_nested(s: NestedForestState) -> NestedForestState:
     """Drop dead rows: stable gather of live rows to the prefix plus a
     parent-id remap — trivial BECAUSE ordering lives in the index columns,
-    not in row order."""
+    not in row order.  The word pool compacts in the same pass: live
+    pooled spans pack to the front (searchsorted span gather) and the
+    value column's offsets are rewritten, reclaiming dead/overwritten
+    string and float storage."""
     N = s.parent.shape[0]
     alive = s.alive == 1
     new_id = jnp.cumsum(alive.astype(I32)) - 1          # old row -> new row
@@ -546,11 +607,32 @@ def compact_nested(s: NestedForestState) -> NestedForestState:
     old_parent = s.parent[order]
     pk = jnp.clip(old_parent, 0, N - 1)
     parent = jnp.where(old_parent < 0, -1, new_id[pk])
+
+    # ------------------------------------------------------------- pool pack
+    value_g = g(s.value)
+    vkind_g = g(s.vkind)
+    vlen_g = g(s.vlen)
+    P = s.pool.shape[0]
+    span = jnp.where(take & _is_pooled(vkind_g), vlen_g, 0)   # [N] words owned
+    ends = jnp.cumsum(span)                                   # inclusive ends
+    new_off = ends - span                                     # exclusive starts
+    total = ends[-1] if N > 0 else jnp.zeros((), I32)
+    t = jnp.arange(P, dtype=I32)
+    # Which packed row does output word t belong to?  searchsorted over the
+    # cumulative ends; src = that row's OLD offset + intra-span position.
+    r = jnp.searchsorted(ends, t, side="right").astype(I32)
+    rk = jnp.clip(r, 0, N - 1)
+    src = value_g[rk] + (t - new_off[rk])
+    pool = jnp.where(t < total, s.pool[jnp.clip(src, 0, P - 1)], 0)
+    value_packed = jnp.where(take & _is_pooled(vkind_g), new_off, value_g)
+
     return NestedForestState(
         parent=jnp.where(take, parent, -1),
         field_id=g(s.field_id), index=g(s.index), ntype=g(s.ntype),
-        value=g(s.value), vkind=g(s.vkind), val_seq=g(s.val_seq),
+        value=value_packed, vkind=vkind_g, vlen=vlen_g, val_seq=g(s.val_seq),
         alive=jnp.where(take, 1, 0),
+        pool=pool,
+        pool_end=total,
         nrow=n_alive,
         error=s.error,
     )
@@ -570,7 +652,9 @@ def nested_to_json(
     ntype = np.asarray(s.ntype)[:nrow]
     value = np.asarray(s.value)[:nrow]
     vkind = np.asarray(s.vkind)[:nrow]
+    vlen = np.asarray(s.vlen)[:nrow]
     alive = np.asarray(s.alive)[:nrow]
+    pool = np.asarray(s.pool)
 
     # parent -> {field -> [(index, row)]}: one O(N) pass, O(1) per lookup.
     children: dict[int, dict[int, list[tuple[int, int]]]] = {}
@@ -582,8 +666,11 @@ def nested_to_json(
 
     def node_json(r: int) -> dict:
         out: dict = {"t": type_names[int(ntype[r])]}
-        if vkind[r] == VKIND_INT:
-            out["v"] = int(value[r])
+        v = decode_pooled_value(
+            int(vkind[r]), int(value[r]), int(vlen[r]), pool
+        )
+        if v is not None:
+            out["v"] = v
         fields = {
             field_names[f]: [node_json(cr) for _i, cr in sorted(rows)]
             for f, rows in children.get(r, {}).items()
@@ -593,3 +680,45 @@ def nested_to_json(
         return out
 
     return [node_json(r) for _i, r in sorted(children.get(-1, {}).get(0, []))]
+
+
+def decode_pooled_value(vkind: int, value: int, vlen: int, pool: np.ndarray):
+    """Host decode of one row's value columns back to the Python leaf."""
+    import struct
+
+    if vkind == VKIND_INT:
+        return int(value)
+    if vkind == VKIND_BOOL:
+        return bool(value)
+    if vkind == VKIND_STR:
+        return "".join(chr(int(c)) for c in pool[value : value + vlen])
+    if vkind == VKIND_F64:
+        lo, hi = int(pool[value]) & 0xFFFFFFFF, int(pool[value + 1]) & 0xFFFFFFFF
+        return struct.unpack("<d", struct.pack("<II", lo, hi))[0]
+    return None
+
+
+def encode_pooled_words(v) -> tuple[int, int, list[int] | None]:
+    """Python leaf -> (vkind, inline value-or-wordcount, pool words).
+
+    Inverse of decode_pooled_value; bool before int (bool is an int
+    subclass), f64 as its two little-endian int32 halves, str as
+    codepoints.  Raises ValueError for values the columns cannot carry
+    (out-of-int32-range ints, exotic types) — callers route those
+    documents to their host fallback."""
+    import struct
+
+    if v is None:
+        return VKIND_NONE, 0, None
+    if isinstance(v, bool):
+        return VKIND_BOOL, int(v), None
+    if isinstance(v, int):
+        if -(1 << 31) <= v < (1 << 31):
+            return VKIND_INT, v, None
+        raise ValueError(f"int leaf out of int32 range: {v!r}")
+    if isinstance(v, float):
+        lo, hi = struct.unpack("<ii", struct.pack("<d", v))
+        return VKIND_F64, 2, [lo, hi]
+    if isinstance(v, str):
+        return VKIND_STR, len(v), [ord(c) for c in v]
+    raise ValueError(f"unsupported leaf value type: {v!r}")

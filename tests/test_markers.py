@@ -1,0 +1,187 @@
+"""Marker segments (VERDICT r4 next #3): insertMarker semantics across the
+channel boundary on BOTH backends, marker-id lookup, tile search, the
+getText/getLength split, concurrent convergence, summaries, reconnect, and
+the snapshotV1 marker wire shape.
+
+Reference: mergeTreeNodes.ts:495 (Marker), sharedString.ts:42
+(insertMarker), client.ts getMarkerFromId / searchForMarker.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.dds.markers import (
+    MARKER_ID_KEY,
+    REF_TILE,
+    TILE_LABELS_KEY,
+)
+from fluidframework_tpu.dds.snapshot_v1 import (
+    decode_snapshot_v1,
+    encode_snapshot_v1,
+)
+from fluidframework_tpu.protocol.stamps import ALL_ACKED
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+pytestmark = pytest.mark.usefixtures("string_backend")
+
+
+def _fleet(n=2):
+    svc = LocalService()
+    doc = svc.document("doc")
+    rts = []
+    for i in range(n):
+        rt = ContainerRuntime(default_registry(), container_id=f"c{i}")
+        rt.create_datastore("root").create_channel("sharedString", "s")
+        rt.connect(doc, f"c{i}")
+        rts.append(rt)
+    doc.process_all()
+    ss = lambda rt: rt.datastore("root").get_channel("s")
+    return svc, doc, rts, ss
+
+
+def _sync(doc, rts):
+    for rt in rts:
+        rt.flush()
+    doc.process_all()
+
+
+def test_marker_text_length_split_and_queries():
+    """Markers occupy positions (getLength) but contribute no text
+    (getText); id lookup and tile search find them."""
+    _svc, doc, rts, ss = _fleet(1)
+    s = ss(rts[0])
+    s.insert_text(0, "hello world")
+    s.insert_marker(5, REF_TILE, {
+        MARKER_ID_KEY: "para1", TILE_LABELS_KEY: ["Eop"],
+    })
+    _sync(doc, rts)
+    assert s.text == "hello world"
+    assert s.backend.visible_length() == 12
+    m = s.get_marker_from_id("para1")
+    assert m is not None and m["position"] == 5 and m["refType"] == REF_TILE
+    assert s.get_marker_from_id("nope") is None
+    # Tile search: nearest labeled marker at-or-before / at-or-after.
+    assert s.search_for_marker(8, "Eop", forwards=False)["position"] == 5
+    assert s.search_for_marker(3, "Eop", forwards=True)["position"] == 5
+    assert s.search_for_marker(6, "Eop", forwards=True) is None
+    assert s.search_for_marker(4, "Eop", forwards=False) is None
+    assert s.search_for_marker(8, "Other", forwards=False) is None
+
+
+def test_marker_concurrent_inserts_converge():
+    """Two writers race markers and text at the same positions; both
+    replicas converge to identical text AND marker tables."""
+    _svc, doc, rts, ss = _fleet(2)
+    a, b = ss(rts[0]), ss(rts[1])
+    a.insert_text(0, "abcdef")
+    _sync(doc, rts)
+    rng = random.Random(11)
+    for i in range(12):
+        for who, s in (("a", a), ("b", b)):
+            n = s.backend.visible_length()
+            if rng.random() < 0.5:
+                s.insert_marker(
+                    rng.randint(0, n), REF_TILE,
+                    {MARKER_ID_KEY: f"{who}{i}", TILE_LABELS_KEY: ["Eop"]},
+                )
+            else:
+                s.insert_text(
+                    max(0, rng.randint(0, n) - 1) if n else 0, "xy"
+                )
+            if n > 4 and rng.random() < 0.3:
+                p = rng.randint(0, n - 2)
+                s.remove_range(p, p + 1)
+        if rng.random() < 0.6:
+            _sync(doc, rts)
+    _sync(doc, rts)
+    assert a.text == b.text
+    assert a.markers() == b.markers()
+    assert len({m["props"][MARKER_ID_KEY] for m in a.markers()}) == len(
+        a.markers()
+    )
+
+
+def test_marker_survives_summary_late_joiner():
+    """A replica loaded from a summary sees the markers (marker-ness lives
+    in the content, so every summary path carries it)."""
+    svc, doc, rts, ss = _fleet(1)
+    s = ss(rts[0])
+    s.insert_text(0, "one two")
+    s.insert_marker(3, REF_TILE, {MARKER_ID_KEY: "m0"})
+    _sync(doc, rts)
+    late = ContainerRuntime(default_registry(), container_id="late")
+    late.load_snapshot(rts[0].summarize())
+    late.connect(doc, "late")
+    doc.process_all()
+    s2 = ss(late)
+    assert s2.text == "one two"
+    assert s2.get_marker_from_id("m0")["position"] == 3
+    # And the late joiner keeps collaborating on marker positions.
+    s2.insert_text(0, "zz")
+    late.flush()
+    doc.process_all()
+    assert ss(rts[0]).get_marker_from_id("m0")["position"] == 5
+
+
+def test_marker_reconnect_resubmit():
+    """Markers pending through a disconnect survive resubmission (the
+    regenerated op carries the marker codepoint, so marker-ness and
+    convergence hold on every replica)."""
+    _svc, doc, rts, ss = _fleet(2)
+    a, b = ss(rts[0]), ss(rts[1])
+    a.insert_text(0, "abc")
+    _sync(doc, rts)
+    rts[0].disconnect()
+    a.insert_marker(1, REF_TILE, {MARKER_ID_KEY: "offline"})
+    a.insert_text(3, "Q")
+    b.insert_text(0, "pp")
+    rts[1].flush()
+    doc.process_all()
+    rts[0].connect(doc, "c0-re")
+    rts[0].flush()
+    doc.process_all()
+    assert a.text == b.text
+    assert a.markers() == b.markers()
+    assert a.get_marker_from_id("offline") is not None
+
+
+def test_snapshot_v1_marker_wire_shape():
+    """Channel-independent: a marker encodes as the reference's
+    {"marker":{"refType":n},"props":{...}} spec and never coalesces with
+    below-MSN text neighbours."""
+    from fluidframework_tpu.dds.mergetree_ref import RefMergeTree
+
+    tree = RefMergeTree()
+    tree.apply_insert(0, "hello", 1, 0, 0)
+    from fluidframework_tpu.dds.markers import marker_char
+
+    seg = tree.apply_insert(2, marker_char(REF_TILE), 2, 0, 1)
+    seg.props["markerId"] = ("m#1", 2)
+    tree.update_min_seq(2)
+    blobs = encode_snapshot_v1(tree, seq=2, get_long_client_id=lambda s: "A")
+    header = json.loads(blobs["header"])
+    specs = header["segments"]
+    assert specs == [
+        "he",
+        {"marker": {"refType": REF_TILE}, "props": {"markerId": "m#1"}},
+        "llo",
+    ]
+    loaded, _seq, _min = decode_snapshot_v1(
+        blobs, lambda n: 0, prop_decoder=str
+    )
+    assert loaded.visible_text(ALL_ACKED, -1) == "hello"
+    assert loaded.marker_scan(ALL_ACKED, -1) == [
+        (2, REF_TILE, {"markerId": "m#1"})
+    ]
+
+
+def test_user_text_rejects_marker_plane():
+    _svc, _doc, rts, ss = _fleet(1)
+    with pytest.raises(ValueError):
+        ss(rts[0]).insert_text(0, "badtext")

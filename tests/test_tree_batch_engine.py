@@ -162,17 +162,34 @@ def test_forest_kernel_move_directions():
 # Nested-doc fuzz: deep shapes on device, full-tree equality (VERDICT #3)
 # --------------------------------------------------------------------------
 
-def _rand_content(rng, depth: int):
-    """A random content tree: int leaves, sometimes an interior node with
+def _rand_value(rng, mixed: bool):
+    """A random leaf value; ``mixed`` draws from every leaf type the
+    reference supports (string/number/boolean/null), not just ints."""
+    if not mixed:
+        return rng.randrange(1000)
+    r = rng.random()
+    if r < 0.35:
+        return rng.randrange(1000)
+    if r < 0.65:
+        return "".join(rng.choices("abcdefgh !", k=rng.randint(0, 12)))
+    if r < 0.8:
+        return rng.uniform(-1e6, 1e6)
+    if r < 0.9:
+        return rng.random() < 0.5
+    return None
+
+
+def _rand_content(rng, depth: int, mixed: bool = False):
+    """A random content tree: leaves, sometimes an interior node with
     1-2 named child fields (bounded depth)."""
     from fluidframework_tpu.dds.tree.forest import Node
 
     if depth <= 0 or rng.random() < 0.55:
-        return leaf(rng.randrange(1000))
+        return leaf(_rand_value(rng, mixed))
     fields = {}
     for key in rng.sample(["a", "b", "kids"], rng.randint(1, 2)):
         fields[key] = [
-            _rand_content(rng, depth - 1) for _ in range(rng.randint(1, 2))
+            _rand_content(rng, depth - 1, mixed) for _ in range(rng.randint(1, 2))
         ]
     return Node(type="obj", value=rng.randrange(100) if rng.random() < 0.5 else None,
                 fields=fields)
@@ -195,9 +212,11 @@ def _descend(rng, forest, max_depth):
         fld = rng.choice(inner) if inner and rng.random() < 0.7 else rng.choice(["a", "b", "kids"])
 
 
-def drive_nested_docs(n_docs, seed, steps=40, clients_per_doc=2, deep_prob=0.05):
+def drive_nested_docs(n_docs, seed, steps=40, clients_per_doc=2, deep_prob=0.05,
+                      mixed=False):
     """Rich nested concurrent sessions; ``deep_prob`` controls edits beyond
-    the kernel's MAX_PATH (genuinely rare shapes that must fall back)."""
+    the kernel's MAX_PATH (genuinely rare shapes that must fall back);
+    ``mixed`` draws leaf values from all four leaf types."""
     rng = random.Random(seed)
     svc = LocalService()
     fleets = {}
@@ -225,7 +244,7 @@ def drive_nested_docs(n_docs, seed, steps=40, clients_per_doc=2, deep_prob=0.05)
             if kind == "ins" or n == 0:
                 t.submit_change(make_insert(
                     path, fld, rng.randint(0, n),
-                    [_rand_content(rng, rng.randint(0, 2))],
+                    [_rand_content(rng, rng.randint(0, 2), mixed)],
                 ))
             elif kind == "rm":
                 i = rng.randrange(n)
@@ -233,8 +252,10 @@ def drive_nested_docs(n_docs, seed, steps=40, clients_per_doc=2, deep_prob=0.05)
                     path, fld, i, rng.randint(1, min(2, n - i))
                 ))
             elif kind == "set":
+                v = _rand_value(rng, mixed)
                 t.submit_change(make_set_value(
-                    path + [(fld, rng.randrange(n))], rng.randrange(1000)
+                    path + [(fld, rng.randrange(n))],
+                    v if v is not None else rng.randrange(1000),
                 ))
             else:
                 s = rng.randrange(n)
@@ -272,6 +293,113 @@ def test_nested_fuzz_more_seeds():
         eng = _feed(svc, 4)
         for d in range(4):
             assert eng.tree_json(d) == expected[d], (seed, d)
+
+
+def _assert_json_type_strict(a, b, where=""):
+    """Structural equality that does NOT conflate True with 1 (Python ==
+    would): every leaf must match in type and value."""
+    assert type(a) is type(b), (where, a, b)
+    if isinstance(a, dict):
+        assert a.keys() == b.keys(), (where, a, b)
+        for k in a:
+            _assert_json_type_strict(a[k], b[k], f"{where}.{k}")
+    elif isinstance(a, list):
+        assert len(a) == len(b), (where, a, b)
+        for i, (x, y) in enumerate(zip(a, b)):
+            _assert_json_type_strict(x, y, f"{where}[{i}]")
+    else:
+        assert a == b, (where, a, b)
+
+
+def test_mixed_value_fuzz_device_fraction():
+    """Realistic documents — string/float/bool/int/null leaves — stay on
+    the device path (>90% of commits) and every tree matches the host
+    stack with TYPE-STRICT equality (VERDICT r4 next #2: the device
+    fraction must be meaningful on mixed-type content, not int-only)."""
+    svc, expected = drive_nested_docs(6, seed=19, steps=40, mixed=True)
+    eng = _feed(svc, 6)
+    for d in range(6):
+        _assert_json_type_strict(eng.tree_json(d), expected[d], f"doc{d}")
+    assert eng.device_fraction() > 0.9, eng.device_fraction()
+
+
+def test_mixed_value_fuzz_more_seeds():
+    for seed in (29, 43):
+        svc, expected = drive_nested_docs(4, seed=seed, steps=30, mixed=True)
+        eng = _feed(svc, 4)
+        for d in range(4):
+            _assert_json_type_strict(eng.tree_json(d), expected[d], str((seed, d)))
+        assert eng.device_fraction() > 0.9, (seed, eng.device_fraction())
+
+
+def test_float_bit_exact_roundtrip():
+    """f64 leaves survive the pool encode/decode bit-exactly (including
+    non-representable-in-f32 values)."""
+    svc = LocalService()
+    doc = svc.document("doc0")
+    rt = ContainerRuntime(default_registry(), container_id="c0")
+    rt.create_datastore("root").create_channel("sharedTree", "t")
+    rt.connect(doc, "c0")
+    doc.process_all()
+    t = rt.datastore("root").get_channel("t")
+    vals = [0.1, -2.5e-308, 1.7976931348623157e308, 3.141592653589793]
+    for v in vals:
+        t.submit_change(make_insert([], "", 0, [leaf(v)]))
+    rt.flush()
+    doc.process_all()
+    eng = _feed(svc, 1)
+    got = eng.values(0)
+    assert got == list(reversed(vals))
+    assert all(type(g) is float for g in got)
+
+
+def test_wide_string_routes_to_fallback():
+    """A leaf wider than one payload row is honest fallback territory —
+    the doc converges through the host Forest."""
+    svc = LocalService()
+    doc = svc.document("doc0")
+    rt = ContainerRuntime(default_registry(), container_id="c0")
+    rt.create_datastore("root").create_channel("sharedTree", "t")
+    rt.connect(doc, "c0")
+    doc.process_all()
+    t = rt.datastore("root").get_channel("t")
+    t.submit_change(make_insert([], "", 0, [leaf("x" * 100)]))
+    t.submit_change(make_insert([], "", 1, [leaf(7)]))
+    rt.flush()
+    doc.process_all()
+    eng = _feed(svc, 1)
+    assert 0 in eng.fallbacks
+    assert eng.values(0) == ["x" * 100, 7]
+
+
+def test_pool_compaction_under_string_churn():
+    """Value overwrites leak pool words until compaction; a set-heavy
+    string stream far beyond pool capacity must stay on device."""
+    rng = random.Random(13)
+    svc = LocalService()
+    doc = svc.document("doc0")
+    rt = ContainerRuntime(default_registry(), container_id="c0")
+    rt.create_datastore("root").create_channel("sharedTree", "t")
+    rt.connect(doc, "c0")
+    doc.process_all()
+    t = rt.datastore("root").get_channel("t")
+    for i in range(4):
+        t.submit_change(make_insert([], "", i, [leaf(f"s{i}")]))
+    for _ in range(120):  # ~120 * ~8 words >> pool_capacity=256
+        t.submit_change(make_set_value(
+            [("", rng.randrange(4))],
+            "".join(rng.choices("abcdefgh", k=8)),
+        ))
+        rt.flush()
+        doc.process_all()
+    rt.flush()
+    doc.process_all()
+    eng = TreeBatchEngine(1, capacity=64, pool_capacity=256, ops_per_step=8)
+    for msg in doc.sequencer.log:
+        eng.ingest(0, msg)
+    eng.step()
+    assert not eng.fallbacks and not eng.errors().any()
+    assert eng.values(0) == [nd.value for nd in t.forest.root_field]
 
 
 def test_device_compaction_under_churn():
