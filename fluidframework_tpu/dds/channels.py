@@ -619,6 +619,14 @@ class SharedStringChannel(Channel):
         # Local view: all acked ops + own pending (sentinel-stamped) ops.
         return self.backend.visible_text(ALL_ACKED, self.backend.local_client)
 
+    def position_text(self) -> str:
+        """The local view as a POSITION-indexed string: marker codepoints
+        kept, so len() == visible_length and slicing by positions is exact
+        (undo capture; ``text`` excludes markers and is shorter)."""
+        return self.backend.visible_text(
+            ALL_ACKED, self.backend.local_client, raw=True
+        )
+
     # ------------------------------------------------------- attribution
     @staticmethod
     def _attr_key(key) -> dict[str, Any]:

@@ -102,6 +102,10 @@ class KernelMergeTree:
         )
         self.max_insert_len = max_insert_len
         self.local_client = local_client
+        # Mutation generation: bumped on EVERY self.state replacement so
+        # host-side caches (marker_scan) invalidate without pinning the
+        # superseded DocState.
+        self._gen = 0
         self._empty_payload = np.zeros((max_insert_len,), np.int32)
         # Host-interned property ids -> kernel prop slots.
         self._prop_slot: dict[int, int] = {}
@@ -122,6 +126,7 @@ class KernelMergeTree:
     def _step(self, op, payload=None) -> None:
         p = self._empty_payload if payload is None else payload
         self.state = _apply_one(self.state, op, p)
+        self._gen += 1
 
     def check_errors(self) -> int:
         return int(self.state.error)
@@ -301,11 +306,17 @@ class KernelMergeTree:
         if min_seq > prev:
             self.state = mk.set_min_seq(self.state, min_seq)
             self.state = _compact(self.state)
+            self._gen += 1
 
     # ------------------------------------------------------------------ views
-    def visible_text(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> str:
+    def visible_text(
+        self,
+        ref_seq: int = ALL_ACKED,
+        view_client: int | None = None,
+        raw: bool = False,
+    ) -> str:
         vc = self.local_client if view_client is None else view_client
-        return mk.visible_text(self.state, ref_seq, vc)
+        return mk.visible_text(self.state, ref_seq, vc, raw=raw)
 
     def visible_length(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> int:
         vc = self.local_client if view_client is None else view_client
@@ -323,17 +334,17 @@ class KernelMergeTree:
         """Visible markers as (position, refType, {prop_id: value_id}) —
         same shape as RefMergeTree.marker_scan (markers are ordinary
         1-char segments in the columns; only this host query decodes
-        them).  The device readback is cached per state object — state is
-        replaced on every mutation, so repeated queries against an
-        unchanged replica (id lookup, tile search) cost one readback."""
+        them).  The device readback is cached per mutation generation, so
+        repeated queries against an unchanged replica (id lookup, tile
+        search) cost one readback — and the cache never pins a superseded
+        DocState (a state reference would hold the dead columns alive)."""
         from .markers import is_marker_text, marker_ref_type
 
         vc = self.local_client if view_client is None else view_client
+        gen = self._gen
         cached = getattr(self, "_marker_cache", None)
-        if cached is not None and cached[0] is self.state and cached[1] == (
-            ref_seq, vc,
-        ):
-            return cached[2]
+        if cached is not None and cached[0] == (gen, ref_seq, vc):
+            return cached[1]
         inv = {v: k for k, v in self._prop_slot.items()}
         out: list[tuple[int, int, dict]] = []
         pos = 0
@@ -347,7 +358,7 @@ class KernelMergeTree:
                     {inv[p]: v for p, (v, _k) in seg.props.items()},
                 ))
             pos += seg.length
-        self._marker_cache = (self.state, (ref_seq, vc), out)
+        self._marker_cache = ((gen, ref_seq, vc), out)
         return out
 
     def attribution_runs(
@@ -513,6 +524,7 @@ class KernelMergeTree:
             for i in range(nseg):
                 if int(uid[i]) in uids:
                     mask[i] = True
+        self._gen += 1
         self.state = mk.restamp(
             s,
             jax.numpy.asarray(mask),
@@ -614,6 +626,7 @@ class KernelMergeTree:
 
         if squash:
             self.state = mk.drop_squashed(self.state)
+            self._gen += 1
 
         out: list[tuple[int, dict]] = []
         # Split removes shift later pieces left by what earlier pieces
@@ -684,6 +697,7 @@ class KernelMergeTree:
             # Range gone from the prefix view: retire the obliterate (strip
             # its never-to-ack stamps, free its record slot).
             self.state = mk.strip_stamp(self.state, key)
+            self._gen += 1
             self.slice_keys.discard(key)
             return []
 
@@ -821,6 +835,7 @@ class KernelMergeTree:
             ob_end_side[j] = o["endSide"]
             ob_ref_seq[j] = o["refSeq"]
 
+        self._gen += 1
         self.state = mk.DocState(
             text=jnp.asarray(text_pool),
             text_end=jnp.asarray(end, jnp.int32),

@@ -174,12 +174,24 @@ class RefMergeTree:
         self._regenerated_keys: set[int] = set()
 
     # ------------------------------------------------------------------ views
-    def visible_text(self, ref_seq: int = ALL_ACKED, view_client: int | None = None) -> str:
+    def visible_text(
+        self,
+        ref_seq: int = ALL_ACKED,
+        view_client: int | None = None,
+        raw: bool = False,
+    ) -> str:
         """Perspective text — EXCLUDES markers (ref getText gathers only
-        TextSegments); they still occupy positions (visible_length)."""
+        TextSegments); they still occupy positions (visible_length).
+        ``raw=True`` keeps marker codepoints, yielding a string whose
+        indices ARE positions (len == visible_length) for position-space
+        slicing (undo capture)."""
         from .markers import strip_markers
 
         vc = self.local_client if view_client is None else view_client
+        if raw:
+            return "".join(
+                s.text for s in self.segments if s.visible(ref_seq, vc)
+            )
         return "".join(
             strip_markers(s.text) for s in self.segments if s.visible(ref_seq, vc)
         )

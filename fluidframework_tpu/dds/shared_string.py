@@ -108,6 +108,9 @@ class SharedString:
     # ------------------------------------------------------------- local edits
     def insert_text(self, pos: int, text: str) -> None:
         assert text
+        from .markers import assert_no_marker_plane
+
+        assert_no_marker_plane(text)
         self._require_joined()
         self._local_seq += 1
         self.backend.apply_insert(
@@ -146,7 +149,10 @@ class SharedString:
         self._require_joined()
         s1 = SIDE_BEFORE if start[1] else SIDE_AFTER
         s2 = SIDE_BEFORE if end[1] else SIDE_AFTER
-        validate_obliterate_places(start[0], s1, end[0], s2, len(self.text))
+        validate_obliterate_places(
+            start[0], s1, end[0], s2,
+            self.backend.visible_length(ALL_ACKED, self.short_client),
+        )
         self._local_seq += 1
         self.backend.apply_obliterate(
             start[0], s1, end[0], s2,

@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Any
 
 from ..dds.channels import SharedMapChannel, SharedStringChannel
+from ..dds.markers import strip_markers
 from ..dds.sequence_intervals import transform_position
 from ..dds.tree.changeset import invert_node_change, rebase_node_change
 from ..dds.tree.shared_tree import SharedTreeChannel
@@ -123,7 +124,10 @@ class _StringInsertRevertible:
         # back its own re-insert revertible.
         inverses = []
         for start, end in sorted(local_spans, reverse=True):
-            removed = self._ch.text[start:end]
+            # Position-space slice (markers kept so indices are exact);
+            # markers inside the range are not re-created by a later undo
+            # (only their text survives capture).
+            removed = strip_markers(self._ch.position_text()[start:end])
             ls = self._ch.remove_range(start, end)
             inverses.append(_StringRemoveRevertible(self._ch, ls, start, removed))
         return inverses or None
@@ -197,7 +201,9 @@ class UndoRedoStackManager:
         self._push(_StringInsertRevertible(channel, ls, pos, len(text)))
 
     def capture_string_remove(self, channel: SharedStringChannel, pos1: int, pos2: int) -> None:
-        removed = channel.text[pos1:pos2]
+        # pos1/pos2 are positions; slice the position-indexed view (markers
+        # in range are removed but not re-created by undo).
+        removed = strip_markers(channel.position_text()[pos1:pos2])
         ls = channel.remove_range(pos1, pos2)
         self._push(_StringRemoveRevertible(channel, ls, pos1, removed))
 

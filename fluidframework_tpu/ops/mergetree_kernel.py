@@ -897,11 +897,15 @@ def _host_vis(s: DocState, ref_seq: int, view_client: int):
     return nseg, ins_occ & ~rem_occ
 
 
-def visible_text(s: DocState, ref_seq: int = ALL_ACKED, view_client: int = -3) -> str:
+def visible_text(
+    s: DocState, ref_seq: int = ALL_ACKED, view_client: int = -3,
+    raw: bool = False,
+) -> str:
     """Materialize the perspective-visible text on the host.  Marker
     codepoints (the reserved U+E000..U+F8FF plane, dds/markers.py) are
     filtered here — markers hold positions but contribute no text, the
-    reference's getText/getLength split."""
+    reference's getText/getLength split.  ``raw=True`` keeps them so
+    string indices equal positions."""
     from ..dds.markers import MARKER_CP_BASE, MARKER_CP_END
 
     nseg, vis = _host_vis(s, ref_seq, view_client)
@@ -912,7 +916,7 @@ def visible_text(s: DocState, ref_seq: int = ALL_ACKED, view_client: int = -3) -
         "".join(
             chr(c)
             for c in text[start[i] : start[i] + length[i]]
-            if not MARKER_CP_BASE <= c < MARKER_CP_END
+            if raw or not MARKER_CP_BASE <= c < MARKER_CP_END
         )
         for i in range(nseg)
         if vis[i]

@@ -123,6 +123,31 @@ class SequencedMessage:
             separators=(",", ":"),
         )
 
+    def wire_line(self) -> bytes:
+        """``to_json() + "\\n"`` encoded ONCE and cached on the message.
+
+        Sequenced messages are immutable after minting, so the deli->
+        firehose hot path encodes each message a single time at sequencing
+        and every subscriber fans out the same buffer — no per-op
+        ``json.dumps`` per consumer under the service lock (ref deli
+        produce, server/routerlicious/packages/lambdas/src/deli/
+        lambda.ts:851, which stringifies once into the Kafka produce)."""
+        b = self.__dict__.get("_wire_line")
+        if b is None:
+            b = (self.to_json() + "\n").encode()
+            self.__dict__["_wire_line"] = b
+        return b
+
+    def op_envelope(self) -> bytes:
+        """The nexus broadcast frame ``{"t":"op","msg":<this>}`` as cached
+        bytes: composed textually around ``wire_line`` so a thousand
+        connected sockets share one encode (ref nexus emit fan-out)."""
+        b = self.__dict__.get("_op_env")
+        if b is None:
+            b = b'{"t":"op","msg":' + self.wire_line()[:-1] + b"}\n"
+            self.__dict__["_op_env"] = b
+        return b
+
     @staticmethod
     def from_json(raw: str) -> "SequencedMessage":
         d = json.loads(raw)

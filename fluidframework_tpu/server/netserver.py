@@ -197,7 +197,9 @@ class NetworkServer:
             doc = self.service.document(doc_id)
 
             def on_op(msg: SequencedMessage, s=session) -> None:
-                s.send({"t": "op", "msg": seq_msg_to_dict(msg)})
+                # Pre-encoded envelope: one json.dumps per message total,
+                # shared by every connected socket (not one per socket).
+                s.send_raw(msg.op_envelope())
 
             def on_nack(nack, s=session) -> None:
                 s.send(
@@ -286,10 +288,10 @@ class NetworkServer:
             delivered = len(log) - doc.pending_count
             for msg in log[:delivered]:
                 if msg.seq > from_seq:
-                    writer.send_raw((msg.to_json() + "\n").encode())
+                    writer.send_raw(msg.wire_line())
             doc.subscribe_stream(
                 consumer_id,
-                lambda msg, w=writer: w.send_raw((msg.to_json() + "\n").encode()),
+                lambda msg, w=writer: w.send_raw(msg.wire_line()),
             )
 
     def handle_submit(self, session: _ClientSession, req: dict) -> None:

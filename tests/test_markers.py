@@ -185,3 +185,25 @@ def test_user_text_rejects_marker_plane():
     _svc, _doc, rts, ss = _fleet(1)
     with pytest.raises(ValueError):
         ss(rts[0]).insert_text(0, "badtext")
+
+
+def test_undo_capture_uses_position_space():
+    """Undo of a remove in a marker-bearing document re-inserts the RIGHT
+    characters: capture slices the position-indexed view, not ``text``
+    (which is shorter by one per preceding marker)."""
+    from fluidframework_tpu.framework.undo_redo import UndoRedoStackManager
+
+    _svc, doc, rts, ss = _fleet(1)
+    s = ss(rts[0])
+    mgr = UndoRedoStackManager()
+    s.insert_text(0, "abc")
+    s.insert_marker(0, REF_TILE, {MARKER_ID_KEY: "m"})  # positions: [mk]abc
+    _sync(doc, rts)
+    assert s.text == "abc" and s.backend.visible_length() == 4
+    mgr.capture_string_remove(s, 1, 2)  # removes "a" (position 1)
+    _sync(doc, rts)
+    assert s.text == "bc"
+    mgr.undo()
+    _sync(doc, rts)
+    assert s.text == "abc"  # "a" restored, not "b"
+    assert s.get_marker_from_id("m")["position"] == 0
