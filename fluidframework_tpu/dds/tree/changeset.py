@@ -102,15 +102,25 @@ Mark = Skip | Insert | Remove | Modify | MoveOut | MoveIn
 
 @dataclass
 class NodeChange:
-    """Changes to one node: an optional value overwrite plus per-field mark
-    lists. ``value`` is (new,) before apply and (new, old) after (enriched
-    for invert)."""
+    """Changes to one node: an optional value overwrite plus per-field
+    changes.  ``value`` is (new,) before apply and (new, old) after
+    (enriched for invert).
+
+    A field change is EITHER a bare ``list[Mark]`` (the sequence field
+    kind — wire format unchanged) or a kind-tagged change object
+    (field_kinds.py: optional/value/registered extensions); every
+    node-level operation dispatches per field through the registry
+    (ref modular-schema/fieldKind.ts)."""
 
     value: Optional[tuple] = None
-    fields: dict[str, list[Mark]] = field(default_factory=dict)
+    fields: dict[str, Any] = field(default_factory=dict)
 
     def is_empty(self) -> bool:
-        return self.value is None and not any(self.fields.values())
+        from .field_kinds import kind_of
+
+        return self.value is None and all(
+            kind_of(fc).is_empty(fc) for fc in self.fields.values()
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -162,18 +172,26 @@ def marks_from_json(data: list) -> list[Mark]:
 
 
 def change_to_json(change: NodeChange) -> dict:
+    from .field_kinds import field_change_to_json
+
     out: dict[str, Any] = {}
     if change.value is not None:
         out["v"] = list(change.value)
     if change.fields:
-        out["f"] = {k: marks_to_json(m) for k, m in change.fields.items()}
+        out["f"] = {
+            k: field_change_to_json(fc) for k, fc in change.fields.items()
+        }
     return out
 
 
 def change_from_json(data: dict) -> NodeChange:
+    from .field_kinds import field_change_from_json
+
     return NodeChange(
         value=tuple(data["v"]) if "v" in data else None,
-        fields={k: marks_from_json(m) for k, m in data.get("f", {}).items()},
+        fields={
+            k: field_change_from_json(m) for k, m in data.get("f", {}).items()
+        },
     )
 
 
@@ -451,16 +469,53 @@ def rebase_node_change(a: NodeChange, b: NodeChange, a_after: bool = True) -> No
     """Rebase one node's change over another's. Value: the later-sequenced
     set wins (LWW) — a keeps its value when it is the later side, and drops
     it when the earlier side is carried over a later set. Fields: pairwise
-    sided mark rebase."""
+    per-kind rebase through the registry."""
+    from .field_kinds import kind_of
+
     value = a.value
     if a.value is not None and b.value is not None and not a_after:
         value = None
     out = NodeChange(value=value)
-    for key, a_marks in a.fields.items():
-        b_marks = b.fields.get(key)
-        out.fields[key] = (
-            rebase_marks(a_marks, b_marks, a_after) if b_marks else list(a_marks)
+    for key, a_fc in a.fields.items():
+        b_fc = b.fields.get(key)
+        if b_fc is None:
+            out.fields[key] = kind_of(a_fc).clone(a_fc)
+            continue
+        kind = kind_of(a_fc)
+        assert kind is kind_of(b_fc), (
+            f"field {key!r}: kind mismatch {kind.name} vs {kind_of(b_fc).name}"
         )
+        out.fields[key] = kind.rebase(a_fc, b_fc, a_after)
+    return out
+
+
+def compose_node_change(a: NodeChange, b: NodeChange) -> NodeChange:
+    """Compose node changes (b reads a's output context; result reads a's
+    input context) — the third leg of the ChangeRebaser triple
+    (changeRebaser.ts:41), dispatched per field kind."""
+    from .field_kinds import kind_of
+
+    if b.value is not None:
+        # Enrichment is carried by tuple LENGTH (2 = applied), never by the
+        # prior's None-ness — None is a legitimate recorded prior.
+        a_applied = a.value is not None and len(a.value) == 2
+        if a_applied or len(b.value) == 2:
+            value = (b.value[0], a.value[1] if a_applied else b.value[1])
+        else:
+            value = (b.value[0],)
+    else:
+        value = a.value
+    out = NodeChange(value=value)
+    for key in {**a.fields, **b.fields}:
+        a_fc, b_fc = a.fields.get(key), b.fields.get(key)
+        if a_fc is None:
+            out.fields[key] = b_fc
+        elif b_fc is None:
+            out.fields[key] = a_fc
+        else:
+            kind = kind_of(a_fc)
+            assert kind is kind_of(b_fc), f"field {key!r}: kind mismatch"
+            out.fields[key] = kind.compose(a_fc, b_fc)
     return out
 
 
@@ -506,13 +561,15 @@ def invert_marks(marks: list[Mark]) -> list[Mark]:
 
 
 def invert_node_change(change: NodeChange) -> NodeChange:
+    from .field_kinds import kind_of
+
     value = None
     if change.value is not None:
         assert len(change.value) == 2, "invert of unapplied value change"
         value = (change.value[1], change.value[0])
     return NodeChange(
         value=value,
-        fields={k: invert_marks(m) for k, m in change.fields.items()},
+        fields={k: kind_of(fc).invert(fc) for k, fc in change.fields.items()},
     )
 
 
@@ -583,32 +640,118 @@ def apply_marks(nodes: list[Node], marks: list[Mark]) -> None:
 
 
 def apply_node_change(node: Node, change: NodeChange) -> None:
+    from .field_kinds import kind_of
+
     if change.value is not None:
         new = change.value[0]
         change.value = (new, node.value)
         node.value = new
-    for key, marks in change.fields.items():
-        apply_marks(node.fields.setdefault(key, []), marks)
+    for key, fc in change.fields.items():
+        kind_of(fc).apply(node.fields.setdefault(key, []), fc)
 
 
 # ---------------------------------------------------------------------------
-# Commits: atomic sequences of changesets (transactions)
+# Commits: atomic sequences of changesets (transactions) + constraints
 # ---------------------------------------------------------------------------
 # A commit is a list of NodeChanges applied in order as ONE sequenced unit —
 # the wire/trunk form of a transaction (ref shared-tree Transactor squashes
 # into one commit; here the sequence itself is the unit, so no separate
 # compose algebra is needed: rebase/invert/apply fold over the elements).
+#
+# Revision constraints (ref shared-tree runtime.constraints /
+# modular-changeset revision constraints): a commit may declare that a node
+# must still satisfy a predicate at sequencing time; rebasing the commit
+# over a concurrent change that breaks the predicate turns the WHOLE commit
+# into a no-op (``violated``).  Constraint paths rebase along with the
+# commit so later checks stay in valid coordinates.
+#
+#   {"type": "nodeInDocument", "path": [[field, idx], ...]}
+#       violated when a concurrent change detaches/replaces any node on
+#       the path (ref nodeExistsConstraint).
+#   {"type": "noChange", "path": [...]}
+#       additionally violated when the subtree at path was edited at all.
 
 
-Commit = list  # list[NodeChange]
+class Commit(list):
+    """list[NodeChange] plus constraint metadata.  Plain lists remain
+    accepted everywhere (constraint-free commits)."""
+
+    def __init__(self, changes=(), constraints=None, violated=False) -> None:
+        super().__init__(changes)
+        self.constraints = list(constraints or [])
+        self.violated = violated
+
+
+def _commit_meta(c) -> tuple[list, bool]:
+    return getattr(c, "constraints", []), getattr(c, "violated", False)
+
+
+def rebase_constraint_path(
+    path: list, change: NodeChange
+) -> tuple[list | None, bool]:
+    """Carry a constraint path through one NodeChange.  Returns
+    (rebased path | None when a node on the path was detached/replaced,
+    whether the subtree at the path was edited)."""
+    from .field_kinds import SEQUENCE, kind_of
+
+    cur: NodeChange | None = change
+    out: list = []
+    for key, idx in path:
+        fc = cur.fields.get(key) if cur is not None else None
+        if fc is None:
+            out.append([key, idx])
+            cur = None
+            continue
+        kind = kind_of(fc)
+        if kind is SEQUENCE:
+            fates = _Fates(fc)
+            k, pos, nested = fates.node(idx)
+            if k != "keep":
+                return None, True
+            out.append([key, pos])
+            cur = nested
+        else:  # optional/value: a set replaces the resident node
+            if fc.set is not None:
+                return None, True
+            out.append([key, idx])
+            cur = fc.nested
+    touched = cur is not None and not cur.is_empty()
+    return out, touched
+
+
+def _rebase_constraints(
+    constraints: list, x: NodeChange
+) -> tuple[list, bool]:
+    """All constraint paths through one concurrent change; returns
+    (updated constraints, violated)."""
+    out = []
+    for c in constraints:
+        path, touched = rebase_constraint_path(c["path"], x)
+        if path is None or (c["type"] == "noChange" and touched):
+            return constraints, True
+        out.append({**c, "path": path})
+    return out, False
 
 
 def rebase_commit_over_change(
     a: "Commit", x: NodeChange, a_after: bool = True
 ) -> "Commit":
     """Rebase the commit a = [c1..cn] over one change x sharing c1's input
-    context: each element rebases over x carried through its predecessors."""
-    out = []
+    context: each element rebases over x carried through its predecessors.
+
+    Constraints evaluate ONLY on the later/unsequenced side
+    (``a_after=True``): a commit that is already sequenced settled its
+    constraints at sequencing time, and re-judging it against LATER local
+    pending edits (the bridge's a_after=False leg) would void it on some
+    replicas only — divergence."""
+    constraints, violated = _commit_meta(a)
+    if constraints and not violated and a_after:
+        constraints, violated = _rebase_constraints(constraints, x)
+        if violated:
+            return Commit([], constraints, violated=True)
+    out = Commit(constraints=constraints, violated=violated)
+    if violated:
+        return out
     for c in a:
         out.append(rebase_node_change(c, x, a_after))
         x = rebase_node_change(x, c, not a_after)
@@ -616,7 +759,8 @@ def rebase_commit_over_change(
 
 
 def rebase_commit(a: "Commit", b: "Commit", a_after: bool = True) -> "Commit":
-    """Rebase commit a over commit b (same input context)."""
+    """Rebase commit a over commit b (same input context).  Constraint
+    violation anywhere in b voids a (the transaction no-ops)."""
     for x in b:
         a = rebase_commit_over_change(a, x, a_after)
         # Carrying x forward happens inside the helper per element; for the
@@ -626,7 +770,18 @@ def rebase_commit(a: "Commit", b: "Commit", a_after: bool = True) -> "Commit":
 
 
 def invert_commit(cs: "Commit") -> "Commit":
-    return [invert_node_change(c) for c in reversed(cs)]
+    return Commit([invert_node_change(c) for c in reversed(cs)])
+
+
+def compose_commit(cs: "Commit") -> NodeChange:
+    """Squash a commit into ONE NodeChange (offline tooling; the trunk
+    pipeline keeps commits as element lists)."""
+    if not cs:
+        return NodeChange()
+    out = cs[0]
+    for c in cs[1:]:
+        out = compose_node_change(out, c)
+    return out
 
 
 def apply_commit(root: Node, cs: "Commit") -> None:
@@ -645,15 +800,31 @@ def rollback_staged(root: Node, staged: list[NodeChange], applied_log: list[Node
 
 
 def clone_commit(cs: "Commit") -> "Commit":
-    return [clone_change(c) for c in cs]
+    constraints, violated = _commit_meta(cs)
+    return Commit(
+        [clone_change(c) for c in cs],
+        [dict(c, path=[list(p) for p in c["path"]]) for c in constraints],
+        violated,
+    )
 
 
-def commit_to_json(cs: "Commit") -> list:
-    return [change_to_json(c) for c in cs]
+def commit_to_json(cs: "Commit"):
+    changes = [change_to_json(c) for c in cs]
+    constraints, violated = _commit_meta(cs)
+    if not constraints and not violated:
+        return changes  # bare-list wire shape (constraint-free compat)
+    return {"changes": changes, "constraints": constraints,
+            "violated": violated}
 
 
-def commit_from_json(data: list) -> "Commit":
-    return [change_from_json(c) for c in data]
+def commit_from_json(data) -> "Commit":
+    if isinstance(data, dict):
+        return Commit(
+            [change_from_json(c) for c in data["changes"]],
+            data.get("constraints"),
+            data.get("violated", False),
+        )
+    return Commit([change_from_json(c) for c in data])
 
 
 # ---------------------------------------------------------------------------
@@ -694,6 +865,47 @@ def make_remove(
     marks: list[Mark] = [Skip(index)] if index else []
     marks.append(Remove(count))
     return _wrap(path, NodeChange(fields={field_key: marks}))
+
+
+def make_optional_set(
+    path: list[tuple[str, int]], field_key: str, content: "Node | None",
+    kind: str = "optional",
+) -> NodeChange:
+    """Replace the whole content of an optional/value field under ``path``
+    (None clears an optional field; ref optional-field set/clear)."""
+    from .field_kinds import OptionalChange
+
+    return _wrap(path, NodeChange(fields={
+        field_key: OptionalChange(
+            kind=kind, set=(content.clone() if content is not None else None,)
+        )
+    }))
+
+
+def make_optional_edit(
+    path: list[tuple[str, int]], field_key: str, nested: NodeChange,
+    kind: str = "optional",
+) -> NodeChange:
+    """Edit the node RESIDENT in an optional/value field (same-kind nested
+    form — a field's kind is fixed by schema, so edits and sets of one
+    field always rebase under the same registry entry)."""
+    from .field_kinds import OptionalChange
+
+    return _wrap(path, NodeChange(fields={
+        field_key: OptionalChange(kind=kind, nested=nested)
+    }))
+
+
+def node_exists_constraint(path: list[tuple[str, int]]) -> dict:
+    """The transaction no-ops if the node at ``path`` was detached by a
+    concurrent edit (ref runtime.constraints nodeInDocument)."""
+    return {"type": "nodeInDocument", "path": [list(p) for p in path]}
+
+
+def no_change_constraint(path: list[tuple[str, int]]) -> dict:
+    """Stricter: the transaction no-ops if the subtree at ``path`` was
+    edited at all concurrently."""
+    return {"type": "noChange", "path": [list(p) for p in path]}
 
 
 _move_counter = 0

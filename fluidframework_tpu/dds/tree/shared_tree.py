@@ -54,6 +54,13 @@ class SharedTreeChannel(Channel):
     def __init__(self, channel_id: str) -> None:
         super().__init__(channel_id)
         self.forest = Forest()  # trunk-tip state + local pending overlay
+        # The SEQUENCED state alone (no local overlay): one trunk apply per
+        # sequenced commit keeps it current, and it is the exact rebuild
+        # base when a constraint violation voids a pending commit (the
+        # inverse-rewind shortcut can diverge when LWW suppressed a
+        # concurrent value set's repair data) — ref shared-tree trunk vs
+        # checkout branch split.
+        self._trunk_forest = Forest()
         self.idc = IdCompressor()
         self.em = EditManager(
             encode_rev=self._rev_to_stable, decode_rev=self._rev_from_stable
@@ -63,6 +70,7 @@ class SharedTreeChannel(Channel):
         # rebased as remote commits land (the sandwich).
         self._local_pending: list[tuple[Any, Commit]] = []
         self._txn: list[NodeChange] | None = None
+        self._txn_constraints: list = []
         self.on_change: Callable[[], None] | None = None  # view invalidation
         # Multiplexed change listeners (simple-tree node events ride these).
         self._change_listeners: list[Callable[[], None]] = []
@@ -93,19 +101,28 @@ class SharedTreeChannel(Channel):
         return ("", self.idc.recompress(stable))
 
     # ------------------------------------------------------------ local edits
-    def submit_change(self, change: NodeChange) -> None:
+    def submit_change(
+        self, change: NodeChange, constraints: list | None = None
+    ) -> None:
         """Apply a local edit optimistically; ships immediately, or as part
         of the enclosing transaction's atomic commit.  The forest apply
         enriches the change (repair data), and the enriched form is what
         goes on the wire so every replica integrates the exact same
-        changeset object."""
+        changeset object.
+
+        ``constraints`` (changeset.node_exists_constraint /
+        no_change_constraint): the edit becomes a no-op on EVERY replica if
+        a concurrent sequenced change violates one (ref runtime.constraints
+        nodeInDocument)."""
         apply_commit(self.forest.root, [change])
         self.applied_log.append(change)
         if self._txn is not None:
             self._txn.append(change)
+            if constraints:
+                self._txn_constraints.extend(constraints)
             self._notify()
             return
-        self._ship_commit([change])
+        self._ship_commit(Commit([change], constraints))
         self._notify()
 
     def _ship_commit(self, commit: Commit) -> None:
@@ -128,13 +145,16 @@ class SharedTreeChannel(Channel):
 
     # ------------------------------------------------------------ transactions
     @contextmanager
-    def transaction(self):
+    def transaction(self, constraints: list | None = None):
         """Atomic edit scope: everything submitted inside lands as one
         commit (one sequence number, all-or-nothing against concurrency);
-        an exception rolls the forest back and ships nothing."""
+        an exception rolls the forest back and ships nothing.
+        ``constraints`` void the whole transaction if violated by a
+        concurrent sequenced edit (ref Transactor + runtime.constraints)."""
         if self._txn is not None:
             raise RuntimeError("transactions do not nest")
         self._txn = []
+        self._txn_constraints = list(constraints or [])
         try:
             yield self
         except BaseException:
@@ -143,8 +163,9 @@ class SharedTreeChannel(Channel):
             self._notify()
             raise
         staged, self._txn = self._txn, None
+        cons, self._txn_constraints = self._txn_constraints, []
         if staged:
-            self._ship_commit(staged)
+            self._ship_commit(Commit(staged, cons))
         self._notify()
 
     def set_schema(self, registry: SchemaRegistry) -> None:
@@ -245,6 +266,7 @@ class SharedTreeChannel(Channel):
                 ref_seq=env.ref_seq,
                 seq=env.seq,
             )
+            apply_commit(self._trunk_forest.root, clone_commit(trunk_change))
             if m.local:
                 # Our own edit reached the trunk: the forest already shows it.
                 assert self._local_pending and self._local_pending[0][0] == rev, (
@@ -254,11 +276,38 @@ class SharedTreeChannel(Channel):
             else:
                 # Sandwich: rebase the local branch over the new trunk commit
                 # and apply its bridged form to the optimistic forest.
+                had = [
+                    getattr(cm, "violated", False)
+                    for _r, cm in self._local_pending
+                ]
+                prev_pending = self._local_pending
                 self._local_pending, x = bridge(
                     self._local_pending, clone_commit(trunk_change)
                 )
-                apply_commit(self.forest.root, x)
-                self.applied_log.extend(x)
+                newly_voided = any(
+                    getattr(cm, "violated", False) and not had[i]
+                    for i, (_r, cm) in enumerate(self._local_pending)
+                )
+                if newly_voided:
+                    # A constraint of OURS was violated by this concurrent
+                    # commit: the optimistic overlay still shows the voided
+                    # edit.  Rebuild from the EXACT trunk state (already
+                    # advanced past this commit) plus the surviving rebased
+                    # pending forms — inverse-rewind shortcuts can diverge
+                    # when LWW suppressed a concurrent set's repair data.
+                    # The applied_log gets a best-effort inverse trail so
+                    # coordinate consumers (undo, tree-agent) keep a
+                    # contiguous history.
+                    for _rev, cm in reversed(prev_pending):
+                        self.applied_log.extend(invert_commit(cm))
+                    self.applied_log.extend(clone_commit(trunk_change))
+                    self.forest.load_json(self._trunk_forest.to_json())
+                    for _rev, cm in self._local_pending:
+                        apply_commit(self.forest.root, cm)
+                        self.applied_log.extend(cm)
+                else:
+                    apply_commit(self.forest.root, x)
+                    self.applied_log.extend(x)
             # Mark AFTER the forest apply: the dirty range must span the
             # POST-change chunk count (a remote append growing the domain
             # past a chunk boundary must dirty the new tail chunk, or the
@@ -392,6 +441,14 @@ class SharedTreeChannel(Channel):
             next_changes = []
             for change in changes:
                 for key, marks in change.fields.items():
+                    if not isinstance(marks, list):
+                        # Non-sequence field kinds (optional/value sets)
+                        # reshape conservatively: re-upload every chunk.
+                        from .field_kinds import kind_of
+
+                        if not kind_of(marks).is_empty(marks):
+                            dirty_all = True
+                        continue
                     if key != fkey:
                         if marks:
                             dirty_all = True  # off-spine edit reshapes domain
@@ -486,6 +543,7 @@ class SharedTreeChannel(Channel):
     def load(self, summary: dict[str, Any]) -> None:
         self.forest.root = Node(type="__root__")
         self.forest.root.fields[ROOT_FIELD] = decode_field_chunked(summary["forest"])
+        self._trunk_forest.load_json(self.forest.to_json())
         if "idCompressor" in summary:
             self.idc = IdCompressor.deserialize(summary["idCompressor"])
         self.em = EditManager(

@@ -247,6 +247,10 @@ class TreeBatchEngine:
             if change.value is not None:
                 raise UnsupportedShape("value change on the virtual root")
             for key, marks in change.fields.items():
+                if not isinstance(marks, list):
+                    # Non-sequence field kinds (optional/value sets) are
+                    # host-fallback territory for now.
+                    raise UnsupportedShape(f"field kind {marks.kind!r}")
                 self._walk_marks(marks, (), self._field_id(key), emit)
         return rows
 
@@ -278,6 +282,8 @@ class TreeBatchEngine:
                 if any(ch.fields.values()):
                     child_steps = steps + ((fid, out_pos),)
                     for key, nested in ch.fields.items():
+                        if not isinstance(nested, list):
+                            raise UnsupportedShape(f"field kind {nested.kind!r}")
                         if nested:
                             self._walk_marks(
                                 nested, child_steps, self._field_id(key), emit

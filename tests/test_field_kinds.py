@@ -1,0 +1,469 @@
+"""Modular change family (VERDICT r4 next #5): per-field-kind rebaser laws
+(rebase convergence / invert / compose identities, ref changeRebaser.ts:41),
+optional-field semantics through the channel boundary, and revision
+constraints (a transaction no-ops on every replica when a concurrent edit
+violates it) including a constraint fuzz.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.channels import default_registry
+from fluidframework_tpu.dds.tree.changeset import (
+    Commit,
+    Insert,
+    Modify,
+    NodeChange,
+    Remove,
+    Skip,
+    apply_commit,
+    apply_marks,
+    apply_node_change,
+    clone_change,
+    commit_from_json,
+    commit_to_json,
+    compose_node_change,
+    invert_marks,
+    invert_node_change,
+    make_insert,
+    make_optional_set,
+    make_remove,
+    make_set_value,
+    no_change_constraint,
+    node_exists_constraint,
+    rebase_commit,
+    rebase_marks,
+)
+from fluidframework_tpu.dds.tree.field_kinds import (
+    OPTIONAL,
+    OptionalChange,
+    compose_marks,
+    field_change_from_json,
+    field_change_to_json,
+)
+from fluidframework_tpu.dds.tree.forest import Node
+from fluidframework_tpu.dds.tree.schema import leaf
+from fluidframework_tpu.runtime import ContainerRuntime
+from fluidframework_tpu.server.local_service import LocalService
+
+
+def _field(values) -> list[Node]:
+    return [leaf(v) for v in values]
+
+
+def _vals(nodes) -> list:
+    return [n.value for n in nodes]
+
+
+def _rand_seq_marks(rng, n: int) -> list:
+    """Random move-free mark list over an n-node field."""
+    marks = []
+    pos = 0
+    while pos < n:
+        k = rng.random()
+        if k < 0.4:
+            step = rng.randint(1, n - pos)
+            marks.append(Skip(step))
+            pos += step
+        elif k < 0.6:
+            marks.append(Insert(_field([rng.randrange(100) for _ in range(rng.randint(1, 2))])))
+        elif k < 0.8:
+            step = rng.randint(1, min(2, n - pos))
+            marks.append(Remove(step))
+            pos += step
+        else:
+            marks.append(Modify(NodeChange(value=(rng.randrange(100),))))
+            pos += 1
+    if rng.random() < 0.5:
+        marks.append(Insert(_field([rng.randrange(100)])))
+    return marks
+
+
+# ---------------------------------------------------------------------------
+# Sequence kind laws
+# ---------------------------------------------------------------------------
+
+
+def test_sequence_rebase_convergence_square():
+    """a sequenced first: apply(a) + rebase(b over a, later) ==
+    apply(b) + rebase(a over b, earlier) — the sided OT square."""
+    for seed in range(40):
+        rng = random.Random(seed)
+        n = rng.randint(0, 6)
+        base = [rng.randrange(100) for _ in range(n)]
+        a = _rand_seq_marks(rng, n)
+        b = _rand_seq_marks(rng, n)
+        f1 = _field(base)
+        apply_marks(f1, [clone_mark for clone_mark in a])
+        apply_marks(f1, rebase_marks(b, a, a_after=True))
+        f2 = _field(base)
+        apply_marks(f2, b)
+        apply_marks(f2, rebase_marks(a, b, a_after=False))
+        assert [x.to_json() for x in f1] == [x.to_json() for x in f2], seed
+
+
+def test_sequence_invert_law():
+    """apply(a) then apply(invert(a)) restores the field."""
+    for seed in range(40):
+        rng = random.Random(1000 + seed)
+        n = rng.randint(0, 6)
+        base = [rng.randrange(100) for _ in range(n)]
+        a = _rand_seq_marks(rng, n)
+        f = _field(base)
+        snapshot = [x.to_json() for x in f]
+        apply_marks(f, a)  # enriches a
+        apply_marks(f, invert_marks(a))
+        assert [x.to_json() for x in f] == snapshot, seed
+
+
+def test_sequence_compose_law():
+    """apply(compose(a, b)) == apply(a); apply(b)."""
+    for seed in range(40):
+        rng = random.Random(2000 + seed)
+        n = rng.randint(0, 6)
+        base = [rng.randrange(100) for _ in range(n)]
+        a = _rand_seq_marks(rng, n)
+        f1 = _field(base)
+        apply_marks(f1, a)
+        b = _rand_seq_marks(rng, len(f1))
+        composed = compose_marks(a, b)
+        apply_marks(f1, b)
+        f2 = _field(base)
+        apply_marks(f2, composed)
+        assert [x.to_json() for x in f1] == [x.to_json() for x in f2], seed
+
+
+# ---------------------------------------------------------------------------
+# Optional kind laws
+# ---------------------------------------------------------------------------
+
+
+def _rand_opt_change(rng, occupied: bool) -> OptionalChange:
+    k = rng.random()
+    if k < 0.4:
+        return OptionalChange(set=(leaf(rng.randrange(100)),))
+    if k < 0.6:
+        return OptionalChange(set=(None,))
+    if occupied:
+        return OptionalChange(nested=NodeChange(value=(rng.randrange(100),)))
+    return OptionalChange(set=(leaf(rng.randrange(100)),))
+
+
+def _opt_field(rng):
+    return _field([rng.randrange(100)]) if rng.random() < 0.7 else []
+
+
+def test_optional_rebase_convergence_square():
+    for seed in range(60):
+        rng = random.Random(seed)
+        base = _opt_field(rng)
+        a = _rand_opt_change(rng, bool(base))
+        b = _rand_opt_change(rng, bool(base))
+        f1 = [n.clone() for n in base]
+        OPTIONAL.apply(f1, OPTIONAL.from_json(OPTIONAL.to_json(a)))
+        rb = OPTIONAL.rebase(b, a, a_after=True)
+        if not OPTIONAL.is_empty(rb):
+            OPTIONAL.apply(f1, OPTIONAL.from_json(OPTIONAL.to_json(rb)))
+        f2 = [n.clone() for n in base]
+        OPTIONAL.apply(f2, OPTIONAL.from_json(OPTIONAL.to_json(b)))
+        ra = OPTIONAL.rebase(a, b, a_after=False)
+        if not OPTIONAL.is_empty(ra):
+            OPTIONAL.apply(f2, OPTIONAL.from_json(OPTIONAL.to_json(ra)))
+        assert [x.to_json() for x in f1] == [x.to_json() for x in f2], seed
+
+
+def test_optional_invert_law():
+    for seed in range(40):
+        rng = random.Random(500 + seed)
+        base = _opt_field(rng)
+        a = _rand_opt_change(rng, bool(base))
+        f = [n.clone() for n in base]
+        snapshot = [x.to_json() for x in f]
+        OPTIONAL.apply(f, a)  # enriches
+        OPTIONAL.apply(f, OPTIONAL.invert(a))
+        assert [x.to_json() for x in f] == snapshot, seed
+
+
+def test_optional_compose_law():
+    for seed in range(40):
+        rng = random.Random(900 + seed)
+        base = _opt_field(rng)
+        a = _rand_opt_change(rng, bool(base))
+        f1 = [n.clone() for n in base]
+        a1 = OPTIONAL.from_json(OPTIONAL.to_json(a))
+        OPTIONAL.apply(f1, a1)
+        b = _rand_opt_change(rng, bool(f1))
+        composed = OPTIONAL.compose(
+            OPTIONAL.from_json(OPTIONAL.to_json(a)),
+            OPTIONAL.from_json(OPTIONAL.to_json(b)),
+        )
+        OPTIONAL.apply(f1, OPTIONAL.from_json(OPTIONAL.to_json(b)))
+        f2 = [n.clone() for n in base]
+        OPTIONAL.apply(f2, composed)
+        assert [x.to_json() for x in f1] == [x.to_json() for x in f2], seed
+
+
+def test_optional_codec_roundtrip():
+    for change in (
+        OptionalChange(set=(leaf(7),)),
+        OptionalChange(set=(None,)),
+        OptionalChange(kind="value", set=(leaf(1), leaf(2))),
+        OptionalChange(nested=NodeChange(value=(3,))),
+    ):
+        data = field_change_to_json(change)
+        back = field_change_from_json(data)
+        assert field_change_to_json(back) == data
+    # Bare lists stay the sequence kind on the wire.
+    assert field_change_to_json([Skip(2), Remove(1)]) == [["s", 2], ["r", 1]]
+
+
+def test_node_change_compose_dispatches_kinds():
+    """compose_node_change folds value + mixed-kind fields."""
+    a = NodeChange(
+        value=(5,),
+        fields={"seq": [Insert(_field([1, 2]))], "opt": OptionalChange(set=(leaf(9),))},
+    )
+    node = Node(type="obj")
+    apply_node_change(node, a)  # enrich
+    b = NodeChange(
+        value=(6,),
+        fields={"seq": [Skip(1), Remove(1)], "opt": OptionalChange(nested=NodeChange(value=(10,)))},
+    )
+    composed = compose_node_change(a, b)
+    n2 = Node(type="obj")
+    apply_node_change(n2, composed)
+    n3 = Node(type="obj")
+    apply_node_change(node, b)
+    assert n2.to_json() == node.to_json()
+    assert n3.to_json() != n2.to_json()  # sanity: compose did something
+
+
+# ---------------------------------------------------------------------------
+# Channel-level optional fields + constraints
+# ---------------------------------------------------------------------------
+
+
+def _tree_fleet(n=2):
+    svc = LocalService()
+    doc = svc.document("doc")
+    rts = []
+    for i in range(n):
+        rt = ContainerRuntime(default_registry(), container_id=f"c{i}")
+        rt.create_datastore("root").create_channel("sharedTree", "t")
+        rt.connect(doc, f"c{i}")
+        rts.append(rt)
+    doc.process_all()
+    tree = lambda rt: rt.datastore("root").get_channel("t")
+    return svc, doc, rts, tree
+
+
+def _sync(doc, rts):
+    for rt in rts:
+        rt.flush()
+    doc.process_all()
+
+
+def test_optional_field_channel_convergence():
+    """Concurrent optional-field sets: later-sequenced wins on every
+    replica; clear and nested edits converge too."""
+    _svc, doc, rts, tree = _tree_fleet(2)
+    a, b = tree(rts[0]), tree(rts[1])
+    a.submit_change(make_insert([], "", 0, [Node(type="obj")]))
+    _sync(doc, rts)
+    # Race two sets on the same optional field.
+    a.submit_change(make_optional_set([("", 0)], "meta", leaf(1)))
+    b.submit_change(make_optional_set([("", 0)], "meta", leaf(2)))
+    rts[0].flush()
+    rts[1].flush()
+    doc.process_all()
+    va = a.forest.root_field[0].fields["meta"][0].value
+    vb = b.forest.root_field[0].fields["meta"][0].value
+    assert va == vb == 2  # b sequenced later, later wins
+    # Clear vs nested edit: the clear (sequenced later) wins.
+    from fluidframework_tpu.dds.tree.changeset import make_optional_edit
+
+    a.submit_change(
+        make_optional_edit([("", 0)], "meta", NodeChange(value=(5,)))
+    )
+    b.submit_change(make_optional_set([("", 0)], "meta", None))
+    rts[0].flush()
+    rts[1].flush()
+    doc.process_all()
+    assert a.forest.root_field[0].fields.get("meta", []) == []
+    assert b.forest.root_field[0].fields.get("meta", []) == []
+    assert a.forest.equal(b.forest)
+
+
+def test_node_exists_constraint_voids_commit_everywhere():
+    """B removes the node A constrained on (B sequenced first): A's edit
+    no-ops on every replica, including A's own optimistic view."""
+    _svc, doc, rts, tree = _tree_fleet(2)
+    a, b = tree(rts[0]), tree(rts[1])
+    a.submit_change(make_insert([], "", 0, _field([10, 20, 30])))
+    _sync(doc, rts)
+    # A edits node 1 under a constraint; B concurrently removes node 1.
+    a.submit_change(
+        make_set_value([("", 1)], 99),
+        constraints=[node_exists_constraint([("", 1)])],
+    )
+    b.submit_change(make_remove([], "", 1, 1))
+    rts[1].flush()  # B sequenced first
+    rts[0].flush()
+    doc.process_all()
+    assert [n.value for n in a.forest.root_field] == [10, 30]
+    assert a.forest.equal(b.forest)
+
+
+def test_constraint_survives_unrelated_edit_and_path_shift():
+    """An insert BEFORE the constrained node shifts the constraint path;
+    the commit still applies (constraints rebase, they don't pin)."""
+    _svc, doc, rts, tree = _tree_fleet(2)
+    a, b = tree(rts[0]), tree(rts[1])
+    a.submit_change(make_insert([], "", 0, _field([10, 20])))
+    _sync(doc, rts)
+    a.submit_change(
+        make_set_value([("", 1)], 99),
+        constraints=[node_exists_constraint([("", 1)])],
+    )
+    b.submit_change(make_insert([], "", 0, _field([5])))  # shifts path
+    rts[1].flush()
+    rts[0].flush()
+    doc.process_all()
+    assert [n.value for n in a.forest.root_field] == [5, 10, 99]
+    assert a.forest.equal(b.forest)
+
+
+def test_no_change_constraint_voided_by_subtree_edit():
+    _svc, doc, rts, tree = _tree_fleet(2)
+    a, b = tree(rts[0]), tree(rts[1])
+    a.submit_change(make_insert([], "", 0, _field([10, 20])))
+    _sync(doc, rts)
+    with a.transaction(constraints=[no_change_constraint([("", 0)])]):
+        a.submit_change(make_insert([], "", 2, _field([77])))
+    b.submit_change(make_set_value([("", 0)], 11))  # touches the subtree
+    rts[1].flush()
+    rts[0].flush()
+    doc.process_all()
+    assert [n.value for n in a.forest.root_field] == [11, 20]  # txn voided
+    assert a.forest.equal(b.forest)
+
+
+def test_constraint_wire_roundtrip():
+    c = Commit(
+        [make_insert([], "", 0, _field([1]))],
+        [node_exists_constraint([("", 2)])],
+    )
+    data = commit_to_json(c)
+    assert isinstance(data, dict) and data["constraints"]
+    back = commit_from_json(data)
+    assert back.constraints == c.constraints and not back.violated
+    # Constraint-free commits keep the bare-list wire shape.
+    assert isinstance(commit_to_json(Commit([make_remove([], "", 0, 1)])), list)
+
+
+def test_constraint_fuzz_converges():
+    """Random constrained and unconstrained edits from multiple writers
+    under random interleaving: every replica's full tree stays identical."""
+    for seed in (3, 17, 31):
+        rng = random.Random(seed)
+        _svc, doc, rts, tree = _tree_fleet(3)
+        t0 = tree(rts[0])
+        t0.submit_change(make_insert([], "", 0, _field(list(range(6)))))
+        _sync(doc, rts)
+        for _step in range(25):
+            rt = rts[rng.randrange(3)]
+            t = tree(rt)
+            n = len(t.forest.root_field)
+            kind = rng.choices(["ins", "rm", "set", "cons"], [4, 2, 3, 3])[0]
+            if kind == "ins" or n == 0:
+                t.submit_change(make_insert([], "", rng.randint(0, n), _field([rng.randrange(100)])))
+            elif kind == "rm":
+                t.submit_change(make_remove([], "", rng.randrange(n), 1))
+            elif kind == "set":
+                t.submit_change(make_set_value([("", rng.randrange(n))], rng.randrange(100)))
+            else:
+                idx = rng.randrange(n)
+                ctor = node_exists_constraint if rng.random() < 0.6 else no_change_constraint
+                t.submit_change(
+                    make_set_value([("", idx)], rng.randrange(100)),
+                    constraints=[ctor([("", idx)])],
+                )
+            if rng.random() < 0.5:
+                rt.flush()
+            if rng.random() < 0.4:
+                doc.process_some(rng.randint(0, doc.pending_count))
+        _sync(doc, rts)
+        ref = tree(rts[0]).forest.to_json()
+        for rt in rts[1:]:
+            assert tree(rt).forest.to_json() == ref, seed
+
+
+def test_incoming_constrained_commit_not_judged_by_local_pending():
+    """A sequenced commit's constraints were settled at sequencing; a local
+    UNSEQUENCED pending edit must not void it on this replica only
+    (bridge's a_after=False leg skips constraint evaluation)."""
+    _svc, doc, rts, tree = _tree_fleet(2)
+    a, b = tree(rts[0]), tree(rts[1])
+    a.submit_change(make_insert([], "", 0, _field([10, 20, 30])))
+    _sync(doc, rts)
+    # B ships a constrained edit; it sequences cleanly (no concurrent
+    # violation).  A has a pending remove of the constrained node that is
+    # NOT yet sequenced when B's commit arrives.
+    b.submit_change(
+        make_set_value([("", 1)], 77),
+        constraints=[node_exists_constraint([("", 1)])],
+    )
+    rts[1].flush()
+    a.submit_change(make_remove([], "", 1, 1))  # pending, unflushed
+    doc.process_all()  # B's commit arrives at A while A's remove is pending
+    rts[0].flush()
+    doc.process_all()
+    # B's edit applied everywhere (the remove was sequenced AFTER it and
+    # simply deletes the node, 77 and all).
+    assert a.forest.equal(b.forest)
+    assert [n.value for n in a.forest.root_field] == [10, 30]
+
+
+def test_constraint_void_with_lww_suppressed_prior():
+    """Constraint void rebuilds from exact trunk state: even when the
+    voided pending set had LWW-suppressed a concurrent sequenced set (so
+    its recorded prior is stale), the issuer converges to the trunk.
+    Offline window keeps A's commit genuinely concurrent with S1/S2."""
+    _svc, doc, rts, tree = _tree_fleet(2)
+    a, b = tree(rts[0]), tree(rts[1])
+    a.submit_change(make_insert([], "", 0, _field([10, 20])))
+    _sync(doc, rts)
+    rts[0].disconnect()
+    # A (offline): constrained set of node 0 to 99 (prior recorded as 10).
+    a.submit_change(
+        make_set_value([("", 0)], 99),
+        constraints=[node_exists_constraint([("", 1)])],
+    )
+    # B: S1 sets the same value to 55 (sequenced first; A's pending set
+    # wins LWW locally on catch-up), then S2 removes node 1 — violating
+    # A's constraint and voiding the whole pending commit.
+    b.submit_change(make_set_value([("", 0)], 55))
+    b.submit_change(make_remove([], "", 1, 1))
+    rts[1].flush()
+    doc.process_all()
+    rts[0].connect(doc, "c0-re")  # catch-up bridges S1 then S2, voids A
+    rts[0].flush()
+    doc.process_all()
+    # Trunk: 55 survives (A's set voided), node 1 gone. A must agree.
+    assert [n.value for n in a.forest.root_field] == [55]
+    assert a.forest.equal(b.forest)
+
+
+def test_voided_optional_change_invert_is_noop():
+    from fluidframework_tpu.dds.tree.field_kinds import OPTIONAL, OptionalChange
+
+    empty = OPTIONAL.rebase(
+        OptionalChange(nested=NodeChange(value=(1,))),
+        OptionalChange(set=(leaf(2),)),
+        a_after=True,
+    )
+    assert OPTIONAL.is_empty(empty)
+    assert OPTIONAL.is_empty(OPTIONAL.invert(empty))  # must not raise
