@@ -195,8 +195,38 @@ def change_from_json(data: dict) -> NodeChange:
     )
 
 
+def _clone_mark(m: Mark) -> Mark:
+    if isinstance(m, Skip):
+        return Skip(m.count)
+    if isinstance(m, Insert):
+        return Insert([n.clone() for n in m.content])
+    if isinstance(m, Remove):
+        return Remove(
+            m.count,
+            [n.clone() for n in m.detached] if m.detached is not None else None,
+        )
+    if isinstance(m, MoveOut):
+        return MoveOut(m.count, m.id, m.offset)
+    if isinstance(m, MoveIn):
+        return MoveIn(m.id, m.count, m.offset)
+    return Modify(clone_change(m.change))
+
+
 def clone_change(change: NodeChange) -> NodeChange:
-    return change_from_json(change_to_json(change))
+    """Structural deep clone — no JSON codec pass; every sequenced commit
+    is cloned once for the trunk-forest apply (shared_tree.py), so this
+    is delta-pump hot-path code."""
+    from .field_kinds import kind_of
+
+    return NodeChange(
+        value=tuple(change.value) if change.value is not None else None,
+        fields={
+            k: [_clone_mark(m) for m in fc]
+            if isinstance(fc, list)
+            else kind_of(fc).clone(fc)
+            for k, fc in change.fields.items()
+        },
+    )
 
 
 # ---------------------------------------------------------------------------

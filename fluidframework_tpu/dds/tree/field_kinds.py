@@ -176,9 +176,19 @@ class OptionalFieldKind(FieldKind):
 
         if b.set is not None:
             new = b.set[0]
-            prior = a.set[1] if (a.set is not None and len(a.set) == 2) else (
-                b.set[1] if len(b.set) == 2 else None
-            )
+            if a.set is not None and len(a.set) == 2:
+                prior = a.set[1]
+            elif len(b.set) == 2:
+                # b's recorded prior lives in a's OUTPUT context; repair
+                # data of the composed change must be in a's INPUT context,
+                # so unwind a's nested edit from it (possible exactly when
+                # a was applied/enriched — the squash-of-applied case).
+                prior = b.set[1]
+                if prior is not None and a.nested is not None:
+                    prior = prior.clone()
+                    apply_node_change(prior, _safe_invert(a.nested))
+            else:
+                prior = None
             out = (new, prior) if (
                 len(b.set) == 2 or (a.set is not None and len(a.set) == 2)
             ) else (new,)
@@ -288,6 +298,18 @@ def field_change_from_json(data):
     return FIELD_KINDS[data["k"]].from_json(data)
 
 
+def _safe_invert(nested):
+    """Invert a nested NodeChange for repair-data context transport; an
+    unenriched change (compose of never-applied changes, which carries no
+    repair data to protect) inverts to the identity instead of asserting."""
+    from .changeset import NodeChange, invert_node_change
+
+    try:
+        return invert_node_change(nested)
+    except AssertionError:
+        return NodeChange()
+
+
 # ---------------------------------------------------------------------------
 # Sequence compose (Skip/Insert/Remove/Modify; moves unsupported)
 # ---------------------------------------------------------------------------
@@ -380,9 +402,14 @@ def compose_marks(a: list, b: list) -> list:
             out_pos += 1
         elif isinstance(m, Remove):
             for off in range(m.count):
-                kind, pos, _nested = item(out_pos)
+                kind, pos, nested = item(out_pos)
                 det = m.detached[off] if m.detached is not None else None
                 if kind == "in":
+                    if det is not None and nested is not None:
+                        # b captured the node AFTER a's Modify; composed
+                        # repair data must be a's-input-context content.
+                        det = det.clone()
+                        apply_node_change(det, _safe_invert(nested))
                     placements.append((
                         pos, 1, seq,
                         Remove(1, [det] if det is not None else None),

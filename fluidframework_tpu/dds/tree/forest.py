@@ -59,7 +59,24 @@ class Node:
         )
 
     def clone(self) -> "Node":
-        return Node.from_json(self.to_json())
+        # Structural clone (no JSON codec pass — this sits on the trunk
+        # apply hot path).  Empty fields prune, matching the canonical
+        # to_json form; non-scalar leaf values deep-copy via the codec
+        # (they are rare; scalars dominate).
+        v = self.value
+        if not isinstance(v, (int, float, str, bool, type(None))):
+            import json as _json
+
+            v = _json.loads(_json.dumps(v))
+        return Node(
+            type=self.type,
+            value=v,
+            fields={
+                k: [c.clone() for c in children]
+                for k, children in self.fields.items()
+                if children
+            },
+        )
 
     def child(self, field_key: str, index: int) -> "Node":
         return self.fields[field_key][index]

@@ -1,0 +1,230 @@
+"""Read path for the REFERENCE SharedTree summary format.
+
+The reference repo commits real SharedTree summaries its own regression
+tests load (`packages/dds/tree/src/test/shared-tree/summary-load-snapshots/
+singleTree-<strategy>-<version>-1.json`, per its README: "summaries written
+by past versions still load with the current code").  Loading those files
+here proves tree-format fidelity against artifacts this repo did not
+produce (VERDICT r4 next #6).
+
+Summary shape (ITree JSON): indexes/{EditManager,Schema,Forest,
+DetachedFieldIndex}, each a tree of blobs.  The Forest blob is the
+chunked-forest FieldBatch codec (tree/src/feature-libraries/chunked-forest/
+codec/format.ts): interned shape table + per-key data streams —
+
+- ``{"c": {type?, value?, fields?: [[key, shapeId]...], extraFields?}}``:
+  a TreeShape.  Unfixed parts stream inline: type string, then (when
+  ``value`` is absent) a has-value bool (+ the value), then one stream
+  item per declared field (decoded under that field's shape), then — with
+  ``extraFields`` — one item holding ``[key, fieldData, ...]`` pairs.
+- ``{"a": shapeId}``: a node ARRAY: one stream item, an array that is
+  itself a stream of back-to-back shape-``shapeId`` node encodings.
+
+Both the Uncompressed strategy (generic ``{"c":{"extraFields"}} + {"a"}``
+pair) and the Compressed strategy (schema-specialized shape dictionary)
+decode through the same two rules.  The schema blob's node kinds
+(Value/Optional/Sequence fields, ``com.fluidframework.leaf.*`` leaves) map
+onto this repo's SchemaRegistry/FieldKind model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from .forest import Node
+from .schema import FieldKind, FieldSchema, NodeSchema, SchemaRegistry
+
+# Overridable for checkouts living elsewhere (CI, other machines); the
+# tests skip cleanly when the directory is absent.
+SNAPSHOT_DIR = os.path.join(
+    os.environ.get("FFTPU_REFERENCE_DIR", "/root/reference"),
+    "packages/dds/tree/src/test/shared-tree/summary-load-snapshots",
+)
+
+# Reference leaf schema identifiers -> this repo's leaf type tags.
+LEAF_TYPE_MAP = {
+    "com.fluidframework.leaf.number": "number",
+    "com.fluidframework.leaf.string": "string",
+    "com.fluidframework.leaf.boolean": "boolean",
+    "com.fluidframework.leaf.null": "null",
+    "com.fluidframework.leaf.handle": "handle",
+}
+
+FIELD_KIND_MAP = {
+    "Value": FieldKind.VALUE,
+    "Optional": FieldKind.OPTIONAL,
+    "Sequence": FieldKind.SEQUENCE,
+    "Identifier": FieldKind.VALUE,
+    "Forbidden": FieldKind.OPTIONAL,
+}
+
+
+def summary_snapshot_files(strategy: str | None = None) -> list[str]:
+    if not os.path.isdir(SNAPSHOT_DIR):
+        return []
+    out = []
+    for f in sorted(os.listdir(SNAPSHOT_DIR)):
+        if not f.endswith(".json"):
+            continue
+        if strategy is not None and f"-{strategy}-" not in f:
+            continue
+        out.append(os.path.join(SNAPSHOT_DIR, f))
+    return out
+
+
+# --------------------------------------------------------------- ITree walk
+
+
+def _itree_blobs(tree: dict, prefix: str = "") -> dict[str, str]:
+    """Flatten an ITree node to {path: blob content}."""
+    out: dict[str, str] = {}
+    for name, entry in tree.get("tree", {}).items():
+        path = f"{prefix}/{name}" if prefix else name
+        if entry["type"] == 1:
+            out.update(_itree_blobs(entry, path))
+        else:
+            out[path] = entry["content"]
+    return out
+
+
+# ----------------------------------------------------------- FieldBatch codec
+
+
+class _Stream:
+    def __init__(self, items: list) -> None:
+        self.items = items
+        self.pos = 0
+
+    def next(self):
+        v = self.items[self.pos]
+        self.pos += 1
+        return v
+
+    @property
+    def done(self) -> bool:
+        return self.pos >= len(self.items)
+
+
+def _map_type(t: str) -> str:
+    return LEAF_TYPE_MAP.get(t, t)
+
+
+def _read_node(shapes: list, spec: dict, stream: _Stream) -> Node:
+    t = spec["type"] if "type" in spec else stream.next()
+    if "value" in spec:
+        value = stream.next() if spec["value"] is True else None
+    else:
+        value = stream.next() if stream.next() else None
+    fields: dict[str, list[Node]] = {}
+    for key, sid in spec.get("fields", []):
+        fields[key] = _read_field(shapes, sid, stream)
+    if "extraFields" in spec:
+        extra = stream.next()
+        it = _Stream(extra)
+        while not it.done:
+            key = it.next()
+            fields[key] = _read_field(shapes, spec["extraFields"], it)
+    return Node(
+        type=_map_type(t),
+        value=value,
+        fields={k: v for k, v in fields.items() if v},
+    )
+
+
+def _read_field(shapes: list, sid: int, stream: _Stream) -> list[Node]:
+    shape = shapes[sid]
+    if "a" in shape:
+        inner = shapes[shape["a"]]
+        assert "c" in inner, f"array of non-node shape {inner}"
+        sub = _Stream(stream.next())
+        out = []
+        while not sub.done:
+            out.append(_read_node(shapes, inner["c"], sub))
+        return out
+    assert "c" in shape, f"unsupported shape {shape}"
+    return [_read_node(shapes, shape["c"], stream)]
+
+
+def decode_field_batch(content: str) -> dict[str, list[Node]]:
+    """One Forest blob -> {field key: nodes} (rootFieldKey carries the
+    document content)."""
+    batch = json.loads(content)
+    fields = batch["fields"]
+    shapes = fields["shapes"]
+    out: dict[str, list[Node]] = {}
+    for key, data in zip(batch["keys"], fields["data"]):
+        stream = _Stream(data)
+        sid = stream.next()
+        nodes = _read_field(shapes, sid, stream)
+        assert stream.done, f"trailing forest data under key {key!r}"
+        out[key] = nodes
+    return out
+
+
+# ----------------------------------------------------------------- schema
+
+
+def schema_from_reference(content: str) -> SchemaRegistry:
+    data = json.loads(content)
+    reg = SchemaRegistry()
+    for name, spec in data.get("nodes", {}).items():
+        # SchemaFormat v2 wraps the node spec in {"kind": {...}}; v1 is
+        # flat — identical payload either way.
+        spec = spec.get("kind", spec) if "leaf" not in spec else spec
+        if "leaf" in spec:
+            continue  # leaves are built-in kinds in this repo's registry
+        holder = spec.get("object") or spec.get("map") or {}
+        fields = {
+            key: FieldSchema(
+                FIELD_KIND_MAP[fs["kind"]],
+                {_map_type(t) for t in fs.get("types", [])},
+            )
+            for key, fs in holder.items()
+        }
+        reg.add(NodeSchema(_map_type(name), fields))
+    root = data.get("root")
+    if root:
+        reg.root = FieldSchema(
+            FIELD_KIND_MAP[root["kind"]],
+            {_map_type(t) for t in root.get("types", [])},
+        )
+    return reg
+
+
+# ------------------------------------------------------------------ loader
+
+
+def load_reference_tree_summary(path: str) -> dict[str, Any]:
+    """Load one committed reference summary.  Returns
+    {root_field: [Node], schema: SchemaRegistry, edit_manager: dict,
+    detached: dict, versions: {index: int}}."""
+    blobs = _itree_blobs(json.load(open(path, encoding="utf-8")))
+
+    def index_blob(index: str, *names: str) -> str:
+        for n in names:
+            key = f"indexes/{index}/{n}"
+            if key in blobs:
+                return blobs[key]
+        raise KeyError(f"no blob for index {index} in {sorted(blobs)}")
+
+    forest_fields = decode_field_batch(
+        index_blob("Forest", "ForestTree", "contents")
+    )
+    em = json.loads(index_blob("EditManager", "String"))
+    detached = json.loads(
+        index_blob("DetachedFieldIndex", "DetachedFieldIndexBlob", "contents")
+    )
+    versions = {
+        idx: json.loads(blobs[f"indexes/{idx}/.metadata"])["version"]
+        for idx in ("EditManager", "Schema", "Forest", "DetachedFieldIndex")
+        if f"indexes/{idx}/.metadata" in blobs
+    }
+    return {
+        "root_field": forest_fields.get("rootFieldKey", []),
+        "schema": schema_from_reference(index_blob("Schema", "SchemaString")),
+        "edit_manager": em,
+        "detached": detached,
+        "versions": versions,
+    }

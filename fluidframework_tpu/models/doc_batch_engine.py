@@ -163,6 +163,32 @@ class DocBatchEngine:
         self._lane_compact = jax.jit(
             lambda s, m: mk.compact(mk.set_min_seq(s, m))
         )
+        # ---- Zipf straggler bucketing (SURVEY §7: doc-packing by op count)
+        # Under skewed per-doc op counts one hot doc would force extra
+        # FULL-fleet steps (every step scans B ops across all D lanes).
+        # When few docs remain busy, gather just those docs' state rows
+        # into a power-of-two cohort, step the small sub-fleet, and
+        # masked-scatter the rows back — pad lanes route out of bounds
+        # (mode="drop"), so duplicate writes never occur.  The jit caches
+        # one executable per cohort size (log2(D) variants).
+        # Single-chip optimization: under a mesh the doc axis is sharded
+        # evenly and arbitrary-index gathers would cross shards.
+        self.bucketing = self.mesh is None
+        self.full_steps = 0     # fleet-wide steps taken
+        self.cohort_steps = 0   # bucketed steps taken
+        self.cohort_lanes = 0   # sum of cohort sizes (work proxy)
+        self._gather_cohort = jax.jit(
+            lambda st, idx: jax.tree.map(lambda x: x[idx], st)
+        )
+
+        def _scatter(st, sub, idx, valid):
+            def put(x, s):
+                safe = jnp.where(valid, idx, x.shape[0])
+                return x.at[safe].set(s, mode="drop")
+
+            return jax.tree.map(put, st, sub)
+
+        self._scatter_cohort = jax.jit(_scatter, donate_argnums=(0,))
 
     # ------------------------------------------------------------------ ingest
     def ingest(self, doc_idx: int, msg: SequencedMessage) -> None:
@@ -382,21 +408,55 @@ class DocBatchEngine:
 
     def step(self) -> int:
         """Run device steps until all staged ops are applied; returns the
-        number of batched steps.  Afterwards, any latched overflow bits are
+        number of batched steps.  Busy-doc cohorts far below fleet size
+        run bucketed (see __init__), so a Zipf-skewed tail stops costing
+        full-fleet steps.  Afterwards, any latched overflow bits are
         recovered (grow-and-replay or oracle routing), so ``errors()`` is
         all-zero on return unless recovery is off."""
         steps = 0
         while True:
-            batch = self.build_step_batch()
-            if batch is None:
+            busy = [d for d, h in enumerate(self.hosts) if h.queue]
+            if not busy:
                 break
-            ops, payloads = batch
-            self.state = self._step(self.state, jnp.asarray(ops), jnp.asarray(payloads))
+            if self.bucketing and len(busy) <= self.capacity // 4:
+                self._cohort_step(busy)
+            else:
+                batch = self.build_step_batch()
+                self.state = self._step(
+                    self.state, jnp.asarray(batch[0]), jnp.asarray(batch[1])
+                )
+                self.full_steps += 1
             steps += 1
         self._step_lanes()
         if self.recovery != "off":
             self.recover()
         return steps
+
+    def _cohort_step(self, busy: list[int]) -> None:
+        """One bucketed step over just the busy docs."""
+        B = self.ops_per_step
+        K = max(1, 1 << (len(busy) - 1).bit_length())  # pow2 ladder
+        idx = np.full((K,), busy[-1], np.int32)  # gather pad: harmless dup
+        idx[: len(busy)] = busy
+        valid = np.zeros((K,), bool)
+        valid[: len(busy)] = True
+        ops = np.zeros((K, B, mk.OP_FIELDS), np.int32)
+        payloads = np.zeros((K, B, self.max_insert_len), np.int32)
+        for j, d in enumerate(busy):
+            h = self.hosts[d]
+            take = min(B, len(h.queue))
+            for k in range(take):
+                ops[j, k] = h.queue[k]
+                payloads[j, k] = h.payloads[k]
+            del h.queue[:take]
+            del h.payloads[:take]
+        sub = self._gather_cohort(self.state, jnp.asarray(idx))
+        sub = self._step(sub, jnp.asarray(ops), jnp.asarray(payloads))
+        self.state = self._scatter_cohort(
+            self.state, sub, jnp.asarray(idx), jnp.asarray(valid)
+        )
+        self.cohort_steps += 1
+        self.cohort_lanes += K
 
     def _step_lanes(self) -> None:
         B = self.ops_per_step

@@ -35,7 +35,21 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--exit-after-rows", type=int, default=0)
     p.add_argument("--recovery", choices=("grow", "oracle", "off"),
                    default="grow")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. cpu); overrides the "
+                        "image default and the FFTPU_PLATFORM env var")
     args = p.parse_args(argv)
+
+    # Platform pinning must land before any backend initializes (some
+    # images force their platform list AFTER env-var processing, so
+    # JAX_PLATFORMS alone is not reliable).
+    import os as _os
+
+    platform = args.platform or _os.environ.get("FFTPU_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
 
     from ..models.doc_batch_engine import DocBatchEngine
     from .fleet_consumer import FleetConsumer
@@ -101,4 +115,9 @@ def main(argv: list[str] | None = None) -> int:
 
 
 if __name__ == "__main__":
+    sys.exit(main())
+
+
+def cli() -> None:
+    """Console-script entry (pyproject fftpu-fleet)."""
     sys.exit(main())

@@ -25,7 +25,10 @@ from typing import Any, Callable
 from ..dds.sequence_intervals import SequenceInterval
 from ..dds.snapshot_v1 import decode_snapshot_v1
 
-V1_SNAPSHOT_DIR = "/root/reference/packages/dds/sequence/src/test/snapshots/v1"
+V1_SNAPSHOT_DIR = os.path.join(
+    os.environ.get("FFTPU_REFERENCE_DIR", "/root/reference"),
+    "packages/dds/sequence/src/test/snapshots/v1",
+)
 
 
 def v1_artifact_files() -> list[str]:

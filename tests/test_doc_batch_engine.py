@@ -83,3 +83,59 @@ def test_engine_state_is_sharded_over_mesh():
     assert len(eng.state.seg_len.sharding.device_set) == n_dev
     for d in range(16):
         assert eng.text(d) == expected[d]
+
+
+def test_zipf_bucketing_cuts_full_fleet_steps():
+    """Straggler mitigation (SURVEY §7 doc-packing): with Zipf-skewed
+    per-doc op counts, one hot doc no longer forces fleet-wide steps —
+    the tail runs in small gathered cohorts, and the result is identical
+    to the unbucketed engine."""
+    rng = random.Random(5)
+    n_docs = 16
+    svc = LocalService()
+    clients = {}
+    # Zipf-ish skew: doc 0 gets ~40 ops, the rest 1-3.
+    for d in range(n_docs):
+        doc = svc.document(f"doc{d}")
+        c = SharedString(client_id=f"d{d}")
+        doc.connect(c.client_id, c.process)
+        doc.process_all()
+        clients[d] = c
+        n_ops = 40 if d == 0 else rng.randint(1, 3)
+        for _ in range(n_ops):
+            n = len(c.text)
+            if n > 6 and rng.random() < 0.3:
+                p = rng.randrange(n - 2)
+                c.remove_range(p, p + 1)
+            else:
+                c.insert_text(rng.randint(0, n), "abcd")
+        for m in c.take_outbox():
+            doc.submit(m)
+        doc.process_all()
+
+    def run(bucketing):
+        eng = DocBatchEngine(
+            n_docs, max_segments=256, text_capacity=4096, max_insert_len=8,
+            ops_per_step=4, use_mesh=False, recovery="off",
+        )
+        eng.bucketing = bucketing
+        for d in range(n_docs):
+            for msg in svc.document(f"doc{d}").sequencer.log:
+                eng.ingest(d, msg)
+        eng.step()
+        assert not eng.errors().any()
+        return eng
+
+    flat = run(False)
+    bucketed = run(True)
+    for d in range(n_docs):
+        assert bucketed.text(d) == flat.text(d) == clients[d].text, d
+    # The hot doc's ~40 ops need ~10 B=4 passes; unbucketed takes them all
+    # fleet-wide, bucketed collapses to a couple of full steps + small
+    # cohorts.
+    assert flat.full_steps >= 8
+    assert bucketed.full_steps <= 2, bucketed.full_steps
+    assert bucketed.cohort_steps >= 6
+    assert bucketed.cohort_lanes <= bucketed.cohort_steps * 4, (
+        "cohorts must stay far below fleet width"
+    )

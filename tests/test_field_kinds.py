@@ -97,7 +97,11 @@ def test_sequence_rebase_convergence_square():
         a = _rand_seq_marks(rng, n)
         b = _rand_seq_marks(rng, n)
         f1 = _field(base)
-        apply_marks(f1, [clone_mark for clone_mark in a])
+        # Apply a DEEP COPY: apply enriches marks in place, and the rebase
+        # below must read the pristine a.
+        from fluidframework_tpu.dds.tree.changeset import _clone_mark
+
+        apply_marks(f1, [_clone_mark(m) for m in a])
         apply_marks(f1, rebase_marks(b, a, a_after=True))
         f2 = _field(base)
         apply_marks(f2, b)
@@ -467,3 +471,46 @@ def test_voided_optional_change_invert_is_noop():
     )
     assert OPTIONAL.is_empty(empty)
     assert OPTIONAL.is_empty(OPTIONAL.invert(empty))  # must not raise
+
+
+def test_compose_invert_restores_original_repair_data():
+    """Invert of a squashed (composed) change restores the ORIGINAL state,
+    not the intermediate: composed repair data must live in the composed
+    change's input context (both reviewer repros)."""
+    from fluidframework_tpu.dds.tree.changeset import compose_commit, invert_commit
+
+    # Sequence: a modifies a node's value, b removes it.
+    node = Node(type="obj")
+    node.fields["seq"] = _field([1])
+    a = NodeChange(fields={"seq": [Modify(NodeChange(value=(2,)))]})
+    b = NodeChange(fields={"seq": [Remove(1)]})
+    apply_node_change(node, a)
+    apply_node_change(node, b)
+    squashed = compose_node_change(a, b)
+    inv = invert_node_change(squashed)
+    apply_node_change(node, inv)
+    assert node.fields["seq"][0].value == 1  # not the intermediate 2
+
+    # Optional: a nested-edits the resident node, b replaces the field.
+    n2 = Node(type="obj")
+    n2.fields["opt"] = _field([1])
+    oa = NodeChange(fields={"opt": OptionalChange(nested=NodeChange(value=(2,)))})
+    ob = NodeChange(fields={"opt": OptionalChange(set=(leaf(9),))})
+    apply_node_change(n2, oa)
+    apply_node_change(n2, ob)
+    sq = compose_node_change(oa, ob)
+    apply_node_change(n2, invert_node_change(sq))
+    assert n2.fields["opt"][0].value == 1
+
+    # Commit-level squash of an applied transaction round-trips too.
+    n3 = Node(type="obj")
+    n3.fields["seq"] = _field([5, 6])
+    commit = [
+        NodeChange(fields={"seq": [Modify(NodeChange(value=(7,)))]}),
+        NodeChange(fields={"seq": [Skip(1), Remove(1)]}),
+    ]
+    for c in commit:
+        apply_node_change(n3, c)
+    sq = compose_commit(commit)
+    apply_node_change(n3, invert_node_change(sq))
+    assert _vals(n3.fields["seq"]) == [5, 6]
