@@ -38,22 +38,46 @@ def _subscribe(listeners: list, fn) -> Callable[[], None]:
 class Presence:
     """One client's view of a presence workspace on a container."""
 
-    def __init__(self, container, clock=None) -> None:
+    def __init__(self, container, clock=None,
+                 attendee_timeout_s: float = 30.0) -> None:
         import time
 
         self._container = container
         # One clock domain per instance (tests inject a simulated clock).
         self._clock = clock if clock is not None else time.monotonic
         self._client_id = container.runtime.client_id
-        # state key -> client id -> value (latest received wins)
-        self._remote: dict[str, dict[str, Any]] = {}
+        # state key -> client id -> (rev, value).  Revisions are per-key
+        # per-writer monotonic stamps (ref datastore rev): a lost or
+        # reordered signal can never let stale state clobber newer state —
+        # receivers keep the highest rev (signal-loss recovery).
+        self._remote: dict[str, dict[str, tuple[Any, Any]]] = {}
         self._local: dict[str, Any] = {}
+        self._rev: dict[str, int] = {}  # our own per-key revision counters
+        # Wire revisions are [epoch, n]: the epoch (instance birth stamp)
+        # makes a RESTARTED client's fresh counters beat its own pre-crash
+        # cached revs (a lost leave signal must not mute the comeback).
+        self._epoch = time.time_ns()
+        # Heartbeat cadence: refresh peers' last-seen view of us even when
+        # idle, so expiry only ever fires on genuinely gone peers.
+        self._last_heartbeat: float | None = None
         self._queue: dict[str, Any] = {}  # batched unflushed local sets
         # Tightest queued update's flush-by time (allowableUpdateLatency).
         self._flush_deadline: float | None = None
         self._listeners: list[Callable[[str, str, Any], None]] = []
-        # Attendees: client ids seen on the presence fabric.
+        # Attendees: client ids seen on the presence fabric, with a
+        # last-activity stamp; signal-silent attendees NOT covered by the
+        # audience expire after ``attendee_timeout_s`` (ref attendee
+        # disconnected-after-inactivity).
         self._attendees: set[str] = set()
+        self._last_seen: dict[str, float] = {}
+        self._attendee_timeout = attendee_timeout_s
+        # Joiner catch-up responses pending our (ranked) jitter window
+        # (ref presenceDatastoreManager.ts:195 joiningClients).
+        self._pending_catchup: dict[str, float] = {}
+        # joiner -> time we last saw a catch-up covering OUR state; a join
+        # signal processed AFTER the primary's (synchronous fan-out
+        # reentrancy) must not schedule a redundant backup.
+        self._recent_catchup: dict[str, float] = {}
         self._joined_listeners: list[Callable[[str], None]] = []
         self._left_listeners: list[Callable[[str], None]] = []
         self._notification_listeners: dict[str, list] = {}
@@ -98,6 +122,7 @@ class Presence:
         ``now`` defaults to the presence CLOCK (constructor-injectable) so
         simulated and wall clocks never mix within one instance."""
         self._local[key] = value
+        self._rev[key] = self._rev.get(key, 0) + 1
         self._queue[key] = value
         if allowed_latency_s is not None:
             now = self._clock() if now is None else now
@@ -106,14 +131,37 @@ class Presence:
                 self._flush_deadline = deadline
 
     def tick(self, now: float | None = None) -> bool:
-        """Flush iff a queued update's latency window has lapsed; returns
-        whether a signal went out (the host loop's timer hook)."""
+        """Timer hook: flush lapsed latency windows, send due joiner
+        catch-ups, emit idle heartbeats, expire signal-silent attendees;
+        returns whether STATE went out (heartbeats are housekeeping and
+        do not count)."""
         now = self._clock() if now is None else now
+        sent = False
         if self._flush_deadline is not None and now >= self._flush_deadline:
             had_updates = bool(self._queue)
             self.flush()
-            return had_updates
-        return False
+            sent = sent or had_updates
+        for joiner, deadline in list(self._pending_catchup.items()):
+            if now >= deadline:
+                del self._pending_catchup[joiner]
+                self._send_catchup(joiner)
+                sent = True
+        # Idle keepalive: a silent-but-connected peer must keep refreshing
+        # everyone's last-seen stamp or expiry would falsely fire on it.
+        if self._attendee_timeout is not None and self._attendees:
+            interval = self._attendee_timeout / 3.0
+            if (
+                self._last_heartbeat is None
+                or now - self._last_heartbeat >= interval
+            ):
+                self._last_heartbeat = now
+                self._container.submit_signal({"presence": "hb"})
+        self._expire_attendees(now)
+        # Bounded bookkeeping: served-joiner stamps age out.
+        for joiner, t in list(self._recent_catchup.items()):
+            if now - t > 60.0:
+                del self._recent_catchup[joiner]
+        return sent
 
     def flush(self) -> None:
         """Broadcast queued updates as ONE signal (ref batch queue :473)."""
@@ -121,7 +169,18 @@ class Presence:
         if not self._queue:
             return
         updates, self._queue = self._queue, {}
-        self._container.submit_signal({"presence": "update", "states": updates})
+        self._container.submit_signal({
+            "presence": "update",
+            "states": {k: [self._wire_rev(k), v] for k, v in updates.items()},
+        })
+
+    def _wire_rev(self, key: str) -> list:
+        return [self._epoch, self._rev.get(key, 0)]
+
+    @staticmethod
+    def _rev_lt(a, b) -> bool:
+        """rev a < rev b; wire revs are [epoch, n] lists."""
+        return tuple(a) < tuple(b)
 
     def set_now(self, key: str, value: Any) -> None:
         self.set(key, value)
@@ -133,13 +192,13 @@ class Presence:
 
     def states(self, key: str) -> dict[str, Any]:
         """client id -> latest value, including our own."""
-        out = dict(self._remote.get(key, {}))
+        out = {c: v for c, (_r, v) in self._remote.get(key, {}).items()}
         if key in self._local:
             out[self._my_id()] = self._local[key]
         return out
 
     def remote_states(self, key: str) -> dict[str, Any]:
-        return dict(self._remote.get(key, {}))
+        return {c: v for c, (_r, v) in self._remote.get(key, {}).items()}
 
     def on_update(self, listener: Callable[[str, str, Any], None]) -> Callable[[], None]:
         """listener(client_id, key, value) per received remote update;
@@ -162,10 +221,26 @@ class Presence:
         return _subscribe(self._left_listeners, fn)
 
     def _saw(self, client_id: str) -> None:
+        self._last_seen[client_id] = self._clock()
         if client_id not in self._attendees:
             self._attendees.add(client_id)
             for fn in list(self._joined_listeners):
                 fn(client_id)
+
+    def _expire_attendees(self, now: float) -> None:
+        """Drop attendees silent beyond the timeout and not vouched for by
+        the audience (signal-only peers whose leave signal was lost)."""
+        if self._attendee_timeout is None:
+            return
+        audience = getattr(self._container, "audience", None)
+        covered = set()
+        if audience is not None:
+            covered = set(audience.get_members())
+        for cid in list(self._attendees):
+            if cid in covered:
+                continue
+            if now - self._last_seen.get(cid, now) > self._attendee_timeout:
+                self._drop_client(cid)
 
     # ------------------------------------------------------------- workspaces
     def states_workspace(self, workspace_id: str) -> "StatesWorkspace":
@@ -193,28 +268,106 @@ class Presence:
         if kind != "leave":
             self._saw(sig.client_id)
         if kind == "join":
-            # A newcomer asked for state: respond with ours (ref join
-            # response broadcast). Flush queued values first so the response
-            # is complete. Respond EVEN when stateless — the response is
-            # also how the newcomer learns we exist (attendees()).
+            # A newcomer asked for state (ref joiningClients catch-up,
+            # presenceDatastoreManager.ts:195).  Every member knows the
+            # whole datastore (own + cached remote state), so ONE response
+            # suffices: members rank deterministically and the first
+            # responds at once; the rest schedule a jittered backup
+            # response, suppressed when an earlier responder's catch-up
+            # already covered their state (thundering-herd avoidance).
             self.flush()
-            self._container.submit_signal(
-                {"presence": "update", "states": dict(self._local)}
-            )
+            rank = self._catchup_rank(sig.client_id)
+            now = self._clock()
+            if rank == 0:
+                self._send_catchup(sig.client_id)
+            elif now - self._recent_catchup.get(sig.client_id, -1e9) > 1.0:
+                self._pending_catchup[sig.client_id] = now + 0.05 * rank
         elif kind == "update":
-            for key, value in content["states"].items():
-                self._remote.setdefault(key, {})[sig.client_id] = value
-                for listener in self._listeners:
-                    listener(sig.client_id, key, value)
+            self._merge_states(sig.client_id, content["states"])
+        elif kind == "catchup":
+            # Full-datastore relay: merge EVERY client's entries by rev —
+            # this is also how members recover state their own lost
+            # signals missed.
+            for cid, states in content["data"].items():
+                if cid == self._my_id():
+                    continue
+                self._saw(cid)
+                self._merge_states(cid, states)
+            joiner = content["for"]
+            mine = content["data"].get(self._my_id())
+            if mine is not None:
+                # Our state was relayed to the joiner: stand down (and
+                # remember, in case the join itself arrives after the
+                # primary's response in the synchronous fan-out).  If the
+                # relay was STALE — the responder missed some of our
+                # updates — broadcast just the newer entries as a
+                # correction, which also heals the responder.
+                stale = {
+                    k: [self._wire_rev(k), v]
+                    for k, v in self._local.items()
+                    if k not in mine
+                    or self._rev_lt(mine[k][0], self._wire_rev(k))
+                }
+                if stale:
+                    self._container.submit_signal(
+                        {"presence": "update", "states": stale}
+                    )
+                self._pending_catchup.pop(joiner, None)
+                self._recent_catchup[joiner] = self._clock()
         elif kind == "notify":
             for fn in list(self._notification_listeners.get(content["ch"], [])):
                 fn(sig.client_id, content["name"], content["payload"])
         elif kind == "leave":
             self._drop_client(sig.client_id)
 
+    def _merge_states(self, client_id: str, states: dict[str, Any]) -> None:
+        """Merge one client's {key: [[epoch, n], value]} entries, highest
+        rev wins (stale/reordered signals never regress state; a fresh
+        epoch beats any pre-restart rev)."""
+        for key, (rev, value) in states.items():
+            slot = self._remote.setdefault(key, {})
+            cur = slot.get(client_id)
+            if cur is not None and not self._rev_lt(cur[0], rev):
+                continue
+            slot[client_id] = (rev, value)
+            for listener in self._listeners:
+                listener(client_id, key, value)
+
+    def _catchup_rank(self, joiner: str) -> int:
+        """Our deterministic position among the members able to answer a
+        join (stable id sort): rank 0 answers immediately, the rest are
+        jittered backups."""
+        candidates = sorted(
+            (self._attendees | {self._my_id()}) - {joiner}
+        )
+        return candidates.index(self._my_id())
+
+    def _send_catchup(self, joiner: str) -> None:
+        """Broadcast the full known datastore for a joiner."""
+        data: dict[str, dict[str, Any]] = {}
+        me = self._my_id()
+        for key, value in self._local.items():
+            data.setdefault(me, {})[key] = [self._wire_rev(key), value]
+        # Stateless members (self included) still announce: the joiner
+        # learns the whole attendee set from one response, and their
+        # backup responses stand down.
+        data.setdefault(me, {})
+        for cid in self._attendees:
+            if cid != joiner:
+                data.setdefault(cid, {})
+        for key, per_client in self._remote.items():
+            for cid, (rev, value) in per_client.items():
+                data.setdefault(cid, {})[key] = [rev, value]
+        self._container.submit_signal(
+            {"presence": "catchup", "for": joiner, "data": data}
+        )
+
     def _drop_client(self, client_id: str) -> None:
         for per_key in self._remote.values():
             per_key.pop(client_id, None)
+        self._last_seen.pop(client_id, None)
+        self._pending_catchup.pop(client_id, None)
+        self._recent_catchup.pop(client_id, None)
         if client_id in self._attendees:
             self._attendees.discard(client_id)
             for fn in list(self._left_listeners):
@@ -310,7 +463,8 @@ class LatestMap:
         out = {}
         for full_key, per_client in self._p._remote.items():
             if full_key.startswith(self._prefix) and client_id in per_client:
-                out[_unesc(full_key[len(self._prefix):])] = per_client[client_id]
+                _rev, value = per_client[client_id]
+                out[_unesc(full_key[len(self._prefix):])] = value
         return out
 
     def on_item_updated(self, fn: Callable[[str, str, Any], None]) -> Callable[[], None]:

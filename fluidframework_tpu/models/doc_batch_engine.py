@@ -390,6 +390,22 @@ class DocBatchEngine:
             len(l.queue) for l in self.overflow.values()
         )
 
+    def _drain_into(
+        self, docs: list[int], ops: np.ndarray, payloads: np.ndarray
+    ) -> None:
+        """Dequeue up to ops_per_step ops per listed doc into row j of the
+        padded arrays — the ONE drain used by full-fleet and cohort steps
+        (their semantics must never diverge)."""
+        B = self.ops_per_step
+        for j, d in enumerate(docs):
+            h = self.hosts[d]
+            take = min(B, len(h.queue))
+            for k in range(take):
+                ops[j, k] = h.queue[k]
+                payloads[j, k] = h.payloads[k]
+            del h.queue[:take]
+            del h.payloads[:take]
+
     def build_step_batch(self) -> tuple[np.ndarray, np.ndarray] | None:
         """Dequeue up to ops_per_step ops per doc into padded [D,B] arrays."""
         B = self.ops_per_step
@@ -397,13 +413,7 @@ class DocBatchEngine:
             return None
         ops = np.zeros((self.capacity, B, mk.OP_FIELDS), np.int32)
         payloads = np.zeros((self.capacity, B, self.max_insert_len), np.int32)
-        for d, h in enumerate(self.hosts):
-            take = min(B, len(h.queue))
-            for j in range(take):
-                ops[d, j] = h.queue[j]
-                payloads[d, j] = h.payloads[j]
-            del h.queue[:take]
-            del h.payloads[:take]
+        self._drain_into(list(range(self.n_docs)), ops, payloads)
         return ops, payloads
 
     def step(self) -> int:
@@ -442,14 +452,7 @@ class DocBatchEngine:
         valid[: len(busy)] = True
         ops = np.zeros((K, B, mk.OP_FIELDS), np.int32)
         payloads = np.zeros((K, B, self.max_insert_len), np.int32)
-        for j, d in enumerate(busy):
-            h = self.hosts[d]
-            take = min(B, len(h.queue))
-            for k in range(take):
-                ops[j, k] = h.queue[k]
-                payloads[j, k] = h.payloads[k]
-            del h.queue[:take]
-            del h.payloads[:take]
+        self._drain_into(busy, ops, payloads)
         sub = self._gather_cohort(self.state, jnp.asarray(idx))
         sub = self._step(sub, jnp.asarray(ops), jnp.asarray(payloads))
         self.state = self._scatter_cohort(

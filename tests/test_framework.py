@@ -418,6 +418,11 @@ def test_presence_latency_window_coalesces_updates(client):
     assert len(signals) == base
     assert pa.tick(now=0.11)              # cursor window lapsed: ONE signal
     assert len(signals) == base + 1
-    assert signals[-1]["states"] == {"cursor": [2, 2], "color": "red"}
+    # Wire entries are [[epoch, n], value]: per-key writer revisions let
+    # receivers drop stale/reordered signals (cursor was set twice -> n=2).
+    states = signals[-1]["states"]
+    assert set(states) == {"cursor", "color"}
+    assert states["cursor"][0][1] == 2 and states["cursor"][1] == [2, 2]
+    assert states["color"][0][1] == 1 and states["color"][1] == "red"
     assert pb.states("cursor")[pa._my_id()] == [2, 2]
     assert not pa.tick(now=10.0)          # queue drained: nothing more

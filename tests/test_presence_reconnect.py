@@ -1,0 +1,221 @@
+"""Presence reconnect reconciliation (VERDICT r4 next #9): joining-client
+catch-up (ref presenceDatastoreManager.ts:195), per-key revision stamps
+(stale/reordered signals never regress state), ranked responders with
+backup suppression, stale-attendee expiry — and the done-criterion fuzz:
+under partial signal delivery a late joiner converges to the same presence
+view, and the catch-up relay also heals the members' own losses.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from fluidframework_tpu.framework.presence import Presence
+from fluidframework_tpu.protocol.messages import SignalMessage
+
+
+class _Bus:
+    """In-test signal fabric with per-recipient drop control."""
+
+    def __init__(self) -> None:
+        self.members: list["_StubContainer"] = []
+        self.drop: Callable[[str, dict, str], bool] = lambda s, c, r: False
+        self.log: list[tuple[str, dict]] = []
+
+    def send(self, sender: str, contents: dict) -> None:
+        self.log.append((sender, contents))
+        for m in list(self.members):
+            if self.drop(sender, contents, m.runtime.client_id):
+                continue
+            for fn in list(m._signal_listeners):
+                fn(SignalMessage(client_id=sender, contents=contents))
+
+
+@dataclass
+class _StubRuntime:
+    client_id: str
+    member_left_listeners: list = field(default_factory=list)
+
+
+class _StubContainer:
+    def __init__(self, bus: _Bus, client_id: str) -> None:
+        self._bus = bus
+        self.runtime = _StubRuntime(client_id)
+        self._signal_listeners: list = []
+        bus.members.append(self)
+
+    def on_signal(self, fn) -> None:
+        self._signal_listeners.append(fn)
+
+    def submit_signal(self, contents) -> None:
+        self._bus.send(self.runtime.client_id, contents)
+
+
+def _mk(bus: _Bus, cid: str, t0: float = 0.0):
+    clock_holder = [t0]
+    p = Presence(
+        _StubContainer(bus, cid), clock=lambda: clock_holder[0],
+        attendee_timeout_s=30.0,
+    )
+    return p, clock_holder
+
+
+def test_revision_stamps_reject_stale_updates():
+    bus = _Bus()
+    pa, _ca = _mk(bus, "A")
+    pb, _cb = _mk(bus, "B")
+    pa.set_now("cursor", 1)
+    pa.set_now("cursor", 2)
+    assert pb.states("cursor")["A"] == 2
+    # A reordered/duplicated older signal must not regress the view.
+    stale_rev = [pa._epoch, 1]
+    for m in bus.members:
+        for fn in list(m._signal_listeners):
+            fn(SignalMessage(client_id="A", contents={
+                "presence": "update", "states": {"cursor": [stale_rev, 1]},
+            }))
+    assert pb.states("cursor")["A"] == 2
+
+
+def test_single_catchup_covers_joiner_and_backups_stand_down():
+    """Rank-0 answers a join immediately with the FULL datastore; other
+    members' backup responses suppress once their state was relayed."""
+    bus = _Bus()
+    ps = [_mk(bus, cid) for cid in ("A", "B", "C")]
+    for (p, _c), v in zip(ps, (1, 2, 3)):
+        p.set_now("x", v)
+    base = len([1 for _s, c in bus.log if c.get("presence") == "catchup"])
+    pj, _cj = _mk(bus, "J")
+    catchups = [c for _s, c in bus.log if c.get("presence") == "catchup"]
+    assert len(catchups) == base + 1  # exactly one immediate responder
+    assert pj.states("x") == {"A": 1, "B": 2, "C": 3}
+    assert pj.attendees() == {"A", "B", "C"}
+    # Backups hold a pending response; advancing their clocks past the
+    # jitter must NOT fire (suppressed by the rank-0 catch-up).
+    for p, clock in ps:
+        clock[0] = 10.0
+        p.tick()
+    assert len([c for _s, c in bus.log if c.get("presence") == "catchup"]) \
+        == base + 1
+
+
+def test_backup_responder_covers_lost_primary_catchup():
+    """The rank-0 catch-up is lost: a jittered backup answers and the
+    joiner still converges."""
+    bus = _Bus()
+    ps = [_mk(bus, cid) for cid in ("A", "B", "C")]
+    for (p, _c), v in zip(ps, (1, 2, 3)):
+        p.set_now("x", v)
+    # Drop every catch-up from the rank-0 responder (lowest id: "A").
+    bus.drop = lambda s, c, r: c.get("presence") == "catchup" and s == "A"
+    pj, _cj = _mk(bus, "J")
+    assert pj.states("x") == {}  # primary lost
+    for p, clock in ps:
+        clock[0] = 1.0
+        p.tick()
+    assert pj.states("x") == {"A": 1, "B": 2, "C": 3}
+
+
+def test_stale_attendee_expires_without_audience():
+    bus = _Bus()
+    pa, ca = _mk(bus, "A")
+    pb, _cb = _mk(bus, "B")
+    pb.set_now("x", 1)
+    assert "B" in pa.attendees()
+    left: list[str] = []
+    pa.on_attendee_left(left.append)
+    bus.members = [m for m in bus.members if m.runtime.client_id != "B"]
+    ca[0] = 31.0  # B silent past the timeout, never sent leave
+    pa.tick()
+    assert "B" not in pa.attendees() and left == ["B"]
+    assert pa.states("x") == {}
+
+
+def test_partial_delivery_fuzz_late_joiner_converges():
+    """THE done-criterion: members edit under ~35% per-recipient update
+    loss; a late joiner then joins (and possibly loses the primary
+    catch-up too).  After the ranked/backup responses the joiner's view
+    equals the writers' own latest state — and the members' views healed
+    through the same relay."""
+    for seed in (1, 7, 21, 33):
+        rng = random.Random(seed)
+        bus = _Bus()
+        ids = ["A", "B", "C", "D"]
+        ps = {cid: _mk(bus, cid) for cid in ids}
+        truth: dict[str, dict[str, Any]] = {cid: {} for cid in ids}
+
+        lossy = {"on": True}
+        bus.drop = lambda s, c, r: (
+            lossy["on"]
+            and c.get("presence") == "update"
+            and rng.random() < 0.35
+        )
+        for _step in range(60):
+            cid = rng.choice(ids)
+            p, _clock = ps[cid]
+            key = rng.choice(["cursor", "color", "sel"])
+            value = rng.randrange(1000)
+            p.set_now(key, value)
+            truth[cid][key] = value
+
+        # Late joiner: updates stay lossy, and half the seeds lose the
+        # primary catch-up as well (backup responders must cover).
+        drop_primary = seed % 2 == 0
+        primary = sorted(ids)[0]
+        bus.drop = lambda s, c, r: (
+            drop_primary and c.get("presence") == "catchup" and s == primary
+        )
+        pj, _cj = _mk(bus, "J")
+        for cid in ids:
+            p, clock = ps[cid]
+            clock[0] = 5.0
+            p.tick()
+
+        for cid in ids:
+            for key, value in truth[cid].items():
+                assert pj.states(key).get(cid) == value, (seed, cid, key)
+        assert pj.attendees() == set(ids), seed
+        # The relay healed every member's remote view too.
+        for cid in ids:
+            p, _clock = ps[cid]
+            for other in ids:
+                if other == cid:
+                    continue
+                for key, value in truth[other].items():
+                    assert p.states(key).get(other) == value, (seed, cid, other)
+
+
+def test_restarted_client_not_muted_by_precrash_revs():
+    """A client whose leave signal was LOST restarts with the same id;
+    its fresh updates (new epoch) must beat peers' cached pre-crash revs."""
+    bus = _Bus()
+    pa, _ca = _mk(bus, "A")
+    pb, _cb = _mk(bus, "B")
+    for _ in range(5):
+        pa.set_now("cursor", 111)  # rev n=5 cached at B
+    assert pb.states("cursor")["A"] == 111
+    # A crashes (no leave) and comes back with the same client id.
+    bus.members = [m for m in bus.members if m.runtime.client_id != "A"]
+    pa2, _ca2 = _mk(bus, "A")
+    pa2.set_now("cursor", 222)  # fresh epoch, n=1
+    assert pb.states("cursor")["A"] == 222
+
+
+def test_idle_connected_peer_survives_expiry_via_heartbeat():
+    """An idle-but-connected peer keeps ticking heartbeats, so peers never
+    falsely expire it (companion to the silent-gone expiry case)."""
+    bus = _Bus()
+    pa, ca = _mk(bus, "A")
+    pb, cb = _mk(bus, "B")
+    pb.set_now("x", 1)
+    left: list[str] = []
+    pa.on_attendee_left(left.append)
+    for t in (12.0, 24.0, 36.0, 48.0):
+        cb[0] = t
+        pb.tick()   # B idle but alive: heartbeats go out
+        ca[0] = t
+        pa.tick()
+    assert "B" in pa.attendees() and left == []
+    assert pa.states("x")["B"] == 1
