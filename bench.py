@@ -944,8 +944,29 @@ def bench_latency(args) -> dict:
         jax.block_until_ready(jnp.zeros((1,), jnp.int32) + 1)
         rt.append(time.perf_counter() - t0)
 
+    # Budget attribution (VERDICT r4 next #10): wall time of a SINGLE-op
+    # jitted apply = dispatch overhead + one apply; subtracting the
+    # K-chain amortized apply isolates the per-call dispatch share — the
+    # number that decides whether the correctness path's one-op-per-call
+    # design needs batching on this transport.
+    ops1 = np.zeros((1, mk.OP_FIELDS), np.int32)
+    pay1 = np.zeros((1, 16), np.int32)
+    pay1[0, :4] = [97, 98, 99, 100]
+    singles = []
+    for i in range(30):
+        ops1[0] = [mk.OpKind.INSERT, seq + i + 1, 0, ALL_ACKED, 0, 0, 4, 0]
+        o, p = jnp.asarray(ops1), jnp.asarray(pay1)
+        jax.block_until_ready((o, p))
+        t0 = time.perf_counter()
+        state = chain(state, o, p)  # same jit; new shape = one more cache entry
+        jax.block_until_ready(state)
+        if i >= 5:  # skip the compile + warmup samples
+            singles.append(time.perf_counter() - t0)
+
     p50 = float(np.percentile(samples, 50) * 1e6)
     p99 = float(np.percentile(samples, 99) * 1e6)
+    single_us = float(np.percentile(singles, 50)) * 1e6
+    dispatch_us = max(single_us - p50, 0.0)
     return {
         "metric": "remote_op_apply_latency_p50",
         "value": round(p50, 1),
@@ -953,6 +974,13 @@ def bench_latency(args) -> dict:
         "vs_baseline": None,
         "p99_us": round(p99, 1),
         "host_roundtrip_us": round(float(np.percentile(rt, 50)) * 1e6, 1),
+        # One-line budget: amortized apply vs per-call dispatch overhead.
+        "budget": {
+            "amortized_apply_us": round(p50, 1),
+            "single_op_wall_us": round(single_us, 1),
+            "dispatch_overhead_us": round(dispatch_us, 1),
+            "dispatch_share": round(dispatch_us / single_us, 3) if single_us else None,
+        },
     }
 
 

@@ -801,6 +801,8 @@ def default_registry() -> dict[str, Any]:
     ISharedObjectRegistry + the fluid-framework re-export surface)."""
     from .extras import EXTRA_DDS_FACTORIES
     from .ot import SharedJsonOTFactory
+    from .ot_json1 import SharedJson1Factory
+    from .property_dds import PropertyTreeFactory
     from .shared_matrix import SharedMatrixFactory
     from .small import SMALL_DDS_FACTORIES
     from .tree import SharedTreeFactory
@@ -814,4 +816,6 @@ def default_registry() -> dict[str, Any]:
     out.update(EXTRA_DDS_FACTORIES)
     out[SharedMatrixFactory.channel_type] = SharedMatrixFactory
     out[SharedJsonOTFactory.channel_type] = SharedJsonOTFactory
+    out[SharedJson1Factory.channel_type] = SharedJson1Factory
+    out[PropertyTreeFactory.channel_type] = PropertyTreeFactory
     return out

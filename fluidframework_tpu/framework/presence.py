@@ -141,14 +141,19 @@ class Presence:
             had_updates = bool(self._queue)
             self.flush()
             sent = sent or had_updates
+        connected = getattr(self._container, "connected", True)
         for joiner, deadline in list(self._pending_catchup.items()):
-            if now >= deadline:
+            if now >= deadline and connected:
                 del self._pending_catchup[joiner]
                 self._send_catchup(joiner)
                 sent = True
         # Idle keepalive: a silent-but-connected peer must keep refreshing
         # everyone's last-seen stamp or expiry would falsely fire on it.
-        if self._attendee_timeout is not None and self._attendees:
+        # Any outbound presence signal counts (flush/_send_catchup stamp
+        # too), so actively-updating clients emit no redundant hb; a
+        # DISCONNECTED client skips — submitting would raise, and peers
+        # are supposed to see it go quiet.
+        if self._attendee_timeout is not None and self._attendees and connected:
             interval = self._attendee_timeout / 3.0
             if (
                 self._last_heartbeat is None
@@ -169,6 +174,7 @@ class Presence:
         if not self._queue:
             return
         updates, self._queue = self._queue, {}
+        self._last_heartbeat = self._clock()  # state traffic IS a keepalive
         self._container.submit_signal({
             "presence": "update",
             "states": {k: [self._wire_rev(k), v] for k, v in updates.items()},
@@ -358,6 +364,7 @@ class Presence:
         for key, per_client in self._remote.items():
             for cid, (rev, value) in per_client.items():
                 data.setdefault(cid, {})[key] = [rev, value]
+        self._last_heartbeat = self._clock()
         self._container.submit_signal(
             {"presence": "catchup", "for": joiner, "data": data}
         )
