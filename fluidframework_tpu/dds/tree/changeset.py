@@ -512,9 +512,17 @@ def rebase_node_change(a: NodeChange, b: NodeChange, a_after: bool = True) -> No
             out.fields[key] = kind_of(a_fc).clone(a_fc)
             continue
         kind = kind_of(a_fc)
-        assert kind is kind_of(b_fc), (
-            f"field {key!r}: kind mismatch {kind.name} vs {kind_of(b_fc).name}"
-        )
+        if kind is not kind_of(b_fc):
+            # Two producers spoke different kinds for one field (a typed
+            # view racing an untyped/schema-less writer).  Degrade
+            # DETERMINISTICALLY instead of crashing the delta pump: the
+            # later-sequenced side drops its field change, the earlier
+            # side carries through untouched — every replica computes the
+            # same outcome from the same sequence order.
+            if a_after:
+                continue
+            out.fields[key] = kind_of(a_fc).clone(a_fc)
+            continue
         out.fields[key] = kind.rebase(a_fc, b_fc, a_after)
     return out
 
@@ -941,17 +949,8 @@ def no_change_constraint(path: list[tuple[str, int]]) -> dict:
 _move_counter = 0
 
 
-def make_move(
-    path: list[tuple[str, int]],
-    field_key: str,
-    src_index: int,
-    count: int,
-    dst_index: int,
-) -> NodeChange:
-    """Move ``count`` nodes from ``src_index`` to the boundary ``dst_index``
-    of the same field, both in PRE-move coordinates (ref sequence-field
-    moveOut/moveIn pair).  A destination inside the moved range is the
-    identity move."""
+def make_move_marks(src_index: int, count: int, dst_index: int) -> list[Mark]:
+    """The field-level mark list of a same-field move (see make_move)."""
     global _move_counter
     _move_counter += 1
     mid = _move_counter
@@ -976,4 +975,21 @@ def make_move(
             marks.append(Skip(src_index))
         marks.append(MoveOut(count, mid))
         marks.append(MoveIn(mid, count))
-    return _wrap(path, NodeChange(fields={field_key: marks}))
+    return marks
+
+
+def make_move(
+    path: list[tuple[str, int]],
+    field_key: str,
+    src_index: int,
+    count: int,
+    dst_index: int,
+) -> NodeChange:
+    """Move ``count`` nodes from ``src_index`` to the boundary ``dst_index``
+    of the same field, both in PRE-move coordinates (ref sequence-field
+    moveOut/moveIn pair).  A destination inside the moved range is the
+    identity move."""
+    return _wrap(
+        path,
+        NodeChange(fields={field_key: make_move_marks(src_index, count, dst_index)}),
+    )

@@ -212,7 +212,10 @@ class OptionalFieldKind(FieldKind):
         if change.is_empty():  # rebase can void a change (conflict loser)
             return
         if change.set is not None:
-            assert len(nodes) <= 1, f"{self.name} field holds {len(nodes)} nodes"
+            # A schema-violating writer (raw sequence ops) can leave >1
+            # node in a 0..1 field; a set COLLAPSES the field to its
+            # content (prior records the first resident — the schema-legal
+            # one — for invert).
             prior = nodes[0] if nodes else None
             new = change.set[0]
             change.set = (new, prior)  # enrich in place (invertibility)

@@ -34,7 +34,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Iterable
 
 from .forest import ROOT_FIELD, Node
-from .changeset import make_insert, make_move, make_remove, make_set_value
+from .changeset import make_insert, make_remove
 from .schema import (
     ARRAY_FIELD,
     FieldKind,
@@ -330,6 +330,10 @@ class TreeObjectNode(TypedNode):
             )
         node = self._node()
         count = len(node.fields.get(key, []))
+        from .changeset import NodeChange
+        from .field_kinds import OptionalChange
+
+        fkind = "value" if spec.kind == FieldKind.VALUE else "optional"
         if (
             spec.kind in (FieldKind.VALUE, FieldKind.OPTIONAL)
             and count == 1
@@ -337,21 +341,26 @@ class TreeObjectNode(TypedNode):
             and value is not None
             and node.fields[key][0].type == leaf(value).type
         ):
-            # Same-leaf-kind overwrite: a value SET, not replace (keeps the
-            # node identity so concurrent edits merge as value LWW).
-            self._view._submit(make_set_value(
-                self._path + [(key, 0)], value
+            # Same-leaf-kind overwrite: a nested value SET, not a replace
+            # (keeps node identity so concurrent edits merge as value
+            # LWW).  Expressed through the field's OWN kind — one field,
+            # one rebaser (mixing sequence marks in would kind-conflict).
+            self._view.submit_field(self._path, key, OptionalChange(
+                kind=fkind, nested=NodeChange(value=(value,)),
             ))
             return
         if value is None and spec.kind == FieldKind.VALUE:
             # Validate BEFORE any submit: a raise must leave no edit behind.
             raise ValueError(f"required field {key!r} cannot be cleared")
-        if count:
-            self._view._submit(make_remove(self._path, key, 0, count))
-        if value is not None:
-            self._view._submit(make_insert(
-                self._path, key, 0, [_content_to_node(spec, value)]
-            ))
+        # Whole-content replace rides the OPTIONAL/VALUE field kind
+        # (field_kinds.py): one atomic set with later-sequenced-wins
+        # semantics.  A remove+insert pair would let two concurrent
+        # replaces double-insert (two children in a 0..1 field).
+        content = None if value is None else _content_to_node(spec, value)
+        self._view.submit_field(self._path, key, OptionalChange(
+            kind=fkind,
+            set=(content.clone() if content is not None else None,),
+        ))
 
 
 class TreeArrayNode(TypedNode):
@@ -378,10 +387,15 @@ class TreeArrayNode(TypedNode):
         spec = self._spec(ARRAY_FIELD)
         return [_content_to_node(spec, it) for it in items]
 
+    def _submit_marks(self, marks: list) -> None:
+        self._view.submit_field(self._path, ARRAY_FIELD, marks)
+
     def insert_at(self, index: int, *items) -> None:
-        self._view._submit(make_insert(
-            self._path, ARRAY_FIELD, index, self._content(items)
-        ))
+        from .changeset import Insert, Skip
+
+        marks = [Skip(index)] if index else []
+        marks.append(Insert([n.clone() for n in self._content(items)]))
+        self._submit_marks(marks)
 
     def insert_at_start(self, *items) -> None:
         self.insert_at(0, *items)
@@ -390,22 +404,28 @@ class TreeArrayNode(TypedNode):
         self.insert_at(self._count(), *items)
 
     def remove_at(self, index: int) -> None:
+        from .changeset import Remove, Skip
+
         self._node()  # rebind before using the path
-        self._view._submit(make_remove(self._path, ARRAY_FIELD, index, 1))
+        marks = [Skip(index)] if index else []
+        marks.append(Remove(1))
+        self._submit_marks(marks)
 
     def remove_range(self, start: int, end: int) -> None:
+        from .changeset import Remove, Skip
+
         self._node()
-        self._view._submit(make_remove(
-            self._path, ARRAY_FIELD, start, end - start
-        ))
+        marks = [Skip(start)] if start else []
+        marks.append(Remove(end - start))
+        self._submit_marks(marks)
 
     def move_to_index(self, dest: int, source: int, count: int = 1) -> None:
         """A REAL move (identity-preserving under concurrency), not
         remove+insert (ref arrayNode.ts moveToIndex/moveRangeToIndex)."""
+        from .changeset import make_move_marks
+
         self._node()
-        self._view._submit(make_move(
-            self._path, ARRAY_FIELD, source, count, dest
-        ))
+        self._submit_marks(make_move_marks(source, count, dest))
 
     def move_to_start(self, source: int, count: int = 1) -> None:
         self.move_to_index(0, source, count)
@@ -482,6 +502,48 @@ class SimpleTreeView:
     def _submit(self, change) -> None:
         self._gate()
         self._channel.submit_change(change)
+
+    # Every typed-view write wraps its ancestor path steps BY FIELD KIND:
+    # a step through a required/optional field encodes as that kind's
+    # nested change, a step through an array/root field as sequence marks.
+    # One field, one rebaser — a concurrent whole-field replace
+    # (OptionalChange) and a nested edit descending through the same field
+    # must meet under the same kind (changeset.rebase_node_change).
+    def _step_kind(self, path: list, depth: int) -> FieldKind:
+        if depth == 0:
+            return FieldKind.SEQUENCE  # the document root field
+        key, _idx = path[depth]
+        parent = self._channel.forest.node_at(path[:depth])
+        schema = self._schemas.get(parent.type)
+        if schema is None or key not in schema.fields:
+            return FieldKind.SEQUENCE
+        return schema.fields[key].kind
+
+    def _wrap_path(self, path: list, leaf: "NodeChange") -> "NodeChange":
+        from .changeset import Modify, NodeChange, Skip
+        from .field_kinds import OptionalChange
+
+        for depth in reversed(range(len(path))):
+            key, idx = path[depth]
+            kind = self._step_kind(path, depth)
+            if kind == FieldKind.SEQUENCE:
+                marks: list = [Skip(idx)] if idx else []
+                marks.append(Modify(leaf))
+                leaf = NodeChange(fields={key: marks})
+            else:
+                leaf = NodeChange(fields={key: OptionalChange(
+                    kind="value" if kind == FieldKind.VALUE else "optional",
+                    nested=leaf,
+                )})
+        return leaf
+
+    def submit_field(self, path: list, field_key: str, field_change) -> None:
+        """Submit one field's change with kind-aware ancestor wrapping."""
+        from .changeset import NodeChange
+
+        self._submit(self._wrap_path(
+            path, NodeChange(fields={field_key: field_change})
+        ))
 
 
 # ---------------------------------------------------------------------------

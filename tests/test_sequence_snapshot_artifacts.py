@@ -151,3 +151,36 @@ def test_artifact_loaded_replicas_keep_converging():
     ]
     assert scans[0] == scans[1]
     assert len(scans[0]) == 564  # edits moved markers, never destroyed ids
+
+
+def test_with_markers_artifact_round_trips_into_kernel_backend():
+    """The reference withMarkers document crosses the backend boundary:
+    oracle (loaded from the artifact) -> v2 summary -> TPU kernel backend,
+    with identical text, lengths, and marker tables.  Props intern to int
+    ids at the boundary exactly as the channel does (backends speak
+    int-columnar)."""
+    from fluidframework_tpu.dds.kernel_backend import KernelMergeTree
+
+    tree, _seq, _min_seq, _ivs = load_sequence_artifact(_by_name("withMarkers"))
+    prop_ids: dict[str, int] = {}
+    val_ids: dict[str, int] = {}
+
+    def pid(p):
+        return prop_ids.setdefault(p, len(prop_ids))
+
+    def vid(v):
+        return val_ids.setdefault(json.dumps(v, sort_keys=True), len(val_ids))
+
+    for seg in tree.segments:
+        seg.props = {pid(p): (vid(v), k) for p, (v, k) in seg.props.items()}
+
+    k = KernelMergeTree(
+        max_segments=2048, prop_slots=6, text_capacity=65536, max_insert_len=8
+    )
+    k.import_summary(tree.export_summary())
+    assert k.visible_text(ALL_ACKED, -1) == tree.visible_text(ALL_ACKED, -1)
+    assert k.visible_length(ALL_ACKED, -1) == tree.visible_length(ALL_ACKED, -1)
+    ms_o = tree.marker_scan(ALL_ACKED, -1)
+    ms_k = k.marker_scan(ALL_ACKED, -1)
+    assert len(ms_o) == 564
+    assert ms_k == ms_o

@@ -269,3 +269,88 @@ def test_failed_required_clear_leaves_no_edit():
     with pytest.raises(ValueError):
         v.root.items[0].title = None
     assert v.root.to_json() == before  # no partial removal leaked
+
+
+def test_concurrent_typed_replace_keeps_single_child():
+    """Whole-content replace of a value/optional field rides the OPTIONAL
+    field kind: two concurrent typed replaces converge to ONE child
+    (later-sequenced wins) — a remove+insert pair would double-insert."""
+    chans, settle = host(2)
+    a, b = chans
+    sf = SchemaFactory("app")
+    Point = sf.object("Point", x=sf.number, y=sf.number)
+    Doc = sf.object("Doc", pt=Point, label=optional(sf.string))
+    cfg = TreeViewConfiguration(Doc)
+    va = a.typed_view(cfg)
+    vb = b.typed_view(cfg)
+    va.initialize(Doc(pt=Point(x=1, y=2)))
+    settle()
+    # Race two whole-node replaces of the required field (settle flushes
+    # client a first, so b's replace sequences later and wins).
+    va.root.pt = Point(x=10, y=10)
+    vb.root.pt = Point(x=20, y=20)
+    settle()
+    for t in (a, b):
+        kids = t.forest.root_field[0].fields["pt"]
+        assert len(kids) == 1, [k.to_json() for k in kids]
+    assert va.root.pt.x == vb.root.pt.x == 20  # later wins
+    # Optional field: concurrent set vs clear converges too.
+    va.root.label = "a"
+    settle()
+    va.root.label = "b"
+    vb.root.label = None
+    settle()
+    assert va.root.label is None and vb.root.label is None
+    assert a.forest.equal(b.forest)
+
+
+def test_replace_races_nested_edit_without_crashing():
+    """Whole-field replace (OptionalChange) vs a nested leaf edit
+    descending THROUGH the same field: ancestor path steps wrap by field
+    kind, so both sides meet under one rebaser and converge (this raced a
+    kind-mismatch assert before the kind-aware wrapper)."""
+    chans, settle = host(2)
+    a, b = chans
+    sf = SchemaFactory("app2")
+    Point = sf.object("Point", x=sf.number, y=sf.number)
+    Doc = sf.object("Doc", pt=Point)
+    cfg = TreeViewConfiguration(Doc)
+    va, vb = a.typed_view(cfg), b.typed_view(cfg)
+    va.initialize(Doc(pt=Point(x=1, y=2)))
+    settle()
+    va.root.pt = Point(x=10, y=10)   # whole-field replace
+    vb.root.pt.x = 99                # nested edit through the same field
+    settle()
+    assert a.forest.equal(b.forest)
+    kids = a.forest.root_field[0].fields["pt"]
+    assert len(kids) == 1
+    # The replace sequenced later (settle flushes a then b... a first):
+    # b's nested edit lands on the OLD node, then a's replace? No — a
+    # flushed first, so the replace is EARLIER and b's nested edit of the
+    # replaced node drops: the replaced content stands.
+    assert va.root.pt.x == vb.root.pt.x == 10
+    assert va.root.pt.y == 10
+
+
+def test_mixed_typed_untyped_producers_degrade_deterministically():
+    """An untyped writer (sequence marks via raw make_* builders) racing a
+    typed replace (OptionalChange) on ONE field: the kind mismatch
+    resolves deterministically (later side drops) on every replica — no
+    crash, identical forests."""
+    from fluidframework_tpu.dds.tree.changeset import make_insert as mi
+
+    chans, settle = host(2)
+    a, b = chans
+    sf = SchemaFactory("app3")
+    Point = sf.object("Point", x=sf.number)
+    Doc = sf.object("Doc", pt=Point)
+    va = a.typed_view(TreeViewConfiguration(Doc))
+    va.initialize(Doc(pt=Point(x=1)))
+    settle()
+    va.root.pt = Point(x=5)                     # optional-kind replace
+    b.submit_change(mi([("", 0)], "pt", 1, [    # raw sequence insert
+        __import__("fluidframework_tpu.dds.tree.schema",
+                   fromlist=["leaf"]).leaf(42)
+    ]))
+    settle()
+    assert a.forest.equal(b.forest)
