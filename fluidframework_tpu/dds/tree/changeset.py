@@ -550,11 +550,40 @@ def compose_node_change(a: NodeChange, b: NodeChange) -> NodeChange:
             out.fields[key] = b_fc
         elif b_fc is None:
             out.fields[key] = a_fc
+        elif kind_of(a_fc) is kind_of(b_fc):
+            out.fields[key] = kind_of(a_fc).compose(a_fc, b_fc)
         else:
-            kind = kind_of(a_fc)
-            assert kind is kind_of(b_fc), f"field {key!r}: kind mismatch"
-            out.fields[key] = kind.compose(a_fc, b_fc)
+            out.fields[key] = _compose_mixed_kinds(a_fc, b_fc)
     return out
+
+
+def _compose_mixed_kinds(a_fc, b_fc):
+    """Compose a field's SEQUENTIAL history written under two different
+    kinds (mixed typed/untyped producers, which rebase now tolerates):
+
+    - a later optional SET shadows everything a did -> b alone;
+    - a later optional NESTED edit targets the field's single resident
+      node -> fold as a Modify at position 0 of a's marks;
+    - later sequence marks over an optional change -> convert a to its
+      mark/content form and fold b in (collapsing to <=1 node).
+    """
+    from .field_kinds import OptionalChange, compose_marks, kind_of
+
+    if isinstance(b_fc, OptionalChange):
+        if b_fc.set is not None:
+            return kind_of(b_fc).clone(b_fc)  # whole-content shadow
+        return compose_marks(a_fc, [Modify(b_fc.nested)])
+    # a is the optional change; b is sequence marks over a's output.
+    assert isinstance(a_fc, OptionalChange)
+    if a_fc.set is None:
+        return compose_marks([Modify(a_fc.nested)], b_fc)
+    new = a_fc.set[0]
+    content = [new.clone()] if new is not None else []
+    apply_marks(content, [_clone_mark(m) for m in b_fc])
+    return OptionalChange(
+        kind=a_fc.kind,
+        set=(content[0] if content else None,) + tuple(a_fc.set[1:]),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -887,22 +916,30 @@ def make_set_value(path: list[tuple[str, int]], value: Any) -> NodeChange:
     return _wrap(prefix, NodeChange(fields={key: marks}))
 
 
+def make_insert_marks(index: int, content: list[Node]) -> list[Mark]:
+    marks: list[Mark] = [Skip(index)] if index else []
+    marks.append(Insert([n.clone() for n in content]))
+    return marks
+
+
+def make_remove_marks(index: int, count: int) -> list[Mark]:
+    marks: list[Mark] = [Skip(index)] if index else []
+    marks.append(Remove(count))
+    return marks
+
+
 def make_insert(
     path: list[tuple[str, int]], field_key: str, index: int, content: list[Node]
 ) -> NodeChange:
     """Insert ``content`` at ``index`` of ``field_key`` under the node at
     ``path`` (path [] addresses the virtual root / root field)."""
-    marks: list[Mark] = [Skip(index)] if index else []
-    marks.append(Insert([n.clone() for n in content]))
-    return _wrap(path, NodeChange(fields={field_key: marks}))
+    return _wrap(path, NodeChange(fields={field_key: make_insert_marks(index, content)}))
 
 
 def make_remove(
     path: list[tuple[str, int]], field_key: str, index: int, count: int
 ) -> NodeChange:
-    marks: list[Mark] = [Skip(index)] if index else []
-    marks.append(Remove(count))
-    return _wrap(path, NodeChange(fields={field_key: marks}))
+    return _wrap(path, NodeChange(fields={field_key: make_remove_marks(index, count)}))
 
 
 def make_optional_set(

@@ -391,11 +391,10 @@ class TreeArrayNode(TypedNode):
         self._view.submit_field(self._path, ARRAY_FIELD, marks)
 
     def insert_at(self, index: int, *items) -> None:
-        from .changeset import Insert, Skip
+        from .changeset import make_insert_marks
 
-        marks = [Skip(index)] if index else []
-        marks.append(Insert([n.clone() for n in self._content(items)]))
-        self._submit_marks(marks)
+        self._node()  # rebind the path BEFORE building the submit
+        self._submit_marks(make_insert_marks(index, self._content(items)))
 
     def insert_at_start(self, *items) -> None:
         self.insert_at(0, *items)
@@ -404,20 +403,16 @@ class TreeArrayNode(TypedNode):
         self.insert_at(self._count(), *items)
 
     def remove_at(self, index: int) -> None:
-        from .changeset import Remove, Skip
+        from .changeset import make_remove_marks
 
         self._node()  # rebind before using the path
-        marks = [Skip(index)] if index else []
-        marks.append(Remove(1))
-        self._submit_marks(marks)
+        self._submit_marks(make_remove_marks(index, 1))
 
     def remove_range(self, start: int, end: int) -> None:
-        from .changeset import Remove, Skip
+        from .changeset import make_remove_marks
 
         self._node()
-        marks = [Skip(start)] if start else []
-        marks.append(Remove(end - start))
-        self._submit_marks(marks)
+        self._submit_marks(make_remove_marks(start, end - start))
 
     def move_to_index(self, dest: int, source: int, count: int = 1) -> None:
         """A REAL move (identity-preserving under concurrency), not

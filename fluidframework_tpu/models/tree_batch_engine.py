@@ -202,7 +202,7 @@ class TreeBatchEngine:
             return
         h.device_commits += 1
         for r, _p in rows:
-            if r[0] == tk.NestedOpKind.INSERT:
+            if r[0] in (tk.NestedOpKind.INSERT, tk.NestedOpKind.REPLACE_FIELD):
                 self._rows_upper[doc_idx] += int(r[tk._TGT + 2])
             self._pool_upper[doc_idx] += self._op_pool_words(r)
         h.queue.extend(r for r, _p in rows)
@@ -210,10 +210,12 @@ class TreeBatchEngine:
 
     @staticmethod
     def _op_pool_words(r: np.ndarray) -> int:
-        """Pool words an op row will append (INSERT/SET of pooled kinds)."""
-        if r[0] in (tk.NestedOpKind.INSERT, tk.NestedOpKind.SET) and int(
-            r[tk._TGT + 5]
-        ) in tk._POOLED:
+        """Pool words an op row will append (insert-like/SET pooled kinds)."""
+        if r[0] in (
+            tk.NestedOpKind.INSERT,
+            tk.NestedOpKind.SET,
+            tk.NestedOpKind.REPLACE_FIELD,
+        ) and int(r[tk._TGT + 5]) in tk._POOLED:
             return int(r[tk._TGT + 4])
         return 0
 
@@ -246,13 +248,64 @@ class TreeBatchEngine:
         for change in trunk_commit:
             if change.value is not None:
                 raise UnsupportedShape("value change on the virtual root")
-            for key, marks in change.fields.items():
-                if not isinstance(marks, list):
-                    # Non-sequence field kinds (optional/value sets) are
-                    # host-fallback territory for now.
-                    raise UnsupportedShape(f"field kind {marks.kind!r}")
-                self._walk_marks(marks, (), self._field_id(key), emit)
+            for key, fc in change.fields.items():
+                self._walk_field(fc, (), self._field_id(key), emit)
         return rows
+
+    def _one_payload(self, val: int, words: list[int] | None) -> np.ndarray:
+        pay = np.zeros((self.max_insert_len,), np.int32)
+        if words is not None:
+            pay[: len(words)] = words
+        else:
+            pay[0] = val
+        return pay
+
+    def _walk_field(self, fc, steps: tuple, fid: int, emit) -> None:
+        """Dispatch one field change by kind: sequence mark lists walk as
+        before; optional/value whole-content sets become REPLACE_FIELD
+        device ops; other kinds route to the host fallback."""
+        from ..dds.tree.field_kinds import OptionalChange
+
+        if isinstance(fc, list):
+            if fc:
+                self._walk_marks(fc, steps, fid, emit)
+            return
+        if not isinstance(fc, OptionalChange):
+            raise UnsupportedShape(f"field kind {getattr(fc, 'kind', fc)!r}")
+        if fc.set is not None:
+            content = fc.set[0]
+            if content is None:
+                emit(tk.NestedOpKind.REPLACE_FIELD, steps, fid, count=0)
+                return
+            vk, val, words = self._encode_value(content.value)
+            nt = self._type_id(content.type)
+            emit(tk.NestedOpKind.REPLACE_FIELD, steps, fid, count=1,
+                 value=val if words is not None else 0, vkind=vk, ntype=nt,
+                 payload=self._one_payload(val, words))
+            child_steps = steps + ((fid, 0),)
+            for key, kids in content.fields.items():
+                if kids:
+                    self._insert_content(
+                        kids, child_steps, self._field_id(key), 0, emit
+                    )
+            return
+        if fc.nested is not None and not fc.nested.is_empty():
+            self._walk_node_change(fc.nested, steps, fid, 0, emit)
+
+    def _walk_node_change(
+        self, ch, steps: tuple, fid: int, pos: int, emit
+    ) -> None:
+        """A NodeChange against the node at (fid, pos) under ``steps``."""
+        if ch.value is not None:
+            vk, val, words = self._encode_value(ch.value[0])
+            emit(tk.NestedOpKind.SET, steps, fid, pos=pos,
+                 value=val, vkind=vk,
+                 payload=self._one_payload(val, words) if words is not None
+                 else None)
+        if any(ch.fields.values()):
+            child_steps = steps + ((fid, pos),)
+            for key, fc in ch.fields.items():
+                self._walk_field(fc, child_steps, self._field_id(key), emit)
 
     def _walk_marks(self, marks, steps: tuple, fid: int, emit) -> None:
         if any(isinstance(m, (MoveOut, MoveIn)) for m in marks):
@@ -270,24 +323,7 @@ class TreeBatchEngine:
                 emit(tk.NestedOpKind.REMOVE, steps, fid, pos=out_pos,
                      count=m.count)
             elif isinstance(m, Modify):
-                ch = m.change
-                if ch.value is not None:
-                    vk, val, words = self._encode_value(ch.value[0])
-                    pay = None
-                    if words is not None:
-                        pay = np.zeros((self.max_insert_len,), np.int32)
-                        pay[: len(words)] = words
-                    emit(tk.NestedOpKind.SET, steps, fid, pos=out_pos,
-                         value=val, vkind=vk, payload=pay)
-                if any(ch.fields.values()):
-                    child_steps = steps + ((fid, out_pos),)
-                    for key, nested in ch.fields.items():
-                        if not isinstance(nested, list):
-                            raise UnsupportedShape(f"field kind {nested.kind!r}")
-                        if nested:
-                            self._walk_marks(
-                                nested, child_steps, self._field_id(key), emit
-                            )
+                self._walk_node_change(m.change, steps, fid, out_pos, emit)
                 out_pos += 1
             else:
                 raise UnsupportedShape(type(m).__name__)
@@ -312,13 +348,7 @@ class TreeBatchEngine:
                      vkind=run_shape[0], ntype=run_shape[1], payload=payload)
             run_vals, run_shape = [], None
 
-        def one_payload(val, words):
-            pay = np.zeros((self.max_insert_len,), np.int32)
-            if words is not None:
-                pay[: len(words)] = words
-            else:
-                pay[0] = val
-            return pay
+        one_payload = self._one_payload
 
         for node in nodes:
             vk, val, words = self._encode_value(node.value)
@@ -428,7 +458,10 @@ class TreeBatchEngine:
                     sum(
                         int(r[tk._TGT + 2])
                         for r in h.queue
-                        if r[0] == tk.NestedOpKind.INSERT
+                        if r[0] in (
+                            tk.NestedOpKind.INSERT,
+                            tk.NestedOpKind.REPLACE_FIELD,
+                        )
                     )
                     for h in self.hosts
                 ], np.int64)

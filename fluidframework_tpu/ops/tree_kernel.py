@@ -384,6 +384,9 @@ class NestedOpKind:
     REMOVE = 2   # count subtrees at pos
     SET = 3      # value of the node at (field, pos)
     MOVE = 4     # count nodes from pos to boundary dst (input coords)
+    REPLACE_FIELD = 5  # kill ALL siblings (+ descendants), insert count fresh
+    #                    nodes — the optional/value field-kind whole-content
+    #                    set (field_kinds.OptionalChange) on device
 
 
 class NestedForestState(NamedTuple):
@@ -446,6 +449,53 @@ def _sibling_mask(s: NestedForestState, parent, fld):
     return (s.alive == 1) & (s.parent == parent) & (s.field_id == fld)
 
 
+def _kill_with_descendants(s: NestedForestState, target) -> jnp.ndarray:
+    """Alive column with ``target`` rows dead and death propagated down
+    the parent chain.  Tree depth through this kernel is bounded by
+    MAX_PATH + 1 (the deepest addressable field), so a static unroll
+    covers every level."""
+    N = s.parent.shape[0]
+    alive = jnp.where(target, 0, s.alive)
+    for _ in range(MAX_PATH + 1):
+        pk = jnp.clip(s.parent, 0, N - 1)
+        parent_dead = (s.parent >= 0) & (alive[pk] == 0)
+        alive = jnp.where(parent_dead, 0, alive)
+    return alive
+
+
+def _fresh_run(
+    s: NestedForestState, *, count, parent, fld, indices, seq,
+    vkind, ntype, wlen, payload, pool, alive, index_others,
+) -> NestedForestState:
+    """Allocate ``count`` fresh rows (one vkind/ntype run) — the shared
+    row-write of INSERT and REPLACE_FIELD.  ``indices`` gives each fresh
+    row's sibling index from its allocation offset j; ``index_others`` is
+    the (possibly shifted) index column for existing rows; ``alive`` the
+    pre-allocation alive column."""
+    N = s.parent.shape[0]
+    idx = jnp.arange(N, dtype=I32)
+    fresh = (idx >= s.nrow) & (idx < s.nrow + count)
+    j = idx - s.nrow
+    pay = payload[jnp.clip(j, 0, payload.shape[0] - 1)]
+    pooled = _is_pooled(vkind)
+    inline = (vkind == VKIND_INT) | (vkind == VKIND_BOOL)
+    row_val = jnp.where(pooled, s.pool_end, jnp.where(inline, pay, 0))
+    return s._replace(
+        parent=jnp.where(fresh, parent, s.parent),
+        field_id=jnp.where(fresh, fld, s.field_id),
+        index=jnp.where(fresh, indices(j), index_others),
+        ntype=jnp.where(fresh, ntype, s.ntype),
+        value=jnp.where(fresh, row_val, s.value),
+        vkind=jnp.where(fresh, vkind, s.vkind),
+        vlen=jnp.where(fresh, wlen, s.vlen),
+        val_seq=jnp.where(fresh, seq, s.val_seq),
+        alive=jnp.where(fresh, 1, alive),
+        pool=pool,
+        pool_end=s.pool_end + wlen,
+        nrow=s.nrow + count,
+    )
+
+
 def apply_nested_op(
     s: NestedForestState, op: jnp.ndarray, payload: jnp.ndarray
 ) -> NestedForestState:
@@ -488,24 +538,11 @@ def apply_nested_op(
         bad = ~okp | (pos > n_sib)
         pool, pool_over = _pool_append(s)
         shifted = jnp.where(sib & (s.index >= pos), s.index + count, s.index)
-        fresh = (idx >= s.nrow) & (idx < s.nrow + count)
-        j = idx - s.nrow
-        pay = payload[jnp.clip(j, 0, payload.shape[0] - 1)]
-        inline = (vkind == VKIND_INT) | (vkind == VKIND_BOOL)
-        row_val = jnp.where(pooled, s.pool_end, jnp.where(inline, pay, 0))
-        out = s._replace(
-            parent=jnp.where(fresh, parent, s.parent),
-            field_id=jnp.where(fresh, fld, s.field_id),
-            index=jnp.where(fresh, pos + j, shifted),
-            ntype=jnp.where(fresh, ntype, s.ntype),
-            value=jnp.where(fresh, row_val, s.value),
-            vkind=jnp.where(fresh, vkind, s.vkind),
-            vlen=jnp.where(fresh, wlen, s.vlen),
-            val_seq=jnp.where(fresh, seq, s.val_seq),
-            alive=jnp.where(fresh, 1, s.alive),
-            pool=pool,
-            pool_end=s.pool_end + wlen,
-            nrow=s.nrow + count,
+        out = _fresh_run(
+            s, count=count, parent=parent, fld=fld,
+            indices=lambda j: pos + j, seq=seq, vkind=vkind, ntype=ntype,
+            wlen=wlen, payload=payload, pool=pool, alive=s.alive,
+            index_others=shifted,
         )
         return jax.lax.cond(
             okp & ~over & ~bad & ~pool_over,
@@ -517,14 +554,7 @@ def apply_nested_op(
     def do_remove(s):
         bad = ~okp | (pos + count > n_sib)
         target = sib & (s.index >= pos) & (s.index < pos + count)
-        alive = jnp.where(target, 0, s.alive)
-        # Kill descendants: a node whose parent died dies too.  Tree depth
-        # through this kernel is bounded by MAX_PATH + 1 (the deepest
-        # addressable field), so a static unroll covers every level.
-        for _ in range(MAX_PATH + 1):
-            pk = jnp.clip(s.parent, 0, N - 1)
-            parent_dead = (s.parent >= 0) & (alive[pk] == 0)
-            alive = jnp.where(parent_dead, 0, alive)
+        alive = _kill_with_descendants(s, target)
         closed = jnp.where(sib & (s.index >= pos + count), s.index - count, s.index)
         out = s._replace(alive=alive, index=closed)
         return jax.lax.cond(
@@ -551,6 +581,27 @@ def apply_nested_op(
             None,
         )
 
+    def do_replace_field(s):
+        # The optional-kind whole-content set: clear the field (subtree
+        # kill like REMOVE over every sibling), then insert the fresh run
+        # at index 0 (same row/pool mechanics as INSERT).
+        over = s.nrow + count > N
+        bad = ~okp
+        pool, pool_over = _pool_append(s)
+        alive = _kill_with_descendants(s, sib)
+        out = _fresh_run(
+            s, count=count, parent=parent, fld=fld,
+            indices=lambda j: j, seq=seq, vkind=vkind, ntype=ntype,
+            wlen=wlen, payload=payload, pool=pool, alive=alive,
+            index_others=s.index,
+        )
+        return jax.lax.cond(
+            okp & ~over & ~pool_over,
+            lambda _: out,
+            lambda _: fail(s, over, bad, pool_over),
+            None,
+        )
+
     def do_move(s):
         # Contiguous same-field block [pos, pos+count) to boundary dst,
         # both in input coordinates: pure sibling-index rewrites.
@@ -570,7 +621,9 @@ def apply_nested_op(
         )
 
     return jax.lax.switch(
-        kind, [do_noop, do_insert, do_remove, do_set, do_move], s
+        kind,
+        [do_noop, do_insert, do_remove, do_set, do_move, do_replace_field],
+        s,
     )
 
 

@@ -514,3 +514,44 @@ def test_compose_invert_restores_original_repair_data():
     sq = compose_commit(commit)
     apply_node_change(n3, invert_node_change(sq))
     assert _vals(n3.fields["seq"]) == [5, 6]
+
+
+def test_compose_mixed_kind_histories():
+    """compose over a field whose sequential history mixes kinds (legal
+    since rebase tolerates mixed producers) folds exactly instead of
+    asserting: optional-set shadows marks; marks fold into set content;
+    nested edits convert to Modify."""
+    from fluidframework_tpu.dds.tree.changeset import Insert as Ins
+
+    # marks then optional SET: the set shadows.
+    a = NodeChange(fields={"f": [Ins(_field([1, 2]))]})
+    b = NodeChange(fields={"f": OptionalChange(set=(leaf(9),))})
+    node = Node(type="obj")
+    apply_node_change(node, a)
+    apply_node_change(node, b)
+    sq = compose_node_change(a, b)
+    n2 = Node(type="obj")
+    apply_node_change(n2, sq)
+    assert n2.to_json() == node.to_json()
+
+    # optional SET then marks (edit of the set content): folds into the set.
+    a2 = NodeChange(fields={"f": OptionalChange(set=(leaf(5),))})
+    b2 = NodeChange(fields={"f": [Modify(NodeChange(value=(6,)))]})
+    node = Node(type="obj")
+    apply_node_change(node, a2)
+    apply_node_change(node, b2)
+    sq2 = compose_node_change(a2, b2)
+    n3 = Node(type="obj")
+    apply_node_change(n3, sq2)
+    assert n3.to_json() == node.to_json()
+
+    # marks then optional NESTED edit: folds as a Modify at position 0.
+    a3 = NodeChange(fields={"f": [Ins(_field([7]))]})
+    b3 = NodeChange(fields={"f": OptionalChange(nested=NodeChange(value=(8,)))})
+    node = Node(type="obj")
+    apply_node_change(node, a3)
+    apply_node_change(node, b3)
+    sq3 = compose_node_change(a3, b3)
+    n4 = Node(type="obj")
+    apply_node_change(n4, sq3)
+    assert n4.to_json() == node.to_json()

@@ -449,3 +449,82 @@ def test_compaction_retriggers_through_long_churn_queue():
     eng.step()
     assert not eng.fallbacks and not eng.errors().any()
     assert eng.values(0) == [nd.value for nd in t.forest.root_field]
+
+
+def test_optional_field_sets_stay_on_device():
+    """Typed-view workloads emit optional-kind whole-field sets; the
+    REPLACE_FIELD device op keeps them on the columnar path (no fallback)
+    with full-tree equality against the host stack."""
+    from fluidframework_tpu.dds.tree.changeset import (
+        make_optional_edit,
+        make_optional_set,
+    )
+    from fluidframework_tpu.dds.tree.changeset import NodeChange
+    from fluidframework_tpu.dds.tree.forest import Node
+
+    rng = random.Random(17)
+    svc = LocalService()
+    for d in range(3):
+        doc = svc.document(f"doc{d}")
+        rts = []
+        for i in range(2):
+            rt = ContainerRuntime(default_registry(), container_id=f"d{d}c{i}")
+            rt.create_datastore("root").create_channel("sharedTree", "t")
+            rt.connect(doc, f"d{d}c{i}")
+            rts.append(rt)
+        doc.process_all()
+        t0 = rts[0].datastore("root").get_channel("t")
+        t0.submit_change(make_insert([], "", 0, [Node(type="obj")]))
+        rts[0].flush()
+        doc.process_all()
+        for _step in range(25):
+            rt = rts[rng.randrange(2)]
+            t = rt.datastore("root").get_channel("t")
+            k = rng.random()
+            if k < 0.4:
+                # Whole-field replace: int leaf, string leaf, or subtree.
+                v = rng.choice([
+                    leaf(rng.randrange(100)),
+                    leaf("s" * rng.randint(1, 6)),
+                    Node(type="obj", fields={"kid": [leaf(rng.randrange(9))]}),
+                ])
+                t.submit_change(make_optional_set([("", 0)], "meta", v))
+            elif k < 0.55:
+                t.submit_change(make_optional_set([("", 0)], "meta", None))
+            elif k < 0.8:
+                n = t.forest.root_field[0]
+                if n.fields.get("meta"):
+                    t.submit_change(make_optional_edit(
+                        [("", 0)], "meta",
+                        NodeChange(value=(rng.randrange(100),)),
+                    ))
+            else:
+                t.submit_change(make_insert(
+                    [], "", rng.randint(0, len(t.forest.root_field)),
+                    [leaf(rng.randrange(100))],
+                ))
+            if rng.random() < 0.6:
+                rt.flush()
+            if rng.random() < 0.4:
+                doc.process_some(rng.randint(0, doc.pending_count))
+        for rt in rts:
+            rt.flush()
+        doc.process_all()
+    eng = _feed(svc, 3)
+    assert not eng.fallbacks, "optional sets must ride REPLACE_FIELD"
+    assert eng.device_fraction() == 1.0
+    for d in range(3):
+        expected = [
+            nd.to_json()
+            for nd in _first_tree(svc, d).forest.root_field
+        ]
+        assert eng.tree_json(d) == expected, f"doc {d} diverged"
+
+
+def _first_tree(svc, d):
+    # Recover a converged replica for doc d by replaying its log.
+    rt = ContainerRuntime(default_registry(), container_id=f"obs{d}")
+    rt.create_datastore("root").create_channel("sharedTree", "t")
+    rt.connect(svc.document(f"doc{d}"), f"obs{d}")
+    svc.document(f"doc{d}").process_all()
+    return rt.datastore("root").get_channel("t")
