@@ -21,6 +21,7 @@ from .markers import (
     assert_no_marker_plane,
     marker_char,
     marker_json,
+    strip_markers,
 )
 from .mergetree_ref import SIDE_AFTER, SIDE_BEFORE, RefMergeTree
 from .sequence_intervals import (
@@ -310,6 +311,38 @@ class SharedStringChannel(Channel):
             if self._raw_marker_prop(props, MARKER_ID_KEY) == marker_id:
                 return self._resolve_marker(pos, rt, props)
         return None
+
+    def annotate_marker(self, marker_id: str, props: dict) -> None:
+        """Annotate the marker with this id (ref sharedString.ts
+        annotateMarker): property updates ride the ordinary annotate op
+        over the marker's 1-position range, so LWW/resubmit semantics are
+        the standard ones."""
+        m = self.get_marker_from_id(marker_id)
+        if m is None:
+            raise KeyError(f"no marker with id {marker_id!r}")
+        for name, value in props.items():
+            self.annotate_range(m["position"], m["position"] + 1, name, value)
+
+    def get_text_and_markers(self, label: str) -> tuple[list[str], list[dict]]:
+        """Parallel (text runs, tile markers) split at every marker whose
+        referenceTileLabels include ``label`` (ref sharedString.ts
+        getTextAndMarkers — the paragraph/table walk)."""
+        raw = self.position_text()
+        cuts = [
+            m for m in self.backend.marker_scan(
+                ALL_ACKED, self.backend.local_client
+            )
+            if label in (self._raw_marker_prop(m[2], TILE_LABELS_KEY) or [])
+        ]
+        texts: list[str] = []
+        markers: list[dict] = []
+        start = 0
+        for m in cuts:
+            texts.append(strip_markers(raw[start:m[0]]))
+            markers.append(self._resolve_marker(*m))
+            start = m[0] + 1
+        texts.append(strip_markers(raw[start:]))
+        return texts, markers
 
     def search_for_marker(
         self, pos: int, label: str, forwards: bool = True

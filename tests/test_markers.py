@@ -207,3 +207,24 @@ def test_undo_capture_uses_position_space():
     _sync(doc, rts)
     assert s.text == "abc"  # "a" restored, not "b"
     assert s.get_marker_from_id("m")["position"] == 0
+
+
+def test_annotate_marker_and_text_and_markers():
+    """annotateMarker + getTextAndMarkers (ref sharedString.ts): marker
+    property updates replicate, and the paragraph walk splits text at
+    labeled tiles."""
+    _svc, doc, rts, ss = _fleet(2)
+    a, b = ss(rts[0]), ss(rts[1])
+    a.insert_text(0, "first para second")
+    a.insert_marker(5, REF_TILE, {MARKER_ID_KEY: "p1", TILE_LABELS_KEY: ["pg"]})
+    a.insert_marker(11, REF_TILE, {MARKER_ID_KEY: "p2", TILE_LABELS_KEY: ["pg"]})
+    _sync(doc, rts)
+    texts, markers = b.get_text_and_markers("pg")
+    assert texts == ["first", " para", " second"]
+    assert [m["props"][MARKER_ID_KEY] for m in markers] == ["p1", "p2"]
+    a.annotate_marker("p2", {"style": "h2"})
+    _sync(doc, rts)
+    m = b.get_marker_from_id("p2")
+    assert m["props"]["style"] == "h2"
+    with pytest.raises(KeyError):
+        a.annotate_marker("nope", {"x": 1})
