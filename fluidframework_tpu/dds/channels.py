@@ -314,19 +314,30 @@ class SharedStringChannel(Channel):
 
     def annotate_marker(self, marker_id: str, props: dict) -> None:
         """Annotate the marker with this id (ref sharedString.ts
-        annotateMarker): property updates ride the ordinary annotate op
-        over the marker's 1-position range, so LWW/resubmit semantics are
-        the standard ones."""
+        annotateMarker): ALL properties ride ONE annotate op under one
+        stamp over the marker's 1-position range — atomic across
+        reconnect resubmission, one ack."""
         m = self.get_marker_from_id(marker_id)
         if m is None:
             raise KeyError(f"no marker with id {marker_id!r}")
+        pos = m["position"]
+        ls = self._next_local_seq()
+        key = encode_stamp(-1, ls)
         for name, value in props.items():
-            self.annotate_range(m["position"], m["position"] + 1, name, value)
+            self.backend.apply_annotate(
+                pos, pos + 1, self._prop_id(name), self._val_id(value),
+                key, self.backend.local_client, ALL_ACKED,
+            )
+        self.submit_local_message(
+            {"type": 2, "pos1": pos, "pos2": pos + 1, "props": dict(props)},
+            {"localSeq": ls},
+        )
 
     def get_text_and_markers(self, label: str) -> tuple[list[str], list[dict]]:
-        """Parallel (text runs, tile markers) split at every marker whose
-        referenceTileLabels include ``label`` (ref sharedString.ts
-        getTextAndMarkers — the paragraph/table walk)."""
+        """Parallel (text runs, tile markers) — one text run PER labeled
+        tile (the text since the previous tile), trailing text after the
+        last tile excluded, exactly the reference's gatherTextAndMarkers
+        shape (ref sharedString.ts getTextAndMarkers)."""
         raw = self.position_text()
         cuts = [
             m for m in self.backend.marker_scan(
@@ -341,7 +352,6 @@ class SharedStringChannel(Channel):
             texts.append(strip_markers(raw[start:m[0]]))
             markers.append(self._resolve_marker(*m))
             start = m[0] + 1
-        texts.append(strip_markers(raw[start:]))
         return texts, markers
 
     def search_for_marker(

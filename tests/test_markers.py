@@ -219,12 +219,19 @@ def test_annotate_marker_and_text_and_markers():
     a.insert_marker(5, REF_TILE, {MARKER_ID_KEY: "p1", TILE_LABELS_KEY: ["pg"]})
     a.insert_marker(11, REF_TILE, {MARKER_ID_KEY: "p2", TILE_LABELS_KEY: ["pg"]})
     _sync(doc, rts)
+    # Reference shape: one text run PER tile; trailing text excluded.
     texts, markers = b.get_text_and_markers("pg")
-    assert texts == ["first", " para", " second"]
+    assert texts == ["first", " para"]
     assert [m["props"][MARKER_ID_KEY] for m in markers] == ["p1", "p2"]
-    a.annotate_marker("p2", {"style": "h2"})
+    # Multi-prop annotate is ONE op under one stamp (atomic resubmit).
+    sent = []
+    orig = a.submit_local_message
+    a.submit_local_message = lambda c, md: (sent.append(c), orig(c, md))[1]
+    a.annotate_marker("p2", {"style": "h2", "lvl": 2})
+    a.submit_local_message = orig
+    assert len(sent) == 1 and set(sent[0]["props"]) == {"style", "lvl"}
     _sync(doc, rts)
     m = b.get_marker_from_id("p2")
-    assert m["props"]["style"] == "h2"
+    assert m["props"]["style"] == "h2" and m["props"]["lvl"] == 2
     with pytest.raises(KeyError):
         a.annotate_marker("nope", {"x": 1})
