@@ -31,14 +31,18 @@ V1_SNAPSHOT_DIR = os.path.join(
 )
 
 
-def v1_artifact_files() -> list[str]:
-    if not os.path.isdir(V1_SNAPSHOT_DIR):
+def _json_files(directory: str) -> list[str]:
+    if not os.path.isdir(directory):
         return []
     return sorted(
-        os.path.join(V1_SNAPSHOT_DIR, f)
-        for f in os.listdir(V1_SNAPSHOT_DIR)
+        os.path.join(directory, f)
+        for f in os.listdir(directory)
         if f.endswith(".json")
     )
+
+
+def v1_artifact_files() -> list[str]:
+    return _json_files(V1_SNAPSHOT_DIR)
 
 
 def artifact_blobs(path: str) -> tuple[dict[str, str], dict[str, str]]:
@@ -85,6 +89,49 @@ def import_reference_intervals(
             ))
         out[value["label"]] = ivs
     return out
+
+
+def legacy_artifact_files() -> list[str]:
+    """The reference's LEGACY-format committed snapshots (snapshotlegacy.ts
+    MergeTreeChunkLegacy): snapshots/legacy and legacyWithCatchUp."""
+    root = os.path.dirname(V1_SNAPSHOT_DIR)
+    out = []
+    for d in ("legacy", "legacyWithCatchUp"):
+        out.extend(_json_files(os.path.join(root, d)))
+    return out
+
+
+def load_legacy_sequence_artifact(path: str):
+    """Load a LEGACY-format artifact (header + optional body chunk of
+    ``segmentTexts`` IJSONSegment specs, snapshotlegacy.ts) into a fresh
+    oracle.  Returns (RefMergeTree, sequenceNumber, {label: intervals})."""
+    from ..dds.snapshot_v1 import _spec_text_props
+    from ..dds.mergetree_ref import RefMergeTree, Segment
+    from ..protocol.stamps import NON_COLLAB_CLIENT, UNIVERSAL_SEQ
+
+    blobs, extra = artifact_blobs(path)
+    header = json.loads(blobs["header"])
+    meta = header["headerMetadata"]
+    chunks = [header]
+    for entry in meta["orderedChunkMetadata"]:
+        if entry["id"] != "header":
+            chunks.append(json.loads(blobs[entry["id"]]))
+    tree = RefMergeTree()
+    for chunk in chunks:
+        assert chunk["chunkSegmentCount"] == len(chunk["segmentTexts"])
+        for spec in chunk["segmentTexts"]:
+            text, props = _spec_text_props(spec)
+            tree.segments.append(Segment(
+                text=text,
+                ins_key=UNIVERSAL_SEQ,
+                ins_client=NON_COLLAB_CLIENT,
+                props={p: (v, UNIVERSAL_SEQ) for p, v in (props or {}).items()},
+            ))
+    assert len(tree.segments) == meta["totalSegmentCount"]
+    intervals = (
+        import_reference_intervals(extra["header"]) if "header" in extra else {}
+    )
+    return tree, meta["sequenceNumber"], intervals
 
 
 def load_sequence_artifact(

@@ -184,3 +184,32 @@ def test_with_markers_artifact_round_trips_into_kernel_backend():
     ms_k = k.marker_scan(ALL_ACKED, -1)
     assert len(ms_o) == 564
     assert ms_k == ms_o
+
+
+def test_legacy_format_artifacts_load_and_match_v1():
+    """The reference's LEGACY snapshot format (snapshotlegacy.ts) loads
+    too, and for every document committed in BOTH formats the two
+    independent reference encodings converge to IDENTICAL state in this
+    repo's oracle — text, lengths, markers, annotations."""
+    from fluidframework_tpu.testing.reference_snapshots import (
+        legacy_artifact_files,
+        load_legacy_sequence_artifact,
+    )
+
+    legacy_files = legacy_artifact_files()
+    assert len(legacy_files) >= 12  # 6 docs x {legacy, legacyWithCatchUp}
+    checked_intervals = 0
+    for path in legacy_files:
+        tree, _seq, ivs = load_legacy_sequence_artifact(path)
+        name = os.path.basename(path)
+        v1, _s, _m, v1_ivs = load_sequence_artifact(
+            _by_name(name.replace(".json", ""))
+        )
+        assert tree.visible_text(ALL_ACKED, -1) == v1.visible_text(ALL_ACKED, -1), name
+        assert tree.visible_length(ALL_ACKED, -1) == v1.visible_length(ALL_ACKED, -1), name
+        assert tree.marker_scan(ALL_ACKED, -1) == v1.marker_scan(ALL_ACKED, -1), name
+        assert tree.annotations(ALL_ACKED, -1) == v1.annotations(ALL_ACKED, -1), name
+        assert ivs == v1_ivs, name  # interval collections agree too
+        if ivs:
+            checked_intervals += 1
+    assert checked_intervals >= 2  # both withIntervals variants carried them
