@@ -213,3 +213,18 @@ def test_legacy_format_artifacts_load_and_match_v1():
         if ivs:
             checked_intervals += 1
     assert checked_intervals >= 2  # both withIntervals variants carried them
+
+
+def test_empty_props_at_end_artifact():
+    """snapshots/emptyPropsAtEnd.json (a legacy-format regression artifact
+    for {text, props:{}} specs) loads with the empty props dropped."""
+    from fluidframework_tpu.testing.reference_snapshots import (
+        V1_SNAPSHOT_DIR,
+        load_legacy_sequence_artifact,
+    )
+
+    path = os.path.join(os.path.dirname(V1_SNAPSHOT_DIR), "emptyPropsAtEnd.json")
+    tree, _seq, _ivs = load_legacy_sequence_artifact(path)
+    assert tree.visible_length(ALL_ACKED, -1) == 38890
+    assert tree.visible_text(ALL_ACKED, -1).startswith("text4999")
+    assert all(not s.props for s in tree.segments)
