@@ -193,6 +193,174 @@ def schema_from_reference(content: str) -> SchemaRegistry:
     return reg
 
 
+# ----------------------------------------------------------------- encoder
+
+INV_LEAF_TYPE_MAP = {v: k for k, v in LEAF_TYPE_MAP.items()}
+INV_FIELD_KIND_MAP = {
+    FieldKind.VALUE: "Value",
+    FieldKind.OPTIONAL: "Optional",
+    FieldKind.SEQUENCE: "Sequence",
+}
+# Reference ValueSchema enum order (core/schema-stored/format.ts).
+LEAF_CODES = {"number": 0, "string": 1, "boolean": 2, "handle": 3, "null": 4}
+
+
+def _inv_type(t: str) -> str:
+    return INV_LEAF_TYPE_MAP.get(t, t)
+
+
+def _encode_node_stream(n: Node, out: list) -> None:
+    """Inverse of _read_node for the generic Uncompressed shape pair
+    ({"c": {"extraFields": 1}} + {"a": 0}).  A null LEAF carries an
+    explicit null value on the wire (reference encodeValue pushes
+    [true, null] — null !== undefined); our model signals nullness by the
+    node type, so the type decides the has-value flag there."""
+    out.append(_inv_type(n.type))
+    if n.value is not None or n.type == "null":
+        out.append(True)
+        out.append(n.value)
+    else:
+        out.append(False)
+    fields: list = []
+    for key, kids in n.fields.items():
+        arr: list = []
+        for c in kids:
+            _encode_node_stream(c, arr)
+        fields.append(key)
+        fields.append(arr)
+    out.append(fields)
+
+
+def encode_field_batch(
+    root_field: list[Node],
+    fields_version: int,
+    top_version: int,
+    other_fields: dict[str, list[Node]] | None = None,
+    key_order: list[str] | None = None,
+) -> str:
+    """Forest blob in the reference's UNCOMPRESSED FieldBatch encoding —
+    the write path matching decode_field_batch (byte-identical against
+    the committed artifacts, tests/test_tree_summary_artifacts.py).
+    ``other_fields`` carries non-root forest keys (detached subtrees) in
+    ``key_order``, so nothing the original stored is dropped."""
+    fields = {"rootFieldKey": root_field, **(other_fields or {})}
+    keys = key_order or list(fields)
+    assert set(keys) == set(fields), "key_order must cover every field"
+    data = []
+    for key in keys:
+        stream: list = []
+        for n in fields[key]:
+            _encode_node_stream(n, stream)
+        data.append([1, stream])
+    return json.dumps({
+        "keys": keys,
+        "fields": {
+            "version": fields_version,
+            "identifiers": [],
+            "shapes": [{"c": {"extraFields": 1}}, {"a": 0}],
+            "data": data,
+        },
+        "version": top_version,
+    }, separators=(",", ":"))
+
+
+def schema_to_reference(reg: SchemaRegistry, version: int) -> str:
+    """SchemaString blob (v1 flat / v2 kind-wrapped) from the registry.
+    Node entries sort by full name (leaves carried by reference from the
+    registry's allowed-type mentions), matching the reference's
+    deterministic serialization."""
+    leaves: set[str] = set()
+
+    def note(types: set[str]) -> None:
+        for t in types:
+            if t in LEAF_CODES:
+                leaves.add(t)
+
+    for node in reg.nodes.values():
+        for fs in node.fields.values():
+            note(fs.allowed_types)
+    if reg.root:
+        note(reg.root.allowed_types)
+
+    entries: dict[str, Any] = {}
+    for t in leaves:
+        entries[_inv_type(t)] = {"leaf": LEAF_CODES[t]}
+    for name, node in reg.nodes.items():
+        entries[_inv_type(name)] = {"object": {
+            key: {
+                "kind": INV_FIELD_KIND_MAP[fs.kind],
+                "types": sorted(_inv_type(t) for t in fs.allowed_types),
+            }
+            for key, fs in node.fields.items()
+        }}
+    nodes = {k: entries[k] for k in sorted(entries)}
+    if version >= 2:
+        nodes = {k: {"kind": v} for k, v in nodes.items()}
+    out: dict[str, Any] = {"version": version, "nodes": nodes}
+    if reg.root:
+        out["root"] = {
+            "kind": INV_FIELD_KIND_MAP[reg.root.kind],
+            "types": sorted(_inv_type(t) for t in reg.root.allowed_types),
+        }
+    return json.dumps(out, separators=(",", ":"))
+
+
+def encode_reference_tree_summary(loaded: dict[str, Any]) -> str:
+    """The FULL summary file (ITree JSON, tab-indented like the
+    reference's JSON.stringify(x, undefined, "\\t")) regenerated from a
+    load_reference_tree_summary result — the Uncompressed write path."""
+    fmt = loaded["format"]
+    if not fmt.get("schema_lossless", True):
+        raise ValueError(
+            "schema uses constructs outside the registry's lossless subset "
+            "(map nodes / Identifier or Forbidden kinds); refusing to "
+            "regenerate a semantically different schema"
+        )
+
+    def blob(content: str) -> dict:
+        return {"type": 2, "content": content}
+
+    def index(name: str, blob_name: str, content: str) -> dict:
+        entries: dict[str, Any] = {}
+        if name in loaded["versions"]:  # mirror the loader's optionality
+            entries[".metadata"] = blob(json.dumps(
+                {"version": loaded["versions"][name]}, separators=(",", ":")
+            ))
+        entries[blob_name] = blob(content)
+        return {"type": 1, "tree": entries}
+
+    other = {
+        k: v for k, v in loaded.get("forest_fields", {}).items()
+        if k != "rootFieldKey"
+    }
+    tree = {
+        "EditManager": index("EditManager", "String", json.dumps(
+            loaded["edit_manager"], separators=(",", ":")
+        )),
+        "Schema": index("Schema", "SchemaString", schema_to_reference(
+            loaded["schema"], fmt["schema_version"]
+        )),
+        "Forest": index("Forest", fmt["forest_blob"], encode_field_batch(
+            loaded["root_field"],
+            fmt["forest_fields_version"],
+            fmt["forest_top_version"],
+            other_fields=other,
+            key_order=loaded.get("forest_key_order"),
+        )),
+        "DetachedFieldIndex": index(
+            "DetachedFieldIndex", "DetachedFieldIndexBlob",
+            json.dumps(loaded["detached"], separators=(",", ":")),
+        ),
+    }
+    doc = {"type": 1, "tree": {
+        ".metadata": blob(json.dumps(
+            {"version": fmt["top_version"]}, separators=(",", ":")
+        )),
+        "indexes": {"type": 1, "tree": tree},
+    }}
+    return json.dumps(doc, indent="\t") + "\n"
+
+
 # ------------------------------------------------------------------ loader
 
 
@@ -209,9 +377,8 @@ def load_reference_tree_summary(path: str) -> dict[str, Any]:
                 return blobs[key]
         raise KeyError(f"no blob for index {index} in {sorted(blobs)}")
 
-    forest_fields = decode_field_batch(
-        index_blob("Forest", "ForestTree", "contents")
-    )
+    forest_raw = index_blob("Forest", "ForestTree", "contents")
+    forest_fields = decode_field_batch(forest_raw)
     em = json.loads(index_blob("EditManager", "String"))
     detached = json.loads(
         index_blob("DetachedFieldIndex", "DetachedFieldIndexBlob", "contents")
@@ -221,10 +388,37 @@ def load_reference_tree_summary(path: str) -> dict[str, Any]:
         for idx in ("EditManager", "Schema", "Forest", "DetachedFieldIndex")
         if f"indexes/{idx}/.metadata" in blobs
     }
+    schema_raw = index_blob("Schema", "SchemaString")
+    forest_parsed = json.loads(forest_raw)
+    schema_data = json.loads(schema_raw)
+    # Is the schema inside the registry's lossless subset?  (map nodes and
+    # Identifier/Forbidden kinds FOLD on load; the encoder refuses to
+    # regenerate them silently.)
+    lossless = True
+    for spec in schema_data.get("nodes", {}).values():
+        spec = spec.get("kind", spec) if "leaf" not in spec else spec
+        if "map" in spec:
+            lossless = False
+        for fs in (spec.get("object") or {}).values():
+            if fs["kind"] not in ("Value", "Optional", "Sequence"):
+                lossless = False
     return {
         "root_field": forest_fields.get("rootFieldKey", []),
-        "schema": schema_from_reference(index_blob("Schema", "SchemaString")),
+        "forest_fields": forest_fields,
+        "forest_key_order": list(forest_fields),
+        "schema": schema_from_reference(schema_raw),
         "edit_manager": em,
         "detached": detached,
         "versions": versions,
+        # Format stamps for the write path (encode_reference_tree_summary).
+        "format": {
+            "top_version": json.loads(blobs[".metadata"])["version"]
+            if ".metadata" in blobs else 1,
+            "schema_version": schema_data.get("version", 1),
+            "schema_lossless": lossless,
+            "forest_blob": "contents"
+            if "indexes/Forest/contents" in blobs else "ForestTree",
+            "forest_top_version": forest_parsed.get("version", 1),
+            "forest_fields_version": forest_parsed["fields"]["version"],
+        },
     }

@@ -145,3 +145,99 @@ def test_field_batch_decoder_rejects_trailing_data():
             '"shapes":[{"c":{"type":"x","value":true}}],'
             '"data":[[0,5,"junk"]]}}'
         )
+
+
+def test_uncompressed_summaries_reencode_full_file_byte_identical():
+    """The WRITE path: every Uncompressed committed summary regenerates
+    from this repo's decoded model (forest nodes + schema registry + index
+    stamps) to the EXACT file the reference wrote — ITree layout,
+    FieldBatch encoding, SchemaString (v1 flat and v2 kind-wrapped),
+    metadata stamps, tab indentation, byte for byte."""
+    from fluidframework_tpu.dds.tree.reference_summary import (
+        encode_reference_tree_summary,
+    )
+
+    files = summary_snapshot_files("Uncompressed")
+    assert len(files) == 7
+    for path in files:
+        loaded = load_reference_tree_summary(path)
+        regenerated = encode_reference_tree_summary(loaded)
+        assert regenerated == open(path, encoding="utf-8").read(), (
+            os.path.basename(path)
+        )
+
+
+def test_field_batch_encode_decode_roundtrip_arbitrary_docs():
+    """encode_field_batch/decode_field_batch round-trip arbitrary forests
+    (not just the committed document)."""
+    import random
+
+    from fluidframework_tpu.dds.tree.forest import Node
+    from fluidframework_tpu.dds.tree.reference_summary import (
+        decode_field_batch,
+        encode_field_batch,
+    )
+    from fluidframework_tpu.dds.tree.schema import leaf
+
+    rng = random.Random(7)
+
+    def rand_node(depth):
+        if depth == 0 or rng.random() < 0.5:
+            return leaf(rng.choice([rng.randrange(100), "s" * rng.randint(1, 4),
+                                    True, None]))
+        return Node(
+            type=f"T{rng.randrange(3)}",
+            value=rng.randrange(10) if rng.random() < 0.4 else None,
+            fields={k: [rand_node(depth - 1) for _ in range(rng.randint(1, 2))]
+                    for k in rng.sample(["a", "b"], rng.randint(1, 2))},
+        )
+
+    for _ in range(10):
+        field = [rand_node(3) for _ in range(rng.randint(0, 4))]
+        blob = encode_field_batch(field, fields_version=2, top_version=2)
+        back = decode_field_batch(blob)["rootFieldKey"]
+        assert [n.to_json() for n in back] == [n.to_json() for n in field]
+
+
+def test_encoder_latent_asymmetries_guarded():
+    """Null leaves keep their explicit wire value; multi-key forests
+    (detached subtrees) thread through the write path; schemas outside
+    the registry's lossless subset refuse to regenerate."""
+    import json as _json
+
+    from fluidframework_tpu.dds.tree.reference_summary import (
+        encode_field_batch,
+        encode_reference_tree_summary,
+    )
+
+    # Null leaf: reference encodes [type, true, null, []].
+    blob = (
+        '{"keys":["rootFieldKey"],"fields":{"version":2,"identifiers":[],'
+        '"shapes":[{"c":{"extraFields":1}},{"a":0}],'
+        '"data":[[1,["com.fluidframework.leaf.null",true,null,[]]]]},'
+        '"version":2}'
+    )
+    nodes = decode_field_batch(blob)["rootFieldKey"]
+    assert nodes[0].type == "null" and nodes[0].value is None
+    assert encode_field_batch(nodes, 2, 2) == blob
+
+    # Multi-key forest round-trips with key order preserved.
+    blob2 = _json.loads(blob)
+    blob2["keys"] = ["rootFieldKey", "detached-0"]
+    blob2["fields"]["data"].append(
+        [1, ["com.fluidframework.leaf.number", True, 7, []]]
+    )
+    raw2 = _json.dumps(blob2, separators=(",", ":"))
+    fields = decode_field_batch(raw2)
+    assert fields["detached-0"][0].value == 7
+    assert encode_field_batch(
+        fields["rootFieldKey"], 2, 2,
+        other_fields={"detached-0": fields["detached-0"]},
+        key_order=["rootFieldKey", "detached-0"],
+    ) == raw2
+
+    # Non-lossless schema (map node) refuses to regenerate.
+    loaded = load_reference_tree_summary(ARTIFACTS[0])
+    loaded["format"]["schema_lossless"] = False
+    with pytest.raises(ValueError):
+        encode_reference_tree_summary(loaded)
