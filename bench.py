@@ -390,7 +390,9 @@ def _string_ingest_rate(n_docs, rounds, writers, seed=0):
     eng.step()
     dt = time.perf_counter() - t0
     assert not eng.errors().any()
-    return round(n_ops / dt, 1)
+    # Degraded-mode health counters ride along so BENCH artifacts track
+    # quarantine/checkpoint/watchdog behavior release over release.
+    return round(n_ops / dt, 1), eng.health()
 
 
 # ---------------------------------------------------------------------------
@@ -437,7 +439,9 @@ def bench_config1(args) -> dict:
         )
 
     out = _mergetree_run(args, 1, gen, "config1_singledoc_replay_ops_per_sec")
-    out["ingest_ops_per_sec"] = _string_ingest_rate(1, rounds=64, writers=4)
+    out["ingest_ops_per_sec"], out["engine_health"] = _string_ingest_rate(
+        1, rounds=64, writers=4
+    )
     return out
 
 
@@ -476,7 +480,7 @@ def bench_config3(args) -> dict:
     out["docs"] = D
     if lane_k < D:
         out["lanes"] = [lane_k, D - lane_k]
-    out["ingest_ops_per_sec"] = _string_ingest_rate(
+    out["ingest_ops_per_sec"], out["engine_health"] = _string_ingest_rate(
         min(D, 128), rounds=16, writers=4
     )
     native = _native_ingest_rate()
@@ -883,6 +887,7 @@ def bench_config5(args) -> dict:
         "edits": n_edits,
         "pipeline_edits_per_sec": round(pipeline, 1),
         "host_translation_edits_per_sec": round(n_edits / t_host, 1),
+        "engine_health": eng.health(),
     }
 
 

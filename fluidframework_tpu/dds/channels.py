@@ -21,6 +21,7 @@ from .markers import (
     assert_no_marker_plane,
     marker_char,
     marker_json,
+    spec_length,
     strip_markers,
 )
 from .mergetree_ref import SIDE_AFTER, SIDE_BEFORE, RefMergeTree
@@ -152,17 +153,37 @@ class SharedStringChannel(Channel):
         self, seg, pos: int, key: int, client: int, ref_seq: int
     ) -> list:
         """Apply one wire insert spec (IJSONSegment: bare text, annotated
-        {text, props}, or marker {marker:{refType}, props}) to the backend.
-        Properties apply as (pos, pos+1) annotates in the SAME perspective:
-        the op's own segment is visible to (ref_seq, sender) — own ops have
-        occurred — so the range lands exactly on the inserted segment."""
+        {text, props}, marker {marker:{refType}, props}, or a LIST of those
+        — a regenerated insert whose split parts carry different props) to
+        the backend.  Properties apply as (pos, pos+1) annotates in the
+        SAME perspective: the op's own segment is visible to (ref_seq,
+        sender) — own ops have occurred — so the range lands exactly on the
+        inserted segment.
+
+        This is the op-apply/decode boundary for the reserved marker plane:
+        only a {"marker": {...}} spec may produce U+E000..U+F8FF
+        codepoints.  Bare/annotated text smuggling plane codepoints is
+        rejected (ValueError) — accepting it would make every replica
+        silently reinterpret peer 'text' as markers, breaking the
+        text/length invariants the local insert_text API already guards."""
+        if isinstance(seg, list):
+            out: list = []
+            off = 0
+            for part in seg:
+                out.extend(
+                    self._apply_insert_spec(part, pos + off, key, client, ref_seq)
+                )
+                off += spec_length(part)
+            return out
         if isinstance(seg, str):
             text, props = seg, None
+            assert_no_marker_plane(text)
         elif "marker" in seg:
             text = marker_char(seg["marker"]["refType"])
             props = seg.get("props")
         else:
             text, props = seg["text"], seg.get("props")
+            assert_no_marker_plane(text)
         ins = self.backend.apply_insert(pos, text, key, client, ref_seq)
         for name, value in (props or {}).items():
             self.backend.apply_annotate(
@@ -576,15 +597,27 @@ class SharedStringChannel(Channel):
                     self._prop_names[int(p)]: self._val_raw[v]
                     for p, v in op["props"].items()
                 }
-            elif op.get("type") == 0 and isinstance(op.get("seg"), dict):
-                # Marker / annotated-insert spec: resolve its prop ids too.
+            elif op.get("type") == 0 and isinstance(op.get("seg"), (dict, list)):
+                # Marker / annotated-insert spec (or a per-props-run spec
+                # list from regeneration): resolve interned prop ids to
+                # their raw wire forms, part by part.
+                def resolve(seg):
+                    if isinstance(seg, str):
+                        return seg
+                    seg = dict(seg)
+                    seg["props"] = {
+                        self._prop_names[int(p)]: self._val_raw[v]
+                        for p, v in seg.get("props", {}).items()
+                    }
+                    return seg
+
                 op = dict(op)
-                seg = dict(op["seg"])
-                seg["props"] = {
-                    self._prop_names[int(p)]: self._val_raw[v]
-                    for p, v in seg.get("props", {}).items()
-                }
-                op["seg"] = seg
+                seg = op["seg"]
+                op["seg"] = (
+                    [resolve(part) for part in seg]
+                    if isinstance(seg, list)
+                    else resolve(seg)
+                )
             self.submit_local_message(op, {"localSeq": fresh_ls})
 
     def apply_stashed(self, contents: Any) -> Any:

@@ -84,6 +84,245 @@ class _Ob:
     ref_seq: int
 
 
+# ---------------------------------------------------------------------------
+# Standalone DocState <-> host snapshot / summary converters.  These are the
+# checkpoint/restore primitives shared by the single-doc backend below and
+# the batched engines (models/doc_batch_engine.py): any packed ``DocState``
+# row — batch slot, overflow lane, or restored checkpoint — round-trips
+# through the same summary JSON schema as RefMergeTree.export_summary.
+# ---------------------------------------------------------------------------
+
+
+def pull_segments(state: mk.DocState, with_text: bool = False) -> list[_Seg]:
+    """Pull the live segment rows of one DocState off device as host records."""
+    s = state
+    nseg = int(s.nseg)
+    seg_uid = np.asarray(s.seg_uid)[:nseg]
+    seg_len = np.asarray(s.seg_len)[:nseg]
+    ins_key = np.asarray(s.ins_key)[:nseg]
+    ins_client = np.asarray(s.ins_client)[:nseg]
+    obpre = np.asarray(s.seg_obpre)[:nseg]
+    rem_k = np.stack([np.asarray(a)[:nseg] for a in s.rem_keys]) if nseg else None
+    rem_c = np.stack([np.asarray(a)[:nseg] for a in s.rem_clients]) if nseg else None
+    prop_k = np.stack([np.asarray(a)[:nseg] for a in s.prop_keys]) if nseg else None
+    prop_v = np.stack([np.asarray(a)[:nseg] for a in s.prop_vals]) if nseg else None
+    texts: list[str | None] = [None] * nseg
+    if with_text and nseg:
+        pool = np.asarray(s.text)
+        start = np.asarray(s.seg_start)[:nseg]
+        texts = [
+            "".join(chr(c) for c in pool[start[i] : start[i] + seg_len[i]])
+            for i in range(nseg)
+        ]
+    out: list[_Seg] = []
+    for i in range(nseg):
+        removes = sorted(
+            (int(rem_k[r, i]), int(rem_c[r, i]))
+            for r in range(rem_k.shape[0])
+            if rem_k[r, i] != NO_REMOVE
+        )
+        props = {
+            p: (int(prop_v[p, i]), int(prop_k[p, i]))
+            for p in range(prop_k.shape[0])
+            if prop_k[p, i] >= 0
+        }
+        out.append(
+            _Seg(
+                uid=int(seg_uid[i]),
+                length=int(seg_len[i]),
+                ins_key=int(ins_key[i]),
+                ins_client=int(ins_client[i]),
+                obpre=int(obpre[i]),
+                removes=removes,
+                props=props,
+                text=texts[i],
+            )
+        )
+    return out
+
+
+def pull_obliterates(state: mk.DocState) -> list[_Ob]:
+    s = state
+    keys = np.asarray(s.ob_key)
+    out = []
+    for i in range(keys.shape[0]):
+        if keys[i] >= 0:
+            out.append(
+                _Ob(
+                    slot=i,
+                    key=int(keys[i]),
+                    client=int(np.asarray(s.ob_client)[i]),
+                    start_uid=int(np.asarray(s.ob_start_uid)[i]),
+                    start_side=int(np.asarray(s.ob_start_side)[i]),
+                    end_uid=int(np.asarray(s.ob_end_uid)[i]),
+                    end_side=int(np.asarray(s.ob_end_side)[i]),
+                    ref_seq=int(np.asarray(s.ob_ref_seq)[i]),
+                )
+            )
+    return out
+
+
+def state_to_summary(
+    state: mk.DocState,
+    prop_names: dict[int, object] | None = None,
+    slice_keys: set[int] | None = None,
+) -> dict:
+    """One DocState -> summary JSON (identical schema to
+    RefMergeTree.export_summary).  ``prop_names`` maps kernel prop slot ->
+    property id; missing slots keep their slot number as the id."""
+    segs = pull_segments(state, with_text=True)
+    prop_names = prop_names or {}
+    out_segs = []
+    for seg in segs:
+        if not _acked(seg.ins_key) or any(not _acked(k) for k, _c in seg.removes):
+            raise RuntimeError("summarize with pending merge-tree state")
+        out_segs.append(
+            {
+                "text": seg.text,
+                "ins": [seg.ins_key, seg.ins_client],
+                "removes": [[k, c] for k, c in seg.removes],
+                "props": {
+                    str(prop_names.get(p, p)): [v, k]
+                    for p, (v, k) in sorted(seg.props.items())
+                },
+            }
+        )
+    uid_index = {seg.uid: i for i, seg in enumerate(segs)}
+    obs = []
+    for ob in sorted(pull_obliterates(state), key=lambda o: o.key):
+        if not _acked(ob.key):
+            raise RuntimeError("summarize with pending merge-tree state")
+        obs.append(
+            {
+                "key": ob.key,
+                "client": ob.client,
+                "start": uid_index.get(ob.start_uid, -1),
+                "startSide": ob.start_side,
+                "end": uid_index.get(ob.end_uid, -1),
+                "endSide": ob.end_side,
+                "refSeq": ob.ref_seq,
+            }
+        )
+    live = {k for seg in segs for k, _c in seg.removes} | {o["key"] for o in obs}
+    return {
+        "segments": out_segs,
+        "obliterates": obs,
+        "minSeq": int(state.min_seq),
+        "sliceKeys": sorted((slice_keys or set()) & live),
+    }
+
+
+def summary_to_state(summary: dict, geometry: dict, slot_for) -> mk.DocState:
+    """Summary JSON -> a fresh DocState packed at ``geometry`` (the
+    checkpoint-restore and grow-replay base).  ``slot_for(prop_id)`` interns
+    a property id to a kernel prop slot — callers keep their own table so
+    later ops encode against the same slots.  Raises ValueError when the
+    summary does not fit the geometry (callers grow and retry)."""
+    import jax.numpy as jnp
+
+    S = geometry["max_segments"]
+    T = geometry["text_capacity"]
+    R = geometry["remove_slots"]
+    P = geometry["prop_slots"]
+    OB = geometry["ob_slots"]
+    entries = summary["segments"]
+    obs = summary.get("obliterates", [])
+    if any("attr" in e for e in entries):
+        raise ValueError(
+            "kernel state cannot carry attribution override runs; "
+            "load this summary into the oracle backend"
+        )
+    if len(entries) > S:
+        raise ValueError(f"summary has {len(entries)} segments > capacity {S}")
+    if len(obs) > OB:
+        raise ValueError(f"summary has {len(obs)} obliterates > capacity {OB}")
+
+    text_pool = np.zeros((T,), np.int32)
+    seg_start = np.zeros((S,), np.int32)
+    seg_len = np.zeros((S,), np.int32)
+    ins_key = np.zeros((S,), np.int32)
+    ins_client = np.full((S,), -1, np.int32)
+    seg_uid = np.full((S,), -1, np.int32)
+    rem_keys = np.full((R, S), NO_REMOVE, np.int32)
+    rem_clients = np.full((R, S), -1, np.int32)
+    prop_keys = np.full((P, S), -1, np.int32)
+    prop_vals = np.zeros((P, S), np.int32)
+    end = 0
+    for i, e in enumerate(entries):
+        txt = e["text"]
+        if end + len(txt) > T:
+            raise ValueError("summary text exceeds pool capacity")
+        text_pool[end : end + len(txt)] = [ord(ch) for ch in txt]
+        seg_start[i] = end
+        seg_len[i] = len(txt)
+        end += len(txt)
+        ins_key[i] = e["ins"][0]
+        ins_client[i] = e["ins"][1]
+        seg_uid[i] = i
+        if len(e["removes"]) > R:
+            raise ValueError("summary removes exceed remove slots")
+        for r, (k, c) in enumerate(e["removes"]):
+            rem_keys[r, i] = k
+            rem_clients[r, i] = c
+        for p_str, (v, k) in e["props"].items():
+            slot = slot_for(int(p_str))
+            prop_keys[slot, i] = k
+            prop_vals[slot, i] = v
+
+    ob_key = np.full((OB,), -1, np.int32)
+    ob_client = np.full((OB,), -1, np.int32)
+    ob_start_uid = np.full((OB,), -1, np.int32)
+    ob_end_uid = np.full((OB,), -1, np.int32)
+    ob_start_side = np.zeros((OB,), np.int32)
+    ob_end_side = np.zeros((OB,), np.int32)
+    ob_ref_seq = np.full((OB,), -1, np.int32)
+    for j, o in enumerate(obs):
+        ob_key[j] = o["key"]
+        ob_client[j] = o["client"]
+        ob_start_uid[j] = o["start"]
+        ob_end_uid[j] = o["end"]
+        ob_start_side[j] = o["startSide"]
+        ob_end_side[j] = o["endSide"]
+        ob_ref_seq[j] = o["refSeq"]
+
+    return mk.DocState(
+        text=jnp.asarray(text_pool),
+        text_end=jnp.asarray(end, jnp.int32),
+        nseg=jnp.asarray(len(entries), jnp.int32),
+        seg_start=jnp.asarray(seg_start),
+        seg_len=jnp.asarray(seg_len),
+        ins_key=jnp.asarray(ins_key),
+        ins_client=jnp.asarray(ins_client),
+        seg_uid=jnp.asarray(seg_uid),
+        seg_obpre=jnp.full((S,), -1, jnp.int32),
+        rem_keys=tuple(jnp.asarray(rem_keys[r]) for r in range(R)),
+        rem_clients=tuple(jnp.asarray(rem_clients[r]) for r in range(R)),
+        prop_keys=tuple(jnp.asarray(prop_keys[p]) for p in range(P)),
+        prop_vals=tuple(jnp.asarray(prop_vals[p]) for p in range(P)),
+        uid_next=jnp.asarray(len(entries), jnp.int32),
+        ob_key=jnp.asarray(ob_key),
+        ob_client=jnp.asarray(ob_client),
+        ob_start_uid=jnp.asarray(ob_start_uid),
+        ob_end_uid=jnp.asarray(ob_end_uid),
+        ob_start_side=jnp.asarray(ob_start_side),
+        ob_end_side=jnp.asarray(ob_end_side),
+        ob_ref_seq=jnp.asarray(ob_ref_seq),
+        min_seq=jnp.asarray(summary["minSeq"], jnp.int32),
+        error=jnp.zeros((), jnp.int32),
+    )
+
+
+def state_geometry(state: mk.DocState) -> dict[str, int]:
+    """The capacity axes of a packed DocState (engine geometry dict shape)."""
+    return {
+        "max_segments": int(state.seg_len.shape[0]),
+        "text_capacity": int(state.text.shape[0]),
+        "remove_slots": len(state.rem_keys),
+        "prop_slots": len(state.prop_keys),
+        "ob_slots": int(state.ob_key.shape[0]),
+    }
+
+
 class KernelMergeTree:
     """Single-doc merge-tree replica backed by the columnar kernel."""
 
@@ -142,70 +381,10 @@ class KernelMergeTree:
     # --------------------------------------------------------------- snapshot
     def _segs(self, with_text: bool = False) -> list[_Seg]:
         """Pull the live segment rows off device as host records."""
-        s = self.state
-        nseg = int(s.nseg)
-        seg_uid = np.asarray(s.seg_uid)[:nseg]
-        seg_len = np.asarray(s.seg_len)[:nseg]
-        ins_key = np.asarray(s.ins_key)[:nseg]
-        ins_client = np.asarray(s.ins_client)[:nseg]
-        obpre = np.asarray(s.seg_obpre)[:nseg]
-        rem_k = np.stack([np.asarray(a)[:nseg] for a in s.rem_keys]) if nseg else None
-        rem_c = np.stack([np.asarray(a)[:nseg] for a in s.rem_clients]) if nseg else None
-        prop_k = np.stack([np.asarray(a)[:nseg] for a in s.prop_keys]) if nseg else None
-        prop_v = np.stack([np.asarray(a)[:nseg] for a in s.prop_vals]) if nseg else None
-        texts: list[str | None] = [None] * nseg
-        if with_text and nseg:
-            pool = np.asarray(s.text)
-            start = np.asarray(s.seg_start)[:nseg]
-            texts = [
-                "".join(chr(c) for c in pool[start[i] : start[i] + seg_len[i]])
-                for i in range(nseg)
-            ]
-        out: list[_Seg] = []
-        for i in range(nseg):
-            removes = sorted(
-                (int(rem_k[r, i]), int(rem_c[r, i]))
-                for r in range(rem_k.shape[0])
-                if rem_k[r, i] != NO_REMOVE
-            )
-            props = {
-                p: (int(prop_v[p, i]), int(prop_k[p, i]))
-                for p in range(prop_k.shape[0])
-                if prop_k[p, i] >= 0
-            }
-            out.append(
-                _Seg(
-                    uid=int(seg_uid[i]),
-                    length=int(seg_len[i]),
-                    ins_key=int(ins_key[i]),
-                    ins_client=int(ins_client[i]),
-                    obpre=int(obpre[i]),
-                    removes=removes,
-                    props=props,
-                    text=texts[i],
-                )
-            )
-        return out
+        return pull_segments(self.state, with_text)
 
     def _obs(self) -> list[_Ob]:
-        s = self.state
-        keys = np.asarray(s.ob_key)
-        out = []
-        for i in range(keys.shape[0]):
-            if keys[i] >= 0:
-                out.append(
-                    _Ob(
-                        slot=i,
-                        key=int(keys[i]),
-                        client=int(np.asarray(s.ob_client)[i]),
-                        start_uid=int(np.asarray(s.ob_start_uid)[i]),
-                        start_side=int(np.asarray(s.ob_start_side)[i]),
-                        end_uid=int(np.asarray(s.ob_end_uid)[i]),
-                        end_side=int(np.asarray(s.ob_end_side)[i]),
-                        ref_seq=int(np.asarray(s.ob_ref_seq)[i]),
-                    )
-                )
-        return out
+        return pull_obliterates(self.state)
 
     def _stamp_uids(self, op_key: int, op_client: int) -> dict[int, int]:
         """uid -> number of remove slots carrying exactly (op_key, op_client)."""
@@ -713,151 +892,19 @@ class KernelMergeTree:
     def export_summary(self) -> dict:
         """Merge-tree snapshot in the shared summary JSON (identical schema
         to RefMergeTree.export_summary; ref snapshotV1.ts:42)."""
-        segs = self._segs(with_text=True)
         inv_prop = {v: k for k, v in self._prop_slot.items()}
-        out_segs = []
-        for seg in segs:
-            if not _acked(seg.ins_key) or any(not _acked(k) for k, _c in seg.removes):
-                raise RuntimeError("summarize with pending merge-tree state")
-            out_segs.append(
-                {
-                    "text": seg.text,
-                    "ins": [seg.ins_key, seg.ins_client],
-                    "removes": [[k, c] for k, c in seg.removes],
-                    "props": {
-                        str(inv_prop[p]): [v, k]
-                        for p, (v, k) in sorted(seg.props.items())
-                    },
-                }
-            )
-        uid_index = {seg.uid: i for i, seg in enumerate(segs)}
-        obs = []
-        for ob in sorted(self._obs(), key=lambda o: o.key):
-            if not _acked(ob.key):
-                raise RuntimeError("summarize with pending merge-tree state")
-            obs.append(
-                {
-                    "key": ob.key,
-                    "client": ob.client,
-                    "start": uid_index.get(ob.start_uid, -1),
-                    "startSide": ob.start_side,
-                    "end": uid_index.get(ob.end_uid, -1),
-                    "endSide": ob.end_side,
-                    "refSeq": ob.ref_seq,
-                }
-            )
-        live = {k for seg in segs for k, _c in seg.removes} | {
-            o["key"] for o in obs
-        }
-        return {
-            "segments": out_segs,
-            "obliterates": obs,
-            "minSeq": int(self.state.min_seq),
-            "sliceKeys": sorted(self.slice_keys & live),
-        }
+        return state_to_summary(self.state, inv_prop, self.slice_keys)
 
     def import_summary(self, summary: dict) -> None:
         """Rebuild device state from summary JSON (fresh text pool, uids =
-        segment indices, obliterate anchors resolved by index)."""
-        import jax.numpy as jnp
-
-        s = self.state
-        S = s.seg_len.shape[0]
-        T = s.text.shape[0]
-        R = len(s.rem_keys)
-        P = len(s.prop_keys)
-        OB = s.ob_key.shape[0]
-        entries = summary["segments"]
-        obs = summary.get("obliterates", [])
-        if any("attr" in e for e in entries):
-            # Attribution override runs exist only on replicas loaded from
-            # a reference V1 snapshot whose below-MSN stamps were
-            # universalized; the columnar state has no per-offset override
-            # storage. Refuse loudly rather than silently dropping
-            # provenance — load such summaries into the oracle backend.
-            raise ValueError(
-                "kernel backend cannot carry attribution override runs; "
-                "load this summary into the oracle backend"
-            )
-        self.slice_keys = set(summary.get("sliceKeys", [])) | {
-            o["key"] for o in obs
-        }
-        if len(entries) > S:
-            raise ValueError(f"summary has {len(entries)} segments > capacity {S}")
-        if len(obs) > OB:
-            raise ValueError(f"summary has {len(obs)} obliterates > capacity {OB}")
-
-        text_pool = np.zeros((T,), np.int32)
-        seg_start = np.zeros((S,), np.int32)
-        seg_len = np.zeros((S,), np.int32)
-        ins_key = np.zeros((S,), np.int32)
-        ins_client = np.full((S,), -1, np.int32)
-        seg_uid = np.full((S,), -1, np.int32)
-        rem_keys = np.full((R, S), NO_REMOVE, np.int32)
-        rem_clients = np.full((R, S), -1, np.int32)
-        prop_keys = np.full((P, S), -1, np.int32)
-        prop_vals = np.zeros((P, S), np.int32)
-        end = 0
-        for i, e in enumerate(entries):
-            txt = e["text"]
-            if end + len(txt) > T:
-                raise ValueError("summary text exceeds pool capacity")
-            text_pool[end : end + len(txt)] = [ord(ch) for ch in txt]
-            seg_start[i] = end
-            seg_len[i] = len(txt)
-            end += len(txt)
-            ins_key[i] = e["ins"][0]
-            ins_client[i] = e["ins"][1]
-            seg_uid[i] = i
-            if len(e["removes"]) > R:
-                raise ValueError("summary removes exceed remove slots")
-            for r, (k, c) in enumerate(e["removes"]):
-                rem_keys[r, i] = k
-                rem_clients[r, i] = c
-            for p_str, (v, k) in e["props"].items():
-                slot = self._slot_for(int(p_str))
-                prop_keys[slot, i] = k
-                prop_vals[slot, i] = v
-
-        ob_key = np.full((OB,), -1, np.int32)
-        ob_client = np.full((OB,), -1, np.int32)
-        ob_start_uid = np.full((OB,), -1, np.int32)
-        ob_end_uid = np.full((OB,), -1, np.int32)
-        ob_start_side = np.zeros((OB,), np.int32)
-        ob_end_side = np.zeros((OB,), np.int32)
-        ob_ref_seq = np.full((OB,), -1, np.int32)
-        for j, o in enumerate(obs):
-            ob_key[j] = o["key"]
-            ob_client[j] = o["client"]
-            ob_start_uid[j] = o["start"]
-            ob_end_uid[j] = o["end"]
-            ob_start_side[j] = o["startSide"]
-            ob_end_side[j] = o["endSide"]
-            ob_ref_seq[j] = o["refSeq"]
-
-        self._gen += 1
-        self.state = mk.DocState(
-            text=jnp.asarray(text_pool),
-            text_end=jnp.asarray(end, jnp.int32),
-            nseg=jnp.asarray(len(entries), jnp.int32),
-            seg_start=jnp.asarray(seg_start),
-            seg_len=jnp.asarray(seg_len),
-            ins_key=jnp.asarray(ins_key),
-            ins_client=jnp.asarray(ins_client),
-            seg_uid=jnp.asarray(seg_uid),
-            seg_obpre=jnp.full((S,), -1, jnp.int32),
-            rem_keys=tuple(jnp.asarray(rem_keys[r]) for r in range(R)),
-            rem_clients=tuple(jnp.asarray(rem_clients[r]) for r in range(R)),
-            prop_keys=tuple(jnp.asarray(prop_keys[p]) for p in range(P)),
-            prop_vals=tuple(jnp.asarray(prop_vals[p]) for p in range(P)),
-            uid_next=jnp.asarray(len(entries), jnp.int32),
-            ob_key=jnp.asarray(ob_key),
-            ob_client=jnp.asarray(ob_client),
-            ob_start_uid=jnp.asarray(ob_start_uid),
-            ob_end_uid=jnp.asarray(ob_end_uid),
-            ob_start_side=jnp.asarray(ob_start_side),
-            ob_end_side=jnp.asarray(ob_end_side),
-            ob_ref_seq=jnp.asarray(ob_ref_seq),
-            min_seq=jnp.asarray(summary["minSeq"], jnp.int32),
-            error=jnp.zeros((), jnp.int32),
+        segment indices, obliterate anchors resolved by index).  Attribution
+        override runs (reference V1 snapshots with universalized below-MSN
+        stamps) are refused loudly — load those into the oracle backend."""
+        state = summary_to_state(
+            summary, state_geometry(self.state), self._slot_for
         )
+        self.slice_keys = set(summary.get("sliceKeys", [])) | {
+            o["key"] for o in summary.get("obliterates", [])
+        }
+        self._gen += 1
+        self.state = state

@@ -90,13 +90,49 @@ def regenerated_insert_spec(parts: list[tuple[str, dict]]) -> Any:
     backends.  ``parts`` = [(segment text, props applied by the SAME op)].
     Props ride ON the insert spec (the original insertMarker shape) because
     the regeneration annotate scan cannot see the op's own segments; values
-    are interned ids the channel resolves at the wire boundary."""
-    text = "".join(t for t, _p in parts)
-    props = parts[0][1] if parts and all(
-        p == parts[0][1] for _t, p in parts
-    ) else {}
-    if not props:
-        return text
-    if is_marker_text(text):
-        return {"marker": {"refType": marker_ref_type(text)}, "props": props}
-    return {"text": text, "props": props}
+    are interned ids the channel resolves at the wire boundary.
+
+    Split parts can carry DIFFERENT props — e.g. a later local annotate
+    restamped a prop on only half the pending insert's range.  Collapsing
+    to one spec would drop annotations on resubmit, so this emits one spec
+    per distinct-props run: a single spec when the runs collapse to one,
+    else a LIST of specs the receiver applies back-to-back at the insert
+    position.  Marker parts always emit marker form ({"marker": ...}) —
+    bare text must never carry reserved-plane codepoints (the op-apply
+    boundary rejects them)."""
+    runs: list[tuple[str, dict]] = []
+    for text, props in parts:
+        if not text:
+            continue
+        props = props or {}
+        if (
+            runs
+            and runs[-1][1] == props
+            and not is_marker_text(text)
+            and not is_marker_text(runs[-1][0][-1:])
+        ):
+            runs[-1] = (runs[-1][0] + text, props)
+        else:
+            runs.append((text, props))
+
+    def one(text: str, props: dict) -> Any:
+        if is_marker_text(text):
+            out: dict[str, Any] = {"marker": {"refType": marker_ref_type(text)}}
+            if props:
+                out["props"] = props
+            return out
+        return {"text": text, "props": props} if props else text
+
+    if not runs:
+        return ""
+    specs = [one(t, p) for t, p in runs]
+    return specs[0] if len(specs) == 1 else specs
+
+
+def spec_length(seg: Any) -> int:
+    """Visible length of one insert spec (marker = 1 position)."""
+    if isinstance(seg, str):
+        return len(seg)
+    if "marker" in seg:
+        return 1
+    return len(seg["text"])

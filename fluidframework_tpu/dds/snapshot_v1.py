@@ -48,7 +48,12 @@ import json
 from typing import Any, Callable
 
 from ..protocol.stamps import NON_COLLAB_CLIENT, NO_REMOVE, UNIVERSAL_SEQ, acked
-from .markers import is_marker_text, marker_char, marker_ref_type
+from .markers import (
+    assert_no_marker_plane,
+    is_marker_text,
+    marker_char,
+    marker_ref_type,
+)
 from .mergetree_ref import RefMergeTree, Segment
 
 CHUNK_SIZE = 10000          # chars per chunk (snapshotV1.ts:49)
@@ -89,11 +94,16 @@ def _json_segment(text: str, props: dict[str, Any] | None) -> Any:
 
 
 def _spec_text_props(j: Any) -> tuple[str, dict[str, Any] | None]:
-    """Inverse of _json_segment (snapshotLoader.ts specToSegment:107)."""
+    """Inverse of _json_segment (snapshotLoader.ts specToSegment:107).
+    Decode boundary for the reserved marker plane: only marker specs may
+    produce U+E000..U+F8FF codepoints — a snapshot artifact smuggling them
+    as 'text' is rejected, matching the op-apply boundary."""
     if isinstance(j, str):
+        assert_no_marker_plane(j)
         return j, None
     if "marker" in j:
         return marker_char(j["marker"]["refType"]), j.get("props")
+    assert_no_marker_plane(j["text"])
     return j["text"], j.get("props")
 
 

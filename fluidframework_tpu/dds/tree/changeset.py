@@ -212,19 +212,25 @@ def _clone_mark(m: Mark) -> Mark:
     return Modify(clone_change(m.change))
 
 
+def _clone_field_change(fc):
+    """Deep clone of one field change: mark lists clone mark-by-mark
+    (SequenceFieldKind.clone is intentionally shallow for the rebase hot
+    path), other kinds through their registry clone."""
+    from .field_kinds import kind_of
+
+    if isinstance(fc, list):
+        return [_clone_mark(m) for m in fc]
+    return kind_of(fc).clone(fc)
+
+
 def clone_change(change: NodeChange) -> NodeChange:
     """Structural deep clone — no JSON codec pass; every sequenced commit
     is cloned once for the trunk-forest apply (shared_tree.py), so this
     is delta-pump hot-path code."""
-    from .field_kinds import kind_of
-
     return NodeChange(
         value=tuple(change.value) if change.value is not None else None,
         fields={
-            k: [_clone_mark(m) for m in fc]
-            if isinstance(fc, list)
-            else kind_of(fc).clone(fc)
-            for k, fc in change.fields.items()
+            k: _clone_field_change(fc) for k, fc in change.fields.items()
         },
     )
 
@@ -546,10 +552,14 @@ def compose_node_change(a: NodeChange, b: NodeChange) -> NodeChange:
     out = NodeChange(value=value)
     for key in {**a.fields, **b.fields}:
         a_fc, b_fc = a.fields.get(key), b.fields.get(key)
+        # One-sided branches CLONE: applying the composed change enriches
+        # it in place (value tuples, Remove.detached), and sharing
+        # structure with the inputs would silently rewrite the original
+        # commits (applied_log / trunk) and corrupt their later invert.
         if a_fc is None:
-            out.fields[key] = b_fc
+            out.fields[key] = _clone_field_change(b_fc)
         elif b_fc is None:
-            out.fields[key] = a_fc
+            out.fields[key] = _clone_field_change(a_fc)
         elif kind_of(a_fc) is kind_of(b_fc):
             out.fields[key] = kind_of(a_fc).compose(a_fc, b_fc)
         else:
@@ -571,7 +581,31 @@ def _compose_mixed_kinds(a_fc, b_fc):
 
     if isinstance(b_fc, OptionalChange):
         if b_fc.set is not None:
-            return kind_of(b_fc).clone(b_fc)  # whole-content shadow
+            # Whole-content shadow — but b's recorded prior (set[1]) lives
+            # in a's OUTPUT context, and the composed change reads a's
+            # INPUT context: unwind a's marks from the prior so that
+            # invert(compose) restores a's input state, not the
+            # intermediate (mirrors the _safe_invert unwind in
+            # OptionalFieldKind.compose).
+            out = kind_of(b_fc).clone(b_fc)
+            if len(out.set) == 2 and out.set[1] is not None:
+                content = [out.set[1]]
+                try:
+                    inv = invert_marks(a_fc)
+                except AssertionError:
+                    # Unapplied/unenriched a: no repair data to protect.
+                    inv = None
+                if inv is not None:
+                    try:
+                        apply_marks(content, inv)
+                    except (IndexError, AssertionError):
+                        # a's output had residents beyond the recorded
+                        # prior; keep the prior as-is (deterministic
+                        # degrade, same on every replica).
+                        pass
+                    else:
+                        out.set = (out.set[0], content[0] if content else None)
+            return out
         return compose_marks(a_fc, [Modify(b_fc.nested)])
     # a is the optional change; b is sequence marks over a's output.
     assert isinstance(a_fc, OptionalChange)

@@ -334,6 +334,7 @@ def compose_marks(a: list, b: list) -> list:
         Skip,
         _emit,
         apply_node_change,
+        clone_change,
         compose_node_change,
     )
 
@@ -379,13 +380,17 @@ def compose_marks(a: list, b: list) -> list:
         return pos
 
     out_pos = 0
+    # Placements always carry CLONES of a's/b's nested changes and content:
+    # applying the composed change enriches nested changes and repair data
+    # in place, and sharing structure with the inputs would silently mutate
+    # the original commits (applied_log / trunk), corrupting their invert.
     for m in b:
         seq += 1
         if isinstance(m, Skip):
             for _ in range(m.count):
                 kind, pos, nested = item(out_pos)
                 if kind == "in" and nested is not None:
-                    placements.append((pos, 1, seq, Modify(nested)))
+                    placements.append((pos, 1, seq, Modify(clone_change(nested))))
                 elif kind == "new":
                     placements.append((pos, 0, seq, Insert([item(out_pos)[2]])))
                 out_pos += 1
@@ -395,12 +400,12 @@ def compose_marks(a: list, b: list) -> list:
                 change = (
                     compose_node_change(nested, m.change)
                     if nested is not None
-                    else m.change
+                    else clone_change(m.change)
                 )
                 placements.append((pos, 1, seq, Modify(change)))
             else:  # b edits a-inserted content: fold into the insert
                 node = item(out_pos)[2]
-                apply_node_change(node, m.change)
+                apply_node_change(node, clone_change(m.change))
                 placements.append((pos, 0, seq, Insert([node])))
             out_pos += 1
         elif isinstance(m, Remove):
@@ -408,11 +413,12 @@ def compose_marks(a: list, b: list) -> list:
                 kind, pos, nested = item(out_pos)
                 det = m.detached[off] if m.detached is not None else None
                 if kind == "in":
-                    if det is not None and nested is not None:
-                        # b captured the node AFTER a's Modify; composed
-                        # repair data must be a's-input-context content.
+                    if det is not None:
                         det = det.clone()
-                        apply_node_change(det, _safe_invert(nested))
+                        if nested is not None:
+                            # b captured the node AFTER a's Modify; composed
+                            # repair data must be a's-input-context content.
+                            apply_node_change(det, _safe_invert(nested))
                     placements.append((
                         pos, 1, seq,
                         Remove(1, [det] if det is not None else None),
@@ -420,14 +426,17 @@ def compose_marks(a: list, b: list) -> list:
                 # b removing a-inserted content: both cancel (no mark).
                 out_pos += 1
         elif isinstance(m, Insert):
-            placements.append((anchor_of(out_pos), 0, seq, Insert(list(m.content))))
+            placements.append((
+                anchor_of(out_pos), 0, seq,
+                Insert([n.clone() for n in m.content]),
+            ))
     # a-output items b never reached keep their a-effects.
     for i in range(out_pos, len(items)):
         kind, pos, nested = item(i)
         if kind == "new":
             placements.append((pos, 0, seq + 1, Insert([items[i][2]])))
         elif nested is not None:
-            placements.append((pos, 1, seq + 1, Modify(nested)))
+            placements.append((pos, 1, seq + 1, Modify(clone_change(nested))))
     for pos, rm in removed:
         placements.append((pos, 1, 0, rm))
 

@@ -34,23 +34,51 @@ ever survives a run.  Recovery policy:
   future ops there (the reference analog of a document leaving the fast
   path; SURVEY §7 capacity-management risk).
 
-ERR_POS_RANGE is not recoverable by capacity: a malformed sequenced op
-would corrupt every conforming replica, so the engine raises.
+Fault isolation (this module's robustness contract):
+
+- **Capacity errors** (ERR_SEG/TEXT/REM/OB_OVERFLOW) are recoverable:
+  grow-and-replay into an overflow lane, or oracle routing (above).
+- **Poison errors** — ERR_POS_RANGE with no capacity bit, a decode failure
+  at ingest, or a divergence caught by the watchdog — mean the op stream
+  (or the device state) is bad for THAT document only.  The doc is
+  **quarantined**: evicted from the device batch into a host oracle lane
+  rebuilt from its last checkpoint + retained tail, where every further op
+  is validated before apply (malformed ops are dropped and counted, never
+  applied).  The other documents in the batch never see a stall or a
+  corrupt row.  A quarantined doc stays fully serviceable (reads + op
+  application through the oracle) and can be re-admitted to the lockstep
+  batch with ``readmit()`` once its replay is clean.
+- **Checkpoints** bound recovery: with a ``checkpoint_store``
+  (server/ordered_log.CheckpointStore) the engine periodically snapshots
+  each doc's packed ``DocState`` as a summary record, truncates the
+  retained wire log to ops after the checkpoint seq, and every recovery
+  replay (grow lanes, quarantine, engine restart via
+  ``restore_from_checkpoints``) starts from the checkpoint instead of op
+  zero — replay work is bounded by ``checkpoint_every``, not history.
+- A sampling **divergence watchdog** cross-checks device text against a
+  host-oracle replay of checkpoint + tail every ``watchdog_every`` steps
+  and quarantines on mismatch.  Health counters (quarantined_docs,
+  checkpoint_age_seqs, recovery_replay_len, watchdog_mismatches, ...)
+  surface through ``health()`` / utils.telemetry.HealthCounters.
 """
 
 from __future__ import annotations
 
+import functools
+import json
 from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..dds import kernel_backend as kb
 from ..dds.mergetree_ref import RefMergeTree
 from ..dds.shared_string import decode_obliterate_places
 from ..ops import mergetree_kernel as mk
 from ..parallel.mesh import doc_mesh, shard_docs
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
+from ..utils.telemetry import HealthCounters
 
 
 @dataclass
@@ -63,13 +91,24 @@ class _DocHost:
     min_seq: int = 0
     # Property id -> kernel prop slot (interned per document).
     prop_slot: dict[int, int] = field(default_factory=dict)
-    # Retained wire log (every OP message, in sequence order): the replay
-    # source for overflow recovery.  Docs fed through the native byte path
-    # retain raw lines instead (mode is fixed per doc at first ingest).
+    # Retained wire log (every OP message with seq > base_seq, in sequence
+    # order): the replay source for recovery.  Bounded by checkpoints —
+    # ops at or below ``base_seq`` live in ``base_summary`` instead.  Docs
+    # fed through the native byte path retain raw lines instead (mode is
+    # fixed per doc at first ingest).
     log: list[SequencedMessage] = field(default_factory=list)
     raw_log: list[bytes] = field(default_factory=list)
     native: object = None  # NativeIngestEncoder once the byte path is used
     mode: str | None = None  # "obj" | "native", fixed at first ingest
+    # Checkpoint floor: the durable record covers ops up to ``base_seq``;
+    # ``base_summary`` is its state (None = empty doc), the replay base.
+    base_seq: int = 0
+    base_summary: dict | None = None
+    last_seq: int = 0  # highest OP seq ingested
+    ops_since_ckpt: int = 0
+    # Set by restore_from_checkpoints: the doc consumes parsed messages
+    # (seq dedupe needs per-message seqs the native encoder can't skip).
+    restored: bool = False
 
 
 @dataclass
@@ -81,6 +120,45 @@ class _OverflowLane:
     growths: int
     queue: list[np.ndarray] = field(default_factory=list)
     payloads: list[np.ndarray] = field(default_factory=list)
+
+
+# Module-level jitted programs: every engine instance shares ONE compile
+# cache keyed by input shapes (geometry x batch), instead of each instance
+# recompiling identical programs through its own jit closures — engines are
+# created per test / per restart, and the programs close over nothing
+# instance-specific.
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _fleet_step(state, ops, payloads):
+    # Scalar (unbatched) obliterate gate: keeps the ob machinery a real
+    # lax.cond branch under vmap (see mk.apply_op docstring).
+    flag = jnp.any(state.ob_key >= 0) | jnp.any(
+        ops[..., 0] == mk.OpKind.OBLITERATE
+    )
+    return jax.vmap(mk.apply_ops, in_axes=(0, 0, 0, None))(
+        state, ops, payloads, flag
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _fleet_compact(state, min_seqs):
+    state = jax.vmap(mk.set_min_seq)(state, min_seqs)
+    flag = jnp.any(state.ob_key >= 0)
+    return jax.vmap(mk.compact, in_axes=(0, None))(state, flag)
+
+
+_lane_apply_jit = jax.jit(mk.apply_ops)
+_lane_compact_jit = jax.jit(lambda s, m: mk.compact(mk.set_min_seq(s, m)))
+_gather_cohort_jit = jax.jit(lambda st, idx: jax.tree.map(lambda x: x[idx], st))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_cohort_jit(st, sub, idx, valid):
+    def put(x, s):
+        safe = jnp.where(valid, idx, x.shape[0])
+        return x.at[safe].set(s, mode="drop")
+
+    return jax.tree.map(put, st, sub)
 
 
 class DocBatchEngine:
@@ -100,6 +178,12 @@ class DocBatchEngine:
         use_mesh: bool = True,
         recovery: str = "grow",
         max_growths: int = 4,
+        checkpoint_store=None,
+        checkpoint_every: int = 0,
+        doc_keys: list[str] | None = None,
+        watchdog_every: int = 0,
+        watchdog_sample: int = 4,
+        telemetry=None,
     ) -> None:
         assert recovery in ("grow", "oracle", "off")
         self.n_docs = n_docs
@@ -118,6 +202,22 @@ class DocBatchEngine:
         # Recovery lanes (doc_idx -> lane / oracle replica).
         self.overflow: dict[int, _OverflowLane] = {}
         self.oracles: dict[int, RefMergeTree] = {}
+        # Quarantine lane: docs whose op stream (or device state) proved
+        # bad — served by a validated host oracle until readmission.
+        self.quarantine: dict[int, RefMergeTree] = {}
+        self.quarantine_reason: dict[int, str] = {}
+        # Checkpoint / watchdog knobs (see module docstring).
+        self.checkpoint_store = checkpoint_store
+        self.checkpoint_every = checkpoint_every
+        self.doc_keys = list(doc_keys) if doc_keys is not None else [
+            str(d) for d in range(n_docs)
+        ]
+        assert len(self.doc_keys) == n_docs
+        self.watchdog_every = watchdog_every
+        self.watchdog_sample = watchdog_sample
+        self._watchdog_cursor = 0
+        self._steps_since_watchdog = 0
+        self.counters = HealthCounters(telemetry)
 
         if use_mesh:
             self.mesh = mesh if mesh is not None else doc_mesh()
@@ -141,28 +241,12 @@ class DocBatchEngine:
                 lambda x: jax.device_put(x, docs_sharding), self.state
             )
 
-        batched = jax.vmap(mk.apply_ops, in_axes=(0, 0, 0, None))
-
-        def _step(state, ops, payloads):
-            # Scalar (unbatched) obliterate gate: keeps the ob machinery a
-            # real lax.cond branch under vmap (see mk.apply_op docstring).
-            flag = jnp.any(state.ob_key >= 0) | jnp.any(
-                ops[..., 0] == mk.OpKind.OBLITERATE
-            )
-            return batched(state, ops, payloads, flag)
-
-        def _compact(state, min_seqs):
-            state = jax.vmap(mk.set_min_seq)(state, min_seqs)
-            flag = jnp.any(state.ob_key >= 0)
-            return jax.vmap(mk.compact, in_axes=(0, None))(state, flag)
-
-        self._step = jax.jit(_step, donate_argnums=(0,))
-        self._compact = jax.jit(_compact, donate_argnums=(0,))
-        # Lane programs: jit caches one executable per lane geometry.
-        self._lane_apply = jax.jit(mk.apply_ops)
-        self._lane_compact = jax.jit(
-            lambda s, m: mk.compact(mk.set_min_seq(s, m))
-        )
+        # Module-level jitted programs (shared compile cache across engine
+        # instances; one executable per geometry/batch shape).
+        self._step = _fleet_step
+        self._compact = _fleet_compact
+        self._lane_apply = _lane_apply_jit
+        self._lane_compact = _lane_compact_jit
         # ---- Zipf straggler bucketing (SURVEY §7: doc-packing by op count)
         # Under skewed per-doc op counts one hot doc would force extra
         # FULL-fleet steps (every step scans B ops across all D lanes).
@@ -177,18 +261,8 @@ class DocBatchEngine:
         self.full_steps = 0     # fleet-wide steps taken
         self.cohort_steps = 0   # bucketed steps taken
         self.cohort_lanes = 0   # sum of cohort sizes (work proxy)
-        self._gather_cohort = jax.jit(
-            lambda st, idx: jax.tree.map(lambda x: x[idx], st)
-        )
-
-        def _scatter(st, sub, idx, valid):
-            def put(x, s):
-                safe = jnp.where(valid, idx, x.shape[0])
-                return x.at[safe].set(s, mode="drop")
-
-            return jax.tree.map(put, st, sub)
-
-        self._scatter_cohort = jax.jit(_scatter, donate_argnums=(0,))
+        self._gather_cohort = _gather_cohort_jit
+        self._scatter_cohort = _scatter_cohort_jit
 
     # ------------------------------------------------------------------ ingest
     def ingest(self, doc_idx: int, msg: SequencedMessage) -> None:
@@ -199,7 +273,7 @@ class DocBatchEngine:
         application is deferred to the next batched device step.
         """
         h = self.hosts[doc_idx]
-        assert h.mode != "native" or doc_idx in self.oracles or doc_idx in self.overflow, (
+        assert h.mode != "native" or self._in_lane(doc_idx), (
             f"doc {doc_idx} already fed through the native byte path; "
             "pick one ingest path per document"
         )
@@ -213,27 +287,75 @@ class DocBatchEngine:
             h.min_seq = max(h.min_seq, msg.min_seq)
             return
         h.min_seq = max(h.min_seq, msg.min_seq)
+        if h.base_seq and msg.seq <= h.base_seq:
+            # Already folded into the durable checkpoint (a restarted
+            # consumer replaying its topic from an older offset): skip —
+            # restart must be idempotent, not double-apply.
+            self.counters.bump("checkpointed_ops_skipped")
+            return
+        h.last_seq = max(h.last_seq, msg.seq)
+        h.ops_since_ckpt += 1
+        if doc_idx in self.quarantine:
+            # Quarantined docs stay serviceable: validated host-oracle
+            # apply; malformed ops are dropped and counted, never applied.
+            self._oracle_apply_validated(self.quarantine[doc_idx], h, msg)
+            # Keep the tail log so checkpoints and readmission replay stay
+            # bounded and auditable.
+            if self.recovery != "off":
+                h.log.append(msg)
+            return
         if doc_idx in self.oracles:
             # Oracle-routed docs apply immediately and can never need
-            # another replay — no point retaining their log further.
-            self._oracle_apply(self.oracles[doc_idx], h, msg)
+            # another replay — no point retaining their log further.  Same
+            # validation gate as quarantine: a malformed op for this doc
+            # drops (counted) instead of crashing the whole consumer.
+            self._oracle_apply_validated(self.oracles[doc_idx], h, msg)
             return
 
         if self.recovery != "off":
-            # Replay source for overflow recovery.  Unbounded by design for
-            # now: bounding it needs DDS-level checkpoints to replay from
-            # (summary + suffix), which this pure-replica engine does not
-            # carry yet.
+            # Replay source for recovery, bounded by checkpoints: ops at or
+            # below base_seq live in base_summary, this list is the tail.
             h.log.append(msg)
+        try:
+            rows = self._encode(h, msg)
+        except NotImplementedError:
+            # Legal-but-unsupported wire form: loud feature gap.  The op
+            # was never applied — keep it out of the replay log so a
+            # caller that survives the raise doesn't poison recovery.
+            if h.log and h.log[-1] is msg:
+                h.log.pop()
+            h.ops_since_ckpt -= 1
+            raise
+        except (ValueError, KeyError, TypeError) as e:
+            if self.recovery == "off":
+                raise  # no retained log to rebuild from: surface it
+            # Decode failure: the wire op is malformed for THIS doc only.
+            # Quarantine it (checkpoint + validated tail replay, which
+            # drops this op and counts it) so the rest of the batch keeps
+            # stepping.
+            self._quarantine_doc(doc_idx, f"decode: {e}")
+            return
         if doc_idx in self.overflow:
             lane = self.overflow[doc_idx]
-            for op, payload in self._encode(h, msg):
+            for op, payload in rows:
                 lane.queue.append(op)
                 lane.payloads.append(payload)
             return
-        for op, payload in self._encode(h, msg):
+        for op, payload in rows:
             h.queue.append(op)
             h.payloads.append(payload)
+
+    def _in_lane(self, doc_idx: int) -> bool:
+        """True when the doc has left the lockstep batch (or was restored
+        from a checkpoint): its ingest consumes parsed messages.  A live
+        native-path doc that merely CHECKPOINTED is not in a lane — it
+        stays on the C++ fast path."""
+        return (
+            doc_idx in self.oracles
+            or doc_idx in self.overflow
+            or doc_idx in self.quarantine
+            or self.hosts[doc_idx].restored
+        )
 
     def ingest_lines(self, doc_idx: int, data: bytes) -> int:
         """Stage newline-separated wire JSON through the NATIVE encoder
@@ -248,9 +370,9 @@ class DocBatchEngine:
         from ..native.ingest_native import NativeIngestEncoder, available
 
         h = self.hosts[doc_idx]
-        in_lane = doc_idx in self.oracles or doc_idx in self.overflow
-        if in_lane or not available():
-            # Lanes (and the no-native fallback) consume parsed messages.
+        if self._in_lane(doc_idx) or not available():
+            # Lanes, checkpoint-restored docs, and the no-native fallback
+            # consume parsed messages.
             self._normalize_native(h)
             lane = self.overflow.get(doc_idx)
             before = len(lane.queue) if lane else len(h.queue)
@@ -260,7 +382,7 @@ class DocBatchEngine:
                     msg = SequencedMessage.from_json(line.decode())
                     n_msgs += msg.type == MessageType.OP
                     self.ingest(doc_idx, msg)
-            if doc_idx in self.oracles:
+            if doc_idx in self.oracles or doc_idx in self.quarantine:
                 return n_msgs
             lane = self.overflow.get(doc_idx)
             return (len(lane.queue) if lane else len(h.queue)) - before
@@ -279,6 +401,19 @@ class DocBatchEngine:
         h.queue.extend(ops)
         h.payloads.extend(payloads)
         h.min_seq = max(h.min_seq, h.native.min_seq)
+        h.ops_since_ckpt += len(ops)
+        if self.checkpoint_store is not None:
+            # Checkpoints need the seq floor; one JSON parse of the chunk's
+            # last line covers the whole chunk (lines are seq-ordered).
+            tail_line = data.rstrip(b"\n").rsplit(b"\n", 1)[-1]
+            if tail_line.strip():
+                try:
+                    h.last_seq = max(
+                        h.last_seq,
+                        int(json.loads(tail_line)["sequenceNumber"]),
+                    )
+                except (ValueError, KeyError):
+                    pass
         return len(ops)
 
     def _normalize_native(self, h: _DocHost) -> None:
@@ -299,8 +434,9 @@ class DocBatchEngine:
                     m = SequencedMessage.from_json(line.decode())
                     if m.type == MessageType.JOIN:
                         h.quorum[m.contents["clientId"]] = m.contents["short"]
-                    elif m.type == MessageType.OP:
+                    elif m.type == MessageType.OP and m.seq > h.base_seq:
                         prefix.append(m)
+                        h.last_seq = max(h.last_seq, m.seq)
         h.raw_log.clear()
         h.log[:0] = prefix
         h.mode = "obj"
@@ -315,6 +451,17 @@ class DocBatchEngine:
         client = h.quorum[msg.client_id]
         empty = np.zeros((self.max_insert_len,), np.int32)
         if kind == DeltaType.INSERT:
+            if not isinstance(c["seg"], str):
+                # Marker/annotated dict specs and per-props-run spec LISTS
+                # are legal channel-layer wire forms this engine cannot
+                # encode yet.  They must fail LOUD (a feature gap), never
+                # quarantine-drop as poison — silently dropping a legal op
+                # would split-brain the fleet tier against every channel
+                # replica that applied it.
+                raise NotImplementedError(
+                    "engine supports plain-text insert segs only; got "
+                    f"{type(c['seg']).__name__}"
+                )
             return mk.encode_insert(
                 c["pos1"], c["seg"], msg.seq, client, msg.ref_seq,
                 self.max_insert_len,
@@ -440,6 +587,14 @@ class DocBatchEngine:
         self._step_lanes()
         if self.recovery != "off":
             self.recover()
+            self._steps_since_watchdog += 1
+            if (
+                self.watchdog_every
+                and self._steps_since_watchdog >= self.watchdog_every
+            ):
+                self._steps_since_watchdog = 0
+                self.watchdog()
+        self.maybe_checkpoint()
         return steps
 
     def _cohort_step(self, busy: list[int]) -> None:
@@ -488,16 +643,28 @@ class DocBatchEngine:
             )
         for d, tree in self.oracles.items():
             tree.update_min_seq(self.hosts[d].min_seq)
+        for d, tree in self.quarantine.items():
+            tree.update_min_seq(self.hosts[d].min_seq)
 
     # --------------------------------------------------------------- recovery
     def recover(self) -> list[int]:
         """Inspect every error vector and recover flagged docs; returns the
-        doc indices recovered this call."""
+        doc indices recovered this call.  Capacity bits grow-and-replay (or
+        oracle-route); poison bits (ERR_POS_RANGE alone) quarantine."""
         recovered: list[int] = []
         err = np.asarray(self.state.error)
         for d in range(self.n_docs):
-            if d not in self.overflow and d not in self.oracles and err[d]:
-                self._recover_doc(d, int(err[d]), growths=0)
+            if (
+                d not in self.overflow
+                and d not in self.oracles
+                and d not in self.quarantine
+                and err[d]
+            ):
+                bits = int(err[d])
+                if mk.is_capacity_error(bits):
+                    self._recover_doc(d, bits, growths=0)
+                else:  # poison: ERR_POS_RANGE with no capacity bit
+                    self._quarantine_doc(d, f"error bits {bits:#x}")
                 # Retire the batch slot: clear the latched bits so the slot
                 # never re-triggers (its queue is empty and future ops route
                 # to the lane).
@@ -508,23 +675,21 @@ class DocBatchEngine:
         for d, lane in list(self.overflow.items()):
             bits = int(lane.state.error)
             if bits:
-                self._recover_doc(d, bits, growths=lane.growths)
+                if mk.is_capacity_error(bits):
+                    self._recover_doc(d, bits, growths=lane.growths)
+                else:
+                    self._quarantine_doc(d, f"error bits {bits:#x}")
                 recovered.append(d)
+        if recovered:
+            # One structured health event per recovery action (no-op
+            # without a telemetry logger).
+            self.counters.emit(recovered_docs=len(recovered))
         return recovered
 
     def _recover_doc(self, d: int, bits: int, growths: int) -> None:
         # Recovery works on the parsed-message log: fold a native doc's raw
         # lines in first (ordering: they precede any object-path appends).
         self._normalize_native(self.hosts[d])
-        if bits == mk.ERR_POS_RANGE:
-            # POS_RANGE alone (no capacity bit) means the op stream itself is
-            # malformed.  Alongside a capacity bit it is usually a CASCADE —
-            # an op referencing content a capacity overflow dropped — which
-            # the replay at grown capacity resolves, so fall through.
-            raise RuntimeError(
-                f"doc {d}: sequenced op out of range (error bits {bits:#x}) — "
-                "not a capacity problem; the op stream is malformed"
-            )
         h = self.hosts[d]
         geom = dict(
             self.overflow[d].geometry if d in self.overflow else self.geometry
@@ -532,26 +697,36 @@ class DocBatchEngine:
         while self.recovery == "grow" and growths < self.max_growths:
             growths += 1
             geom = self._grown_geometry(geom, bits)
+            if h.base_summary is not None:
+                # The replay base must fit before a single op applies.
+                geom = self._fit_geometry(
+                    geom, h.base_summary, len(h.prop_slot)
+                )
             state = self._replay(h, geom)
             new_bits = int(state.error)
             if new_bits == 0:
                 self.overflow[d] = _OverflowLane(
                     state=state, geometry=geom, growths=growths
                 )
+                self.counters.bump("capacity_recoveries")
                 return
             bits = new_bits
-            if bits == mk.ERR_POS_RANGE:
-                raise RuntimeError(
-                    f"doc {d}: sequenced op out of range during replay at "
-                    f"capacity {geom} — the op stream is malformed"
+            if mk.is_poison_error(bits):
+                # POS_RANGE that survives replay at grown capacity is not a
+                # cascade: the op stream itself is malformed.  Isolate the
+                # document instead of killing the fleet.
+                self._quarantine_doc(
+                    d, f"error bits {bits:#x} during replay at {geom}"
                 )
+                return
         # Growth exhausted (or policy is oracle): host replica takes over.
         self.overflow.pop(d, None)
-        tree = RefMergeTree()
+        tree = self._oracle_from_base(h)
         for msg in h.log:
             self._oracle_apply(tree, h, msg)
         tree.update_min_seq(h.min_seq)
         self.oracles[d] = tree
+        self.counters.bump("oracle_routes")
 
     @staticmethod
     def _grown_geometry(base: dict[str, int], bits: int) -> dict[str, int]:
@@ -566,16 +741,51 @@ class DocBatchEngine:
             geom["ob_slots"] *= 2
         return geom
 
-    def _replay(self, h: _DocHost, geom: dict[str, int]) -> mk.DocState:
-        """Re-apply the retained wire log on a fresh state with ``geom``."""
-        state = mk.init_state(
-            geom["max_segments"], geom["remove_slots"], geom["prop_slots"],
-            geom["text_capacity"], geom["ob_slots"],
+    @staticmethod
+    def _fit_geometry(
+        geom: dict[str, int], summary: dict, min_prop_slots: int = 0
+    ) -> dict[str, int]:
+        """Grow ``geom`` (doubling, preserving the pow2 ladder) until the
+        checkpoint summary fits — a replay base must never itself overflow.
+        ``min_prop_slots`` covers slots the doc's restored prop table
+        already interned (slot indices, not just distinct summary props)."""
+        geom = dict(geom)
+        n_seg = len(summary["segments"])
+        n_text = sum(len(e["text"]) for e in summary["segments"])
+        n_rem = max(
+            (len(e["removes"]) for e in summary["segments"]), default=0
         )
+        n_ob = len(summary.get("obliterates", []))
+        while geom["max_segments"] < n_seg:
+            geom["max_segments"] *= 2
+        while geom["text_capacity"] < n_text:
+            geom["text_capacity"] *= 2
+        while geom["remove_slots"] < n_rem:
+            geom["remove_slots"] *= 2
+        while geom["ob_slots"] < n_ob:
+            geom["ob_slots"] *= 2
+        while geom["prop_slots"] < min_prop_slots:
+            geom["prop_slots"] *= 2
+        return geom
+
+    def _replay(self, h: _DocHost, geom: dict[str, int]) -> mk.DocState:
+        """Re-apply the retained wire log on a state with ``geom`` — from
+        the checkpoint base when one exists (bounded replay), from scratch
+        otherwise."""
+        if h.base_summary is not None:
+            state = kb.summary_to_state(
+                h.base_summary, geom, lambda p: self._prop_slot_for_geom(h, p, geom)
+            )
+        else:
+            state = mk.init_state(
+                geom["max_segments"], geom["remove_slots"], geom["prop_slots"],
+                geom["text_capacity"], geom["ob_slots"],
+            )
         B = self.ops_per_step
         rows: list[tuple[np.ndarray, np.ndarray]] = []
         for msg in h.log:
             rows.extend(self._encode(h, msg))
+        self.counters.gauge("recovery_replay_len", len(h.log))
         for i in range(0, len(rows), B):
             chunk = rows[i : i + B]
             ops = np.zeros((B, mk.OP_FIELDS), np.int32)
@@ -588,6 +798,371 @@ class DocBatchEngine:
             )
         return state
 
+    def _prop_slot_for_geom(self, h: _DocHost, prop: int, geom: dict) -> int:
+        """Intern a checkpointed property id during a replay-base restore
+        (same table as live encoding; range-checked against ``geom``)."""
+        if prop not in h.prop_slot:
+            slot = len(h.prop_slot)
+            if slot >= geom["prop_slots"]:
+                raise ValueError(
+                    f"checkpoint needs more than {geom['prop_slots']} prop slots"
+                )
+            h.prop_slot[prop] = slot
+        return h.prop_slot[prop]
+
+    # ------------------------------------------------------------- quarantine
+    def _oracle_from_base(self, h: _DocHost) -> RefMergeTree:
+        """A host oracle seeded with the doc's checkpoint base (or empty)."""
+        tree = RefMergeTree()
+        if h.base_summary is not None:
+            tree.import_summary(h.base_summary)
+        return tree
+
+    def _oracle_apply_validated(
+        self, tree: RefMergeTree, h: _DocHost, msg: SequencedMessage
+    ) -> bool:
+        """Apply one wire op to a quarantine oracle with a validation gate:
+        positions must resolve inside the op's own perspective and the
+        sender must be in the quorum.  A malformed op is dropped and
+        counted — it can corrupt neither this replica nor the batch."""
+        try:
+            c = msg.contents
+            client = h.quorum[msg.client_id]  # KeyError: unknown sender
+            n = tree.visible_length(msg.ref_seq, client)
+            kind = c["type"]
+            if kind == DeltaType.INSERT:
+                if not isinstance(c["seg"], str):
+                    # Legal-but-unsupported spec shapes fail LOUD (see
+                    # _encode) — they are a feature gap, not poison.
+                    raise NotImplementedError(
+                        f"unsupported seg spec {type(c['seg']).__name__}"
+                    )
+                if not (0 <= c["pos1"] <= n):
+                    raise ValueError(f"insert pos {c['pos1']} > length {n}")
+            elif kind in (DeltaType.REMOVE, DeltaType.ANNOTATE):
+                if not (0 <= c["pos1"] < c["pos2"] <= n):
+                    raise ValueError(
+                        f"range [{c['pos1']},{c['pos2']}) outside length {n}"
+                    )
+            elif kind in (DeltaType.OBLITERATE, DeltaType.OBLITERATE_SIDED):
+                p1, s1, p2, s2 = decode_obliterate_places(c)
+                from ..dds.shared_string import validate_obliterate_places
+
+                validate_obliterate_places(p1, s1, p2, s2, n)
+            self._oracle_apply(tree, h, msg)
+            return True
+        except NotImplementedError:
+            raise  # feature gap, not poison: stay loud
+        except Exception as e:  # noqa: BLE001 — the gate IS the handler
+            self.counters.bump("poison_ops_dropped")
+            if self.counters.logger is not None:
+                self.counters.logger.error(
+                    "poison_op_dropped", e, seq=msg.seq
+                )
+            return False
+
+    def _quarantine_doc(self, d: int, reason: str) -> None:
+        """Evict one doc from the device batch into the validated host
+        oracle lane: checkpoint base + validated replay of the retained
+        tail (malformed ops drop).  The rest of the batch is untouched."""
+        h = self.hosts[d]
+        self._normalize_native(h)
+        tree = self._oracle_from_base(h)
+        self.counters.gauge("quarantine_replay_len", len(h.log))
+        for msg in h.log:
+            self._oracle_apply_validated(tree, h, msg)
+        tree.update_min_seq(h.min_seq)
+        self.overflow.pop(d, None)
+        self.quarantine[d] = tree
+        self.quarantine_reason[d] = reason
+        h.queue.clear()
+        h.payloads.clear()
+        if d < self.capacity:
+            self.state = self.state._replace(
+                error=self.state.error.at[d].set(0)
+            )
+        self.counters.bump("quarantines")
+        if self.counters.logger is not None:
+            self.counters.logger.error(
+                "doc_quarantined", reason, doc=self.doc_keys[d]
+            )
+
+    def readmit(self, d: int) -> bool:
+        """Re-admit a quarantined doc to the lockstep batch: pack the
+        oracle's (clean, validated) state back into the batch geometry and
+        scatter it into the doc's row.  Returns False — doc stays
+        quarantined — when the state no longer fits the batch geometry."""
+        tree = self.quarantine.get(d)
+        if tree is None:
+            return False
+        h = self.hosts[d]
+        summary = tree.export_summary()
+        try:
+            row = kb.summary_to_state(
+                summary, self.geometry,
+                lambda p: self._prop_slot_for_geom(h, p, self.geometry),
+            )
+        except (ValueError, IndexError):
+            return False
+        self.state = jax.tree.map(
+            lambda x, s: x.at[d].set(s), self.state, row
+        )
+        del self.quarantine[d]
+        self.quarantine_reason.pop(d, None)
+        # The oracle state becomes the doc's new replay base: the dropped
+        # poison ops are gone from both the state and the log.
+        h.base_summary = summary
+        h.base_seq = max(h.base_seq, h.last_seq)
+        h.log = [m for m in h.log if m.seq > h.base_seq]
+        self.counters.bump("readmissions")
+        return True
+
+    # --------------------------------------------------------------- watchdog
+    def watchdog(self, sample: int | None = None) -> list[int]:
+        """Cross-check a rotating sample of batch docs against a host-oracle
+        replay of checkpoint + tail; quarantine (oracle wins) on mismatch.
+        Returns the doc indices that failed the check."""
+        if self.recovery == "off":
+            return []
+        eligible = [
+            d for d in range(self.n_docs)
+            if not (
+                d in self.overflow or d in self.oracles or d in self.quarantine
+            )
+            and self.hosts[d].mode == "obj"
+            and not self.hosts[d].queue
+        ]
+        if not eligible:
+            return []
+        k = sample if sample is not None else self.watchdog_sample
+        start = self._watchdog_cursor
+        picks = [eligible[(start + i) % len(eligible)] for i in range(min(k, len(eligible)))]
+        self._watchdog_cursor = (start + len(picks)) % max(len(eligible), 1)
+        failed: list[int] = []
+        for d in picks:
+            h = self.hosts[d]
+            try:
+                tree = self._oracle_from_base(h)
+                for msg in h.log:
+                    self._oracle_apply(tree, h, msg)
+                expected = tree.visible_text()
+            except Exception:
+                # The oracle replay itself failing means the log carries an
+                # op the strict host path rejects — that is the quarantine
+                # lane's job, not the watchdog's verdict to fake.
+                self._quarantine_doc(d, "watchdog: oracle replay failed")
+                failed.append(d)
+                continue
+            self.counters.bump("watchdog_checks")
+            if mk.visible_text(self.doc_state(d)) != expected:
+                self.counters.bump("watchdog_mismatches")
+                self._quarantine_doc(d, "watchdog: device/oracle divergence")
+                failed.append(d)
+        return failed
+
+    # ------------------------------------------------------------- checkpoint
+    def maybe_checkpoint(self, force: bool = False) -> list[int]:
+        """Write durable checkpoint records for docs whose op count since
+        the last checkpoint reached ``checkpoint_every`` (all dirty docs
+        when ``force``), then truncate their replay logs to the tail.
+        Returns the doc indices checkpointed."""
+        if self.checkpoint_store is None:
+            return []
+        if not force and self.checkpoint_every <= 0:
+            return []
+        due = [
+            d for d in range(self.n_docs)
+            if self.hosts[d].ops_since_ckpt > 0
+            and (force or self.hosts[d].ops_since_ckpt >= self.checkpoint_every)
+        ]
+        if not due:
+            return []  # host-side check only: no device readback paid
+        out: list[int] = []
+        # ONE bulk device->host transfer covers every due batch doc (the
+        # per-doc summary walk below then slices host arrays; per-doc
+        # device_get would serialize ~25 tiny transfers per doc against
+        # the step pipeline).
+        host_state = (
+            jax.tree.map(np.asarray, self.state)
+            if any(
+                d not in self.quarantine
+                and d not in self.oracles
+                and d not in self.overflow
+                for d in due
+            )
+            else None
+        )
+        err = np.asarray(host_state.error) if host_state is not None else None
+        for d in due:
+            h = self.hosts[d]
+            if h.queue or (d in self.overflow and self.overflow[d].queue):
+                continue  # staged-but-unapplied ops: state is mid-step
+            lane = "batch"
+            geometry = None
+            if d in self.quarantine:
+                lane = "quarantine"
+                summary = self.quarantine[d].export_summary()
+            elif d in self.oracles:
+                lane = "oracle"
+                summary = self.oracles[d].export_summary()
+            elif d in self.overflow:
+                lane = "overflow"
+                ln = self.overflow[d]
+                if int(ln.state.error):
+                    continue
+                geometry = ln.geometry
+                growths = ln.growths
+                summary = kb.state_to_summary(
+                    ln.state, {v: k for k, v in h.prop_slot.items()}
+                )
+            else:
+                if err[d]:
+                    continue  # never checkpoint a poisoned row
+                summary = kb.state_to_summary(
+                    jax.tree.map(lambda x: x[d], host_state),
+                    {v: k for k, v in h.prop_slot.items()},
+                )
+            record = {
+                "engine": "doc_batch",
+                "lane": lane,
+                "summary": summary,
+                "quorum": h.quorum,
+                "prop_slot": {str(k): v for k, v in h.prop_slot.items()},
+                "min_seq": h.min_seq,
+                "mode": h.mode,
+            }
+            if geometry is not None:
+                record["geometry"] = geometry
+                record["growths"] = growths
+            self.checkpoint_store.save(self.doc_keys[d], h.last_seq, record)
+            h.base_seq = h.last_seq
+            h.base_summary = summary
+            h.log = [m for m in h.log if m.seq > h.base_seq]
+            if h.raw_log:
+                h.raw_log = self._truncate_raw_log(h.raw_log, h.base_seq)
+            h.ops_since_ckpt = 0
+            self.counters.bump("checkpoints_written")
+            out.append(d)
+        return out
+
+    @staticmethod
+    def _truncate_raw_log(raw_log: list[bytes], base_seq: int) -> list[bytes]:
+        """Drop raw wire OP lines already covered by the checkpoint.  JOIN
+        lines are retained regardless of seq: a later recovery replay
+        rebuilds the quorum from them (_normalize_native), and a native
+        doc's checkpoint record carries no parsed quorum to fall back on."""
+        kept: list[bytes] = []
+        for chunk in raw_log:
+            lines = []
+            for line in chunk.split(b"\n"):
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                    if (
+                        rec.get("type") == MessageType.JOIN
+                        or int(rec.get("sequenceNumber", 0)) > base_seq
+                    ):
+                        lines.append(line)
+                except ValueError:
+                    lines.append(line)
+            if lines:
+                kept.append(b"\n".join(lines) + b"\n")
+        return kept
+
+    def restore_from_checkpoints(self, store=None) -> list[int]:
+        """Engine restart path: load each doc's durable checkpoint record,
+        rebuild its state (batch row, overflow lane, or oracle/quarantine
+        replica), and set the seq floor so the upstream replay of ops the
+        checkpoint already covers is skipped.  Returns restored doc
+        indices."""
+        store = store if store is not None else self.checkpoint_store
+        if store is None:
+            return []
+        restored: list[int] = []
+        for d in range(self.n_docs):
+            rec = store.load(self.doc_keys[d])
+            if rec is None or rec.get("engine") != "doc_batch":
+                continue
+            h = self.hosts[d]
+            h.quorum = dict(rec.get("quorum", {}))
+            h.prop_slot = {int(k): v for k, v in rec.get("prop_slot", {}).items()}
+            h.min_seq = rec.get("min_seq", 0)
+            h.base_seq = h.last_seq = int(rec["seq"])
+            h.base_summary = rec["summary"]
+            # Restored docs consume parsed messages (the object path): the
+            # native encoder cannot skip already-checkpointed seqs.
+            h.mode = "obj"
+            h.restored = True
+            lane = rec.get("lane", "batch")
+            if lane in ("oracle", "quarantine"):
+                tree = RefMergeTree()
+                tree.import_summary(rec["summary"])
+                tree.update_min_seq(h.min_seq)
+                if lane == "oracle":
+                    self.oracles[d] = tree
+                else:
+                    self.quarantine[d] = tree
+                    self.quarantine_reason[d] = "restored"
+            elif lane == "overflow":
+                geom = {k: int(v) for k, v in rec["geometry"].items()}
+                state = kb.summary_to_state(
+                    rec["summary"], geom,
+                    lambda p, _h=h, _g=geom: self._prop_slot_for_geom(_h, p, _g),
+                )
+                self.overflow[d] = _OverflowLane(
+                    state=state, geometry=geom,
+                    growths=int(rec.get("growths", 1)),
+                )
+            else:
+                try:
+                    row = kb.summary_to_state(
+                        rec["summary"], self.geometry,
+                        lambda p, _h=h: self._prop_slot_for_geom(
+                            _h, p, self.geometry
+                        ),
+                    )
+                except (ValueError, IndexError):
+                    # The checkpoint outgrew the batch geometry (a restart
+                    # with smaller capacity — including fewer prop slots
+                    # than the restored prop table): restore into an
+                    # overflow lane at a fitted geometry.
+                    geom = self._fit_geometry(
+                        self.geometry, rec["summary"], len(h.prop_slot)
+                    )
+                    state = kb.summary_to_state(
+                        rec["summary"], geom,
+                        lambda p, _h=h, _g=geom: self._prop_slot_for_geom(
+                            _h, p, _g
+                        ),
+                    )
+                    self.overflow[d] = _OverflowLane(
+                        state=state, geometry=geom, growths=1
+                    )
+                else:
+                    self.state = jax.tree.map(
+                        lambda x, s: x.at[d].set(s), self.state, row
+                    )
+            restored.append(d)
+            self.counters.bump("docs_restored")
+        return restored
+
+    # ----------------------------------------------------------------- health
+    def health(self) -> dict:
+        """Per-engine degraded-mode health counters (bench + fleet status)."""
+        ages = [
+            h.last_seq - h.base_seq for h in self.hosts if h.last_seq
+        ]
+        snap = self.counters.snapshot()
+        snap.update(
+            quarantined_docs=len(self.quarantine),
+            overflow_docs=len(self.overflow),
+            oracle_docs=len(self.oracles),
+            checkpoint_age_seqs=max(ages, default=0),
+            retained_log_msgs=sum(len(h.log) for h in self.hosts),
+        )
+        return snap
+
     # ------------------------------------------------------------------ views
     def doc_state(self, doc_idx: int) -> mk.DocState:
         if doc_idx in self.overflow:
@@ -595,11 +1170,15 @@ class DocBatchEngine:
         return jax.tree.map(lambda x: x[doc_idx], self.state)
 
     def text(self, doc_idx: int) -> str:
+        if doc_idx in self.quarantine:
+            return self.quarantine[doc_idx].visible_text()
         if doc_idx in self.oracles:
             return self.oracles[doc_idx].visible_text()
         return mk.visible_text(self.doc_state(doc_idx))
 
     def annotations(self, doc_idx: int) -> list[dict[int, int]]:
+        if doc_idx in self.quarantine:
+            return self.quarantine[doc_idx].annotations()
         if doc_idx in self.oracles:
             return self.oracles[doc_idx].annotations()
         raw = mk.annotations(self.doc_state(doc_idx))
@@ -607,12 +1186,17 @@ class DocBatchEngine:
         return [{inv[p]: v for p, v in d.items()} for d in raw]
 
     def errors(self) -> np.ndarray:
-        """Combined per-doc error vector across batch, lanes, and oracles."""
+        """Combined per-doc error vector across batch, lanes, and oracles.
+        Quarantined docs read 0: they are isolated and serviceable — their
+        degraded state surfaces through ``health()``, not as a latched
+        error that would fail a convergence sweep."""
         err = np.asarray(self.state.error).copy()
         for d in range(self.n_docs, self.capacity):
             err[d] = 0  # padding slots
         for d, lane in self.overflow.items():
             err[d] = int(lane.state.error)
         for d in self.oracles:
+            err[d] = 0
+        for d in self.quarantine:
             err[d] = 0
         return err

@@ -27,6 +27,7 @@ policy as the string engine.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 
 import jax
@@ -38,6 +39,7 @@ from ..dds.tree.changeset import (
     Modify,
     MoveIn,
     MoveOut,
+    NodeChange,
     Remove,
     Skip,
     apply_commit,
@@ -47,6 +49,7 @@ from ..dds.tree.editmanager import EditManager
 from ..dds.tree.forest import ROOT_FIELD, Forest, Node
 from ..ops import tree_kernel as tk
 from ..protocol.messages import MessageType, SequencedMessage
+from ..utils.telemetry import HealthCounters
 
 
 @dataclass
@@ -61,10 +64,26 @@ class _TreeHost:
     checkpoint: Forest = field(default_factory=Forest)
     device_commits: int = 0
     total_commits: int = 0
+    # Durable-checkpoint floor (ops at or below base_seq are covered by the
+    # stored record; a restarted consumer's replay of them is skipped).
+    base_seq: int = 0
+    last_seq: int = 0
+    ops_since_ckpt: int = 0
 
 
 class UnsupportedShape(Exception):
     """A commit the columnar path cannot express."""
+
+
+# Module-level jitted programs: shared compile cache across engine
+# instances (keyed by input shapes), instead of per-instance jit closures.
+
+_tree_step_jit = functools.partial(jax.jit, donate_argnums=(0,))(
+    jax.vmap(tk.apply_nested_ops)
+)
+_tree_compact_jit = functools.partial(jax.jit, donate_argnums=(0,))(
+    jax.vmap(tk.compact_nested)
+)
 
 
 class TreeBatchEngine:
@@ -81,6 +100,10 @@ class TreeBatchEngine:
         max_insert_len: int = 16,
         pool_capacity: int = 4096,
         mesh=None,
+        checkpoint_store=None,
+        checkpoint_every: int = 0,
+        doc_keys: list[str] | None = None,
+        telemetry=None,
     ) -> None:
         self.n_docs = n_docs
         self.capacity = capacity
@@ -90,6 +113,13 @@ class TreeBatchEngine:
         self.hosts = [_TreeHost() for _ in range(n_docs)]
         self.fallbacks: dict[int, Forest] = {}
         self.mesh = mesh
+        self.checkpoint_store = checkpoint_store
+        self.checkpoint_every = checkpoint_every
+        self.doc_keys = list(doc_keys) if doc_keys is not None else [
+            str(d) for d in range(n_docs)
+        ]
+        assert len(self.doc_keys) == n_docs
+        self.counters = HealthCounters(telemetry)
         # Interning tables shared by the fleet; ROOT_FIELD must be id 0
         # (the virtual root's field in the kernel's materializer).
         self._fields: dict[str, int] = {ROOT_FIELD: 0}
@@ -107,12 +137,8 @@ class TreeBatchEngine:
             self.state = jax.tree.map(
                 lambda x: jax.device_put(x, shard_docs(mesh)), self.state
             )
-        self._step = jax.jit(
-            jax.vmap(tk.apply_nested_ops), donate_argnums=(0,)
-        )
-        self._compact = jax.jit(
-            jax.vmap(tk.compact_nested), donate_argnums=(0,)
-        )
+        self._step = _tree_step_jit
+        self._compact = _tree_compact_jit
         # Host-side upper bound on each doc's row watermark (rows only grow
         # on INSERT ops, whose counts the host knows at staging time) — the
         # compaction trigger without a per-batch device readback.  The word
@@ -173,6 +199,12 @@ class TreeBatchEngine:
 
     def _ingest_edit(self, doc_idx: int, msg: SequencedMessage, c: dict) -> None:
         h = self.hosts[doc_idx]
+        if h.base_seq and msg.seq <= h.base_seq:
+            # Covered by the durable checkpoint (restart replay): skip.
+            self.counters.bump("checkpointed_ops_skipped")
+            return
+        h.last_seq = max(h.last_seq, msg.seq)
+        h.ops_since_ckpt += 1
         commit = commit_from_json(c["changes"])
         trunk = h.em.add_sequenced(
             client_id=msg.client_id,
@@ -507,10 +539,124 @@ class TreeBatchEngine:
             if err[d] and d not in self.fallbacks:
                 # Capacity/range overflow on device: replay on the host.
                 self._route_to_fallback(d)
+                self.counters.bump("fallback_routes")
                 self.state = self.state._replace(
                     error=self.state.error.at[d].set(0)
                 )
+        self.maybe_checkpoint()
         return steps
+
+    # ------------------------------------------------------------- checkpoint
+    def maybe_checkpoint(self, force: bool = False) -> list[int]:
+        """Write durable checkpoint records (forest + EditManager window)
+        for docs whose commit count since the last record reached
+        ``checkpoint_every``; all dirty docs when ``force``.  The host
+        trunk fold (``checkpoint`` forest) IS the snapshot state, so this
+        needs no device readback."""
+        if self.checkpoint_store is None:
+            return []
+        if not force and self.checkpoint_every <= 0:
+            return []
+        out: list[int] = []
+        for d in range(self.n_docs):
+            h = self.hosts[d]
+            if h.ops_since_ckpt <= 0:
+                continue
+            if not force and h.ops_since_ckpt < self.checkpoint_every:
+                continue
+            if d in self.fallbacks:
+                lane = "fallback"
+                forest_json = self.fallbacks[d].to_json()
+            else:
+                lane = "device"
+                # Fold the trunk suffix so the checkpoint forest is the
+                # full trunk state (this is the same fold the host-memory
+                # bound performs, just on the durable cadence too).
+                for t in h.trunk_log:
+                    apply_commit(h.checkpoint.root, t)
+                h.trunk_log.clear()
+                forest_json = h.checkpoint.to_json()
+            record = {
+                "engine": "tree_batch",
+                "lane": lane,
+                "forest": forest_json,
+                "em": h.em.summarize(),
+                "commits": h.total_commits,
+            }
+            self.checkpoint_store.save(self.doc_keys[d], h.last_seq, record)
+            h.base_seq = h.last_seq
+            h.ops_since_ckpt = 0
+            self.counters.bump("checkpoints_written")
+            out.append(d)
+        return out
+
+    def restore_from_checkpoints(self, store=None) -> list[int]:
+        """Engine restart path: rebuild each doc's host forest and
+        EditManager window from its durable record, re-materialize the
+        device columns from the forest (a synthesized whole-content insert
+        commit), and set the seq floor so replayed ops the checkpoint
+        covers are skipped."""
+        store = store if store is not None else self.checkpoint_store
+        if store is None:
+            return []
+        restored: list[int] = []
+        for d in range(self.n_docs):
+            rec = store.load(self.doc_keys[d])
+            if rec is None or rec.get("engine") != "tree_batch":
+                continue
+            h = self.hosts[d]
+            h.em = EditManager()
+            h.em.load(rec["em"])
+            h.base_seq = h.last_seq = int(rec["seq"])
+            h.total_commits = int(rec.get("commits", 0))
+            forest = Forest()
+            forest.load_json(rec["forest"])
+            if rec.get("lane") == "fallback":
+                self.fallbacks[d] = forest
+                h.checkpoint = Forest()
+                restored.append(d)
+                self.counters.bump("docs_restored")
+                continue
+            h.checkpoint = forest
+            if forest.root_field:
+                # Re-materialize the device columns: the checkpoint forest
+                # as one whole-content insert commit (same flatten path as
+                # live commits, so interning and accounting match).
+                ch = NodeChange()
+                ch.fields[ROOT_FIELD] = [
+                    Insert([n.clone() for n in forest.root_field])
+                ]
+                try:
+                    rows = self._flatten([ch], seq=h.base_seq)
+                except UnsupportedShape:
+                    self._route_to_fallback(d)
+                    restored.append(d)
+                    self.counters.bump("docs_restored")
+                    continue
+                for r, _p in rows:
+                    if r[0] in (
+                        tk.NestedOpKind.INSERT, tk.NestedOpKind.REPLACE_FIELD
+                    ):
+                        self._rows_upper[d] += int(r[tk._TGT + 2])
+                    self._pool_upper[d] += self._op_pool_words(r)
+                h.queue.extend(r for r, _p in rows)
+                h.payloads.extend(p for _r, p in rows)
+            restored.append(d)
+            self.counters.bump("docs_restored")
+        return restored
+
+    # ----------------------------------------------------------------- health
+    def health(self) -> dict:
+        snap = self.counters.snapshot()
+        snap.update(
+            fallback_docs=len(self.fallbacks),
+            checkpoint_age_seqs=max(
+                (h.last_seq - h.base_seq for h in self.hosts if h.last_seq),
+                default=0,
+            ),
+            device_fraction=round(self.device_fraction(), 4),
+        )
+        return snap
 
     # ------------------------------------------------------------------ views
     def _name_tables(self) -> tuple[dict[int, str], dict[int, str]]:

@@ -57,6 +57,28 @@ ERR_REM_OVERFLOW = 4
 ERR_POS_RANGE = 8
 ERR_OB_OVERFLOW = 16
 
+# Error lanes (host recovery policy dispatch): capacity bits are recoverable
+# by growing the implicated axis and replaying; anything else (today only
+# ERR_POS_RANGE alone) means the op stream itself is malformed — growing
+# cannot fix it, the document must leave the device batch (quarantine).
+ERR_CAPACITY_MASK = (
+    ERR_SEG_OVERFLOW | ERR_TEXT_OVERFLOW | ERR_REM_OVERFLOW | ERR_OB_OVERFLOW
+)
+
+
+def is_capacity_error(bits: int) -> bool:
+    """True iff the latched bits are recoverable by growth + replay.
+    ERR_POS_RANGE *alongside* a capacity bit is usually a cascade (an op
+    referencing content a capacity overflow dropped), which replay at
+    grown capacity resolves — so any capacity bit keeps the doc on the
+    grow lane."""
+    return bits != 0 and (bits & ERR_CAPACITY_MASK) != 0
+
+
+def is_poison_error(bits: int) -> bool:
+    """True iff the bits indicate a malformed op stream (quarantine lane)."""
+    return bits != 0 and (bits & ERR_CAPACITY_MASK) == 0
+
 # Obliterate endpoint sides (ref sequencePlace.ts Side; mergetree_ref.py).
 SIDE_BEFORE = 0
 SIDE_AFTER = 1

@@ -139,8 +139,20 @@ class FleetConsumer:
         return staged
 
     def step(self) -> int:
-        """Apply everything staged as one batched device step."""
+        """Apply everything staged as one batched device step (the engine
+        runs its own recovery, watchdog cadence, and checkpoint cadence
+        inside ``step`` when configured)."""
         return self.engine.step()
+
+    def health(self) -> dict:
+        """Engine health counters + this consumer's transport state."""
+        out = self.engine.health()
+        out.update(
+            dead_socks=len(self.dead_socks),
+            rows_staged=self.rows_staged,
+            bytes_consumed=self.bytes_consumed,
+        )
+        return out
 
     def run_for(self, expected_rows: int, max_idle_pumps: int = 200) -> None:
         """Pump until ``expected_rows`` op rows staged (test/bench driver);

@@ -35,6 +35,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--exit-after-rows", type=int, default=0)
     p.add_argument("--recovery", choices=("grow", "oracle", "off"),
                    default="grow")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="directory for durable per-doc checkpoint records; "
+                        "enables bounded recovery + restart-from-checkpoint")
+    p.add_argument("--checkpoint-every", type=int, default=256,
+                   help="ops per doc between durable checkpoints "
+                        "(with --checkpoint-dir)")
+    p.add_argument("--watchdog-every", type=int, default=0,
+                   help="engine steps between divergence-watchdog sweeps "
+                        "(0 disables)")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu); overrides the "
                         "image default and the FFTPU_PLATFORM env var")
@@ -53,8 +62,14 @@ def main(argv: list[str] | None = None) -> int:
 
     from ..models.doc_batch_engine import DocBatchEngine
     from .fleet_consumer import FleetConsumer
+    from .ordered_log import CheckpointStore
 
     doc_ids = [d for d in args.docs.split(",") if d]
+    store = (
+        CheckpointStore(args.checkpoint_dir)
+        if args.checkpoint_dir is not None
+        else None
+    )
     eng = DocBatchEngine(
         len(doc_ids),
         max_segments=args.capacity,
@@ -63,7 +78,21 @@ def main(argv: list[str] | None = None) -> int:
         ops_per_step=args.ops_per_step,
         use_mesh=False,
         recovery=args.recovery,
+        checkpoint_store=store,
+        checkpoint_every=args.checkpoint_every if store is not None else 0,
+        doc_keys=doc_ids,
+        watchdog_every=args.watchdog_every,
     )
+    if store is not None:
+        # Restart path: restore durable checkpoints BEFORE consuming, so
+        # the firehose catch-up replay of already-checkpointed ops is
+        # skipped and recovery replay stays bounded.
+        restored = eng.restore_from_checkpoints()
+        if restored:
+            print(json.dumps({
+                "restored": [doc_ids[d] for d in restored],
+                "health": eng.health(),
+            }), flush=True)
     fc = FleetConsumer(args.host, args.port, eng, doc_ids)
 
     def status(**extra) -> None:
@@ -72,12 +101,17 @@ def main(argv: list[str] | None = None) -> int:
             "rows": fc.rows_staged,
             "bytes": fc.bytes_consumed,
             "errors": int(errs.sum()),
+            "health": eng.health(),
             **extra,
         }
         if errs.any():
             out["errorDocs"] = [
                 doc_ids[i] for i in range(len(doc_ids)) if errs[i]
             ]
+        if eng.quarantine:
+            out["quarantinedDocs"] = sorted(
+                doc_ids[d] for d in eng.quarantine
+            )
         print(json.dumps(out), flush=True)
 
     last_status = time.monotonic()
@@ -88,8 +122,10 @@ def main(argv: list[str] | None = None) -> int:
                 # A shard closed our firehose (restart/shutdown): exit
                 # nonzero so the supervisor restarts this tier — sleeping
                 # on dead sockets would look healthy while applying
-                # nothing forever.
+                # nothing forever.  Checkpoint first so the restart
+                # resumes from here instead of replaying history.
                 fc.step()
+                eng.maybe_checkpoint(force=True)
                 status(disconnected=sorted(
                     doc_ids[i] for i in fc.dead_socks
                 ))
@@ -103,12 +139,14 @@ def main(argv: list[str] | None = None) -> int:
                 last_status = now
                 status()
             if args.exit_after_rows and fc.rows_staged >= args.exit_after_rows:
+                eng.maybe_checkpoint(force=True)
                 status(
                     texts={d: eng.text(i) for i, d in enumerate(doc_ids)},
                     done=True,
                 )
                 return 0
     except KeyboardInterrupt:
+        eng.maybe_checkpoint(force=True)
         return 0
     finally:
         fc.close()

@@ -126,6 +126,36 @@ class PerformanceEvent:
             self.cancel(exc)
 
 
+class HealthCounters:
+    """Named monotonic counters + gauges for degraded-mode health surfaces
+    (engine quarantine/checkpoint/watchdog state).  Counters accumulate
+    (``bump``), gauges overwrite (``gauge``); ``snapshot`` returns a plain
+    dict for status lines and bench artifacts, ``emit`` sends the same dict
+    as one structured telemetry event so fleets report health through the
+    ordinary logger pipeline."""
+
+    def __init__(self, logger: Logger | None = None, **initial: int) -> None:
+        self.logger = logger
+        self._values: dict[str, Any] = dict(initial)
+
+    def bump(self, name: str, by: int = 1) -> int:
+        self._values[name] = self._values.get(name, 0) + by
+        return self._values[name]
+
+    def gauge(self, name: str, value: Any) -> None:
+        self._values[name] = value
+
+    def get(self, name: str, default: Any = 0) -> Any:
+        return self._values.get(name, default)
+
+    def snapshot(self) -> dict[str, Any]:
+        return dict(self._values)
+
+    def emit(self, event_name: str = "engine_health", **props: Any) -> None:
+        if self.logger is not None:
+            self.logger.generic(event_name, **self._values, **props)
+
+
 @dataclass
 class _SampleBucket:
     count: int = 0

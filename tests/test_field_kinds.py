@@ -515,6 +515,78 @@ def test_compose_invert_restores_original_repair_data():
     apply_node_change(n3, invert_node_change(sq))
     assert _vals(n3.fields["seq"]) == [5, 6]
 
+    # Mixed kinds: a = sequence marks (insert on an EMPTY field), b = a
+    # later optional SET shadowing it.  b's recorded prior is a's OUTPUT
+    # (the inserted node); the composed change must unwind a so its invert
+    # restores the EMPTY input field, not re-create the intermediate.
+    n4 = Node(type="obj")
+    n4.fields["mix"] = []
+    ma = NodeChange(fields={"mix": [Insert(_field([1]))]})
+    mb = NodeChange(fields={"mix": OptionalChange(set=(leaf(9),))})
+    apply_node_change(n4, ma)
+    apply_node_change(n4, mb)
+    sqm = compose_node_change(ma, mb)
+    apply_node_change(n4, invert_node_change(sqm))
+    assert n4.fields["mix"] == []  # a's INPUT context: empty field
+
+    # Mixed kinds with a resident: a modifies the resident via marks, b
+    # sets — invert of the squash restores the ORIGINAL value.
+    n5 = Node(type="obj")
+    n5.fields["mix"] = _field([1])
+    ma2 = NodeChange(fields={"mix": [Modify(NodeChange(value=(2,)))]})
+    mb2 = NodeChange(fields={"mix": OptionalChange(set=(leaf(9),))})
+    apply_node_change(n5, ma2)
+    apply_node_change(n5, mb2)
+    sqm2 = compose_node_change(ma2, mb2)
+    apply_node_change(n5, invert_node_change(sqm2))
+    assert _vals(n5.fields["mix"]) == [1]  # not the intermediate 2
+
+
+def test_compose_and_apply_do_not_mutate_inputs():
+    """Composing and then APPLYING the composed change must leave the input
+    changes untouched: apply enriches in place (value tuples,
+    Remove.detached), and the inputs may still be referenced by
+    applied_log / trunk commits whose invert must stay correct."""
+    from fluidframework_tpu.dds.tree.changeset import change_to_json
+
+    # One-sided field (only a has it) + nested Modify under b's Skip.
+    a = NodeChange(fields={
+        "only_a": [Insert(_field([1, 2]))],
+        "both": [Modify(NodeChange(value=(7,)))],
+    })
+    b = NodeChange(fields={
+        "both": [Skip(1)],
+        "only_b": [Remove(1)],
+    })
+    node = Node(type="obj")
+    node.fields["only_a"] = []
+    node.fields["both"] = _field([5])
+    node.fields["only_b"] = _field([8])
+    a_before = change_to_json(a)
+    b_before = change_to_json(b)
+    composed = compose_node_change(a, b)
+    apply_node_change(node, composed)
+    assert change_to_json(a) == a_before, "compose+apply mutated input a"
+    assert change_to_json(b) == b_before, "compose+apply mutated input b"
+    # And the enriched composed change still inverts to the original state.
+    apply_node_change(node, invert_node_change(composed))
+    assert node.fields["only_a"] == []
+    assert _vals(node.fields["both"]) == [5]
+    assert _vals(node.fields["only_b"]) == [8]
+
+    # compose_marks placements: b's Insert content and Modify changes must
+    # be fresh objects, not b's own.
+    ma = [Modify(NodeChange(value=(3,)))]
+    mb = [Skip(1), Insert(_field([4]))]
+    nodes = _field([1])
+    ma_before = [repr(m) for m in ma]
+    from fluidframework_tpu.dds.tree.field_kinds import compose_marks as cm
+
+    out = cm(ma, mb)
+    apply_marks(nodes, out)
+    assert [repr(m) for m in ma] == ma_before
+    assert mb[1].content[0].value == 4 and _vals(nodes) == [3, 4]
+
 
 def test_compose_mixed_kind_histories():
     """compose over a field whose sequential history mixes kinds (legal

@@ -151,6 +151,102 @@ def test_marker_reconnect_resubmit():
     assert a.get_marker_from_id("offline") is not None
 
 
+def test_regenerated_insert_spec_per_props_runs():
+    """Split parts with DIFFERING same-op props regenerate as one spec per
+    distinct-props run (a single collapsed spec would drop props on the
+    mismatched portion); marker parts always keep marker form, even
+    without props (bare text must never carry plane codepoints)."""
+    from fluidframework_tpu.dds.markers import (
+        marker_char,
+        regenerated_insert_spec,
+        spec_length,
+    )
+
+    # Uniform props still collapse to one annotated spec.
+    assert regenerated_insert_spec([("ab", {"1": 2}), ("cd", {"1": 2})]) == {
+        "text": "abcd", "props": {"1": 2},
+    }
+    # Bare runs collapse to bare text.
+    assert regenerated_insert_spec([("ab", {}), ("cd", {})]) == "abcd"
+    # Differing props -> one spec per run, in order.
+    specs = regenerated_insert_spec(
+        [("a", {"1": 2}), ("bc", {}), ("d", {"1": 2})]
+    )
+    assert specs == [
+        {"text": "a", "props": {"1": 2}}, "bc", {"text": "d", "props": {"1": 2}},
+    ]
+    assert sum(spec_length(s) for s in specs) == 4
+    # A props-less marker regenerates in marker form, not bare PUA text.
+    assert regenerated_insert_spec([(marker_char(REF_TILE), {})]) == {
+        "marker": {"refType": REF_TILE},
+    }
+
+
+def test_reconnect_resubmit_preserves_partial_props():
+    """A pending annotated insert whose range a LATER local annotate
+    partially restamped must resubmit with per-run props — the old
+    collapse-to-one-spec path shipped the insert bare and lost the
+    annotations on every remote replica for the uncovered portion."""
+    _svc, doc, rts, ss = _fleet(2)
+    a, b = ss(rts[0]), ss(rts[1])
+    rts[0].disconnect()
+    # Rehydrate a stashed annotated insert (the one wire shape that puts
+    # same-op props on a multi-char range), exactly as the runtime's
+    # stash path does.
+    contents = {
+        "address": "root",
+        "contents": {
+            "address": "s",
+            "contents": {
+                "type": 0, "pos1": 0,
+                "seg": {"text": "abcd", "props": {"bold": 1}},
+            },
+        },
+    }
+    md = rts[0]._datastores["root"].apply_stashed(contents["contents"])
+    rts[0]._psm.add_stashed(contents, md, "stash-batch", "")
+    # Later local annotate restamps the middle of the pending range.
+    a.annotate_range(1, 3, "bold", 2)
+    assert a.annotations() == [
+        {"bold": 1}, {"bold": 2}, {"bold": 2}, {"bold": 1},
+    ]
+    rts[0].connect(doc, "c0-re")
+    rts[0].flush()
+    doc.process_all()
+    assert a.text == b.text == "abcd"
+    assert b.annotations() == a.annotations() == [
+        {"bold": 1}, {"bold": 2}, {"bold": 2}, {"bold": 1},
+    ]
+
+
+def test_remote_and_stashed_text_rejects_marker_plane():
+    """The reserved plane is enforced at the op-apply/decode boundary, not
+    just the local insert_text API: a peer smuggling PUA codepoints as
+    'text' (bare or annotated) is rejected on every replica."""
+    _svc, doc, rts, ss = _fleet(2)
+    a, b = ss(rts[0]), ss(rts[1])
+    a.insert_text(0, "ok")
+    _sync(doc, rts)
+    smuggled = chr(0xE000 + 5)
+    # Forge a wire insert carrying a plane codepoint as bare text.
+    with pytest.raises(ValueError):
+        a._apply_insert_spec(smuggled, 0, 7, 1, 0)
+    with pytest.raises(ValueError):
+        a._apply_insert_spec({"text": "x" + smuggled, "props": {}}, 0, 7, 1, 0)
+    with pytest.raises(ValueError):
+        a.apply_stashed({"type": 0, "pos1": 0, "seg": "ab" + smuggled})
+    # Marker-form specs remain the one legal producer of plane codepoints.
+    a._apply_insert_spec({"marker": {"refType": REF_TILE}}, 0, 7, 1, 0)
+    # Legacy snapshot segmentTexts decode enforces the same boundary.
+    from fluidframework_tpu.dds.snapshot_v1 import _spec_text_props
+
+    with pytest.raises(ValueError):
+        _spec_text_props("oops" + smuggled)
+    with pytest.raises(ValueError):
+        _spec_text_props({"text": smuggled})
+    assert _spec_text_props({"marker": {"refType": 1}})[0] == chr(0xE001)
+
+
 def test_snapshot_v1_marker_wire_shape():
     """Channel-independent: a marker encodes as the reference's
     {"marker":{"refType":n},"props":{...}} spec and never coalesces with

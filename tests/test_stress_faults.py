@@ -236,6 +236,78 @@ def test_offline_remove_split_by_concurrent_insert_regenerates():
     assert string_of(d).text == string_of(c2).text == "x"
 
 
+def test_quarantine_checkpoint_schedule():
+    """Batched-engine schedule stress (the fleet-robustness contract): a
+    malformed sequenced op lands in one doc of an 8-doc batch mid-schedule
+    and an engine crash follows — the healthy docs stay byte-identical to
+    a no-fault control, the poisoned doc quarantines with checkpoint-
+    bounded replay, the restarted engine restores from the durable records
+    (including the quarantine lane), and the whole fleet converges after a
+    full-stream replay plus readmission."""
+    import tempfile
+
+    from test_engine_checkpoint import _join, _mk_engine, _schedule
+
+    from fluidframework_tpu.server.ordered_log import CheckpointStore
+
+    D, ROUNDS, CKPT, POISON_DOC = 8, 10, 3, 5
+    sched = _schedule(D, ROUNDS, seed=21, poison=(POISON_DOC, 4))
+
+    # No-fault control (the poison op excluded, seq numbering identical).
+    ctl = _mk_engine(D)
+    for d in range(D):
+        ctl.ingest(d, _join("w0", 0))
+    for d, m, is_poison in sched:
+        if not is_poison:
+            ctl.ingest(d, m)
+    ctl.step()
+    expected = [ctl.text(d) for d in range(D)]
+
+    # Faulted run with checkpoints; crash ~70% through the schedule.
+    tmp = tempfile.mkdtemp()
+    eng = _mk_engine(D, CheckpointStore(tmp), checkpoint_every=CKPT)
+    for d in range(D):
+        eng.ingest(d, _join("w0", 0))
+    crash_at = (7 * len(sched)) // 10
+    for i, (d, m, _p) in enumerate(sched[:crash_at]):
+        eng.ingest(d, m)
+        if i % (2 * D) == 0:
+            eng.step()
+    eng.step()
+    assert POISON_DOC in eng.quarantine
+    h = eng.health()
+    assert 0 < h["quarantine_replay_len"] < ROUNDS  # checkpoint-bounded
+    assert h["checkpoints_written"] > 0
+    del eng  # crash — only the durable records survive
+
+    eng2 = _mk_engine(D, CheckpointStore(tmp), checkpoint_every=CKPT)
+    restored = eng2.restore_from_checkpoints()
+    assert restored, "crash restart found no durable checkpoints"
+    # Full-stream replay from offset 0 (what a restarted consumer sees).
+    for d in range(D):
+        eng2.ingest(d, _join("w0", 0))
+    for d, m, _p in sched:
+        eng2.ingest(d, m)
+    eng2.step()
+    assert eng2.health()["checkpointed_ops_skipped"] > 0
+    for d in range(D):
+        assert eng2.text(d) == expected[d], f"doc {d} diverged after restart"
+    assert not eng2.errors().any()
+
+    # The poisoned doc survived the crash IN quarantine (restored lane),
+    # and re-admits cleanly once the stream is healthy again.
+    assert POISON_DOC in eng2.quarantine
+    assert eng2.readmit(POISON_DOC)
+    from test_engine_checkpoint import _ins
+
+    next_seq = ROUNDS + 2
+    for d in range(D):
+        eng2.ingest(d, _ins(next_seq, 0, "ok"))
+    eng2.step()
+    for d in range(D):
+        assert eng2.text(d) == "ok" + expected[d]
+
+
 def test_injected_disconnect_replays_pending():
     svc = LocalService()
     factory = FaultInjectionDocumentServiceFactory(LocalDocumentServiceFactory(svc))
