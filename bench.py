@@ -406,6 +406,61 @@ def _copy_args(args):
     return out
 
 
+def _scribe_probe(n_docs: int = 8, ops_per_doc: int = 64) -> dict:
+    """Drive the scribe service over a synthetic op topic and report its
+    health counters (summaries written, handle reuse, ack floor ages, log
+    bytes reclaimed by compaction) so BENCH artifacts track the
+    summarize -> ack -> compact loop release over release."""
+    import contextlib
+    import tempfile
+
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+    from fluidframework_tpu.server.ordered_log import ConsumerGroup, DurableTopic
+    from fluidframework_tpu.server.scribe import ScribeConfig, ScribeLambda
+
+    stack = contextlib.ExitStack()
+    tmp = stack.enter_context(tempfile.TemporaryDirectory(prefix="bench-scribe-"))
+    topic = DurableTopic(
+        "deltas", 2, os.path.join(tmp, "log"),
+        encode=lambda m: m.to_json(), decode=SequencedMessage.from_json,
+    )
+    stack.callback(topic.close)
+    rng = np.random.default_rng(0)
+    lengths = [0] * n_docs
+    for d in range(n_docs):
+        topic.produce(f"doc{d}", SequencedMessage(
+            seq=0, min_seq=0, ref_seq=0, client_id="w0", client_seq=0,
+            type=MessageType.JOIN, contents={"clientId": "w0", "short": 0},
+        ))
+    for s in range(1, ops_per_doc + 1):
+        for d in range(n_docs):
+            pos = int(rng.integers(0, lengths[d] + 1))
+            topic.produce(f"doc{d}", SequencedMessage(
+                seq=s, min_seq=0, ref_seq=s - 1, client_id="w0", client_seq=s,
+                type=MessageType.OP,
+                contents={"type": 0, "pos1": pos, "seg": "abcd"},
+            ))
+            lengths[d] += 4
+    scribe = ScribeLambda(topic, os.path.join(tmp, "scribe"),
+                          config=ScribeConfig(max_ops=16))
+    stack.callback(scribe.close)
+    fleet = ConsumerGroup(topic, "fleet", os.path.join(tmp, "scribe"))
+    fleet.join("bench")
+    t0 = time.perf_counter()
+    n = scribe.pump()
+    dt = time.perf_counter() - t0
+    for p, rec in fleet.consume("bench"):
+        fleet.commit(p, rec.offset + 1)
+    scribe.compact(extra_groups=(fleet,))
+    out = scribe.health()
+    out["records_per_sec"] = round(n / dt, 1) if dt else None
+    stack.close()  # closes scribe + topic + removes the tempdir
+    return out
+
+
 def bench_headline(args) -> dict:
     """Driver headline: config 3's single-writer form (round-comparable)."""
     D, B = args.docs, args.ops_per_step
@@ -417,7 +472,12 @@ def bench_headline(args) -> dict:
         )
         return ops, payloads, min_seqs, 2 * args.steps * D * B
 
-    return _mergetree_run(args, D, gen, "mergetree_ops_per_sec_per_chip")
+    out = _mergetree_run(args, D, gen, "mergetree_ops_per_sec_per_chip")
+    try:
+        out["scribe_health"] = _scribe_probe()
+    except Exception as e:  # noqa: BLE001 — the probe must never sink the headline
+        out["scribe_health"] = {"error": repr(e)[-200:]}
+    return out
 
 
 def bench_config1(args) -> dict:

@@ -109,6 +109,10 @@ class _DocHost:
     # Set by restore_from_checkpoints: the doc consumes parsed messages
     # (seq dedupe needs per-message seqs the native encoder can't skip).
     restored: bool = False
+    # Count applied ops as boot_replay_len only during the boot catch-up
+    # phase — the first post-boot checkpoint ends it (live traffic after
+    # that must not keep inflating a counter named "boot").
+    boot_counting: bool = False
 
 
 @dataclass
@@ -152,6 +156,29 @@ _lane_compact_jit = jax.jit(lambda s, m: mk.compact(mk.set_min_seq(s, m)))
 _gather_cohort_jit = jax.jit(lambda st, idx: jax.tree.map(lambda x: x[idx], st))
 
 
+@jax.jit
+def _fleet_digest(state):
+    """Cheap per-doc state digest computed ON DEVICE from the batched
+    state: a position-weighted checksum of the text pool plus the segment
+    layout scalars.  The divergence watchdog uses it as a pre-filter — a
+    doc whose digest has not moved since its last verified check cannot
+    have diverged SINCE then, so the expensive host-oracle replay is spent
+    only on docs whose digest drifted."""
+    U = jnp.uint32
+    T = state.text.shape[-1]
+    S = state.seg_len.shape[-1]
+    wt = (jnp.arange(T, dtype=U) * U(2654435761) + U(0x9E3779B9))
+    ws = (jnp.arange(S, dtype=U) * U(0x85EBCA6B) + U(0xC2B2AE35))
+    dig = (state.text.astype(U) * wt).sum(axis=-1)
+    dig += (state.seg_len.astype(U) * ws).sum(axis=-1)
+    dig += (state.seg_start.astype(U) * (ws ^ U(0xA5A5A5A5))).sum(axis=-1)
+    for rk in state.rem_keys:
+        dig = dig * U(31) + (rk.astype(U) * ws).sum(axis=-1)
+    dig = dig * U(31) + state.text_end.astype(U)
+    dig = dig * U(31) + state.nseg.astype(U)
+    return dig
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _scatter_cohort_jit(st, sub, idx, valid):
     def put(x, s):
@@ -183,6 +210,8 @@ class DocBatchEngine:
         doc_keys: list[str] | None = None,
         watchdog_every: int = 0,
         watchdog_sample: int = 4,
+        readmit_after_steps: int = 0,
+        poison_budget: int = 0,
         telemetry=None,
     ) -> None:
         assert recovery in ("grow", "oracle", "off")
@@ -217,6 +246,29 @@ class DocBatchEngine:
         self.watchdog_sample = watchdog_sample
         self._watchdog_cursor = 0
         self._steps_since_watchdog = 0
+        # Watchdog pre-filter state: device digest at the last sweep, and
+        # per doc the (digest, last_seq) pair recorded when it last PASSED
+        # a check.  Skipping requires BOTH unchanged: the digest alone
+        # cannot distinguish "no ops applied" from "ops silently dropped
+        # by the kernel" — the exact divergence class the watchdog hunts.
+        self._digests: np.ndarray | None = None
+        self._verified_digest: dict[int, tuple[int, int]] = {}
+        # Quarantine auto-readmission policy: with ``readmit_after_steps``
+        # a quarantined doc is automatically re-tried after that many
+        # engine steps, doubling per flap (exponential backoff).  A doc
+        # that gets quarantined more than ``poison_budget`` times (0 = no
+        # budget) is flapping — permanently oracle-routed instead of
+        # bouncing in and out of the batch forever.
+        self.readmit_after_steps = readmit_after_steps
+        self.poison_budget = poison_budget
+        self._step_count = 0
+        self._flaps: dict[int, int] = {}
+        self._readmit_due: dict[int, int] = {}
+        # Current backoff interval per quarantined doc: doubles on every
+        # flap AND on every failed readmission attempt (a doc whose state
+        # outgrew the batch geometry must not re-pay the export/pack cost
+        # at a fixed cadence forever).
+        self._readmit_interval: dict[int, int] = {}
         self.counters = HealthCounters(telemetry)
 
         if use_mesh:
@@ -295,6 +347,11 @@ class DocBatchEngine:
             return
         h.last_seq = max(h.last_seq, msg.seq)
         h.ops_since_ckpt += 1
+        if h.boot_counting:
+            # Post-summary tail actually replayed on a boot-from-checkpoint/
+            # summary consumer (the skipped prefix counts separately above;
+            # the first post-boot checkpoint ends the boot phase).
+            self.counters.bump("boot_replay_len")
         if doc_idx in self.quarantine:
             # Quarantined docs stay serviceable: validated host-oracle
             # apply; malformed ops are dropped and counted, never applied.
@@ -585,6 +642,7 @@ class DocBatchEngine:
                 self.full_steps += 1
             steps += 1
         self._step_lanes()
+        self._step_count += 1
         if self.recovery != "off":
             self.recover()
             self._steps_since_watchdog += 1
@@ -594,8 +652,30 @@ class DocBatchEngine:
             ):
                 self._steps_since_watchdog = 0
                 self.watchdog()
+            if self.readmit_after_steps:
+                self._maybe_readmit()
         self.maybe_checkpoint()
         return steps
+
+    def _maybe_readmit(self) -> None:
+        """Backoff-scheduled quarantine readmission (see __init__)."""
+        for d, due_step in list(self._readmit_due.items()):
+            if self._step_count < due_step or d not in self.quarantine:
+                if d not in self.quarantine:
+                    self._readmit_due.pop(d, None)
+                continue
+            if self.readmit(d):
+                self.counters.bump("auto_readmissions")
+            else:
+                # State no longer fits the batch geometry: double the
+                # backoff and retry later (the doc stays serviceable in
+                # its quarantine lane).
+                interval = min(
+                    2 * self._readmit_interval.get(d, self.readmit_after_steps),
+                    self.readmit_after_steps << 16,
+                )
+                self._readmit_interval[d] = interval
+                self._readmit_due[d] = self._step_count + interval
 
     def _cohort_step(self, busy: list[int]) -> None:
         """One bucketed step over just the busy docs."""
@@ -873,8 +953,31 @@ class DocBatchEngine:
             self._oracle_apply_validated(tree, h, msg)
         tree.update_min_seq(h.min_seq)
         self.overflow.pop(d, None)
-        self.quarantine[d] = tree
-        self.quarantine_reason[d] = reason
+        flaps = self._flaps[d] = self._flaps.get(d, 0) + 1
+        if self.poison_budget and flaps > self.poison_budget:
+            # Flapping: the doc keeps getting re-poisoned after clean
+            # readmissions.  Spend no more recovery work on it — route it
+            # to the oracle lane permanently (still serviceable, never
+            # auto-readmitted).
+            self.quarantine.pop(d, None)
+            self.quarantine_reason.pop(d, None)
+            self._readmit_due.pop(d, None)
+            self._readmit_interval.pop(d, None)
+            self.oracles[d] = tree
+            self.counters.bump("poison_routed_docs")
+            if self.counters.logger is not None:
+                self.counters.logger.error(
+                    "doc_poison_routed", reason, doc=self.doc_keys[d],
+                    flaps=flaps,
+                )
+        else:
+            self.quarantine[d] = tree
+            self.quarantine_reason[d] = reason
+            if self.readmit_after_steps:
+                # Exponential backoff: 1 flap -> base, 2 -> 2x, 3 -> 4x...
+                interval = self.readmit_after_steps << min(flaps - 1, 16)
+                self._readmit_interval[d] = interval
+                self._readmit_due[d] = self._step_count + interval
         h.queue.clear()
         h.payloads.clear()
         if d < self.capacity:
@@ -909,6 +1012,11 @@ class DocBatchEngine:
         )
         del self.quarantine[d]
         self.quarantine_reason.pop(d, None)
+        self._readmit_due.pop(d, None)
+        self._readmit_interval.pop(d, None)
+        # The scattered row is fresh device truth: invalidate the verified
+        # digest so the watchdog re-verifies it on the next sweep.
+        self._verified_digest.pop(d, None)
         # The oracle state becomes the doc's new replay base: the dropped
         # poison ops are gone from both the state and the log.
         h.base_summary = summary
@@ -932,6 +1040,21 @@ class DocBatchEngine:
             and self.hosts[d].mode == "obj"
             and not self.hosts[d].queue
         ]
+        if eligible:
+            # Device-digest pre-filter: one [D] device reduction per sweep
+            # (NOT per step — it blocks on a device->host transfer).  A doc
+            # whose digest AND ingested seq both match its last PASSED
+            # check cannot have diverged since — skip its host-oracle
+            # replay entirely (counted).
+            self._digests = np.asarray(_fleet_digest(self.state))
+            drifted = []
+            for d in eligible:
+                mark = (int(self._digests[d]), self.hosts[d].last_seq)
+                if self._verified_digest.get(d) == mark:
+                    self.counters.bump("watchdog_prefiltered")
+                else:
+                    drifted.append(d)
+            eligible = drifted
         if not eligible:
             return []
         k = sample if sample is not None else self.watchdog_sample
@@ -958,6 +1081,12 @@ class DocBatchEngine:
                 self.counters.bump("watchdog_mismatches")
                 self._quarantine_doc(d, "watchdog: device/oracle divergence")
                 failed.append(d)
+            elif self._digests is not None:
+                # Passed: pin (digest, seq) so the pre-filter can skip this
+                # doc until its device state or ingested stream moves.
+                self._verified_digest[d] = (
+                    int(self._digests[d]), self.hosts[d].last_seq
+                )
         return failed
 
     # ------------------------------------------------------------- checkpoint
@@ -1041,6 +1170,7 @@ class DocBatchEngine:
             if h.raw_log:
                 h.raw_log = self._truncate_raw_log(h.raw_log, h.base_seq)
             h.ops_since_ckpt = 0
+            h.boot_counting = False  # a new durable floor ends the boot phase
             self.counters.bump("checkpoints_written")
             out.append(d)
         return out
@@ -1081,6 +1211,11 @@ class DocBatchEngine:
             return []
         restored: list[int] = []
         for d in range(self.n_docs):
+            if self.hosts[d].restored:
+                # Already seeded by an earlier restore (e.g. a local
+                # checkpoint before a scribe boot-from-summary pass): the
+                # first source wins — never regress a doc's replay floor.
+                continue
             rec = store.load(self.doc_keys[d])
             if rec is None or rec.get("engine") != "doc_batch":
                 continue
@@ -1094,6 +1229,7 @@ class DocBatchEngine:
             # native encoder cannot skip already-checkpointed seqs.
             h.mode = "obj"
             h.restored = True
+            h.boot_counting = True
             lane = rec.get("lane", "batch")
             if lane in ("oracle", "quarantine"):
                 tree = RefMergeTree()
@@ -1104,6 +1240,15 @@ class DocBatchEngine:
                 else:
                     self.quarantine[d] = tree
                     self.quarantine_reason[d] = "restored"
+                    if self.readmit_after_steps:
+                        # A restart must not strand the doc in quarantine
+                        # when auto-readmission is the configured policy:
+                        # schedule it like a first flap.
+                        self._flaps.setdefault(d, 1)
+                        self._readmit_interval[d] = self.readmit_after_steps
+                        self._readmit_due[d] = (
+                            self._step_count + self.readmit_after_steps
+                        )
             elif lane == "overflow":
                 geom = {k: int(v) for k, v in rec["geometry"].items()}
                 state = kb.summary_to_state(
@@ -1160,6 +1305,8 @@ class DocBatchEngine:
             oracle_docs=len(self.oracles),
             checkpoint_age_seqs=max(ages, default=0),
             retained_log_msgs=sum(len(h.log) for h in self.hosts),
+            quarantine_flaps=sum(self._flaps.values()),
+            readmits_scheduled=len(self._readmit_due),
         )
         return snap
 

@@ -69,6 +69,11 @@ class _TreeHost:
     base_seq: int = 0
     last_seq: int = 0
     ops_since_ckpt: int = 0
+    # Set by restore_from_checkpoints: tail ops this doc applies are a
+    # boot replay (counted as boot_replay_len in health until the first
+    # post-boot checkpoint ends the boot phase).
+    restored: bool = False
+    boot_counting: bool = False
 
 
 class UnsupportedShape(Exception):
@@ -205,6 +210,8 @@ class TreeBatchEngine:
             return
         h.last_seq = max(h.last_seq, msg.seq)
         h.ops_since_ckpt += 1
+        if h.boot_counting:
+            self.counters.bump("boot_replay_len")
         commit = commit_from_json(c["changes"])
         trunk = h.em.add_sequenced(
             client_id=msg.client_id,
@@ -586,6 +593,7 @@ class TreeBatchEngine:
             self.checkpoint_store.save(self.doc_keys[d], h.last_seq, record)
             h.base_seq = h.last_seq
             h.ops_since_ckpt = 0
+            h.boot_counting = False  # a new durable floor ends the boot phase
             self.counters.bump("checkpoints_written")
             out.append(d)
         return out
@@ -601,6 +609,8 @@ class TreeBatchEngine:
             return []
         restored: list[int] = []
         for d in range(self.n_docs):
+            if self.hosts[d].restored:
+                continue  # already seeded (first restore source wins)
             rec = store.load(self.doc_keys[d])
             if rec is None or rec.get("engine") != "tree_batch":
                 continue
@@ -608,6 +618,8 @@ class TreeBatchEngine:
             h.em = EditManager()
             h.em.load(rec["em"])
             h.base_seq = h.last_seq = int(rec["seq"])
+            h.restored = True
+            h.boot_counting = True
             h.total_commits = int(rec.get("commits", 0))
             forest = Forest()
             forest.load_json(rec["forest"])

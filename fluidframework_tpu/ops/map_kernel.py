@@ -101,3 +101,47 @@ def host_items(s: MapState) -> dict[int, int]:
     present = np.asarray(s.present).astype(bool)
     values = np.asarray(s.values)
     return {int(k): int(values[k]) for k in np.nonzero(present)[0]}
+
+
+# --------------------------------------------------------------------------
+# Summary-record codecs (the DDS-level checkpoint format map fleets were
+# missing — same record shape as the string/tree engines: a JSON summary a
+# cold consumer can boot from, replaying only the post-summary tail)
+# --------------------------------------------------------------------------
+
+def state_to_summary(s: MapState) -> dict:
+    """MapState -> summary JSON: the sparse live slot set (slot, value,
+    seq, present), exact — ``summary_to_state`` reproduces the arrays
+    bit-for-bit.  Interning tables (key slot <-> name) are the channel
+    adapter's to carry alongside (the kernel never sees names)."""
+    values = np.asarray(s.values)
+    present = np.asarray(s.present)
+    val_seq = np.asarray(s.val_seq)
+    live = np.nonzero((present != 0) | (val_seq != 0) | (values != 0))[0]
+    return {
+        "max_keys": int(values.shape[0]),
+        "slots": [
+            [int(k), int(values[k]), int(val_seq[k]), int(present[k])]
+            for k in live
+        ],
+    }
+
+
+def summary_to_state(summary: dict, max_keys: int | None = None) -> MapState:
+    """Summary JSON -> a MapState identical to the one summarized.  Raises
+    ValueError when a recorded slot does not fit ``max_keys`` (callers grow
+    and retry, like the string engine's geometry fitting)."""
+    K = int(max_keys if max_keys is not None else summary["max_keys"])
+    values = np.zeros((K,), np.int32)
+    present = np.zeros((K,), np.int32)
+    val_seq = np.zeros((K,), np.int32)
+    for k, v, seq, pres in summary["slots"]:
+        if not 0 <= k < K:
+            raise ValueError(f"summary slot {k} outside max_keys {K}")
+        values[k], val_seq[k], present[k] = v, seq, pres
+    return MapState(
+        values=jnp.asarray(values),
+        present=jnp.asarray(present),
+        val_seq=jnp.asarray(val_seq),
+        error=jnp.zeros((), I32),
+    )

@@ -223,3 +223,83 @@ def to_grid(s: MatrixState):
         [int(val[rh, ch]) if present[rh, ch] else None for ch in cols]
         for rh in rows
     ]
+
+
+# --------------------------------------------------------------------------
+# Summary-record codecs (the DDS-level checkpoint format matrix fleets were
+# missing — same record shape as the string/tree engines: a JSON summary a
+# cold consumer can boot from, replaying only the post-summary tail)
+# --------------------------------------------------------------------------
+
+def _perm_to_json(perm: mk.DocState) -> dict:
+    """Exact dump of a permutation merge-tree (full arrays: seg layout,
+    stamps, uids, remove slots — a restored perm must resolve every future
+    position identically, including tiebreak/perspective state the
+    canonical summary walk would normalize away)."""
+    out = {}
+    for name, arr in perm._asdict().items():
+        if isinstance(arr, tuple):
+            out[name] = [np.asarray(a).tolist() for a in arr]
+        else:
+            out[name] = np.asarray(arr).tolist()
+    return out
+
+
+def _perm_from_json(d: dict) -> mk.DocState:
+    kw = {}
+    for name, val in d.items():
+        if name in ("rem_keys", "rem_clients", "prop_keys", "prop_vals"):
+            kw[name] = tuple(jnp.asarray(v, I32) for v in val)
+        else:
+            kw[name] = jnp.asarray(val, I32)
+    return mk.DocState(**kw)
+
+
+def state_to_summary(s: MatrixState) -> dict:
+    """MatrixState -> summary JSON: exact perm dumps + the sparse touched
+    cell set + handle counters.  ``summary_to_state`` reproduces the state
+    arrays bit-for-bit (given the same geometry)."""
+    val = np.asarray(s.cell_val)
+    present = np.asarray(s.cell_present)
+    seq = np.asarray(s.cell_seq)
+    client = np.asarray(s.cell_client)
+    touched = np.nonzero((present != 0) | (seq != 0) | (client != -1) | (val != 0))
+    return {
+        "shape": [int(val.shape[0]), int(val.shape[1])],
+        "rows": _perm_to_json(s.rows),
+        "cols": _perm_to_json(s.cols),
+        "next_row_handle": int(s.next_row_handle),
+        "next_col_handle": int(s.next_col_handle),
+        "cells": [
+            [int(r), int(c), int(val[r, c]), int(present[r, c]),
+             int(seq[r, c]), int(client[r, c])]
+            for r, c in zip(*touched)
+        ],
+        "fww": int(s.fww),
+    }
+
+
+def summary_to_state(summary: dict) -> MatrixState:
+    """Summary JSON -> a MatrixState identical to the one summarized."""
+    HR, HC = summary["shape"]
+    cell_val = np.zeros((HR, HC), np.int32)
+    cell_present = np.zeros((HR, HC), np.int32)
+    cell_seq = np.zeros((HR, HC), np.int32)
+    cell_client = np.full((HR, HC), -1, np.int32)
+    for r, c, v, pres, sq, cl in summary["cells"]:
+        if not (0 <= r < HR and 0 <= c < HC):
+            raise ValueError(f"summary cell ({r},{c}) outside shape {HR}x{HC}")
+        cell_val[r, c], cell_present[r, c] = v, pres
+        cell_seq[r, c], cell_client[r, c] = sq, cl
+    return MatrixState(
+        rows=_perm_from_json(summary["rows"]),
+        cols=_perm_from_json(summary["cols"]),
+        next_row_handle=jnp.asarray(summary["next_row_handle"], I32),
+        next_col_handle=jnp.asarray(summary["next_col_handle"], I32),
+        cell_val=jnp.asarray(cell_val),
+        cell_present=jnp.asarray(cell_present),
+        cell_seq=jnp.asarray(cell_seq),
+        cell_client=jnp.asarray(cell_client),
+        fww=jnp.asarray(summary["fww"], I32),
+        error=jnp.zeros((), I32),
+    )

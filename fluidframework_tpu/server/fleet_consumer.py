@@ -31,6 +31,7 @@ class FleetConsumer:
         engine: DocBatchEngine,
         doc_ids: list[str],
         recv_bytes: int = 1 << 16,
+        boot_store=None,
     ) -> None:
         if len(doc_ids) > engine.n_docs:
             raise ValueError(
@@ -38,6 +39,16 @@ class FleetConsumer:
             )
         self.engine = engine
         self.doc_ids = list(doc_ids)
+        self.booted_docs: list[int] = []
+        if boot_store is not None:
+            # Boot-from-summary: seed the engine from the latest acked
+            # scribe commits (or checkpoint records) BEFORE attaching, so
+            # the firehose catch-up replay of the covered prefix is
+            # skipped by seq floor and only the post-ack tail applies
+            # (counted as boot_replay_len in engine health).
+            self.booted_docs = engine.restore_from_checkpoints(
+                store=boot_store
+            )
         self._recv_bytes = recv_bytes
         self._socks: list[socket.socket] = []
         self._tails: list[bytes] = [b"" for _ in doc_ids]
@@ -151,6 +162,7 @@ class FleetConsumer:
             dead_socks=len(self.dead_socks),
             rows_staged=self.rows_staged,
             bytes_consumed=self.bytes_consumed,
+            booted_docs=len(self.booted_docs),
         )
         return out
 

@@ -41,9 +41,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--checkpoint-every", type=int, default=256,
                    help="ops per doc between durable checkpoints "
                         "(with --checkpoint-dir)")
+    p.add_argument("--scribe-dir", default=None,
+                   help="a scribe service directory (server/scribe.py): "
+                        "boot each doc from its latest ACKED summary commit "
+                        "instead of replaying full history")
     p.add_argument("--watchdog-every", type=int, default=0,
                    help="engine steps between divergence-watchdog sweeps "
                         "(0 disables)")
+    p.add_argument("--readmit-after-steps", type=int, default=0,
+                   help="auto-readmit quarantined docs after this many "
+                        "engine steps (backoff-doubled per flap; 0 = manual)")
+    p.add_argument("--poison-budget", type=int, default=0,
+                   help="quarantine flaps before a doc is permanently "
+                        "oracle-routed (0 = unlimited)")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu); overrides the "
                         "image default and the FFTPU_PLATFORM env var")
@@ -82,6 +92,8 @@ def main(argv: list[str] | None = None) -> int:
         checkpoint_every=args.checkpoint_every if store is not None else 0,
         doc_keys=doc_ids,
         watchdog_every=args.watchdog_every,
+        readmit_after_steps=args.readmit_after_steps,
+        poison_budget=args.poison_budget,
     )
     if store is not None:
         # Restart path: restore durable checkpoints BEFORE consuming, so
@@ -93,7 +105,21 @@ def main(argv: list[str] | None = None) -> int:
                 "restored": [doc_ids[d] for d in restored],
                 "health": eng.health(),
             }), flush=True)
-    fc = FleetConsumer(args.host, args.port, eng, doc_ids)
+    boot_store = None
+    if args.scribe_dir is not None:
+        # Boot-from-summary: cold docs (no local checkpoint) seed from the
+        # scribe's latest ACKED commits, so catch-up replays only the
+        # post-ack tail instead of full history.
+        from .scribe import SummaryRecordStore
+
+        boot_store = SummaryRecordStore.open(args.scribe_dir)
+    fc = FleetConsumer(args.host, args.port, eng, doc_ids,
+                       boot_store=boot_store)
+    if fc.booted_docs:
+        print(json.dumps({
+            "bootedFromSummary": [doc_ids[d] for d in fc.booted_docs],
+            "health": eng.health(),
+        }), flush=True)
 
     def status(**extra) -> None:
         errs = eng.errors()

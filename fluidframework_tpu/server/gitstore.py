@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 from typing import Any
 
 
@@ -35,16 +36,62 @@ def _canon(obj: Any) -> bytes:
 
 
 class GitStore:
-    """One content-addressed object store (may back many documents)."""
+    """One content-addressed object store (may back many documents).
 
-    def __init__(self) -> None:
+    With ``directory`` the store is durable: every new object appends one
+    JSONL line to ``objects.jsonl`` (content-addressed objects are
+    immutable, so an append-only log IS the store; a torn trailing line
+    from a crash drops harmlessly — the object was never referenced by a
+    durable ref).  Reopening replays the log."""
+
+    def __init__(self, directory: str | None = None, readonly: bool = False) -> None:
         self._objects: dict[str, tuple[str, Any]] = {}  # sha -> (kind, payload)
         self.writes = 0       # put calls
         self.stored = 0       # objects actually created
         self.bytes_stored = 0
+        self.loaded = 0       # objects replayed from the durable log
+        self.readonly = readonly
+        self._file = None
+        if directory is not None:
+            path = os.path.join(directory, "objects.jsonl")
+            if not readonly:
+                os.makedirs(directory, exist_ok=True)
+            if os.path.exists(path):
+                good_bytes = 0
+                with open(path, "rb") as f:
+                    raw_lines = f.read().split(b"\n")
+                for i, raw in enumerate(raw_lines):
+                    try:
+                        sha, kind, payload = json.loads(raw) if raw.strip() else (
+                            None, None, None
+                        )
+                    except (json.JSONDecodeError, ValueError):
+                        if i == len(raw_lines) - 1:
+                            # Torn trailing write: keep the good prefix AND
+                            # truncate the tear away — appending after it
+                            # would fuse two records into one garbage line
+                            # and silently drop every later object on the
+                            # NEXT reopen (same repair as DurablePartition).
+                            break
+                        # Interior corruption is NOT a crash artifact:
+                        # truncating here would destroy every later object
+                        # (possibly the only copy of compacted-away state).
+                        # Surface it instead.
+                        raise
+                    if sha is not None:
+                        self._objects[sha] = (kind, payload)
+                        self.loaded += 1
+                    good_bytes += len(raw) + 1
+                if not readonly:
+                    with open(path, "r+b") as f:
+                        f.truncate(min(good_bytes, os.path.getsize(path)))
+            if not readonly:
+                self._file = open(path, "a")
 
     # ------------------------------------------------------------- primitives
     def _put(self, kind: str, payload: Any) -> str:
+        if self.readonly:
+            raise RuntimeError("read-only GitStore: writes not permitted")
         raw = _canon([kind, payload])
         sha = hashlib.sha256(raw).hexdigest()
         self.writes += 1
@@ -56,7 +103,27 @@ class GitStore:
             self._objects[sha] = (kind, json.loads(raw.decode())[1])
             self.stored += 1
             self.bytes_stored += len(raw)
+            if self._file is not None:
+                self._file.write(
+                    json.dumps([sha, kind, self._objects[sha][1]]) + "\n"
+                )
+                self._file.flush()
         return sha
+
+    def sync(self) -> None:
+        """Force the object log to disk (flush + fsync).  Callers invoke
+        this before externalizing a commit sha (ack records, refs): once a
+        sha is referenced durably, the objects behind it must not be
+        sitting in the page cache when compaction destroys the op log they
+        summarize."""
+        if self._file is not None:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.close()
+            self._file = None
 
     def put_blob(self, content: Any) -> str:
         return self._put("blob", content)
@@ -127,10 +194,22 @@ class GitSnapshotStore:
 
     def save(self, seq: int, plain: dict) -> str:
         root = self.store.write_snapshot(plain)
+        return self.save_root(seq, root)
+
+    def save_root(self, seq: int, root_sha: str) -> str:
+        """Commit a PRE-BUILT root tree (the scribe's handle-reuse path:
+        unchanged channels keep their previous sha without re-walking)."""
         parent = self.versions[-1][1] if self.versions else None
-        commit = self.store.put_commit(root, seq, parent)
+        commit = self.store.put_commit(root_sha, seq, parent)
         self.versions.append((seq, commit))
         return commit
+
+    def adopt_version(self, seq: int, commit_sha: str) -> None:
+        """Re-attach a version minted by a previous incarnation (scribe
+        restart: refs reload from disk, objects from the durable log)."""
+        if commit_sha not in self.store:
+            raise KeyError(f"unknown commit {commit_sha[:12]}")
+        self.versions.append((seq, commit_sha))
 
     def read_commit(self, commit_sha: str) -> tuple[int, dict]:
         kind, payload = self.store.get(commit_sha)

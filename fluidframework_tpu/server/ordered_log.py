@@ -48,18 +48,39 @@ class LogRecord:
 class Partition:
     def __init__(self) -> None:
         self.records: list[LogRecord] = []
+        # Truncation floor: offsets below ``base`` have been compacted away
+        # (their content lives in acked summaries).  Offsets stay absolute —
+        # record N keeps offset N forever — only storage is reclaimed.
+        self.base = 0
+        self.records_reclaimed = 0
 
     def append(self, doc_id: str, payload: Any) -> int:
-        off = len(self.records)
+        off = self.base + len(self.records)
         self.records.append(LogRecord(offset=off, doc_id=doc_id, payload=payload))
         return off
 
     def read(self, from_offset: int, max_records: int = 1 << 30) -> list[LogRecord]:
-        return self.records[from_offset : from_offset + max_records]
+        # Clamp to the floor: records below it are gone (compacted); a
+        # consumer resuming from an old offset starts at the floor instead
+        # of slicing garbage (see ConsumerGroup.consume for the telemetry).
+        i = max(from_offset - self.base, 0)
+        return self.records[i : i + max_records]
+
+    def truncate_below(self, offset: int) -> int:
+        """Reclaim every record with offset < ``offset`` (clamped to the
+        head); returns the number of records reclaimed.  Offsets of the
+        surviving records are unchanged."""
+        cut = min(max(offset, self.base), self.head) - self.base
+        if cut <= 0:
+            return 0
+        del self.records[:cut]
+        self.base += cut
+        self.records_reclaimed += cut
+        return cut
 
     @property
     def head(self) -> int:
-        return len(self.records)
+        return self.base + len(self.records)
 
 
 @dataclass
@@ -110,6 +131,7 @@ class DurablePartition(Partition):
         self._path = path
         self._encode = encode
         self._decode = decode
+        self.bytes_reclaimed = 0
         if os.path.exists(path):
             good_bytes = 0
             with open(path, "rb") as f:
@@ -128,7 +150,13 @@ class DurablePartition(Partition):
                         # exists for.
                         break
                     raise
-                super().append(rec["doc"], decode(rec["payload"]))
+                if "base" in rec and "doc" not in rec:
+                    # Compaction header (always the first line after a
+                    # truncate_below rewrite): offsets resume above the
+                    # reclaimed prefix.
+                    self.base = int(rec["base"])
+                else:
+                    super().append(rec["doc"], decode(rec["payload"]))
                 good_bytes += len(raw) + 1
             with open(path, "r+b") as f:
                 f.truncate(min(good_bytes, os.path.getsize(path)))
@@ -141,6 +169,34 @@ class DurablePartition(Partition):
         )
         self._file.flush()
         return off
+
+    def truncate_below(self, offset: int) -> int:
+        """Reclaim records below ``offset`` AND rewrite the segment file
+        without them (write-fsync-rename, like every other recovery file):
+        a crash mid-compaction leaves the previous full segment intact.
+        The surviving file leads with a ``{"base": N}`` header so a reopen
+        resumes at the right offsets."""
+        before = os.path.getsize(self._path) if os.path.exists(self._path) else 0
+        cut = super().truncate_below(offset)
+        if cut == 0:
+            return 0
+        self._file.close()
+        tmp = self._path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps({"base": self.base}) + "\n")
+            for rec in self.records:
+                f.write(
+                    json.dumps(
+                        {"doc": rec.doc_id, "payload": self._encode(rec.payload)}
+                    )
+                    + "\n"
+                )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+        self._file = open(self._path, "a")
+        self.bytes_reclaimed += max(before - os.path.getsize(self._path), 0)
+        return cut
 
     def close(self) -> None:
         self._file.close()
@@ -203,6 +259,11 @@ class ConsumerGroup:
         self.members: list[str] = []
         self.generation = 0  # bumps on every rebalance
         self._offsets: dict[int, int] = {}
+        # Records a resuming consumer could not read because compaction
+        # already reclaimed them (committed offset below the truncated
+        # floor): counted, never raised — the content lives in an acked
+        # summary, so resuming at the floor is the correct recovery.
+        self.truncated_records_skipped = 0
         self._path = (
             os.path.join(directory, f"offsets-{group_id}.json")
             if directory is not None
@@ -235,7 +296,12 @@ class ConsumerGroup:
 
     # --------------------------------------------------------------- offsets
     def committed(self, partition: int) -> int:
-        return self._offsets.get(partition, 0)
+        """The group's resume offset: never below the partition's truncated
+        floor — an offset pointing into a reclaimed prefix resumes at the
+        floor (the skipped records are already folded into acked summaries;
+        ``consume`` counts them)."""
+        stored = self._offsets.get(partition, 0)
+        return max(stored, self.topic.partition(partition).base)
 
     def commit(self, partition: int, offset: int) -> None:
         self._offsets[partition] = offset
@@ -250,7 +316,15 @@ class ConsumerGroup:
         at-least-once)."""
         out: list[tuple[int, LogRecord]] = []
         for p in self.assignments(member_id):
-            for rec in self.topic.partition(p).read(self.committed(p), max_records):
+            part = self.topic.partition(p)
+            stored = self._offsets.get(p, 0)
+            if stored < part.base:
+                # Resume-below-floor: count the gap once and adopt the
+                # floor as the committed position (the records are gone;
+                # re-reporting the same gap every pump would lie).
+                self.truncated_records_skipped += part.base - stored
+                self.commit(p, part.base)
+            for rec in part.read(self.committed(p), max_records):
                 out.append((p, rec))
         return out
 

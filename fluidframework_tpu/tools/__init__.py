@@ -1,4 +1,5 @@
-"""Tooling (SURVEY §2 layer 10): replay tool over the replay driver."""
+"""Tooling (SURVEY §2 layer 10): replay tool over the replay driver +
+summary-inspect CLI over the scribe's acked commits."""
 
 from .replay_tool import ReplayTool
 

@@ -308,6 +308,55 @@ def test_stale_handle_retry_still_gets_nacked():
     assert types == [MessageType.SUMMARY_ACK, MessageType.SUMMARY_NACK]
 
 
+# ----------------------------------------------------------- log compaction
+
+def test_durable_partition_truncate_below_persists(tmp_path):
+    """truncate_below reclaims the prefix, keeps offsets absolute, writes
+    the floor header atomically, and survives reopen + further appends."""
+    import os
+
+    t = DurableTopic("raw", 1, str(tmp_path))
+    for i in range(8):
+        t.produce("doc", {"i": i})
+    part = t.partition(0)
+    size_before = os.path.getsize(os.path.join(str(tmp_path), "raw", "p0.jsonl"))
+    assert part.truncate_below(5) == 5
+    assert part.base == 5 and part.head == 8
+    assert part.bytes_reclaimed > 0 and part.bytes_reclaimed < size_before
+    # Reads below the floor clamp to it; offsets stay absolute.
+    assert [r.offset for r in part.read(0)] == [5, 6, 7]
+    assert [r.payload["i"] for r in part.read(6)] == [6, 7]
+    # Idempotent / clamped.
+    assert part.truncate_below(3) == 0
+    assert part.truncate_below(100) == 3 and part.head == part.base == 8
+    t.produce("doc", {"i": 8})
+    t.close()
+
+    t2 = DurableTopic("raw", 1, str(tmp_path))
+    p2 = t2.partition(0)
+    assert p2.base == 8 and p2.head == 9
+    assert [r.payload["i"] for r in p2.read(0)] == [8]
+    t2.close()
+
+
+def test_consumer_group_tolerates_offsets_below_floor():
+    """A committed offset stranded below a truncated floor resumes at the
+    floor (skips counted in telemetry) instead of misreading or raising."""
+    topic = Topic("t", 1)
+    for i in range(10):
+        topic.produce("doc", {"i": i})
+    g = ConsumerGroup(topic, "g1")
+    g.join("m1")
+    g.commit(0, 2)
+    topic.partition(0).truncate_below(6)
+    assert g.committed(0) == 6
+    recs = g.consume("m1")
+    assert [r.payload["i"] for _p, r in recs] == [6, 7, 8, 9]
+    assert g.truncated_records_skipped == 4
+    g.consume("m1")
+    assert g.truncated_records_skipped == 4  # counted once, not per pump
+
+
 # ------------------------------------------------------ stateless multi-front
 
 def test_two_front_pairs_share_one_core():

@@ -197,6 +197,87 @@ def test_watchdog_quarantines_diverged_doc():
     assert eng.text(0) == "ahello"  # oracle state, corruption discarded
 
 
+# ----------------------------------------------- readmission policy / budget
+
+def test_auto_readmit_after_backoff():
+    """A quarantined doc re-enters the lockstep batch automatically after
+    the backoff window — no operator readmit() call."""
+    eng = _mk_engine(2, readmit_after_steps=2)
+    for d in range(2):
+        eng.ingest(d, _join("w0", 0))
+        eng.ingest(d, _ins(1, 0, "hi"))
+    eng.step()
+    eng.ingest(0, _ins(2, 10**6, "XX"))  # poison
+    eng.step()
+    assert 0 in eng.quarantine
+    assert eng.health()["readmits_scheduled"] == 1
+    for s in range(3, 8):  # idle-ish steps advance the readmit clock
+        eng.ingest(1, _ins(s, 0, "a"))
+        eng.step()
+        if 0 not in eng.quarantine:
+            break
+    h = eng.health()
+    assert 0 not in eng.quarantine and h["auto_readmissions"] == 1
+    assert h["quarantine_flaps"] == 1 and h["readmits_scheduled"] == 0
+    # The readmitted doc keeps applying on the device path.
+    eng.ingest(0, _ins(3, 0, "ok"))
+    eng.step()
+    assert eng.text(0).startswith("ok")
+    assert not eng.errors().any()
+
+
+def test_poison_budget_routes_flapping_doc_to_oracle():
+    """A doc that keeps getting re-poisoned after clean readmissions burns
+    its poison budget and is permanently oracle-routed (still serviceable,
+    never auto-readmitted again)."""
+    eng = _mk_engine(1, readmit_after_steps=1, poison_budget=2)
+    eng.ingest(0, _join("w0", 0))
+    eng.ingest(0, _ins(1, 0, "hi"))
+    eng.step()
+    seq = 2
+    for _flap in range(4):
+        eng.ingest(0, _ins(seq, 10**6, "XX"))
+        seq += 1
+        eng.step()
+        for _ in range(6):
+            eng.step()
+            if 0 not in eng.quarantine:
+                break
+        if 0 in eng.oracles:
+            break
+    h = eng.health()
+    assert 0 in eng.oracles and 0 not in eng.quarantine
+    assert h["poison_routed_docs"] == 1 and h["quarantine_flaps"] == 3
+    # Still serviceable through the oracle lane.
+    eng.ingest(0, _ins(seq, 0, "zz"))
+    assert eng.text(0).startswith("zz")
+
+
+def test_watchdog_digest_prefilter_skips_unchanged_docs():
+    """The device-side text-pool digest gates the host-replay watchdog: an
+    idle doc verified once is skipped until its digest drifts, while real
+    divergence (bit-rot) still quarantines."""
+    eng = _mk_engine(2, watchdog_every=1)
+    for d in range(2):
+        eng.ingest(d, _join("w0", 0))
+        eng.ingest(d, _ins(1, 0, "hello"))
+    eng.step()  # both docs verified, digests pinned
+    checks0 = eng.health()["watchdog_checks"]
+    eng.ingest(0, _ins(2, 0, "a"))  # only doc 0 moves
+    eng.step()
+    h = eng.health()
+    assert h["watchdog_prefiltered"] >= 1  # doc 1 skipped, digest unmoved
+    assert h["watchdog_checks"] == checks0 + 1
+    # Divergence still caught: corrupt doc 0's pool behind the engine.
+    bad = eng.state.text.at[0, 0].set(ord("X"))
+    eng.state = eng.state._replace(text=bad)
+    eng.ingest(0, _ins(3, 0, "b"))
+    eng.ingest(1, _ins(2, 5, "!"))
+    eng.step()
+    assert 0 in eng.quarantine
+    assert eng.health()["watchdog_mismatches"] == 1
+
+
 # ------------------------------------------------------------ crash/restart
 
 def test_engine_restart_restores_from_durable_checkpoint():

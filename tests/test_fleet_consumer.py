@@ -156,6 +156,62 @@ def test_fleet_main_entry_cross_process(server):
     assert status["texts"]["dm"] == "compose"
 
 
+def test_fleet_consumer_boots_from_scribe_summary(server, tmp_path):
+    """Boot-from-summary through the REAL wire path: a scribe summarizes
+    and acks the doc's sequenced prefix; a cold FleetConsumer seeds its
+    engine from the acked commit, consumes the firehose from offset 0, and
+    converges byte-identically — replaying only the post-ack tail."""
+    from fluidframework_tpu.server.ordered_log import Topic
+    from fluidframework_tpu.server.scribe import (
+        ScribeConfig,
+        ScribeLambda,
+        SummaryRecordStore,
+    )
+
+    writers = _writers(server, "db", 2)
+    a, b = writers
+    a.insert_text(0, "hello scribe")
+    _flush(server, "db", writers)
+    b.remove_range(0, 6)
+    _flush(server, "db", writers)
+
+    # The scribe consumes the same total order (here: mirrored from the
+    # doc's sequencer log into an op topic) and acks the prefix.
+    topic = Topic("deltas", 1)
+    with server.lock:
+        for m in server.service.document("db").sequencer.log:
+            topic.produce("db", m)
+    scribe = ScribeLambda(topic, str(tmp_path / "scribe"),
+                          config=ScribeConfig(max_ops=1))
+    scribe.pump()
+    acked_seq = scribe.refs["db"]["seq"]
+    assert scribe.health()["summaries_written"] >= 1
+
+    # Post-ack tail lands after the summary was acked.
+    a.insert_text(len(a.text), "!")
+    tail_rows = _flush(server, "db", writers)
+
+    eng = DocBatchEngine(1, max_segments=256, text_capacity=4096,
+                         max_insert_len=16, ops_per_step=8, use_mesh=False,
+                         doc_keys=["db"])
+    fc = FleetConsumer("127.0.0.1", server.port, eng, ["db"],
+                       boot_store=SummaryRecordStore.from_scribe(scribe))
+    try:
+        assert fc.booted_docs == [0]
+        assert eng.text(0) == "scribe"  # summary state alone, pre-catch-up
+        fc.run_for(tail_rows)  # catch-up replays all; only the tail stages
+        assert eng.text(0) == a.text == "scribe!"
+        h = fc.health()
+        assert h["checkpointed_ops_skipped"] > 0, "prefix not skipped"
+        assert h["boot_replay_len"] == tail_rows
+        assert h["booted_docs"] == 1
+        assert eng.hosts[0].base_seq == acked_seq
+        assert not eng.errors().any()
+    finally:
+        fc.close()
+        scribe.close()
+
+
 def test_fleet_consumer_reports_dead_sockets_on_shard_close():
     """The shard closing the firehose must surface as dead_socks (the
     supervisor-restart signal), never as a silent healthy-looking idle.

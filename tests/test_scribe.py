@@ -1,0 +1,488 @@
+"""Scribe service: summarize -> ack -> boot-from-summary -> compaction.
+
+Pins the tentpole contract of server/scribe.py over server/ordered_log.py
+and server/gitstore.py:
+
+- a cold consumer booting from the latest ACKED summary commit plus the
+  post-ack tail reaches byte-identical state to a full-history replay, for
+  all four engine families (string / tree / map / matrix);
+- log compaction never truncates past the minimum acked/committed offset
+  across the consumer group, and a consumer whose committed offset falls
+  below the truncated floor resumes at the floor (counted, not raised);
+- a scribe crash/restart (even with lost consumer offsets) replays its own
+  acks from the ordered log and never double-acks a summary it already
+  produced.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.protocol.messages import (
+    DeltaType,
+    MessageType,
+    SequencedMessage,
+)
+from fluidframework_tpu.runtime.summary import parse_scribe_ack
+from fluidframework_tpu.server.ordered_log import ConsumerGroup, DurableTopic, Topic
+from fluidframework_tpu.server.scribe import (
+    ScribeConfig,
+    ScribeLambda,
+    SummaryRecordStore,
+    detect_family,
+)
+
+
+# ------------------------------------------------------------------ helpers
+
+def _join(doc, topic, client="w0", short=0):
+    topic.produce(doc, SequencedMessage(
+        seq=0, min_seq=0, ref_seq=0, client_id=client, client_seq=0,
+        type=MessageType.JOIN, contents={"clientId": client, "short": short},
+    ))
+
+
+def _op(doc, topic, seq, contents, client="w0", ref=0, min_seq=0):
+    msg = SequencedMessage(
+        seq=seq, min_seq=min_seq, ref_seq=ref, client_id=client,
+        client_seq=seq, type=MessageType.OP, contents=contents,
+    )
+    topic.produce(doc, msg)
+    return msg
+
+
+def _durable_topic(tmp_path, n_partitions=1):
+    return DurableTopic(
+        "deltas", n_partitions, str(tmp_path / "log"),
+        encode=lambda m: m.to_json(), decode=SequencedMessage.from_json,
+    )
+
+
+def _string_stream(doc, topic, seqs, seed=0):
+    """Deterministic single-writer string edits (valid in own perspective)."""
+    rng = np.random.default_rng(seed)
+    length = 0
+    out = []
+    for s in seqs:
+        if length >= 4 and rng.random() < 0.3:
+            p = int(rng.integers(0, length - 1))
+            out.append(_op(doc, topic, s, {"type": 1, "pos1": p, "pos2": p + 1}))
+            length -= 1
+        else:
+            p = int(rng.integers(0, length + 1))
+            out.append(_op(doc, topic, s, {"type": 0, "pos1": p, "seg": "ab"}))
+            length += 2
+    return out
+
+
+def _acks_for(topic, doc):
+    out = []
+    for p in range(topic.n_partitions):
+        for rec in topic.partition(p).read(0):
+            ack = parse_scribe_ack(rec.payload)
+            if ack is not None and ack[0] == doc:
+                out.append(ack)
+    return out
+
+
+# --------------------------------------------------- boot-from-summary: string
+
+def test_boot_from_summary_string_byte_identity(tmp_path):
+    from fluidframework_tpu.models.doc_batch_engine import DocBatchEngine
+
+    topic = _durable_topic(tmp_path)
+    _join("d0", topic)
+    msgs = list(_string_stream("d0", topic, range(1, 25)))
+    scribe = ScribeLambda(topic, str(tmp_path / "scribe"),
+                          config=ScribeConfig(max_ops=10))
+    scribe.pump()
+    assert scribe.health()["summaries_written"] >= 1
+    # The ack rides the ordered log, after the ops it covers.
+    (doc, seq, commit), = _acks_for(topic, "d0")[-1:]
+    assert doc == "d0" and seq == 24 and commit in scribe.store
+    # Post-ack tail.
+    msgs += _string_stream("d0", topic, range(25, 31), seed=9)
+    all_msgs = [m for m in msgs]
+
+    def feed(eng):
+        eng.ingest(0, SequencedMessage(
+            seq=0, min_seq=0, ref_seq=0, client_id="w0", client_seq=0,
+            type=MessageType.JOIN, contents={"clientId": "w0", "short": 0}))
+        for m in all_msgs:
+            eng.ingest(0, m)
+        eng.step()
+
+    full = DocBatchEngine(1, max_insert_len=8, ops_per_step=4, use_mesh=False,
+                          doc_keys=["d0"])
+    feed(full)
+
+    boot = DocBatchEngine(1, max_insert_len=8, ops_per_step=4, use_mesh=False,
+                          doc_keys=["d0"])
+    store = SummaryRecordStore.from_scribe(scribe)
+    assert boot.restore_from_checkpoints(store=store) == [0]
+    feed(boot)  # full stream from offset 0: covered prefix must skip
+
+    assert boot.text(0) == full.text(0)
+    assert boot.annotations(0) == full.annotations(0)
+    h = boot.health()
+    assert h["checkpointed_ops_skipped"] == 24  # the acked prefix
+    assert h["boot_replay_len"] == 6            # only the post-ack tail
+    assert not boot.errors().any()
+    topic.close()
+    scribe.close()
+
+
+# ----------------------------------------------------- boot-from-summary: tree
+
+def test_boot_from_summary_tree_byte_identity(tmp_path):
+    from test_tree_batch_engine import drive_tree_docs
+
+    from fluidframework_tpu.models.tree_batch_engine import TreeBatchEngine
+
+    svc, expected = drive_tree_docs(2, seed=4, steps=16)
+    topic = Topic("deltas", 1)
+    streams = {d: list(svc.document(f"doc{d}").sequencer.log) for d in range(2)}
+    # Ship a PREFIX through the scribe; the rest is the post-ack tail.
+    cut = {d: (2 * len(streams[d])) // 3 for d in streams}
+    for d, msgs in streams.items():
+        for m in msgs[: cut[d]]:
+            topic.produce(f"doc{d}", m)
+    scribe = ScribeLambda(topic, str(tmp_path / "scribe"),
+                          config=ScribeConfig(max_ops=4))
+    scribe.pump()
+    assert scribe.health()["summaries_written"] >= 2
+    for d, msgs in streams.items():
+        for m in msgs[cut[d]:]:
+            topic.produce(f"doc{d}", m)
+
+    full = TreeBatchEngine(2, doc_keys=["doc0", "doc1"])
+    for d, msgs in streams.items():
+        for m in msgs:
+            full.ingest(d, m)
+    full.step()
+
+    boot = TreeBatchEngine(2, doc_keys=["doc0", "doc1"])
+    restored = boot.restore_from_checkpoints(
+        store=SummaryRecordStore.from_scribe(scribe)
+    )
+    assert restored == [0, 1]
+    boot.step()  # apply the re-materialization rows
+    for d, msgs in streams.items():  # full stream: covered prefix skips
+        for m in msgs:
+            boot.ingest(d, m)
+    boot.step()
+    for d in range(2):
+        assert boot.values(d) == full.values(d) == expected[d], f"doc {d}"
+    h = boot.health()
+    assert h["checkpointed_ops_skipped"] > 0 and h["boot_replay_len"] > 0
+    scribe.close()
+
+
+# ----------------------------------------------- boot-from-summary: map/matrix
+
+def test_boot_from_summary_map_and_matrix_byte_identity(tmp_path):
+    import jax
+
+    from fluidframework_tpu.server.scribe import _MapDocScribe, _MatrixDocScribe
+
+    topic = _durable_topic(tmp_path)
+    rng = np.random.default_rng(1)
+    map_msgs, mx_msgs = [], []
+    # Map traffic: sets/deletes/clears over a small key space.
+    for s in range(1, 31):
+        r = rng.random()
+        if r < 0.7:
+            c = {"type": "set", "key": f"k{int(rng.integers(6))}",
+                 "value": int(rng.integers(100))}
+        elif r < 0.9:
+            c = {"type": "delete", "key": f"k{int(rng.integers(6))}"}
+        else:
+            c = {"type": "clear"}
+        map_msgs.append(_op("dmap", topic, s, c))
+    # Matrix traffic: structure from one writer, then a cell storm.
+    _join("dmx", topic)
+    mx_msgs.append(_op("dmx", topic, 1, {"type": "insertRows", "pos": 0, "count": 4}))
+    mx_msgs.append(_op("dmx", topic, 2, {"type": "insertCols", "pos": 0, "count": 4},
+                       ref=1))
+    for s in range(3, 27):
+        mx_msgs.append(_op("dmx", topic, s, {
+            "type": "set", "row": int(rng.integers(4)),
+            "col": int(rng.integers(4)), "value": int(rng.integers(50)),
+        }, ref=2))
+
+    scribe = ScribeLambda(topic, str(tmp_path / "scribe"),
+                          config=ScribeConfig(max_ops=12, map_max_keys=16,
+                                              matrix_shape=(8, 8),
+                                              matrix_segments=16))
+    scribe.pump()
+    store = SummaryRecordStore.from_scribe(scribe)
+    rec_map, rec_mx = store.load("dmap"), store.load("dmx")
+    assert rec_map["engine"] == "map_batch" and rec_mx["engine"] == "matrix_batch"
+    assert store.family("dmap") == "map_batch"
+
+    # Post-ack tails.
+    for s in range(31, 37):
+        map_msgs.append(_op("dmap", topic, s, {
+            "type": "set", "key": f"k{int(rng.integers(6))}",
+            "value": int(rng.integers(100))}))
+    for s in range(27, 33):
+        mx_msgs.append(_op("dmx", topic, s, {
+            "type": "set", "row": int(rng.integers(4)),
+            "col": int(rng.integers(4)), "value": int(rng.integers(50)),
+        }, ref=2))
+
+    # Full replay vs boot-from-summary + tail, byte-identical state arrays.
+    full_map = _MapDocScribe(max_keys=16)
+    for m in map_msgs:
+        full_map.apply(m)
+    full_map.flush()
+    boot_map = _MapDocScribe(max_keys=16)
+    boot_map.load(rec_map["seq"], rec_map)
+    for m in map_msgs:
+        boot_map.apply(m)  # covered prefix skips by seq floor
+    boot_map.flush()
+    for a, b in zip(jax.tree.leaves(full_map.state), jax.tree.leaves(boot_map.state)):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    assert full_map.items() == boot_map.items()
+
+    full_mx = _MatrixDocScribe(shape=(8, 8), segments=16)
+    full_mx.quorum = {"w0": 0}
+    for m in mx_msgs:
+        full_mx.apply(m)
+    full_mx.flush()
+    boot_mx = _MatrixDocScribe(shape=(8, 8), segments=16)
+    boot_mx.load(rec_mx["seq"], rec_mx)
+    for m in mx_msgs:
+        boot_mx.apply(m)
+    boot_mx.flush()
+    for a, b in zip(jax.tree.leaves(full_mx.state), jax.tree.leaves(boot_mx.state)):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    assert full_mx.grid() == boot_mx.grid()
+    assert not int(full_mx.state.error) and not int(boot_mx.state.error)
+    topic.close()
+    scribe.close()
+
+
+# ------------------------------------------------------------------ compaction
+
+def test_compaction_never_passes_min_acked_or_committed(tmp_path):
+    topic = _durable_topic(tmp_path)
+    _join("d0", topic)
+    _string_stream("d0", topic, range(1, 31))
+    scribe = ScribeLambda(topic, str(tmp_path / "scribe"),
+                          config=ScribeConfig(max_ops=10))
+    scribe.pump()
+
+    # A fleet consumer group lagging mid-log pins the floor.
+    fleet = ConsumerGroup(topic, "fleet", str(tmp_path / "scribe"))
+    fleet.join("f0")
+    recs = fleet.consume("f0")
+    lag_at = recs[14][1].offset + 1
+    fleet.commit(0, lag_at)
+
+    stats = scribe.compact(extra_groups=(fleet,))
+    part = topic.partition(0)
+    assert part.base <= min(lag_at, scribe.refs["d0"]["offset"])
+    assert part.base == min(lag_at, scribe.refs["d0"]["offset"])
+    assert stats["records"] == part.base and stats["bytes"] > 0
+
+    # The lagging consumer resumes exactly where it committed: no skips,
+    # no divergence, offsets still absolute.
+    tail = fleet.consume("f0")
+    assert fleet.truncated_records_skipped == 0
+    assert [r.offset for _p, r in tail] == list(range(lag_at, part.head))
+
+    # As the group catches up + the scribe acks more, the floor advances
+    # under sustained traffic — disk stays bounded.
+    for _p, r in tail:
+        fleet.commit(0, r.offset + 1)
+    _string_stream("d0", topic, range(31, 61), seed=7)
+    scribe.pump()
+    for p, r in fleet.consume("f0"):
+        fleet.commit(p, r.offset + 1)
+    base_before = part.base
+    scribe.compact(extra_groups=(fleet,))
+    assert part.base > base_before
+    assert scribe.health()["log_bytes_reclaimed"] > 0
+
+    # Durability: reopening the topic preserves the floor and the tail.
+    topic.close()
+    topic2 = _durable_topic(tmp_path)
+    topic2.open_all()
+    p2 = topic2.partition(0)
+    assert p2.base == part.base and p2.head == part.head
+    assert [r.offset for r in p2.read(0)] == list(range(p2.base, p2.head))
+    topic2.close()
+    scribe.close()
+
+
+def test_consumer_below_floor_resumes_at_floor_with_telemetry(tmp_path):
+    topic = _durable_topic(tmp_path)
+    _join("d0", topic)
+    _string_stream("d0", topic, range(1, 21))
+    scribe = ScribeLambda(topic, str(tmp_path / "scribe"),
+                          config=ScribeConfig(max_ops=5))
+    scribe.pump()
+    scribe.compact()  # only the scribe group: floor = its acked offset
+    part = topic.partition(0)
+    assert part.base > 0
+
+    # A group that was NOT part of the retention policy (committed offset
+    # 0, below the floor) must resume at the floor and count the gap.
+    late = ConsumerGroup(topic, "late-fleet")
+    late.join("m0")
+    assert late.committed(0) == part.base
+    recs = late.consume("m0")
+    assert late.truncated_records_skipped == part.base
+    assert [r.offset for _p, r in recs] == list(range(part.base, part.head))
+    # Counted once, not per pump.
+    late.consume("m0")
+    assert late.truncated_records_skipped == part.base
+    topic.close()
+    scribe.close()
+
+
+# ------------------------------------------------------------- crash/restart
+
+def test_scribe_restart_never_double_acks(tmp_path):
+    topic = _durable_topic(tmp_path)
+    _join("d0", topic)
+    _string_stream("d0", topic, range(1, 25))
+    sdir = str(tmp_path / "scribe")
+    scribe = ScribeLambda(topic, sdir, config=ScribeConfig(max_ops=10))
+    scribe.pump()
+    assert len(_acks_for(topic, "d0")) == 1
+    refs_before = dict(scribe.refs)
+    scribe.close()
+
+    # Crash that LOSES the committed consumer offsets (the worst case:
+    # the ack reached the log but the offset commit did not).
+    os.remove(os.path.join(sdir, "offsets-scribe.json"))
+    scribe2 = ScribeLambda(topic, sdir, config=ScribeConfig(max_ops=10))
+    assert scribe2.health()["docs_restored"] == 1
+    scribe2.pump()  # replays the full log INCLUDING its own ack
+    # No duplicate ack, no second summary, refs unchanged.
+    assert len(_acks_for(topic, "d0")) == 1
+    assert scribe2.health().get("summaries_written", 0) == 0
+    assert scribe2.refs["d0"]["commit"] == refs_before["d0"]["commit"]
+
+    # New traffic after the restart summarizes normally (exactly one new
+    # ack) and the chain links to the pre-crash commit.
+    _string_stream("d0", topic, range(25, 41), seed=3)
+    scribe2.pump()
+    acks = _acks_for(topic, "d0")
+    assert len(acks) == 2 and acks[-1][1] == 40
+    _k, payload = scribe2.store.get(acks[-1][2])
+    assert payload["parent"] == refs_before["d0"]["commit"]
+    # Handle reuse: the quorum channel was untouched between the commits.
+    assert scribe2.health()["summary_handles_reused"] >= 1
+    topic.close()
+    scribe2.close()
+
+
+def test_scribe_crash_cannot_lose_folded_unsummarized_ops(tmp_path):
+    """The durable group offset only ever commits up to the COVERED floor:
+    ops folded into the in-memory replica but not yet inside an acked
+    summary are re-read after a crash — the next summary misses nothing."""
+    topic = _durable_topic(tmp_path)
+    _join("d0", topic)
+    sdir = str(tmp_path / "scribe")
+    scribe = ScribeLambda(topic, sdir, config=ScribeConfig(max_ops=10))
+    _string_stream("d0", topic, range(1, 11))
+    scribe.pump()  # due -> summary + ack at seq 10
+    assert scribe.refs["d0"]["seq"] == 10
+    tail = _string_stream("d0", topic, range(11, 16), seed=5)
+    scribe.pump()  # folded but NOT due: no summary cut
+    # The commit floor pins at the first uncovered op (join + 10 ops + the
+    # ack record precede it), even though the read position is at head.
+    part = topic.partition(0)
+    assert scribe.group.committed(0) == part.head - len(tail)
+    scribe.close()  # crash: the in-memory fold of ops 11-15 dies
+
+    scribe2 = ScribeLambda(topic, sdir, config=ScribeConfig(max_ops=10))
+    _string_stream("d0", topic, range(16, 21), seed=6)
+    scribe2.pump()  # re-reads 11-15 from the log, then 16-20 -> due
+    assert scribe2.refs["d0"]["seq"] == 20
+    rec = SummaryRecordStore.from_scribe(scribe2).load("d0")
+    # Replay the acked summary through the engine restore path and check
+    # it reflects EVERY op, including the 5 that died with the crash.
+    from fluidframework_tpu.models.doc_batch_engine import DocBatchEngine
+
+    eng = DocBatchEngine(1, max_insert_len=8, ops_per_step=4, use_mesh=False,
+                         doc_keys=["d0"])
+    eng.restore_from_checkpoints(store=SummaryRecordStore.from_scribe(scribe2))
+    ctl = DocBatchEngine(1, max_insert_len=8, ops_per_step=4, use_mesh=False,
+                         doc_keys=["d0"])
+    for p in range(topic.n_partitions):
+        for r in topic.partition(p).read(0):
+            if isinstance(r.payload, SequencedMessage):
+                ctl.ingest(0, r.payload)
+    ctl.step()
+    assert eng.text(0) == ctl.text(0)
+    topic.close()
+    scribe2.close()
+
+
+def test_scribe_failed_doc_is_isolated(tmp_path):
+    """A doc whose stream the scribe cannot apply (unknown client) is
+    marked failed and never summarized; sibling docs keep summarizing."""
+    topic = _durable_topic(tmp_path)
+    _join("good", topic)
+    _string_stream("good", topic, range(1, 13))
+    _op("bad", topic, 1, {"type": 0, "pos1": 0, "seg": "x"}, client="ghost")
+    scribe = ScribeLambda(topic, str(tmp_path / "scribe"),
+                          config=ScribeConfig(max_ops=5))
+    scribe.pump()
+    h = scribe.health()
+    assert h["failed_docs"] == 1 and h["docs_failed"] == 1
+    assert "good" in scribe.refs and "bad" not in scribe.refs
+    topic.close()
+    scribe.close()
+
+
+# ---------------------------------------------------------------- detection
+
+def test_family_detection():
+    assert detect_family({"type": 0, "pos1": 0, "seg": "x"}) == "doc_batch"
+    assert detect_family({"type": "set", "key": "k", "value": 1}) == "map_batch"
+    assert detect_family({"type": "clear"}) == "map_batch"
+    assert detect_family({"type": "set", "row": 1, "col": 2, "value": 3}) == "matrix_batch"
+    assert detect_family({"type": "insertRows", "pos": 0, "count": 1}) == "matrix_batch"
+    assert detect_family({"type": "edit", "sid": "s", "rev": 1, "changes": []}) == "tree_batch"
+    assert detect_family({"address": "root", "contents": {}}) == "tree_batch"
+
+
+# ------------------------------------------------------------------- tooling
+
+def test_summary_inspect_cli(tmp_path, capsys):
+    from fluidframework_tpu.tools.summary_inspect import main as inspect_main
+
+    topic = _durable_topic(tmp_path)
+    _join("d0", topic)
+    _string_stream("d0", topic, range(1, 13))
+    sdir = str(tmp_path / "scribe")
+    scribe = ScribeLambda(topic, sdir, config=ScribeConfig(max_ops=6))
+    scribe.pump()
+    _string_stream("d0", topic, range(13, 25), seed=2)
+    scribe.pump()
+    assert len(_acks_for(topic, "d0")) == 2
+    scribe.close()
+
+    assert inspect_main(["list", sdir]) == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert len(lines) == 2 and {l["seq"] for l in lines} == {12, 24}
+    assert sum(l["latest"] for l in lines) == 1
+
+    assert inspect_main(["show", sdir, "--doc", "d0"]) == 0
+    shown = json.loads(capsys.readouterr().out)
+    assert shown["seq"] == 24 and shown["record"]["engine"] == "doc_batch"
+
+    assert inspect_main(["diff", sdir, "--doc", "d0"]) == 0
+    diff = json.loads(capsys.readouterr().out)
+    assert diff["from"]["seq"] == 12 and diff["to"]["seq"] == 24
+    assert any(c["path"].startswith("summary") for c in diff["changes"])
+    topic.close()
