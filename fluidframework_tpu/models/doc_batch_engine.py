@@ -79,6 +79,7 @@ from ..ops import mergetree_kernel as mk
 from ..parallel.mesh import doc_mesh, shard_docs
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters
+from .staging import StagingRing
 
 
 @dataclass
@@ -142,6 +143,15 @@ def _fleet_step(state, ops, payloads):
     return jax.vmap(mk.apply_ops, in_axes=(0, 0, 0, None))(
         state, ops, payloads, flag
     )
+
+
+# Megastep dispatch: a [K, D, B] op ring applied as ONE donated program
+# (lax.scan over slices, vmap over docs, per-slice obliterate gate carried
+# on device — see mk.apply_megastep).  Amortizes the per-slice jit dispatch
+# and host->device upload that starved the device at high fleet rates.
+_fleet_megastep = functools.partial(jax.jit, donate_argnums=(0,))(
+    mk.apply_megastep
+)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -212,12 +222,17 @@ class DocBatchEngine:
         watchdog_sample: int = 4,
         readmit_after_steps: int = 0,
         poison_budget: int = 0,
+        megastep_k: int = 1,
         telemetry=None,
     ) -> None:
         assert recovery in ("grow", "oracle", "off")
         self.n_docs = n_docs
         self.max_insert_len = max_insert_len
         self.ops_per_step = ops_per_step
+        # Megastep depth cap: up to K [D, B] op slices fuse into one
+        # donated dispatch (adaptive per dispatch — see _select_k).  K=1
+        # preserves the per-slice dispatch behavior exactly.
+        self.megastep_k = max(1, megastep_k)
         self.recovery = recovery
         self.max_growths = max_growths
         self.hosts = [_DocHost() for _ in range(n_docs)]
@@ -296,9 +311,17 @@ class DocBatchEngine:
         # Module-level jitted programs (shared compile cache across engine
         # instances; one executable per geometry/batch shape).
         self._step = _fleet_step
+        self._megastep = _fleet_megastep
         self._compact = _fleet_compact
         self._lane_apply = _lane_apply_jit
         self._lane_compact = _lane_compact_jit
+        # Incremental busy set: doc indices whose host queue is nonempty,
+        # maintained by ingest/drain/quarantine — step() never rescans the
+        # whole host array (O(busy) per loop iteration, not O(capacity)).
+        self._busy: set[int] = set()
+        # Preallocated, double-buffered [K, D, B] staging (lazy: sized from
+        # the megastep depth and fleet capacity on first use).
+        self._stage: StagingRing | None = None
         # ---- Zipf straggler bucketing (SURVEY §7: doc-packing by op count)
         # Under skewed per-doc op counts one hot doc would force extra
         # FULL-fleet steps (every step scans B ops across all D lanes).
@@ -401,6 +424,8 @@ class DocBatchEngine:
         for op, payload in rows:
             h.queue.append(op)
             h.payloads.append(payload)
+        if h.queue:
+            self._busy.add(doc_idx)
 
     def _in_lane(self, doc_idx: int) -> bool:
         """True when the doc has left the lockstep batch (or was restored
@@ -457,6 +482,8 @@ class DocBatchEngine:
             h.raw_log.append(data)
         h.queue.extend(ops)
         h.payloads.extend(payloads)
+        if h.queue:
+            self._busy.add(doc_idx)
         h.min_seq = max(h.min_seq, h.native.min_seq)
         h.ops_since_ckpt += len(ops)
         if self.checkpoint_store is not None:
@@ -595,52 +622,122 @@ class DocBatchEngine:
         )
 
     def _drain_into(
-        self, docs: list[int], ops: np.ndarray, payloads: np.ndarray
-    ) -> None:
-        """Dequeue up to ops_per_step ops per listed doc into row j of the
-        padded arrays — the ONE drain used by full-fleet and cohort steps
-        (their semantics must never diverge)."""
+        self,
+        docs: list[int],
+        ops: np.ndarray,
+        payloads: np.ndarray,
+        rows: list[int] | None = None,
+    ) -> list[int]:
+        """Dequeue up to ops_per_step ops per listed doc into the padded
+        arrays (``docs[j]`` fills row ``rows[j]``, default ``j``) — the
+        ONE drain used by full-fleet, cohort, and megastep packing (their
+        semantics must never diverge).  Vectorized: each doc moves as two
+        slice copies (op rows + payload rows), never a per-op Python loop.
+        The caller guarantees the target rows are zeroed
+        (StagingRing.acquire); returns the rows written so a reused buffer
+        re-zeroes exactly those."""
         B = self.ops_per_step
+        written: list[int] = []
         for j, d in enumerate(docs):
             h = self.hosts[d]
             take = min(B, len(h.queue))
-            for k in range(take):
-                ops[j, k] = h.queue[k]
-                payloads[j, k] = h.payloads[k]
+            if not take:
+                continue
+            r = j if rows is None else rows[j]
+            ops[r, :take] = h.queue[:take]
+            payloads[r, :take] = h.payloads[:take]
             del h.queue[:take]
             del h.payloads[:take]
+            if not h.queue:
+                self._busy.discard(d)
+            written.append(r)
+        return written
 
-    def build_step_batch(self) -> tuple[np.ndarray, np.ndarray] | None:
-        """Dequeue up to ops_per_step ops per doc into padded [D,B] arrays."""
+    def _staging(self) -> StagingRing:
+        if self._stage is None:
+            self._stage = StagingRing(
+                self.megastep_k, self.capacity, self.ops_per_step,
+                mk.OP_FIELDS, self.max_insert_len,
+            )
+        return self._stage
+
+    @staticmethod
+    def _pow2_floor(n: int) -> int:
+        return 1 << (max(n, 1).bit_length() - 1)
+
+    def _select_k(self, busy: list[int], cohort: bool) -> int:
+        """Adaptive megastep depth from queue depths: how many B-op slices
+        to fuse into the next dispatch.  Cohort-bucketing aware: a
+        full-fleet megastep fuses only as many slices as the busy set
+        stays ABOVE the cohort threshold (bounded by the (thresh+1)-th
+        deepest queue), so a Zipf tail still collapses into small gathered
+        cohorts exactly when it would have.  Quantized to powers of two
+        (compile cache stays log2(K) deep, and an undershoot just means
+        one more dispatch — never wasted all-NOOP slices)."""
+        if self.megastep_k <= 1:
+            return 1
         B = self.ops_per_step
-        if not any(h.queue for h in self.hosts):
-            return None
-        ops = np.zeros((self.capacity, B, mk.OP_FIELDS), np.int32)
-        payloads = np.zeros((self.capacity, B, self.max_insert_len), np.int32)
-        self._drain_into(list(range(self.n_docs)), ops, payloads)
-        return ops, payloads
+        depths = np.array(
+            [-(-len(self.hosts[d].queue) // B) for d in busy], np.int64
+        )
+        if cohort or not self.bucketing:
+            need = int(depths.max())
+        else:
+            thresh = self.capacity // 4
+            if len(depths) > thresh:
+                # Slices until the busy set shrinks to cohort size: the
+                # (thresh+1)-th deepest queue still has ops at slice k iff
+                # its depth > k.
+                need = int(np.partition(depths, -thresh - 1)[-thresh - 1])
+            else:
+                need = int(depths.max())
+        return min(self.megastep_k, self._pow2_floor(need))
+
+    def _full_step(self, busy: list[int]) -> int:
+        """One fleet-wide megastep: pack up to K [capacity, B] slices into
+        the staging ring (slice k+1 packs while the upload/dispatch of the
+        previous megastep is still in flight) and apply them as one
+        donated program; returns the slices applied."""
+        K = self._select_k(busy, cohort=False)
+        stage = self._staging()
+        ops, payloads = stage.acquire(K, self.capacity)
+        for k in range(K):
+            stage.mark(
+                k, self._drain_into(busy, ops[k], payloads[k], rows=busy)
+            )
+            if k + 1 < K:
+                busy = [d for d in busy if d in self._busy]
+        if K == 1:
+            dev_ops, dev_payloads = jnp.asarray(ops[0]), jnp.asarray(payloads[0])
+            stage.launched(dev_ops, dev_payloads)
+            self.state = self._step(self.state, dev_ops, dev_payloads)
+        else:
+            dev_ops, dev_payloads = jnp.asarray(ops), jnp.asarray(payloads)
+            stage.launched(dev_ops, dev_payloads)
+            self.state = self._megastep(self.state, dev_ops, dev_payloads)
+        self.full_steps += K
+        self.counters.bump("megastep_dispatches")
+        self.counters.bump("megastep_slices", K)
+        return K
 
     def step(self) -> int:
-        """Run device steps until all staged ops are applied; returns the
-        number of batched steps.  Busy-doc cohorts far below fleet size
-        run bucketed (see __init__), so a Zipf-skewed tail stops costing
-        full-fleet steps.  Afterwards, any latched overflow bits are
-        recovered (grow-and-replay or oracle routing), so ``errors()`` is
-        all-zero on return unless recovery is off."""
+        """Run device dispatches until all staged ops are applied; returns
+        the number of batched SLICES applied (a K-slice megastep counts K,
+        so the return value is K-invariant).  Busy-doc cohorts far below
+        fleet size run bucketed (see __init__), so a Zipf-skewed tail
+        stops costing full-fleet steps.  No host/device sync happens
+        between megasteps — uploads and dispatches queue asynchronously;
+        the pipeline synchronizes only at the recover()/watchdog/
+        checkpoint boundaries below.  Afterwards, any latched overflow
+        bits are recovered (grow-and-replay or oracle routing), so
+        ``errors()`` is all-zero on return unless recovery is off."""
         steps = 0
-        while True:
-            busy = [d for d, h in enumerate(self.hosts) if h.queue]
-            if not busy:
-                break
+        while self._busy:
+            busy = sorted(self._busy)
             if self.bucketing and len(busy) <= self.capacity // 4:
-                self._cohort_step(busy)
+                steps += self._cohort_step(busy)
             else:
-                batch = self.build_step_batch()
-                self.state = self._step(
-                    self.state, jnp.asarray(batch[0]), jnp.asarray(batch[1])
-                )
-                self.full_steps += 1
-            steps += 1
+                steps += self._full_step(busy)
         self._step_lanes()
         self._step_count += 1
         if self.recovery != "off":
@@ -677,39 +774,71 @@ class DocBatchEngine:
                 self._readmit_interval[d] = interval
                 self._readmit_due[d] = self._step_count + interval
 
-    def _cohort_step(self, busy: list[int]) -> None:
-        """One bucketed step over just the busy docs."""
-        B = self.ops_per_step
-        K = max(1, 1 << (len(busy) - 1).bit_length())  # pow2 ladder
-        idx = np.full((K,), busy[-1], np.int32)  # gather pad: harmless dup
+    def _cohort_step(self, busy: list[int]) -> int:
+        """One bucketed megastep over just the busy docs: gather the
+        cohort's state rows once, apply up to K fused [Kc, B] slices, and
+        masked-scatter the rows back — K > 1 amortizes the gather/scatter
+        pair as well as the dispatch.  Returns the slices applied."""
+        K = self._select_k(busy, cohort=True)
+        Kc = max(1, 1 << (len(busy) - 1).bit_length())  # pow2 ladder
+        idx = np.full((Kc,), busy[-1], np.int32)  # gather pad: harmless dup
         idx[: len(busy)] = busy
-        valid = np.zeros((K,), bool)
+        valid = np.zeros((Kc,), bool)
         valid[: len(busy)] = True
-        ops = np.zeros((K, B, mk.OP_FIELDS), np.int32)
-        payloads = np.zeros((K, B, self.max_insert_len), np.int32)
-        self._drain_into(busy, ops, payloads)
+        stage = self._staging()
+        ops, payloads = stage.acquire(K, Kc)
+        row_of = {d: j for j, d in enumerate(busy)}
+        cur = busy
+        for k in range(K):
+            stage.mark(
+                k,
+                self._drain_into(
+                    cur, ops[k], payloads[k], rows=[row_of[d] for d in cur]
+                ),
+            )
+            if k + 1 < K:
+                cur = [d for d in cur if d in self._busy]
         sub = self._gather_cohort(self.state, jnp.asarray(idx))
-        sub = self._step(sub, jnp.asarray(ops), jnp.asarray(payloads))
+        if K == 1:
+            dev_ops, dev_payloads = jnp.asarray(ops[0]), jnp.asarray(payloads[0])
+            stage.launched(dev_ops, dev_payloads)
+            sub = self._step(sub, dev_ops, dev_payloads)
+        else:
+            dev_ops, dev_payloads = jnp.asarray(ops), jnp.asarray(payloads)
+            stage.launched(dev_ops, dev_payloads)
+            sub = self._megastep(sub, dev_ops, dev_payloads)
         self.state = self._scatter_cohort(
             self.state, sub, jnp.asarray(idx), jnp.asarray(valid)
         )
-        self.cohort_steps += 1
-        self.cohort_lanes += K
+        self.cohort_steps += K
+        self.cohort_lanes += K * Kc
+        self.counters.bump("megastep_dispatches")
+        self.counters.bump("megastep_slices", K)
+        return K
 
     def _step_lanes(self) -> None:
         B = self.ops_per_step
+        if not self.overflow:
+            return
+        stage = self._staging()
         for lane in self.overflow.values():
             while lane.queue:
                 take = min(B, len(lane.queue))
-                ops = np.zeros((B, mk.OP_FIELDS), np.int32)
-                payloads = np.zeros((B, self.max_insert_len), np.int32)
-                for j in range(take):
-                    ops[j] = lane.queue[j]
-                    payloads[j] = lane.payloads[j]
+                # One staged [B] chunk per dispatch through the shared
+                # ring (row 0 of a 1-slice view): slice copies, no fresh
+                # allocation, and the double buffer keeps the host from
+                # mutating an upload still in flight.
+                ops, payloads = stage.acquire(1, 1)
+                ops[0, 0, :take] = lane.queue[:take]
+                payloads[0, 0, :take] = lane.payloads[:take]
                 del lane.queue[:take]
                 del lane.payloads[:take]
+                stage.mark(0, [0])
+                dev_ops = jnp.asarray(ops[0, 0])
+                dev_payloads = jnp.asarray(payloads[0, 0])
+                stage.launched(dev_ops, dev_payloads)
                 lane.state = self._lane_apply(
-                    lane.state, jnp.asarray(ops), jnp.asarray(payloads)
+                    lane.state, dev_ops, dev_payloads
                 )
 
     def compact(self) -> None:
@@ -870,9 +999,8 @@ class DocBatchEngine:
             chunk = rows[i : i + B]
             ops = np.zeros((B, mk.OP_FIELDS), np.int32)
             payloads = np.zeros((B, self.max_insert_len), np.int32)
-            for j, (op, payload) in enumerate(chunk):
-                ops[j] = op
-                payloads[j] = payload
+            ops[: len(chunk)] = [op for op, _ in chunk]
+            payloads[: len(chunk)] = [payload for _, payload in chunk]
             state = self._lane_apply(
                 state, jnp.asarray(ops), jnp.asarray(payloads)
             )
@@ -980,6 +1108,7 @@ class DocBatchEngine:
                 self._readmit_due[d] = self._step_count + interval
         h.queue.clear()
         h.payloads.clear()
+        self._busy.discard(d)
         if d < self.capacity:
             self.state = self.state._replace(
                 error=self.state.error.at[d].set(0)
@@ -1298,6 +1427,21 @@ class DocBatchEngine:
         ages = [
             h.last_seq - h.base_seq for h in self.hosts if h.last_seq
         ]
+        # Megastep pipeline surface: configured depth, realized dispatch
+        # amortization, and how often the double buffer actually overlapped
+        # a pack with in-flight device work.
+        self.counters.gauge("megastep_k", self.megastep_k)
+        self.counters.gauge(
+            "staging_overlap_packs",
+            self._stage.overlapped_packs if self._stage is not None else 0,
+        )
+        self.counters.gauge(
+            "staging_aliased_swaps",
+            self._stage.aliased_swaps if self._stage is not None else 0,
+        )
+        self.counters.ratio(
+            "steps_per_dispatch", "megastep_slices", "megastep_dispatches"
+        )
         snap = self.counters.snapshot()
         snap.update(
             quarantined_docs=len(self.quarantine),

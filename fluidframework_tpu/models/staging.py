@@ -1,0 +1,165 @@
+"""Host-side op staging for the megastep pipeline (doc/tree batch engines).
+
+The per-slice dispatch path used to allocate a fresh ``np.zeros`` [D, B]
+batch every device step and upload it synchronously with the dispatch.  The
+megastep pipeline replaces that with a ring of PREALLOCATED [K, D, B]
+staging buffers:
+
+- **Reuse, not reallocation**: buffers are zeroed lazily — only the rows a
+  previous megastep actually wrote are cleared before refill (tracked per
+  slice), so idle lanes cost nothing and the allocator is out of the hot
+  loop entirely.
+- **Double buffering**: with ``depth=2`` the engine packs megastep N+1 into
+  one buffer while the ``jax.device_put`` + dispatch of megastep N is still
+  reading the other.  Before a buffer is reused, the ring blocks on the
+  device arrays produced FROM IT (transfer completion only, two megasteps
+  stale by then — in the steady state a no-op wait), so the host never
+  mutates memory an in-flight upload may still be reading.  This is the
+  only sync the pipeline takes between megasteps; full synchronization
+  happens solely at the engine's recover()/watchdog/checkpoint boundaries.
+- **Zero-copy backends**: on backends where host->device "transfer" is
+  zero-copy (CPU jax: ``jnp.asarray(np_arr)`` ALIASES the numpy memory),
+  the uploaded device array reads the staging buffer for as long as it
+  lives — reuse would mutate the input of an asynchronously executing (or
+  even future) dispatch.  ``acquire`` detects this by pointer probe and
+  hands the memory over to the device arrays, swapping a fresh buffer
+  into the ring slot (``aliased_swaps`` counts these).  That degrades the
+  reuse win to exactly the seed's allocate-per-step behavior on CPU while
+  keeping the DMA-backed reuse path on real accelerators.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _StageBuf:
+    __slots__ = ("ops", "payloads", "dirty", "inflight")
+
+    def __init__(self, shape_ops: tuple, shape_payloads: tuple) -> None:
+        self.ops = np.zeros(shape_ops, np.int32)
+        self.payloads = np.zeros(shape_payloads, np.int32)
+        # [(slice k, row-index array)] written since the last reset.
+        self.dirty: list[tuple[int, object]] = []
+        # Device arrays last uploaded from this buffer (held so the memory
+        # they were copied from is provably drained before reuse).
+        self.inflight: tuple | None = None
+
+
+class StagingRing:
+    """A depth-N ring of reusable [K, D, B] op/payload staging buffers.
+
+    Usage per megastep::
+
+        ops, payloads = ring.acquire(k, rows)   # zeroed [k, rows, B, ...]
+        ...fill slices, ring.mark(k, written_rows) per slice...
+        dev = jnp.asarray(ops), jnp.asarray(payloads)
+        ring.launched(*dev)                     # arms the reuse barrier
+
+    ``acquire`` hands out views of the preallocated buffers; leading-axis
+    views ([:k]) are contiguous, so the full-fleet upload path is
+    zero-extra-copy.  Sub-row views ([:k, :rows]) are strided and copied by
+    ``jnp.asarray`` (cohort steps — small by construction).
+    """
+
+    def __init__(
+        self,
+        k_max: int,
+        rows: int,
+        batch: int,
+        op_fields: int,
+        payload_len: int,
+        depth: int = 2,
+    ) -> None:
+        self.k_max = max(1, int(k_max))
+        self._shape_ops = (self.k_max, rows, batch, op_fields)
+        self._shape_payloads = (self.k_max, rows, batch, payload_len)
+        self._bufs = [
+            _StageBuf(self._shape_ops, self._shape_payloads)
+            for _ in range(depth)
+        ]
+        self._i = 0
+        self._cur: _StageBuf | None = None
+        # Packs that overlapped an in-flight upload/dispatch (no blocking
+        # wait was needed before reuse) — the double-buffer win counter.
+        self.overlapped_packs = 0
+        # Buffers surrendered to zero-copy device arrays (see module
+        # docstring): each swap is one fresh allocation, the seed-parity
+        # cost on backends without a real host->device transfer.
+        self.aliased_swaps = 0
+
+    def acquire(self, k: int, rows: int) -> tuple[np.ndarray, np.ndarray]:
+        """A zeroed [k, rows, B, ...] staging view, safe to fill now."""
+        slot = self._i
+        buf = self._bufs[slot]
+        self._i = (self._i + 1) % len(self._bufs)
+        if buf.inflight is not None:
+            import jax
+
+            arrs = buf.inflight
+            buf.inflight = None
+            if self._aliased(buf, arrs):
+                # The device arrays ALIAS this buffer's memory (zero-copy
+                # backend): reuse would corrupt an in-flight dispatch's
+                # input.  The arrays keep the old memory alive; the ring
+                # slot gets fresh zeroed buffers.
+                buf = self._bufs[slot] = _StageBuf(
+                    self._shape_ops, self._shape_payloads
+                )
+                self.aliased_swaps += 1
+            elif all(_transfer_done(a) for a in arrs):
+                # The upload that read this buffer already drained: this
+                # pack overlaps the previous megastep's device work.
+                self.overlapped_packs += 1
+            else:
+                jax.block_until_ready(arrs)
+        for kk, rr in buf.dirty:
+            buf.ops[kk, rr] = 0
+            buf.payloads[kk, rr] = 0
+        buf.dirty.clear()
+        self._cur = buf
+        return buf.ops[:k, :rows], buf.payloads[:k, :rows]
+
+    def mark(self, k: int, written_rows) -> None:
+        """Record the rows slice ``k`` wrote (cleared on the next reuse)."""
+        if len(written_rows):
+            self._cur.dirty.append((k, np.asarray(written_rows)))
+
+    def launched(self, *device_arrays) -> None:
+        """Arm the reuse barrier with the arrays uploaded from the current
+        buffer: the next acquire of this buffer waits for their transfers
+        (not the consuming computation) before handing the memory back."""
+        self._cur.inflight = device_arrays
+
+    @staticmethod
+    def _aliased(buf: _StageBuf, arrs) -> bool:
+        """True when any uploaded device array points into the staging
+        buffer's own memory (zero-copy backend; probe is best-effort —
+        backends with real transfers either copy or lack the pointer)."""
+        spans = [
+            (buf.ops.ctypes.data, buf.ops.nbytes),
+            (buf.payloads.ctypes.data, buf.payloads.nbytes),
+        ]
+        for a in arrs:
+            probe = getattr(a, "unsafe_buffer_pointer", None)
+            if probe is None:
+                continue
+            try:
+                p = int(probe())
+            except Exception:  # noqa: BLE001 — probe failure = assume no alias
+                continue
+            if any(base <= p < base + n for base, n in spans):
+                return True
+        return False
+
+
+def _transfer_done(arr) -> bool:
+    """Non-blocking transfer-completion probe (best effort: absent on some
+    jax versions/backends, where the caller just blocks)."""
+    probe = getattr(arr, "is_ready", None)
+    if probe is None:
+        return False
+    try:
+        return bool(probe())
+    except Exception:  # noqa: BLE001 — a probe failure must never break staging
+        return False

@@ -50,6 +50,7 @@ from ..dds.tree.forest import ROOT_FIELD, Forest, Node
 from ..ops import tree_kernel as tk
 from ..protocol.messages import MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters
+from .staging import StagingRing
 
 
 @dataclass
@@ -86,6 +87,9 @@ class UnsupportedShape(Exception):
 _tree_step_jit = functools.partial(jax.jit, donate_argnums=(0,))(
     jax.vmap(tk.apply_nested_ops)
 )
+_tree_megastep_jit = functools.partial(jax.jit, donate_argnums=(0,))(
+    tk.apply_nested_megastep
+)
 _tree_compact_jit = functools.partial(jax.jit, donate_argnums=(0,))(
     jax.vmap(tk.compact_nested)
 )
@@ -108,6 +112,7 @@ class TreeBatchEngine:
         checkpoint_store=None,
         checkpoint_every: int = 0,
         doc_keys: list[str] | None = None,
+        megastep_k: int = 1,
         telemetry=None,
     ) -> None:
         self.n_docs = n_docs
@@ -115,6 +120,10 @@ class TreeBatchEngine:
         self.pool_capacity = pool_capacity
         self.ops_per_step = ops_per_step
         self.max_insert_len = max_insert_len
+        # Megastep depth cap (see doc_batch_engine): up to K [D, B] op
+        # slices fuse into one donated dispatch; K=1 is the exact
+        # per-slice path.
+        self.megastep_k = max(1, megastep_k)
         self.hosts = [_TreeHost() for _ in range(n_docs)]
         self.fallbacks: dict[int, Forest] = {}
         self.mesh = mesh
@@ -143,7 +152,12 @@ class TreeBatchEngine:
                 lambda x: jax.device_put(x, shard_docs(mesh)), self.state
             )
         self._step = _tree_step_jit
+        self._megastep = _tree_megastep_jit
         self._compact = _tree_compact_jit
+        # Incremental busy set + preallocated double-buffered staging
+        # (lazy), mirroring doc_batch_engine's megastep pipeline.
+        self._busy: set[int] = set()
+        self._stage: StagingRing | None = None
         # Host-side upper bound on each doc's row watermark (rows only grow
         # on INSERT ops, whose counts the host knows at staging time) — the
         # compaction trigger without a per-batch device readback.  The word
@@ -246,6 +260,8 @@ class TreeBatchEngine:
             self._pool_upper[doc_idx] += self._op_pool_words(r)
         h.queue.extend(r for r, _p in rows)
         h.payloads.extend(p for _r, p in rows)
+        if h.queue:
+            self._busy.add(doc_idx)
 
     @staticmethod
     def _op_pool_words(r: np.ndarray) -> int:
@@ -459,6 +475,7 @@ class TreeBatchEngine:
         h.trunk_log.clear()  # never replayed again
         h.queue.clear()
         h.payloads.clear()
+        self._busy.discard(doc_idx)
         # The doc's device columns are dead weight now; stop letting its
         # stale watermarks trigger fleet-wide compactions.
         self._rows_upper[doc_idx] = 0
@@ -474,10 +491,48 @@ class TreeBatchEngine:
         dev = sum(h.device_commits for h in self.hosts)
         return dev / total if total else 1.0
 
+    def _staging(self) -> StagingRing:
+        if self._stage is None:
+            self._stage = StagingRing(
+                self.megastep_k, self.n_docs, self.ops_per_step,
+                tk.NESTED_OP_FIELDS, self.max_insert_len,
+            )
+        return self._stage
+
+    def _select_k(self, busy: list[int]) -> int:
+        """Megastep depth from the deepest busy queue (pow2-quantized,
+        capped at megastep_k); K=1 degenerates to the per-slice path."""
+        if self.megastep_k <= 1:
+            return 1
+        B = self.ops_per_step
+        need = max(-(-len(self.hosts[d].queue) // B) for d in busy)
+        return min(self.megastep_k, 1 << (max(need, 1).bit_length() - 1))
+
+    def _drain_into(
+        self, busy: list[int], ops: np.ndarray, payloads: np.ndarray
+    ) -> list[int]:
+        """Dequeue up to ops_per_step op rows per busy doc into its row of
+        the zeroed staging arrays — slice copies, never a per-op Python
+        loop.  Returns the rows written (buffer-reuse dirty tracking)."""
+        B = self.ops_per_step
+        written: list[int] = []
+        for d in busy:
+            h = self.hosts[d]
+            take = min(B, len(h.queue))
+            if not take:
+                continue
+            ops[d, :take] = h.queue[:take]
+            payloads[d, :take] = h.payloads[:take]
+            del h.queue[:take]
+            del h.payloads[:take]
+            if not h.queue:
+                self._busy.discard(d)
+            written.append(d)
+        return written
+
     def step(self) -> int:
         steps = 0
-        B = self.ops_per_step
-        while any(h.queue for h in self.hosts):
+        while self._busy:
             # Proactive compact: dead rows accumulate monotonically (stable
             # rows never reuse slots) — reclaim before overflow.  The
             # trigger is the host-side row UPPER BOUND (no per-batch device
@@ -528,19 +583,26 @@ class TreeBatchEngine:
                     + queued_words,
                     0,
                 )
-            ops = np.zeros((self.n_docs, B, tk.NESTED_OP_FIELDS), np.int32)
-            payloads = np.zeros((self.n_docs, B, self.max_insert_len), np.int32)
-            for d, h in enumerate(self.hosts):
-                take = min(B, len(h.queue))
-                for j in range(take):
-                    ops[d, j] = h.queue[j]
-                    payloads[d, j] = h.payloads[j]
-                del h.queue[:take]
-                del h.payloads[:take]
-            self.state = self._step(
-                self.state, jnp.asarray(ops), jnp.asarray(payloads)
-            )
-            steps += 1
+            busy = sorted(self._busy)
+            K = self._select_k(busy)
+            stage = self._staging()
+            ops, payloads = stage.acquire(K, self.n_docs)
+            for k in range(K):
+                stage.mark(k, self._drain_into(busy, ops[k], payloads[k]))
+                if k + 1 < K:
+                    busy = [d for d in busy if d in self._busy]
+            if K == 1:
+                dev_ops = jnp.asarray(ops[0])
+                dev_payloads = jnp.asarray(payloads[0])
+                stage.launched(dev_ops, dev_payloads)
+                self.state = self._step(self.state, dev_ops, dev_payloads)
+            else:
+                dev_ops, dev_payloads = jnp.asarray(ops), jnp.asarray(payloads)
+                stage.launched(dev_ops, dev_payloads)
+                self.state = self._megastep(self.state, dev_ops, dev_payloads)
+            steps += K
+            self.counters.bump("megastep_dispatches")
+            self.counters.bump("megastep_slices", K)
         err = np.asarray(self.state.error)
         for d in range(self.n_docs):
             if err[d] and d not in self.fallbacks:
@@ -653,12 +715,26 @@ class TreeBatchEngine:
                     self._pool_upper[d] += self._op_pool_words(r)
                 h.queue.extend(r for r, _p in rows)
                 h.payloads.extend(p for _r, p in rows)
+                if h.queue:
+                    self._busy.add(d)
             restored.append(d)
             self.counters.bump("docs_restored")
         return restored
 
     # ----------------------------------------------------------------- health
     def health(self) -> dict:
+        self.counters.gauge("megastep_k", self.megastep_k)
+        self.counters.gauge(
+            "staging_overlap_packs",
+            self._stage.overlapped_packs if self._stage is not None else 0,
+        )
+        self.counters.gauge(
+            "staging_aliased_swaps",
+            self._stage.aliased_swaps if self._stage is not None else 0,
+        )
+        self.counters.ratio(
+            "steps_per_dispatch", "megastep_slices", "megastep_dispatches"
+        )
         snap = self.counters.snapshot()
         snap.update(
             fallback_docs=len(self.fallbacks),

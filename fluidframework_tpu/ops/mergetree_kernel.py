@@ -744,6 +744,38 @@ def apply_ops(
     )
 
 
+def apply_megastep(
+    s: DocState, ops: jnp.ndarray, payloads: jnp.ndarray
+) -> DocState:
+    """Apply a [K, D, B] op ring to a [D, ...] document batch in ONE fused
+    program: ``lax.scan`` over the K slice axis, ``vmap`` over the D doc
+    axis inside the scan body.
+
+    This is the megastep dispatch amortizer: where the per-slice path pays
+    one jit dispatch + one host->device upload per [D, B] slice, a megastep
+    pays them once per K slices — error bits latch into the carried state
+    on device and are read back once per megastep, never per slice.
+
+    Semantics are bit-identical to K sequential ``apply_ops`` dispatches:
+    each slice's obliterate gate is the same whole-batch scalar the
+    per-slice dispatch computes (any doc's ob table nonempty | any op in
+    the slice is an OBLITERATE), re-evaluated per slice from the CARRIED
+    state — hoisting it to the scan carry keeps the common no-obliterate
+    slice a single fully-fused scan body (see apply_ops).
+
+    ops: int32[K, D, B, OP_FIELDS]; payloads: int32[K, D, B, L].
+    """
+
+    def body(st: DocState, xs):
+        o, p = xs
+        flag = jnp.any(st.ob_key >= 0) | jnp.any(o[..., 0] == OpKind.OBLITERATE)
+        st = jax.vmap(apply_ops, in_axes=(0, 0, 0, None))(st, o, p, flag)
+        return st, None
+
+    out, _ = jax.lax.scan(body, s, (ops, payloads))
+    return out
+
+
 # --------------------------------------------------------------------------
 # Compaction (zamboni)
 # --------------------------------------------------------------------------

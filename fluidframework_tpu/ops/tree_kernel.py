@@ -640,6 +640,24 @@ def apply_nested_ops(
     return out
 
 
+def apply_nested_megastep(
+    s: NestedForestState, ops: jnp.ndarray, payloads: jnp.ndarray
+) -> NestedForestState:
+    """Apply a [K, D, B] op ring to a [D, ...] forest batch in ONE fused
+    program (``lax.scan`` over K slices of ``vmap(apply_nested_ops)``) —
+    the tree engine's megastep dispatch amortizer.  Bit-identical to K
+    sequential batched dispatches: slices apply in order against the
+    carried state, and error/overflow bits latch on device for a single
+    per-megastep readback."""
+
+    def body(st: NestedForestState, xs):
+        o, p = xs
+        return jax.vmap(apply_nested_ops)(st, o, p), None
+
+    out, _ = jax.lax.scan(body, s, (ops, payloads))
+    return out
+
+
 def compact_nested(s: NestedForestState) -> NestedForestState:
     """Drop dead rows: stable gather of live rows to the prefix plus a
     parent-id remap — trivial BECAUSE ordering lives in the index columns,

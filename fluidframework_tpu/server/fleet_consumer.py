@@ -10,6 +10,13 @@ at chunk granularity — per-socket ``recv``, one ``rfind(b"\\n")`` to peel
 the trailing partial line, one ``ingest_lines`` call; all JSON parsing,
 quorum lookup, insert chunking, and op-row encoding run in C++, and op
 application runs on device in the batched engine step.
+
+With a megastep-enabled engine (``DocBatchEngine(megastep_k=K)``, the
+``fleet_main --megastep-k`` flag) each ``step()`` fuses up to K staged op
+slices into one donated device dispatch, and the next ``pump()``'s staging
+overlaps the in-flight upload/dispatch — ``health()`` surfaces the realized
+amortization as ``steps_per_dispatch`` / ``megastep_k`` /
+``staging_overlap_packs`` alongside the transport counters.
 """
 
 from __future__ import annotations

@@ -21,6 +21,29 @@ import sys
 import time
 
 
+def status_snapshot(eng, doc_ids, rows=0, bytes_consumed=0, **extra) -> dict:
+    """One fleet status line as a dict (the supervisor surface): rows/bytes
+    consumed, error state, and the engine's full health counters —
+    including the megastep pipeline surface (``megastep_k``,
+    ``steps_per_dispatch``, ``staging_overlap_packs``).  Module-level so
+    tests and tools can assert on the exact shape ``main`` emits."""
+    errs = eng.errors()
+    out = {
+        "rows": rows,
+        "bytes": bytes_consumed,
+        "errors": int(errs.sum()),
+        "health": eng.health(),
+        **extra,
+    }
+    if errs.any():
+        out["errorDocs"] = [
+            doc_ids[i] for i in range(len(doc_ids)) if errs[i]
+        ]
+    if eng.quarantine:
+        out["quarantinedDocs"] = sorted(doc_ids[d] for d in eng.quarantine)
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--host", default="127.0.0.1")
@@ -54,6 +77,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--poison-budget", type=int, default=0,
                    help="quarantine flaps before a doc is permanently "
                         "oracle-routed (0 = unlimited)")
+    p.add_argument("--megastep-k", type=int, default=8,
+                   help="max op slices fused into one device dispatch "
+                        "(adaptive by queue depth; 1 = exact per-slice "
+                        "dispatch, the pre-megastep behavior)")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu); overrides the "
                         "image default and the FFTPU_PLATFORM env var")
@@ -94,6 +121,7 @@ def main(argv: list[str] | None = None) -> int:
         watchdog_every=args.watchdog_every,
         readmit_after_steps=args.readmit_after_steps,
         poison_budget=args.poison_budget,
+        megastep_k=args.megastep_k,
     )
     if store is not None:
         # Restart path: restore durable checkpoints BEFORE consuming, so
@@ -122,23 +150,10 @@ def main(argv: list[str] | None = None) -> int:
         }), flush=True)
 
     def status(**extra) -> None:
-        errs = eng.errors()
-        out = {
-            "rows": fc.rows_staged,
-            "bytes": fc.bytes_consumed,
-            "errors": int(errs.sum()),
-            "health": eng.health(),
-            **extra,
-        }
-        if errs.any():
-            out["errorDocs"] = [
-                doc_ids[i] for i in range(len(doc_ids)) if errs[i]
-            ]
-        if eng.quarantine:
-            out["quarantinedDocs"] = sorted(
-                doc_ids[d] for d in eng.quarantine
-            )
-        print(json.dumps(out), flush=True)
+        print(json.dumps(status_snapshot(
+            eng, doc_ids, rows=fc.rows_staged,
+            bytes_consumed=fc.bytes_consumed, **extra,
+        )), flush=True)
 
     last_status = time.monotonic()
     try:

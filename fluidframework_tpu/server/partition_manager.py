@@ -226,3 +226,111 @@ class PartitionManager:
 
     def snapshots_of(self, doc_id: str) -> list[tuple[int, dict]]:
         return self.snapshot_store.get(doc_id, [])
+
+
+# ---------------------------------------------------------------------------
+# Scribe scale-out (the standalone summarizer service, server/scribe.py)
+# ---------------------------------------------------------------------------
+
+
+class ScribePool:
+    """Membership manager for N standalone-scribe members over ONE op topic
+    (ROADMAP: scribe scale-out / election + handoff).
+
+    All members share the durable substrate — one consumer group (so
+    partitions deal round-robin over the live membership and committed
+    offsets are group-global), one content-addressed object store, and one
+    merged ``refs.json`` — while each member folds and summarizes only its
+    assigned partitions.  On any membership change the group rebalances
+    and a partition's new owner resumes it by **summary adoption**
+    (``ScribeLambda._adopt_summary``): each doc's replica loads from the
+    latest acked commit recorded in the shared refs, and only the tail
+    above the group's committed floor re-folds.  Because the committed
+    floor never passes a consumed-but-unsummarized record, a KILLED
+    member's unsummarized fold work is re-read exactly; and because acks
+    are idempotent by seq floor, the successor can never double-ack a
+    summary the dead member already produced."""
+
+    def __init__(
+        self, topic: Topic, directory: str, config=None, families=None
+    ) -> None:
+        import os
+
+        from .gitstore import GitStore as _GitStore
+        from .ordered_log import ConsumerGroup
+
+        self.topic = topic
+        self.directory = directory
+        self.config = config
+        self.families = families
+        os.makedirs(directory, exist_ok=True)
+        self.store = _GitStore(os.path.join(directory, "objects"))
+        self.group = ConsumerGroup(topic, "scribe", directory)
+        self.members: dict[str, Any] = {}
+        self.kills = 0
+
+    def add_member(self, member_id: str):
+        """Join one scribe member (rebalances the group immediately)."""
+        from .scribe import ScribeLambda as _ScribeService
+
+        if member_id in self.members:
+            raise ValueError(f"scribe member {member_id!r} already present")
+        member = _ScribeService(
+            self.topic, self.directory, config=self.config,
+            families=self.families, member_id=member_id,
+            store=self.store, group=self.group,
+        )
+        self.members[member_id] = member
+        return member
+
+    def remove_member(self, member_id: str) -> None:
+        """Graceful departure: cut summaries for everything pending first,
+        so successors adopt the freshest possible floors.  The member stays
+        in the pool (and the group) until its flush succeeds — a failed
+        flush must leave it pumpable/retriable, never stranded as a group
+        member nobody pumps."""
+        self.members[member_id].summarize_all()
+        self.members.pop(member_id)
+        self.group.leave(member_id)
+
+    def kill_member(self, member_id: str) -> None:
+        """Crash: no flush, no goodbye.  The group rebalances; new owners
+        resume from the committed floors + shared refs/object store."""
+        self.members.pop(member_id)
+        self.group.leave(member_id)
+        self.kills += 1
+
+    def pump(self) -> int:
+        return sum(m.pump() for m in list(self.members.values()))
+
+    def compact(self, extra_groups: tuple = ()) -> dict:
+        """Pool-safe compaction: fold the SHARED refs union into one member
+        before flooring, so a doc tracked only by a peer (or only on disk
+        after a kill) still pins its partition's truncation floor — a
+        member compacting from its private view alone could reclaim tail
+        records a cold boot-from-summary of a peer's doc still needs."""
+        import json as _json
+        import os
+
+        if not self.members:
+            return {}  # nobody to compact through; reclaim nothing
+        lead = next(iter(self.members.values()))
+        refs_path = os.path.join(self.directory, "refs.json")
+        if os.path.exists(refs_path):
+            try:
+                with open(refs_path) as f:
+                    on_disk = _json.load(f)
+            except (ValueError, OSError):
+                on_disk = {}
+            # Seed the lead's view directly from the one parse above
+            # (_ref_for would re-open and re-parse refs.json per doc).
+            for doc, ref in on_disk.items():
+                if doc not in lead.refs and doc not in lead._dropped_refs:
+                    lead.refs[doc] = dict(ref)
+        return lead.compact(extra_groups=extra_groups)
+
+    def health(self) -> dict:
+        return {m: s.health() for m, s in sorted(self.members.items())}
+
+    def close(self) -> None:
+        self.store.close()

@@ -577,6 +577,10 @@ class ScribeLambda:
         # doc -> earliest consumed-but-not-yet-summarized record offset
         # (pins the durable commit floor for its partition).
         self._uncovered: dict[str, int] = {}
+        # Docs whose persisted ref this incarnation DELIBERATELY dropped
+        # (missing/unloadable commit): _ref_for must not resurrect them
+        # from disk — the drop forces a full replay on purpose.
+        self._dropped_refs: set[str] = set()
         self._restore()
 
     # ---------------------------------------------------------------- restore
@@ -593,6 +597,7 @@ class ScribeLambda:
             if commit not in self.store:
                 # Object log lost/partial: drop the ref, replay from zero.
                 self.counters.bump("refs_dropped_missing_commit")
+                self._dropped_refs.add(doc)
                 continue
             seq, record = self._read_commit(commit)
             # The record's own engine tag is authoritative for the replica
@@ -605,6 +610,7 @@ class ScribeLambda:
                 ad.load(seq, record)
             except Exception:  # noqa: BLE001 — degrade to full replay, never brick
                 self.counters.bump("refs_dropped_unloadable")
+                self._dropped_refs.add(doc)
                 continue
             ad.mark_summarized()
             self.docs[doc] = ad
@@ -625,6 +631,76 @@ class ScribeLambda:
         if kind != "commit":
             raise KeyError(f"{commit_sha[:12]} is a {kind}, not a commit")
         return payload["seq"], self.store.read_snapshot(payload["tree"])
+
+    # --------------------------------------------------- scale-out handoff
+    def _write_ref(self, doc_id: str) -> None:
+        """Persist one doc's ref by MERGING into refs.json (read-modify-
+        write under the atomic dump): scale-out members sharing one scribe
+        directory (partition_manager.ScribePool) own disjoint partitions,
+        so a whole-dict dump from one member would clobber the entries its
+        peers persisted for theirs."""
+        on_disk: dict = {}
+        if os.path.exists(self._refs_path):
+            try:
+                with open(self._refs_path) as f:
+                    on_disk = json.load(f)
+            except (json.JSONDecodeError, OSError):
+                on_disk = {}
+        on_disk[doc_id] = self.refs[doc_id]
+        atomic_json_dump(on_disk, self._refs_path)
+
+    def _ref_for(self, doc_id: str) -> dict | None:
+        """This member's view of a doc's latest acked summary, falling back
+        to refs.json: after a rebalance the partition's new owner learns
+        its docs' floors from the ref a pool peer (or a previous
+        incarnation) persisted — necessary because the producing ack can
+        sit BELOW the group's committed offset, where no replay will ever
+        surface it again.  Never resurrects a ref this incarnation
+        deliberately dropped (missing/unloadable commit)."""
+        ref = self.refs.get(doc_id)
+        if (
+            ref is None
+            and doc_id not in self._dropped_refs
+            and os.path.exists(self._refs_path)
+        ):
+            try:
+                with open(self._refs_path) as f:
+                    ref = json.load(f).get(doc_id)
+            except (json.JSONDecodeError, OSError):
+                ref = None
+            if ref is not None:
+                self.refs[doc_id] = dict(ref)
+        return ref
+
+    def _adopt_summary(self, doc_id: str, family: str):
+        """A doc's starting replica for this member: loaded from its latest
+        acked summary when one is reachable (shared refs + object store) —
+        the partition-handoff resume.  A member taking over a partition
+        mid-stream folds only the tail above the acked floor onto the
+        adopted state; re-folding from the committed offset onto an EMPTY
+        replica would silently cut a corrupt next summary.  Falls back to
+        an empty replica (full replay) when nothing is adoptable."""
+        ref = self._ref_for(doc_id)
+        if ref is not None and ref.get("commit") in self.store:
+            try:
+                seq, record = self._read_commit(ref["commit"])
+                ad = _make_doc(record.get("engine", family), self.config)
+                ad.load(seq, record)
+                ad.mark_summarized()
+                chain = GitSnapshotStore(self.store)
+                chain.adopt_version(seq, ref["commit"])
+                self.chains[doc_id] = chain
+                # Seed handle reuse from the adopted commit's own tree.
+                _k, tree_payload = self.store.get(
+                    self.store.get(ref["commit"])[1]["tree"]
+                )
+                self._channel_sha[doc_id] = dict(tree_payload)
+                self.counters.bump("summaries_adopted")
+                return ad
+            except Exception:  # noqa: BLE001 — degrade to full replay
+                self.counters.bump("refs_dropped_unloadable")
+                self._dropped_refs.add(doc_id)
+        return _make_doc(family, self.config)
 
     # ------------------------------------------------------------------- pump
     def pump(self) -> int:
@@ -684,7 +760,7 @@ class ScribeLambda:
             if msg.type != MessageType.OP:
                 return
             family = self.families.get(doc_id) or detect_family(msg.contents)
-            ad = self.docs[doc_id] = _make_doc(family, self.config)
+            ad = self.docs[doc_id] = self._adopt_summary(doc_id, family)
             for join in self._pending_joins.pop(doc_id, []):
                 try:
                     ad.apply(join)
@@ -779,7 +855,7 @@ class ScribeLambda:
         compaction may lag, it can never outrun coverage — ops sequenced
         between the peer's summary point and its ack record sit below the
         ack's offset without being covered)."""
-        ref = self.refs.get(doc_id)
+        ref = self._ref_for(doc_id)
         if ref is not None and ref["seq"] >= seq:
             return
         if offset is None:
@@ -801,7 +877,8 @@ class ScribeLambda:
             "seq": int(seq), "commit": commit, "offset": int(offset),
             "family": family,
         }
-        atomic_json_dump(self.refs, self._refs_path)
+        self._write_ref(doc_id)
+        self._dropped_refs.discard(doc_id)
         ad = self.docs.get(doc_id)
         if ad is not None and ad.last_seq <= seq:
             ad.mark_summarized()

@@ -22,6 +22,21 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache: the suite is compile-dominated on small CI
+# boxes (hundreds of unique engine/kernel geometries, each a multi-second
+# XLA compile), and every pytest process recompiles from scratch.  Caching
+# compiled executables on disk makes reruns bounded by actual test work.
+# Opt out with FFTPU_TEST_COMPILE_CACHE=0; the dir is gitignored.
+if os.environ.get("FFTPU_TEST_COMPILE_CACHE", "1") != "0":
+    _cache_dir = os.environ.get(
+        "FFTPU_TEST_COMPILE_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                     ".jax_compile_cache"),
+    )
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import pytest  # noqa: E402
 
 # Modules whose every test triggers JAX kernel compilation (the expensive
@@ -33,6 +48,7 @@ _DEVICE_MODULES = {
     "test_kernel_channel",
     "test_long_doc",
     "test_matrix_kernel",
+    "test_megastep",
     "test_mergetree_kernel",
     "test_multidevice",
     "test_native_ingest",

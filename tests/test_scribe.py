@@ -444,6 +444,101 @@ def test_scribe_failed_doc_is_isolated(tmp_path):
     scribe.close()
 
 
+# ------------------------------------------------- multi-scribe rebalance
+
+def test_multi_scribe_rebalance_kill_midstream(tmp_path):
+    """Scribe scale-out (ROADMAP): two pool members share one topic via
+    the group in ``partition_manager.ScribePool``; killing one mid-stream
+    (folded-but-unsummarized work lost, no flush) rebalances its
+    partitions to the survivor, which resumes every doc by summary
+    adoption — no doc is double-acked, every partition's summary chain
+    continues from the pre-kill commit, and boot-from-summary stays
+    byte-identical to full replay."""
+    from fluidframework_tpu.models.doc_batch_engine import DocBatchEngine
+    from fluidframework_tpu.server.partition_manager import ScribePool
+
+    topic = _durable_topic(tmp_path, n_partitions=4)
+    docs = [f"d{i}" for i in range(4)]  # byte-sum routing: d<i> -> partition i
+    for d in docs:
+        _join(d, topic)
+    pool = ScribePool(topic, str(tmp_path / "scribe"),
+                      config=ScribeConfig(max_ops=10))
+    a = pool.add_member("a")
+    b = pool.add_member("b")
+    owned = {p for m in ("a", "b") for p in pool.group.assignments(m)}
+    assert owned == {0, 1, 2, 3}
+    assert pool.group.assignments("a") and pool.group.assignments("b")
+
+    # Phase 1: every doc summarizes once (14 ops > max_ops).
+    for i, d in enumerate(docs):
+        _string_stream(d, topic, range(1, 15), seed=i)
+    pool.pump()
+    first = {}
+    for d in docs:
+        acks = _acks_for(topic, d)
+        assert len(acks) == 1 and acks[0][1] == 14
+        first[d] = acks[0][2]
+
+    # Phase 2: fold-but-not-due traffic, then KILL member a mid-stream —
+    # its in-memory fold of these 5 ops dies unsummarized.
+    for i, d in enumerate(docs):
+        _string_stream(d, topic, range(15, 20), seed=10 + i)
+    pool.pump()
+    killed_partitions = pool.group.assignments("a")
+    pool.kill_member("a")
+    assert pool.group.assignments("b") == [0, 1, 2, 3]
+
+    # Phase 3: traffic continues; the survivor re-reads the dead member's
+    # uncovered tail from the group floor, folds onto ADOPTED summaries,
+    # and cuts exactly one new ack per doc.
+    for i, d in enumerate(docs):
+        _string_stream(d, topic, range(20, 30), seed=20 + i)
+    pool.pump()
+    for d in docs:
+        acks = _acks_for(topic, d)
+        seqs = [s for _d, s, _c in acks]
+        assert len(acks) == 2, f"{d}: expected exactly 2 acks, got {seqs}"
+        assert len(set(seqs)) == len(seqs) and seqs == sorted(seqs)
+        assert acks[-1][1] == 29
+        # The post-kill chain links to the pre-kill commit: the survivor
+        # adopted the dead member's summary, it did not restart from zero.
+        _k, payload = pool.store.get(acks[-1][2])
+        assert payload["parent"] == first[d]
+    # The survivor adopted exactly the dead member's docs.
+    assert b.health()["summaries_adopted"] == len(killed_partitions)
+    # Idempotence: re-pumping (which drains phase 3's own ack records)
+    # never re-acks or re-summarizes.
+    pool.pump()
+    pool.pump()
+    for d in docs:
+        assert len(_acks_for(topic, d)) == 2
+
+    # Boot-from-summary through the survivor's record store is
+    # byte-identical to a full-history replay for EVERY doc, including the
+    # dead member's.
+    store = SummaryRecordStore.from_scribe(b)
+    eng = DocBatchEngine(4, max_insert_len=8, ops_per_step=4, use_mesh=False,
+                         doc_keys=docs)
+    eng.restore_from_checkpoints(store=store)
+    ctl = DocBatchEngine(4, max_insert_len=8, ops_per_step=4, use_mesh=False,
+                         doc_keys=docs)
+    by_doc = {d: i for i, d in enumerate(docs)}
+    for p in range(topic.n_partitions):
+        for r in topic.partition(p).read(0):
+            if isinstance(r.payload, SequencedMessage) and r.doc_id in by_doc:
+                ctl.ingest(by_doc[r.doc_id], r.payload)
+    ctl.step()
+    for i, d in enumerate(docs):
+        assert eng.text(i) == ctl.text(i), d
+
+    # Pool-safe compaction reclaims the covered prefix without stranding
+    # any partition (refs union pins the floors).
+    reclaimed = pool.compact()
+    assert sum(reclaimed.values()) >= 0
+    topic.close()
+    pool.close()
+
+
 # ---------------------------------------------------------------- detection
 
 def test_family_detection():
