@@ -41,21 +41,21 @@ from .forest import Node
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class Skip:
     """Pass over ``count`` nodes unchanged (consumes N, produces N)."""
 
     count: int
 
 
-@dataclass
+@dataclass(slots=True)
 class Insert:
     """Insert ``content`` at the current position (consumes 0, produces N)."""
 
     content: list[Node]
 
 
-@dataclass
+@dataclass(slots=True)
 class Remove:
     """Remove ``count`` nodes (consumes N, produces 0). ``detached`` holds
     the removed subtrees once applied (repair data for invert/revive)."""
@@ -64,14 +64,14 @@ class Remove:
     detached: Optional[list[Node]] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class Modify:
     """Apply a nested NodeChange to one node (consumes 1, produces 1)."""
 
     change: "NodeChange"
 
 
-@dataclass
+@dataclass(slots=True)
 class MoveOut:
     """Detach ``count`` nodes into the move register ``id`` (consumes N,
     produces 0).  ``offset`` is the first node's index within the ORIGINAL
@@ -85,7 +85,7 @@ class MoveOut:
     offset: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class MoveIn:
     """Attach nodes of move register ``id`` here (consumes 0, produces
     ``count``).  ``offset`` selects which original-move offsets to attach
@@ -100,7 +100,7 @@ class MoveIn:
 Mark = Skip | Insert | Remove | Modify | MoveOut | MoveIn
 
 
-@dataclass
+@dataclass(slots=True)
 class NodeChange:
     """Changes to one node: an optional value overwrite plus per-field
     changes.  ``value`` is (new,) before apply and (new, old) after
@@ -501,12 +501,28 @@ def rebase_marks(a: list[Mark], b: list[Mark], a_after: bool = True) -> list[Mar
     return out
 
 
+_kind_of = None
+
+
+def _get_kind_of():
+    """Lazily-cached field_kinds.kind_of (changeset cannot import
+    field_kinds at module scope — field_kinds imports changeset — and the
+    per-call ``from .field_kinds import kind_of`` paid importlib overhead
+    on every rebase/compose dispatch in the trunk-translation hot path)."""
+    global _kind_of
+    if _kind_of is None:
+        from .field_kinds import kind_of as k
+
+        _kind_of = k
+    return _kind_of
+
+
 def rebase_node_change(a: NodeChange, b: NodeChange, a_after: bool = True) -> NodeChange:
     """Rebase one node's change over another's. Value: the later-sequenced
     set wins (LWW) — a keeps its value when it is the later side, and drops
     it when the earlier side is carried over a later set. Fields: pairwise
     per-kind rebase through the registry."""
-    from .field_kinds import kind_of
+    kind_of = _kind_of or _get_kind_of()
 
     value = a.value
     if a.value is not None and b.value is not None and not a_after:
@@ -537,7 +553,7 @@ def compose_node_change(a: NodeChange, b: NodeChange) -> NodeChange:
     """Compose node changes (b reads a's output context; result reads a's
     input context) — the third leg of the ChangeRebaser triple
     (changeRebaser.ts:41), dispatched per field kind."""
-    from .field_kinds import kind_of
+    kind_of = _kind_of or _get_kind_of()
 
     if b.value is not None:
         # Enrichment is carried by tuple LENGTH (2 = applied), never by the

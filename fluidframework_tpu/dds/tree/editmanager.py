@@ -59,6 +59,31 @@ class TrunkCommit:
 class PeerBranch:
     base: int  # trunk seq this peer has integrated (its max refSeq seen)
     inflight: list[tuple[Any, Commit]] = field(default_factory=list)
+    # ---- incremental translation stream (see add_sequenced) ----
+    # Trunk seq the stream is current to (>= base; never rewinds).
+    pos: int = 0
+    # [(trunk_seq, x)]: other peers' trunk commits in (base-ish, pos],
+    # each rebased through every one of THIS peer's in-flight commits that
+    # was submitted before the trunk commit was integrated (maintained by
+    # the fold write-back in add_sequenced).  An incoming commit from this
+    # peer translates to trunk coordinates by folding over the (ref, seq]
+    # slice of this list — O(window) rebases instead of re-walking the
+    # trunk with a cloned in-flight scratch per commit (O(window x
+    # inflight) and a full clone, the measured host-translation hotspot).
+    xs: list[tuple[int, Commit]] = field(default_factory=list)
+    # Post-load residue: in-flight commits integrated by a PREVIOUS
+    # incarnation (their write-back state is lost), kept as local-coords
+    # clones that stream extension bridges through until their trunk
+    # entries are crossed.  Empty in steady state.
+    scratch: list[Commit] = field(default_factory=list)
+    # Parallel to ``inflight``: each element's fold intermediates
+    # [(trunk_seq, commit-at-that-base)] recorded during its integration —
+    # the exact values the old per-advance bridge walk recomputed, so
+    # ``_advance`` materializes base moves by lookup instead of O(window x
+    # inflight) rebases.  ``None`` marks a post-load element (stages lost
+    # with the previous incarnation) which forces the legacy bridge walk
+    # until it pops.
+    stages: list = field(default_factory=list)
 
 
 def bridge(inflight: list[tuple[Any, Commit]], incoming: Commit) -> tuple[
@@ -76,6 +101,21 @@ def bridge(inflight: list[tuple[Any, Commit]], incoming: Commit) -> tuple[
     out = []
     for rev, f in inflight:
         out.append((rev, rebase_commit(f, x, a_after=True)))
+        x = rebase_commit(x, f, a_after=False)
+    return out, x
+
+
+def bridge_bare(commits: list[Commit], incoming: Commit) -> tuple[
+    list[Commit], Commit
+]:
+    """``bridge`` over a bare Commit list (no revision tags) — the
+    post-load scratch residue's fold.  One definition of the mirrored
+    rebase pair, shared by stream extension and the compaction-floor
+    advance."""
+    x = incoming
+    out = []
+    for f in commits:
+        out.append(rebase_commit(f, x, a_after=True))
         x = rebase_commit(x, f, a_after=False)
     return out, x
 
@@ -112,37 +152,113 @@ class EditManager:
         seq: int,
     ) -> Commit:
         """Integrate one sequenced commit; returns its trunk-coordinates
-        version (what a caller applies to trunk-tip state)."""
+        version (what a caller applies to trunk-tip state).
+
+        Translation is INCREMENTAL: instead of re-walking the trunk range
+        (ref_seq, seq] with a cloned copy of the peer's in-flight list per
+        commit (the original O(window x inflight) bridge walk), each peer
+        carries a cached translation stream ``xs`` of other peers' trunk
+        commits already rebased through this peer's in-flight context.
+        The incoming commit folds over the stream's (ref_seq, seq] slice,
+        and the fold WRITES BACK the mirrored rebase (the bridge pair) so
+        later commits from this peer see its effect — sound because a
+        bridge transforms each list prefix independently of its suffix,
+        so the cached prefix evolution is exactly what a fresh walk would
+        recompute.  Entries at or below the peer's refSeq are dead (per-
+        client refSeqs are monotone) and are dropped as the ref advances."""
         br = self.peers.get(client_id)
         if br is None:
-            br = self.peers[client_id] = PeerBranch(base=max(ref_seq, self.trunk_base))
-        # 1. advance the peer's base to its refSeq.
+            base = max(ref_seq, self.trunk_base)
+            br = self.peers[client_id] = PeerBranch(base=base, pos=base)
+        # 1. advance the peer's base to its refSeq (in-flight maintenance
+        # for summaries and FIFO accounting; unchanged semantics).
         self._advance(client_id, br, ref_seq)
-        # 2. translate to trunk coordinates over commits the peer hasn't seen.
-        # Range is (ref_seq, seq] over the EXISTING trunk: grouped batches
-        # give several commits one sequence number, and earlier same-seq
-        # commits from this client are part of this commit's context.
-        scratch = [(rev, clone_commit(ch)) for rev, ch in br.inflight]
-        c = clone_commit(change)
-        for t in self._trunk_range(ref_seq, seq):
+        # 2. extend the translation stream over trunk commits the stream
+        # has not consumed.  Grouped batches give several commits one
+        # sequence number; earlier same-seq commits from this client were
+        # folded into the stream by their own write-back.
+        for t in self._trunk_range(br.pos, seq):
             if t.client_id == client_id:
-                assert scratch and scratch[0][0] == t.revision, "peer FIFO skew"
-                scratch.pop(0)
-            else:
-                scratch, x = bridge(scratch, t.change)
-                c = rebase_commit(c, x)
-        assert not scratch, "peer had unsequenced ops ahead of this commit"
+                # Own commit integrated by a previous incarnation (post-
+                # load): its local-coords clone leaves the scratch residue
+                # exactly when the walk crosses its trunk entry.
+                if br.scratch:
+                    br.scratch.pop(0)
+                continue
+            x = t.change
+            if br.scratch:
+                br.scratch, x = bridge_bare(br.scratch, x)
+            br.xs.append((t.seq, x))
+        br.pos = max(br.pos, seq)
+        assert not br.scratch, "peer had unsequenced ops ahead of this commit"
+        # 3. drop stream entries the peer has integrated (ref monotone),
+        # then fold the commit over the live slice with bridge write-back.
+        xs = br.xs
+        drop = 0
+        while drop < len(xs) and xs[drop][0] <= ref_seq:
+            drop += 1
+        if drop:
+            del xs[:drop]
+        c = clone_commit(change)
+        stage_list: list[tuple[int, Commit]] = []
+        for i in range(len(xs)):
+            tseq, x = xs[i]
+            nxt = rebase_commit(c, x, a_after=True)
+            xs[i] = (tseq, rebase_commit(x, c, a_after=False))
+            c = nxt
+            stage_list.append((tseq, c))
+        # The recorded stages share Mark objects with each other AND with
+        # the final fold value (rebase's per-field clones are shallow), and
+        # the caller apply-ENRICHES the returned trunk commit in place — so
+        # the trunk log and caller get a private deep clone, keeping every
+        # recorded stage at its unapplied form (what _advance materializes
+        # and summarize serializes, exactly as the legacy bridge walk
+        # produced).  One clone per commit, not per stage.
+        ret = clone_commit(c) if stage_list else c
         br.inflight.append((revision, clone_commit(change)))
-        self.trunk.append(TrunkCommit(seq=seq, client_id=client_id, revision=revision, change=c))
-        return c
+        br.stages.append(stage_list)
+        self.trunk.append(TrunkCommit(seq=seq, client_id=client_id, revision=revision, change=ret))
+        return ret
 
     def _advance(self, client_id: str, br: PeerBranch, upto: int) -> None:
-        for t in self._trunk_range(br.base, upto):
-            if t.client_id == client_id:
-                assert br.inflight and br.inflight[0][0] == t.revision, "peer FIFO skew"
-                br.inflight.pop(0)
-            else:
-                br.inflight, _ = bridge(br.inflight, t.change)
+        """Advance the peer's base: pop own commits the base crosses and
+        bring the surviving in-flight values to base coordinates.  Steady
+        state materializes each value from its recorded fold stages (the
+        bridge walk's exact outputs, captured when they were first
+        computed); post-load elements (no stages) force the legacy
+        O(window x inflight) bridge walk until they pop."""
+        if upto <= br.base:
+            return
+        rng = self._trunk_range(br.base, upto)
+        if any(s is None for s in br.stages):
+            for t in rng:
+                if t.client_id == client_id:
+                    assert br.inflight and br.inflight[0][0] == t.revision, \
+                        "peer FIFO skew"
+                    br.inflight.pop(0)
+                    br.stages.pop(0)
+                else:
+                    br.inflight, _ = bridge(br.inflight, t.change)
+        else:
+            moved = False
+            for t in rng:
+                if t.client_id == client_id:
+                    assert br.inflight and br.inflight[0][0] == t.revision, \
+                        "peer FIFO skew"
+                    br.inflight.pop(0)
+                    br.stages.pop(0)
+                else:
+                    moved = True
+            if moved:
+                for i, stages in enumerate(br.stages):
+                    val = None
+                    for tseq, cm in stages:
+                        if tseq <= upto:
+                            val = cm
+                        else:
+                            break
+                    if val is not None:
+                        br.inflight[i] = (br.inflight[i][0], val)
         br.base = max(br.base, upto)
 
     # -------------------------------------------------------------- lifecycle
@@ -157,6 +273,31 @@ class EditManager:
         for client_id, br in self.peers.items():
             if br.base < min_seq:
                 self._advance(client_id, br, min_seq)
+            # Translation-stream floor: every future refSeq from this peer
+            # is >= min_seq, so entries at or below it can never be folded
+            # again — and the stream position must stay inside retained
+            # trunk history.  Skipped commits in (pos, min_seq] would only
+            # have produced entries the ref GC dropped immediately.
+            drop = 0
+            while drop < len(br.xs) and br.xs[drop][0] <= min_seq:
+                drop += 1
+            if drop:
+                del br.xs[:drop]
+            if br.pos < min_seq:
+                # Advance the stream position over the about-to-be-evicted
+                # range.  The x entries it would have produced are dead
+                # (all <= min_seq), but a post-load scratch residue still
+                # pops/bridges through the range so its coordinates stay
+                # consistent for entries beyond the floor.
+                if br.scratch:
+                    for t in self._trunk_range(br.pos, min_seq):
+                        if not br.scratch:
+                            break
+                        if t.client_id == client_id:
+                            br.scratch.pop(0)
+                        else:
+                            br.scratch, _ = bridge_bare(br.scratch, t.change)
+                br.pos = min_seq
         self.trunk = [t for t in self.trunk if t.seq > min_seq]
         self.trunk_base = min_seq
 
@@ -199,13 +340,20 @@ class EditManager:
             )
             for t in data["trunk"]
         ]
-        self.peers = {
-            cid: PeerBranch(
+        self.peers = {}
+        for cid, p in data["peers"].items():
+            inflight = [
+                (self._decode_rev(rev), commit_from_json(ch))
+                for rev, ch in p["inflight"]
+            ]
+            # The previous incarnation's fold write-back state is not part
+            # of the summary; re-seed the stream from the in-flight clones
+            # (extension bridges through them until their trunk entries
+            # are crossed — the original walk, applied lazily).
+            self.peers[cid] = PeerBranch(
                 base=p["base"],
-                inflight=[
-                    (self._decode_rev(rev), commit_from_json(ch))
-                    for rev, ch in p["inflight"]
-                ],
+                inflight=inflight,
+                pos=p["base"],
+                scratch=[clone_commit(ch) for _rev, ch in inflight],
+                stages=[None] * len(inflight),
             )
-            for cid, p in data["peers"].items()
-        }

@@ -34,6 +34,24 @@ from typing import Any
 
 from .forest import Node
 
+# Module-level import is safe (changeset never imports field_kinds at module
+# scope); the previous per-call lazy imports in the rebase/compose hot path
+# paid importlib machinery on every field dispatch.
+from .changeset import (
+    NodeChange,
+    apply_marks,
+    apply_node_change,
+    change_from_json,
+    change_to_json,
+    compose_node_change,
+    invert_marks,
+    invert_node_change,
+    marks_from_json,
+    marks_to_json,
+    rebase_marks,
+    rebase_node_change,
+)
+
 # ---------------------------------------------------------------------------
 # Optional / value field changes
 # ---------------------------------------------------------------------------
@@ -95,31 +113,21 @@ class SequenceFieldKind(FieldKind):
         return list(change)  # shallow, matching the historical copy
 
     def rebase(self, a, b, a_after: bool):
-        from .changeset import rebase_marks
-
         return rebase_marks(a, b, a_after)
 
     def invert(self, change):
-        from .changeset import invert_marks
-
         return invert_marks(change)
 
     def compose(self, a, b):
         return compose_marks(a, b)
 
     def apply(self, nodes: list[Node], change) -> None:
-        from .changeset import apply_marks
-
         apply_marks(nodes, change)
 
     def to_json(self, change):
-        from .changeset import marks_to_json
-
         return marks_to_json(change)  # bare list: wire-compatible
 
     def from_json(self, data):
-        from .changeset import marks_from_json
-
         return marks_from_json(data)
 
     def is_empty(self, change) -> bool:
@@ -142,8 +150,6 @@ class OptionalFieldKind(FieldKind):
         """Always returns a FRESH change object — a rebased pending form is
         later apply-enriched in place, and sharing structure with the
         original shipped commit would rewrite its repair data."""
-        from .changeset import rebase_node_change
-
         if b.set is not None:
             # b replaced the field content.
             if a.set is not None:
@@ -158,8 +164,6 @@ class OptionalFieldKind(FieldKind):
         return self.clone(a)
 
     def invert(self, change: OptionalChange):
-        from .changeset import invert_node_change
-
         if change.is_empty():  # rebase can void a change (conflict loser)
             return self._mk()
         if change.set is not None:
@@ -172,8 +176,6 @@ class OptionalFieldKind(FieldKind):
         return self._mk(nested=invert_node_change(change.nested))
 
     def compose(self, a: OptionalChange, b: OptionalChange):
-        from .changeset import apply_node_change, compose_node_change
-
         if b.set is not None:
             new = b.set[0]
             if a.set is not None and len(a.set) == 2:
@@ -207,8 +209,6 @@ class OptionalFieldKind(FieldKind):
         return a if b.is_empty() else b
 
     def apply(self, nodes: list[Node], change: OptionalChange) -> None:
-        from .changeset import apply_node_change
-
         if change.is_empty():  # rebase can void a change (conflict loser)
             return
         if change.set is not None:
@@ -225,8 +225,6 @@ class OptionalFieldKind(FieldKind):
         apply_node_change(nodes[0], change.nested)
 
     def to_json(self, change: OptionalChange):
-        from .changeset import change_to_json
-
         out: dict[str, Any] = {"k": self.name}
         if change.set is not None:
             out["set"] = [
@@ -237,8 +235,6 @@ class OptionalFieldKind(FieldKind):
         return out
 
     def from_json(self, data):
-        from .changeset import change_from_json
-
         return self._mk(
             set=tuple(
                 Node.from_json(n) if n is not None else None
@@ -305,8 +301,6 @@ def _safe_invert(nested):
     """Invert a nested NodeChange for repair-data context transport; an
     unenriched change (compose of never-applied changes, which carries no
     repair data to protect) inverts to the identity instead of asserting."""
-    from .changeset import NodeChange, invert_node_change
-
     try:
         return invert_node_change(nested)
     except AssertionError:

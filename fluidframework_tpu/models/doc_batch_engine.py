@@ -79,16 +79,18 @@ from ..ops import mergetree_kernel as mk
 from ..parallel.mesh import doc_mesh, shard_docs
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters
-from .staging import StagingRing
+from .staging import RowQueue, StagingRing
 
 
 @dataclass
 class _DocHost:
     """Host-side per-document bookkeeping."""
 
+    # Columnar pending op rows (ops + payloads in one RowQueue): batch
+    # ingest lands whole blocks, the drain consumes slice copies — no
+    # per-op Python list traffic on either side.
+    queue: RowQueue = None
     quorum: dict[str, int] = field(default_factory=dict)
-    queue: list[np.ndarray] = field(default_factory=list)
-    payloads: list[np.ndarray] = field(default_factory=list)
     min_seq: int = 0
     # Property id -> kernel prop slot (interned per document).
     prop_slot: dict[int, int] = field(default_factory=dict)
@@ -123,8 +125,19 @@ class _OverflowLane:
     state: mk.DocState
     geometry: dict[str, int]
     growths: int
-    queue: list[np.ndarray] = field(default_factory=list)
-    payloads: list[np.ndarray] = field(default_factory=list)
+    queue: RowQueue = None
+
+
+def _i32(v) -> int:
+    """Coerce one wire scalar for the batch walk with the per-message
+    path's exact failure shape: ``np.array([...], np.int32)`` raises
+    OverflowError on out-of-range ints, while the batch path's int64
+    staging columns would silently WRAP on the int32 cast — so the range
+    check must happen at collection time, loudly."""
+    v = int(v)
+    if not (-0x80000000 <= v <= 0x7FFFFFFF):
+        raise OverflowError(f"op scalar {v} out of int32 range")
+    return v
 
 
 # Module-level jitted programs: every engine instance shares ONE compile
@@ -235,7 +248,10 @@ class DocBatchEngine:
         self.megastep_k = max(1, megastep_k)
         self.recovery = recovery
         self.max_growths = max_growths
-        self.hosts = [_DocHost() for _ in range(n_docs)]
+        self.hosts = [
+            _DocHost(queue=RowQueue(mk.OP_FIELDS, max_insert_len))
+            for _ in range(n_docs)
+        ]
         self.geometry = {
             "max_segments": max_segments,
             "remove_slots": remove_slots,
@@ -416,16 +432,237 @@ class DocBatchEngine:
             self._quarantine_doc(doc_idx, f"decode: {e}")
             return
         if doc_idx in self.overflow:
-            lane = self.overflow[doc_idx]
-            for op, payload in rows:
-                lane.queue.append(op)
-                lane.payloads.append(payload)
+            self.overflow[doc_idx].queue.extend_rows(rows)
             return
-        for op, payload in rows:
-            h.queue.append(op)
-            h.payloads.append(payload)
+        h.queue.extend_rows(rows)
         if h.queue:
             self._busy.add(doc_idx)
+
+    # -------------------------------------------------------- batched ingest
+    def ingest_batch(self, doc_idxs, msgs) -> int:
+        """Columnar ingest fast path: decode a whole wire batch into
+        [N, OP_FIELDS] op rows + payload rows with vectorized numpy and
+        land them in the per-doc RowQueues as block copies — Python is
+        touched per *message* for routing/bookkeeping only; all op-row
+        materialization is batched (mk.encode_insert_batch /
+        encode_obliterate_batch / column stacks).
+
+        Semantics are byte-identical to calling ``ingest`` per message:
+
+        - JOINs, non-OP messages, quarantined/oracle/overflow docs, and
+          native-mode docs take the per-message path row by row
+          (``ingest_fallback_msgs`` counts them).
+        - A decode error quarantines ONLY the offending doc, exactly as
+          the per-message path does: its earlier batch rows are dropped
+          from the scatter (they already rode the retained log into the
+          quarantine replay) and its later messages route through the
+          validated oracle.
+        - Recovery logging, checkpoint-floor dedupe, and boot counting
+          run per message in the routing walk, unchanged.
+
+        Returns the op-row count landed through the batch path.
+        """
+        L = self.max_insert_len
+        counters = self.counters
+        total = 0
+        doc_of: list[int] = []  # row id -> doc
+        # Per-kind columnar collectors (row ids reserved in walk order so
+        # the per-doc ordering of mixed-kind streams is preserved).
+        i_start: list[int] = []
+        i_nch: list[int] = []
+        i_pos: list[int] = []
+        i_txt: list[str] = []
+        i_key: list[int] = []
+        i_cli: list[int] = []
+        i_ref: list[int] = []
+        s_id: list[int] = []  # single-row ops: global row ids
+        s_row: list[tuple[int, int, int, int, int, int, int, int]] = []
+        o_id: list[int] = []  # obliterates (vectorized encoder columns)
+        o_col: tuple[list[int], ...] = ([], [], [], [], [], [], [])
+        pending_raise: BaseException | None = None
+        for d, msg in zip(doc_idxs, msgs):
+            h = self.hosts[d]
+            if (
+                msg.type != MessageType.OP
+                or d in self.quarantine
+                or d in self.oracles
+                or d in self.overflow
+                or h.mode == "native"
+            ):
+                counters.bump("ingest_fallback_msgs")
+                self.ingest(d, msg)
+                continue
+            if h.mode is None:
+                h.mode = "obj"
+            h.min_seq = max(h.min_seq, msg.min_seq)
+            if h.base_seq and msg.seq <= h.base_seq:
+                counters.bump("checkpointed_ops_skipped")
+                continue
+            h.last_seq = max(h.last_seq, msg.seq)
+            h.ops_since_ckpt += 1
+            if h.boot_counting:
+                counters.bump("boot_replay_len")
+            if self.recovery != "off":
+                h.log.append(msg)
+            try:
+                c = msg.contents
+                kind = c["type"]
+                client = h.quorum[msg.client_id]
+                if kind == DeltaType.INSERT:
+                    seg = c["seg"]
+                    if not isinstance(seg, str):
+                        # Legal-but-unsupported wire form: loud feature
+                        # gap, never applied — same unwinding as _encode.
+                        if h.log and h.log[-1] is msg:
+                            h.log.pop()
+                        h.ops_since_ckpt -= 1
+                        pending_raise = NotImplementedError(
+                            "engine supports plain-text insert segs only; "
+                            f"got {type(seg).__name__}"
+                        )
+                        break
+                    # _i32 coercions throughout this walk are load-bearing
+                    # AND must complete before ANY collector append: a
+                    # malformed scalar (string value, dict pos) raises
+                    # INSIDE this try — per-doc quarantine — an
+                    # out-of-int32 scalar raises OverflowError (per-message
+                    # parity: loud, never a silent int64->int32 wrap), and
+                    # a partial append would misalign the columnar
+                    # collectors and crash the whole-batch numpy scatter.
+                    pos = _i32(c["pos1"])
+                    nch = -(-len(seg) // L)
+                    i_start.append(total)
+                    i_nch.append(nch)
+                    i_pos.append(pos)
+                    i_txt.append(seg)
+                    i_key.append(_i32(msg.seq))
+                    i_cli.append(client)
+                    i_ref.append(_i32(msg.ref_seq))
+                    doc_of.extend([d] * nch)
+                    total += nch
+                elif kind == DeltaType.REMOVE:
+                    row = (
+                        mk.OpKind.REMOVE, _i32(msg.seq), client,
+                        _i32(msg.ref_seq), _i32(c["pos1"]), _i32(c["pos2"]),
+                        0, 0,
+                    )
+                    s_id.append(total)
+                    s_row.append(row)
+                    doc_of.append(d)
+                    total += 1
+                elif kind == DeltaType.ANNOTATE:
+                    seq32, ref32 = _i32(msg.seq), _i32(msg.ref_seq)
+                    p1, p2 = _i32(c["pos1"]), _i32(c["pos2"])
+                    # All props coerce before any append, mirroring the
+                    # per-message path where a mid-props failure lands
+                    # NOTHING for the message.
+                    prop_rows = [
+                        (self._prop_slot_for(h, int(prop)), _i32(value))
+                        for prop, value in c["props"].items()
+                    ]
+                    for slot, value in prop_rows:
+                        s_id.append(total)
+                        s_row.append((
+                            mk.OpKind.ANNOTATE, seq32, client,
+                            ref32, p1, p2, slot, value,
+                        ))
+                        doc_of.append(d)
+                        total += 1
+                elif kind in (DeltaType.OBLITERATE, DeltaType.OBLITERATE_SIDED):
+                    places = decode_obliterate_places(c)
+                    vals = tuple(
+                        _i32(v)
+                        for v in (*places, msg.seq, client, msg.ref_seq)
+                    )
+                    o_id.append(total)
+                    for col, v in zip(o_col, vals):
+                        col.append(v)
+                    doc_of.append(d)
+                    total += 1
+                else:
+                    raise ValueError(f"unsupported op type {kind}")
+            except OverflowError as e:
+                # Per-message parity: OverflowError is NOT a quarantine
+                # class there (np.array raises it out of ingest with the
+                # message's bookkeeping committed) — land the earlier
+                # messages' rows, then surface it.
+                pending_raise = e
+                break
+            except (ValueError, KeyError, TypeError) as e:
+                if self.recovery == "off":
+                    pending_raise = e
+                    break
+                # Decode failure: poison for THIS doc only — quarantine it
+                # (its staged + batch rows ride the retained log into the
+                # validated replay) and keep batching the rest.
+                self._quarantine_doc(d, f"decode: {e}")
+        staged = self._scatter_batch_rows(
+            total, doc_of, i_start, i_nch, i_pos, i_txt, i_key, i_cli,
+            i_ref, s_id, s_row, o_id, o_col,
+        )
+        if pending_raise is not None:
+            raise pending_raise
+        return staged
+
+    def _scatter_batch_rows(
+        self, total, doc_of, i_start, i_nch, i_pos, i_txt, i_key, i_cli,
+        i_ref, s_id, s_row, o_id, o_col,
+    ) -> int:
+        """Materialize the collected batch rows (vectorized) and land them
+        per doc as block copies; rows for docs that left the device path
+        mid-batch are dropped (their ops already rode the log into the
+        lane replay)."""
+        if not total:
+            return 0
+        ops_all = np.zeros((total, mk.OP_FIELDS), np.int32)
+        pay_all = np.zeros((total, self.max_insert_len), np.int32)
+        if i_txt:
+            ops_i, pay_i, _owner = mk.encode_insert_batch(
+                np.asarray(i_pos, np.int64), i_txt,
+                np.asarray(i_key, np.int64), np.asarray(i_cli, np.int64),
+                np.asarray(i_ref, np.int64), self.max_insert_len,
+            )
+            nch = np.asarray(i_nch, np.int64)
+            m = int(nch.sum())
+            row0 = np.concatenate(([0], np.cumsum(nch)[:-1]))
+            ids = np.repeat(np.asarray(i_start, np.int64), nch) + (
+                np.arange(m) - np.repeat(row0, nch)
+            )
+            ops_all[ids] = ops_i
+            pay_all[ids] = pay_i
+        if s_row:
+            ops_all[np.asarray(s_id, np.int64)] = np.asarray(s_row, np.int32)
+        if o_id:
+            ops_all[np.asarray(o_id, np.int64)] = mk.encode_obliterate_batch(
+                *(np.asarray(col, np.int64) for col in o_col)
+            )
+        doc_arr = np.asarray(doc_of, np.int64)
+        live = np.ones((total,), bool)
+        for d in set(doc_of):
+            if d in self.quarantine or d in self.oracles or d in self.overflow:
+                live[doc_arr == d] = False
+        # Stable doc-sort: one extend_block per doc, original order kept.
+        order = np.argsort(doc_arr, kind="stable")
+        order = order[live[order]]
+        staged = int(order.size)
+        if not staged:
+            return 0
+        sorted_docs = doc_arr[order]
+        cuts = np.flatnonzero(np.diff(sorted_docs)) + 1
+        for seg in np.split(order, cuts):
+            d = int(doc_arr[seg[0]])
+            self.hosts[d].queue.extend_block(ops_all[seg], pay_all[seg])
+            self._busy.add(d)
+        self.counters.bump("ingest_batch_rows", staged)
+        return staged
+
+    def _make_lane(
+        self, state: mk.DocState, geometry: dict[str, int], growths: int
+    ) -> _OverflowLane:
+        return _OverflowLane(
+            state=state, geometry=geometry, growths=growths,
+            queue=RowQueue(mk.OP_FIELDS, self.max_insert_len),
+        )
 
     def _in_lane(self, doc_idx: int) -> bool:
         """True when the doc has left the lockstep batch (or was restored
@@ -454,16 +691,19 @@ class DocBatchEngine:
         h = self.hosts[doc_idx]
         if self._in_lane(doc_idx) or not available():
             # Lanes, checkpoint-restored docs, and the no-native fallback
-            # consume parsed messages.
+            # consume parsed messages — decoded as one batch and fed
+            # through the columnar fast path (ingest_batch routes lane
+            # docs message by message itself, so semantics match).
             self._normalize_native(h)
             lane = self.overflow.get(doc_idx)
             before = len(lane.queue) if lane else len(h.queue)
-            n_msgs = 0
-            for line in data.split(b"\n"):
-                if line.strip():
-                    msg = SequencedMessage.from_json(line.decode())
-                    n_msgs += msg.type == MessageType.OP
-                    self.ingest(doc_idx, msg)
+            msgs = [
+                SequencedMessage.from_json(line.decode())
+                for line in data.split(b"\n")
+                if line.strip()
+            ]
+            n_msgs = sum(m.type == MessageType.OP for m in msgs)
+            self.ingest_batch([doc_idx] * len(msgs), msgs)
             if doc_idx in self.oracles or doc_idx in self.quarantine:
                 return n_msgs
             lane = self.overflow.get(doc_idx)
@@ -480,8 +720,9 @@ class DocBatchEngine:
         ops, payloads = h.native.encode(data)
         if self.recovery != "off":
             h.raw_log.append(data)
-        h.queue.extend(ops)
-        h.payloads.extend(payloads)
+        # Native row output lands as one block copy per chunk — the doc
+        # lane "gather" is a slice assignment, never a per-row Python loop.
+        h.queue.extend_block(ops, payloads)
         if h.queue:
             self._busy.add(doc_idx)
         h.min_seq = max(h.min_seq, h.native.min_seq)
@@ -644,10 +885,9 @@ class DocBatchEngine:
             if not take:
                 continue
             r = j if rows is None else rows[j]
-            ops[r, :take] = h.queue[:take]
-            payloads[r, :take] = h.payloads[:take]
-            del h.queue[:take]
-            del h.payloads[:take]
+            src_ops, src_payloads = h.queue.take(take)
+            ops[r, :take] = src_ops
+            payloads[r, :take] = src_payloads
             if not h.queue:
                 self._busy.discard(d)
             written.append(r)
@@ -829,10 +1069,9 @@ class DocBatchEngine:
                 # allocation, and the double buffer keeps the host from
                 # mutating an upload still in flight.
                 ops, payloads = stage.acquire(1, 1)
-                ops[0, 0, :take] = lane.queue[:take]
-                payloads[0, 0, :take] = lane.payloads[:take]
-                del lane.queue[:take]
-                del lane.payloads[:take]
+                src_ops, src_payloads = lane.queue.take(take)
+                ops[0, 0, :take] = src_ops
+                payloads[0, 0, :take] = src_payloads
                 stage.mark(0, [0])
                 dev_ops = jnp.asarray(ops[0, 0])
                 dev_payloads = jnp.asarray(payloads[0, 0])
@@ -914,9 +1153,7 @@ class DocBatchEngine:
             state = self._replay(h, geom)
             new_bits = int(state.error)
             if new_bits == 0:
-                self.overflow[d] = _OverflowLane(
-                    state=state, geometry=geom, growths=growths
-                )
+                self.overflow[d] = self._make_lane(state, geom, growths)
                 self.counters.bump("capacity_recoveries")
                 return
             bits = new_bits
@@ -1107,7 +1344,6 @@ class DocBatchEngine:
                 self._readmit_interval[d] = interval
                 self._readmit_due[d] = self._step_count + interval
         h.queue.clear()
-        h.payloads.clear()
         self._busy.discard(d)
         if d < self.capacity:
             self.state = self.state._replace(
@@ -1384,9 +1620,8 @@ class DocBatchEngine:
                     rec["summary"], geom,
                     lambda p, _h=h, _g=geom: self._prop_slot_for_geom(_h, p, _g),
                 )
-                self.overflow[d] = _OverflowLane(
-                    state=state, geometry=geom,
-                    growths=int(rec.get("growths", 1)),
+                self.overflow[d] = self._make_lane(
+                    state, geom, int(rec.get("growths", 1))
                 )
             else:
                 try:
@@ -1410,9 +1645,7 @@ class DocBatchEngine:
                             _h, p, _g
                         ),
                     )
-                    self.overflow[d] = _OverflowLane(
-                        state=state, geometry=geom, growths=1
-                    )
+                    self.overflow[d] = self._make_lane(state, geom, 1)
                 else:
                     self.state = jax.tree.map(
                         lambda x, s: x.at[d].set(s), self.state, row

@@ -33,6 +33,102 @@ from __future__ import annotations
 import numpy as np
 
 
+class RowQueue:
+    """Columnar per-document pending-op queue: one [N, F] op-row array and
+    one [N, L] payload array with head/tail cursors, replacing the
+    list-of-tiny-arrays queues that made the host feeder touch Python per
+    op.  The batched ingest path lands whole wire batches with ONE slice
+    copy per document (``extend_block``), and ``_drain_into`` consumes
+    with one slice copy per document per slice (``take``) — the host cost
+    of a message is amortized over its batch, not paid per op row.
+
+    Growth doubles; a drained prefix is reclaimed by shifting the live
+    window down whenever it would save a grow (amortized O(1) per row).
+    ``take`` returns views valid until the next append/extend — callers
+    copy out immediately (the staging buffers do).
+    """
+
+    __slots__ = ("ops", "payloads", "head", "tail")
+
+    def __init__(self, op_fields: int, payload_len: int, capacity: int = 0) -> None:
+        self.ops = np.empty((capacity, op_fields), np.int32)
+        self.payloads = np.empty((capacity, payload_len), np.int32)
+        self.head = 0
+        self.tail = 0
+
+    def __len__(self) -> int:
+        return self.tail - self.head
+
+    def __bool__(self) -> bool:
+        return self.tail > self.head
+
+    def __iter__(self):
+        """Iterate pending op rows (diagnostics/tests; not a hot path)."""
+        return iter(self.ops[self.head : self.tail])
+
+    def _room(self, n: int) -> None:
+        cap = self.ops.shape[0]
+        if self.tail + n <= cap:
+            return
+        live = self.tail - self.head
+        if live + n <= cap and self.head >= live + n:
+            # Shifting beats growing: reclaim the drained prefix in place.
+            self.ops[:live] = self.ops[self.head : self.tail]
+            self.payloads[:live] = self.payloads[self.head : self.tail]
+        else:
+            new_cap = max(16, cap)
+            while new_cap < live + n:
+                new_cap *= 2
+            ops = np.empty((new_cap, self.ops.shape[1]), np.int32)
+            pay = np.empty((new_cap, self.payloads.shape[1]), np.int32)
+            ops[:live] = self.ops[self.head : self.tail]
+            pay[:live] = self.payloads[self.head : self.tail]
+            self.ops, self.payloads = ops, pay
+        self.head, self.tail = 0, live
+
+    def append(self, op: np.ndarray, payload: np.ndarray) -> None:
+        self._room(1)
+        self.ops[self.tail] = op
+        self.payloads[self.tail] = payload
+        self.tail += 1
+
+    def extend_rows(self, rows) -> None:
+        """Per-message path: a small list of (op_row, payload_row) pairs."""
+        n = len(rows)
+        if not n:
+            return
+        self._room(n)
+        t = self.tail
+        for op, payload in rows:
+            self.ops[t] = op
+            self.payloads[t] = payload
+            t += 1
+        self.tail = t
+
+    def extend_block(self, ops: np.ndarray, payloads: np.ndarray) -> None:
+        """Batch path: land [M, F] / [M, L] row blocks as two slice copies."""
+        m = ops.shape[0]
+        if not m:
+            return
+        self._room(m)
+        self.ops[self.tail : self.tail + m] = ops
+        self.payloads[self.tail : self.tail + m] = payloads
+        self.tail += m
+
+    def take(self, n: int) -> tuple[np.ndarray, np.ndarray]:
+        """Dequeue ``n`` rows as views (copy out before the next append)."""
+        h = self.head
+        self.head = h + n
+        return self.ops[h : h + n], self.payloads[h : h + n]
+
+    def pending(self) -> tuple[np.ndarray, np.ndarray]:
+        """Views of everything queued (watermark accounting, tests)."""
+        return self.ops[self.head : self.tail], self.payloads[self.head : self.tail]
+
+    def clear(self) -> None:
+        self.head = self.tail = 0
+
+
 class _StageBuf:
     __slots__ = ("ops", "payloads", "dirty", "inflight")
 

@@ -46,18 +46,20 @@ from ..dds.tree.changeset import (
     commit_from_json,
 )
 from ..dds.tree.editmanager import EditManager
+from ..dds.tree.field_kinds import OptionalChange
 from ..dds.tree.forest import ROOT_FIELD, Forest, Node
 from ..ops import tree_kernel as tk
 from ..protocol.messages import MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters
-from .staging import StagingRing
+from .staging import RowQueue, StagingRing
 
 
 @dataclass
 class _TreeHost:
     em: EditManager = field(default_factory=EditManager)
-    queue: list[np.ndarray] = field(default_factory=list)
-    payloads: list[np.ndarray] = field(default_factory=list)
+    # Columnar pending op rows (see staging.RowQueue): flattened edits land
+    # as row blocks, the drain consumes slice copies.
+    queue: RowQueue = None
     # Trunk-coordinate commit suffix since ``checkpoint`` (replay source for
     # fallback routing); folded into the checkpoint forest every
     # CHECKPOINT_EVERY commits so host memory stays bounded.
@@ -79,6 +81,109 @@ class _TreeHost:
 
 class UnsupportedShape(Exception):
     """A commit the columnar path cannot express."""
+
+
+class _FlattenCollector:
+    """One walk per trunk commit collects the structural KEY (field path /
+    kind / payload arity — everything that determines row layout) and the
+    DYNAMIC scalars (path indices, positions, counts, destinations,
+    values, payload words).  A commit whose key was seen before skips all
+    per-row numpy work: its cached _TranslationPlan turns the dynamics
+    into row blocks with two vectorized fills (steady-state translation
+    is a fill, not a walk)."""
+
+    __slots__ = ("key", "dyn", "pay")
+
+    _PTAG = {"v": 1, "w": 2, "r": 3}
+
+    def __init__(self) -> None:
+        self.key: list[tuple] = []
+        self.dyn: list[int] = []
+        # Per-row payload spec: None | ('v', val) | ('w', words) | ('r', vals)
+        self.pay: list[tuple | None] = []
+
+    def reset(self) -> None:
+        self.key.clear()
+        self.dyn.clear()
+        self.pay.clear()
+
+    def emit(self, kind, steps, fld, pos=0, count=0, dst=0, value=0,
+             vkind=0, ntype=0, payload=None):
+        if len(steps) > tk.MAX_PATH:
+            raise UnsupportedShape("path deeper than kernel MAX_PATH")
+        ptag = 0 if payload is None else self._PTAG[payload[0]]
+        plen = len(payload[1]) if ptag >= 2 else ptag
+        self.key.append(
+            (kind, fld, ptag, plen, vkind, ntype, len(steps))
+            + tuple(f for f, _ in steps)
+        )
+        dyn = self.dyn
+        for _f, i in steps:
+            dyn.append(i)
+        dyn.append(pos)
+        dyn.append(count)
+        dyn.append(dst)
+        dyn.append(value)
+        self.pay.append(payload)
+
+
+class _TranslationPlan:
+    """Cached row layout for one commit shape: a static template block
+    plus the (row, col) scatter of every dynamic cell.  ``fill`` reuses
+    the plan's own scratch blocks — callers copy them out immediately
+    (RowQueue.extend_block does), so steady state allocates nothing."""
+
+    __slots__ = (
+        "template", "dyn_rows", "dyn_cols", "scratch_ops", "scratch_pay",
+    )
+
+    def __init__(self, key: tuple, payload_len: int) -> None:
+        t = tk._TGT
+        m = len(key)
+        self.template = np.zeros((m, tk.NESTED_OP_FIELDS), np.int32)
+        dyn_rows: list[int] = []
+        dyn_cols: list[int] = []
+        for r, (kind, fld, _ptag, _plen, vkind, ntype, depth, *fids) in enumerate(key):
+            row = self.template[r]
+            row[0] = kind
+            row[2] = depth
+            for k, f in enumerate(fids):
+                row[3 + 2 * k] = f
+            row[t] = fld
+            row[t + 5] = vkind
+            row[t + 6] = ntype
+            # Dynamic cells, in collector emission order: path indices,
+            # then pos / count / dst / value.
+            for k in range(depth):
+                dyn_rows.append(r)
+                dyn_cols.append(4 + 2 * k)
+            for col in (t + 1, t + 2, t + 3, t + 4):
+                dyn_rows.append(r)
+                dyn_cols.append(col)
+        self.dyn_rows = np.asarray(dyn_rows, np.int64)
+        self.dyn_cols = np.asarray(dyn_cols, np.int64)
+        self.scratch_ops = np.empty_like(self.template)
+        # Payload cells beyond each row's fixed arity stay zero forever
+        # (arity is part of the key), so one zeroing at build time
+        # suffices — every fill rewrites exactly the same cells.
+        self.scratch_pay = np.zeros((m, payload_len), np.int32)
+
+    def fill(self, dyn: list[int], pays: list, seq: int):
+        ops = self.scratch_ops
+        np.copyto(ops, self.template)
+        ops[:, 1] = seq
+        if self.dyn_rows.size:
+            ops[self.dyn_rows, self.dyn_cols] = dyn
+        pay = self.scratch_pay
+        for r, spec in enumerate(pays):
+            if spec is None:
+                continue
+            tag, data = spec
+            if tag == "v":
+                pay[r, 0] = data
+            else:  # 'w' words / 'r' run values
+                pay[r, : len(data)] = data
+        return ops, pay
 
 
 # Module-level jitted programs: shared compile cache across engine
@@ -113,6 +218,7 @@ class TreeBatchEngine:
         checkpoint_every: int = 0,
         doc_keys: list[str] | None = None,
         megastep_k: int = 1,
+        plan_cache: bool = True,
         telemetry=None,
     ) -> None:
         self.n_docs = n_docs
@@ -124,7 +230,10 @@ class TreeBatchEngine:
         # slices fuse into one donated dispatch; K=1 is the exact
         # per-slice path.
         self.megastep_k = max(1, megastep_k)
-        self.hosts = [_TreeHost() for _ in range(n_docs)]
+        self.hosts = [
+            _TreeHost(queue=RowQueue(tk.NESTED_OP_FIELDS, max_insert_len))
+            for _ in range(n_docs)
+        ]
         self.fallbacks: dict[int, Forest] = {}
         self.mesh = mesh
         self.checkpoint_store = checkpoint_store
@@ -138,6 +247,14 @@ class TreeBatchEngine:
         # (the virtual root's field in the kernel's materializer).
         self._fields: dict[str, int] = {ROOT_FIELD: 0}
         self._types: dict[str, int] = {}
+        # Translation plan cache: commit shape -> row-layout plan (see
+        # _FlattenCollector).  ``plan_cache=False`` keeps the original
+        # per-row emit path — the independent oracle the batch-vs-legacy
+        # identity fuzz compares against.
+        self.plan_cache = plan_cache
+        self._plans: dict[tuple, _TranslationPlan] = {}
+        self._collector = _FlattenCollector()
+        self._PLAN_CACHE_MAX = 4096
         if mesh is not None:
             n_shards = mesh.devices.size
             assert n_docs % n_shards == 0, "pad n_docs to a mesh multiple"
@@ -216,6 +333,16 @@ class TreeBatchEngine:
         for edit in self._unwrap(msg.contents):
             self._ingest_edit(doc_idx, msg, edit)
 
+    def ingest_batch(self, doc_idxs, msgs) -> None:
+        """Batch-delivery seam (BroadcasterLambda.subscribe_batch / the
+        fleet feeder): tree translation is inherently per-edit — each
+        commit rebases through the EditManager before it can flatten — so
+        the batch win here is the translation plan cache + columnar
+        RowQueue landing, which ``ingest`` already rides.  This wrapper
+        keeps the two engine families API-compatible for batch callers."""
+        for d, m in zip(doc_idxs, msgs):
+            self.ingest(d, m)
+
     def _ingest_edit(self, doc_idx: int, msg: SequencedMessage, c: dict) -> None:
         h = self.hosts[doc_idx]
         if h.base_seq and msg.seq <= h.base_seq:
@@ -249,43 +376,84 @@ class TreeBatchEngine:
                 apply_commit(h.checkpoint.root, t)
             h.trunk_log.clear()
         try:
-            rows = self._flatten(trunk, msg.seq)
+            ops_blk, pay_blk = self._flatten(trunk, msg.seq)
         except UnsupportedShape:
             self._route_to_fallback(doc_idx)
             return
         h.device_commits += 1
-        for r, _p in rows:
-            if r[0] in (tk.NestedOpKind.INSERT, tk.NestedOpKind.REPLACE_FIELD):
-                self._rows_upper[doc_idx] += int(r[tk._TGT + 2])
-            self._pool_upper[doc_idx] += self._op_pool_words(r)
-        h.queue.extend(r for r, _p in rows)
-        h.payloads.extend(p for _r, p in rows)
+        rows_up, words_up = self._block_upper(ops_blk)
+        self._rows_upper[doc_idx] += rows_up
+        self._pool_upper[doc_idx] += words_up
+        h.queue.extend_block(ops_blk, pay_blk)
         if h.queue:
             self._busy.add(doc_idx)
 
     @staticmethod
-    def _op_pool_words(r: np.ndarray) -> int:
-        """Pool words an op row will append (insert-like/SET pooled kinds)."""
-        if r[0] in (
-            tk.NestedOpKind.INSERT,
-            tk.NestedOpKind.SET,
-            tk.NestedOpKind.REPLACE_FIELD,
-        ) and int(r[tk._TGT + 5]) in tk._POOLED:
-            return int(r[tk._TGT + 4])
-        return 0
+    def _block_upper(ops_blk: np.ndarray) -> tuple[int, int]:
+        """(row, pool-word) upper bounds of an op-row block — vectorized
+        watermark accounting (ingest and resync share it)."""
+        if not len(ops_blk):
+            return 0, 0
+        kinds = ops_blk[:, 0]
+        ins = (kinds == tk.NestedOpKind.INSERT) | (
+            kinds == tk.NestedOpKind.REPLACE_FIELD
+        )
+        vk = ops_blk[:, tk._TGT + 5]
+        pooled_vk = vk == tk._POOLED[0]
+        for p in tk._POOLED[1:]:
+            pooled_vk |= vk == p
+        pooled = (ins | (kinds == tk.NestedOpKind.SET)) & pooled_vk
+        return (
+            int(ops_blk[ins, tk._TGT + 2].sum()),
+            int(ops_blk[pooled, tk._TGT + 4].sum()),
+        )
+
+    def _queued_upper(self, h: _TreeHost) -> tuple[int, int]:
+        q_ops, _q_pay = h.queue.pending()
+        return self._block_upper(q_ops)
 
     # --------------------------------------------------------------- flatten
-    def _flatten(self, trunk_commit, seq: int) -> list[tuple[np.ndarray, np.ndarray]]:
-        """Trunk commit -> nested forest op rows.
+    def _flatten(self, trunk_commit, seq: int) -> tuple[np.ndarray, np.ndarray]:
+        """Trunk commit -> nested forest op-row BLOCKS ([M, F], [M, L]).
 
         Front-to-back walk in OUTPUT coordinates: every emitted op's
         positions (and every path step's sibling index) are valid in the
         state produced by the ops emitted before it, so sequential device
         application reproduces the simultaneous mark semantics exactly —
         including nested paths, which back-to-front emission could not
-        keep stable."""
-        rows: list[tuple[np.ndarray, np.ndarray]] = []
-        empty = np.zeros((self.max_insert_len,), np.int32)
+        keep stable.
+
+        With the plan cache on (default), the walk only COLLECTS (key +
+        dynamic scalars, plain list appends); the per-row numpy work runs
+        once per commit SHAPE and replays as a vectorized fill (see
+        _TranslationPlan).  ``plan_cache=False`` runs the original
+        per-row emit — the identity-fuzz oracle."""
+        if not self.plan_cache:
+            return self._flatten_legacy(trunk_commit, seq)
+        col = self._collector
+        col.reset()
+        for change in trunk_commit:
+            if change.value is not None:
+                raise UnsupportedShape("value change on the virtual root")
+            for key, fc in change.fields.items():
+                self._walk_field(fc, (), self._field_id(key), col.emit)
+        key = tuple(col.key)
+        plan = self._plans.get(key)
+        if plan is None:
+            plan = _TranslationPlan(key, self.max_insert_len)
+            if len(self._plans) < self._PLAN_CACHE_MAX:
+                self._plans[key] = plan
+            self.counters.bump("translation_plan_misses")
+        else:
+            self.counters.bump("translation_plan_hits")
+        return plan.fill(col.dyn, col.pay, seq)
+
+    def _flatten_legacy(self, trunk_commit, seq: int) -> tuple[np.ndarray, np.ndarray]:
+        """The pre-plan-cache path: one numpy row pair per emit."""
+        ops_rows: list[np.ndarray] = []
+        pay_rows: list[np.ndarray] = []
+        L = self.max_insert_len
+        empty = np.zeros((L,), np.int32)
 
         def emit(kind, steps, fld, pos=0, count=0, dst=0, value=0,
                  vkind=0, ntype=0, payload=None):
@@ -298,29 +466,34 @@ class TreeBatchEngine:
             t = tk._TGT
             op[t], op[t + 1], op[t + 2], op[t + 3] = fld, pos, count, dst
             op[t + 4], op[t + 5], op[t + 6] = value, vkind, ntype
-            rows.append((op, empty if payload is None else payload))
+            ops_rows.append(op)
+            if payload is None:
+                pay_rows.append(empty)
+            else:
+                tag, data = payload
+                pay = np.zeros((L,), np.int32)
+                if tag == "v":
+                    pay[0] = data
+                else:
+                    pay[: len(data)] = data
+                pay_rows.append(pay)
 
         for change in trunk_commit:
             if change.value is not None:
                 raise UnsupportedShape("value change on the virtual root")
             for key, fc in change.fields.items():
                 self._walk_field(fc, (), self._field_id(key), emit)
-        return rows
-
-    def _one_payload(self, val: int, words: list[int] | None) -> np.ndarray:
-        pay = np.zeros((self.max_insert_len,), np.int32)
-        if words is not None:
-            pay[: len(words)] = words
-        else:
-            pay[0] = val
-        return pay
+        if not ops_rows:
+            return (
+                np.zeros((0, tk.NESTED_OP_FIELDS), np.int32),
+                np.zeros((0, L), np.int32),
+            )
+        return np.stack(ops_rows), np.stack(pay_rows)
 
     def _walk_field(self, fc, steps: tuple, fid: int, emit) -> None:
         """Dispatch one field change by kind: sequence mark lists walk as
         before; optional/value whole-content sets become REPLACE_FIELD
         device ops; other kinds route to the host fallback."""
-        from ..dds.tree.field_kinds import OptionalChange
-
         if isinstance(fc, list):
             if fc:
                 self._walk_marks(fc, steps, fid, emit)
@@ -336,7 +509,7 @@ class TreeBatchEngine:
             nt = self._type_id(content.type)
             emit(tk.NestedOpKind.REPLACE_FIELD, steps, fid, count=1,
                  value=val if words is not None else 0, vkind=vk, ntype=nt,
-                 payload=self._one_payload(val, words))
+                 payload=("w", words) if words is not None else ("v", val))
             child_steps = steps + ((fid, 0),)
             for key, kids in content.fields.items():
                 if kids:
@@ -355,8 +528,7 @@ class TreeBatchEngine:
             vk, val, words = self._encode_value(ch.value[0])
             emit(tk.NestedOpKind.SET, steps, fid, pos=pos,
                  value=val, vkind=vk,
-                 payload=self._one_payload(val, words) if words is not None
-                 else None)
+                 payload=("w", words) if words is not None else None)
         if any(ch.fields.values()):
             child_steps = steps + ((fid, pos),)
             for key, fc in ch.fields.items():
@@ -396,14 +568,11 @@ class TreeBatchEngine:
         def flush() -> None:
             nonlocal run_vals, run_shape
             if run_vals:
-                payload = np.zeros((self.max_insert_len,), np.int32)
-                payload[: len(run_vals)] = run_vals
                 emit(tk.NestedOpKind.INSERT, steps, fid,
                      pos=pos - len(run_vals), count=len(run_vals),
-                     vkind=run_shape[0], ntype=run_shape[1], payload=payload)
+                     vkind=run_shape[0], ntype=run_shape[1],
+                     payload=("r", list(run_vals)))
             run_vals, run_shape = [], None
-
-        one_payload = self._one_payload
 
         for node in nodes:
             vk, val, words = self._encode_value(node.value)
@@ -416,7 +585,7 @@ class TreeBatchEngine:
                 flush()
                 emit(tk.NestedOpKind.INSERT, steps, fid, pos=pos, count=1,
                      value=val if pooled else 0, vkind=vk, ntype=nt,
-                     payload=one_payload(val, words))
+                     payload=("w", words) if pooled else ("v", val))
                 child_steps = steps + ((fid, pos),)
                 for key, kids in node.fields.items():
                     if kids:
@@ -474,7 +643,6 @@ class TreeBatchEngine:
         h.checkpoint = Forest()
         h.trunk_log.clear()  # never replayed again
         h.queue.clear()
-        h.payloads.clear()
         self._busy.discard(doc_idx)
         # The doc's device columns are dead weight now; stop letting its
         # stale watermarks trigger fleet-wide compactions.
@@ -521,10 +689,9 @@ class TreeBatchEngine:
             take = min(B, len(h.queue))
             if not take:
                 continue
-            ops[d, :take] = h.queue[:take]
-            payloads[d, :take] = h.payloads[:take]
-            del h.queue[:take]
-            del h.payloads[:take]
+            src_ops, src_payloads = h.queue.take(take)
+            ops[d, :take] = src_ops
+            payloads[d, :take] = src_payloads
             if not h.queue:
                 self._busy.discard(d)
             written.append(d)
@@ -548,21 +715,11 @@ class TreeBatchEngine:
                 # each doc's queue (unapplied) — dropping the queued part
                 # would let a long churn stream overflow mid-step without
                 # ever re-triggering compaction.
-                queued = np.array([
-                    sum(
-                        int(r[tk._TGT + 2])
-                        for r in h.queue
-                        if r[0] in (
-                            tk.NestedOpKind.INSERT,
-                            tk.NestedOpKind.REPLACE_FIELD,
-                        )
-                    )
-                    for h in self.hosts
-                ], np.int64)
-                queued_words = np.array([
-                    sum(self._op_pool_words(r) for r in h.queue)
-                    for h in self.hosts
-                ], np.int64)
+                queued_pairs = [self._queued_upper(h) for h in self.hosts]
+                queued = np.array([q for q, _w in queued_pairs], np.int64)
+                queued_words = np.array(
+                    [w for _q, w in queued_pairs], np.int64
+                )
                 # Fallback docs keep stale live rows on device (nothing
                 # compacts them away); excluding them here keeps the reset
                 # in _route_to_fallback effective — otherwise one resync
@@ -701,20 +858,16 @@ class TreeBatchEngine:
                     Insert([n.clone() for n in forest.root_field])
                 ]
                 try:
-                    rows = self._flatten([ch], seq=h.base_seq)
+                    ops_blk, pay_blk = self._flatten([ch], seq=h.base_seq)
                 except UnsupportedShape:
                     self._route_to_fallback(d)
                     restored.append(d)
                     self.counters.bump("docs_restored")
                     continue
-                for r, _p in rows:
-                    if r[0] in (
-                        tk.NestedOpKind.INSERT, tk.NestedOpKind.REPLACE_FIELD
-                    ):
-                        self._rows_upper[d] += int(r[tk._TGT + 2])
-                    self._pool_upper[d] += self._op_pool_words(r)
-                h.queue.extend(r for r, _p in rows)
-                h.payloads.extend(p for _r, p in rows)
+                rows_up, words_up = self._block_upper(ops_blk)
+                self._rows_upper[d] += rows_up
+                self._pool_upper[d] += words_up
+                h.queue.extend_block(ops_blk, pay_blk)
                 if h.queue:
                     self._busy.add(d)
             restored.append(d)
@@ -735,6 +888,13 @@ class TreeBatchEngine:
         self.counters.ratio(
             "steps_per_dispatch", "megastep_slices", "megastep_dispatches"
         )
+        hits = self.counters.get("translation_plan_hits")
+        misses = self.counters.get("translation_plan_misses")
+        self.counters.gauge(
+            "translation_plan_hit_rate",
+            round(hits / (hits + misses), 4) if hits + misses else 0.0,
+        )
+        self.counters.gauge("translation_plans", len(self._plans))
         snap = self.counters.snapshot()
         snap.update(
             fallback_docs=len(self.fallbacks),

@@ -199,6 +199,18 @@ class NativeSequencer:
     def mint_service(self, mtype: str, contents) -> SequencedMessage:
         out_min = ctypes.c_int64()
         seq = _lib.seq_mint_service(self._h, ctypes.byref(out_min))
+        # Scribe-driven MSN plumbing (mirror Sequencer.mint_service): a
+        # summary ack carries the ack-derived compaction floor.  The floor
+        # itself is Python-side state — the C++ core predates acks and its
+        # checkpoint format must stay stable — so a restore conservatively
+        # restarts the floor at 0 (compaction lags, never overruns).
+        if mtype == MessageType.SUMMARY_ACK and isinstance(contents, dict):
+            ref = contents.get("refSeq")
+            if isinstance(ref, int):
+                self._ack_floor = max(getattr(self, "_ack_floor", 0), ref)
+            contents.setdefault(
+                "msn", min(getattr(self, "_ack_floor", 0), out_min.value)
+            )
         out = SequencedMessage(
             client_id="__service__",
             client_seq=0,

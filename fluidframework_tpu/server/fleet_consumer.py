@@ -123,6 +123,7 @@ class FleetConsumer:
         stalled the drain up to 50ms per quiet socket per pass, which was
         most of the measured wire-ingest gap)."""
         staged = 0
+        acked = False
         if len(self.dead_socks) == len(self._socks):
             return 0
         ready = self._sel.select(wait_s)
@@ -152,8 +153,22 @@ class FleetConsumer:
                 continue
             feed, self._tails[idx] = buf[: cut + 1], buf[cut + 1 :]
             self.bytes_consumed += len(feed)
+            # Scribe-driven MSN: a summary ack in the feed is the zamboni
+            # TRIGGER (one substring probe per chunk, anchored on the wire
+            # type field — no extra parse).  The compaction floor itself is
+            # each host's min_seq, refreshed by the ack message's own
+            # min_seq stamp through ingest; the ack's contents["msn"] is
+            # the durable ack-derived floor, carried on the wire for
+            # consumers that need durability-bounded windows.
+            acked = acked or b'"type":"summaryAck"' in feed
             staged += self.engine.ingest_lines(idx, feed)
         self.rows_staged += staged
+        if acked:
+            # Compact collab windows on the ack, not on a timer: the
+            # scribe's durable floor just advanced, and every host's
+            # min_seq was refreshed by the ack message itself.
+            self.engine.compact()
+            self.engine.counters.bump("msn_compactions")
         return staged
 
     def step(self) -> int:

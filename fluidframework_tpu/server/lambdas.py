@@ -186,23 +186,53 @@ class ScriptoriumLambda:
 
 
 class BroadcasterLambda:
-    """Fans sequenced ops out to per-document subscribers."""
+    """Fans sequenced ops out to per-document subscribers.
+
+    Two delivery shapes: per-message ``subscribe`` (the classic client
+    seam) and ``subscribe_batch``, which hands each pump's decoded
+    messages for a document as ONE list — the columnar-ingest seam
+    (engines feed the whole batch to ``ingest_batch`` instead of paying
+    per-message Python through the fan-out)."""
 
     def __init__(self, deltas: Topic, partition: int):
         self._in = deltas.partition(partition)
         self.offset = 0
         self._subs: dict[str, list[Callable[[SequencedMessage], None]]] = {}
+        self._batch_subs: dict[
+            str, list[Callable[[list[SequencedMessage]], None]]
+        ] = {}
 
     def subscribe(self, doc_id: str, fn: Callable[[SequencedMessage], None]) -> None:
         self._subs.setdefault(doc_id, []).append(fn)
 
+    def subscribe_batch(
+        self, doc_id: str, fn: Callable[[list[SequencedMessage]], None]
+    ) -> None:
+        self._batch_subs.setdefault(doc_id, []).append(fn)
+
     def pump(self) -> int:
         n = 0
+        batches: dict[str, list[SequencedMessage]] = {}
         for rec in self._in.read(self.offset):
             for fn in self._subs.get(rec.doc_id, []):
                 fn(rec.payload)
+            if rec.doc_id in self._batch_subs:
+                batches.setdefault(rec.doc_id, []).append(rec.payload)
             self.offset = rec.offset + 1
             n += 1
+        for doc_id, msgs in batches.items():
+            for fn in self._batch_subs[doc_id]:
+                # Failure contract: a raising batch subscriber (e.g.
+                # ingest_batch's loud NotImplementedError on an unsupported
+                # wire form) forfeits this pump's remaining messages for
+                # the doc, exactly as if the consumer process had crashed
+                # mid-batch — redelivery is owned by durable recovery
+                # (checkpoint floor + replay), never by an offset rewind:
+                # the subscriber may have landed a PREFIX of the batch
+                # before raising, and engines deliberately carry no seq
+                # dedupe above the checkpoint floor, so rewinding here
+                # would double-apply that prefix on the retry.
+                fn(msgs)
         return n
 
 

@@ -51,6 +51,10 @@ class Sequencer:
         self._clients: dict[str, ClientEntry] = {}
         self._next_short = 0
         self.log: list[SequencedMessage] = []  # scriptorium analog (op log)
+        # Highest summary-acked refSeq the scribe has externalized through
+        # this sequencer (mint_service tracks it): the durable floor that
+        # drives consumer-side zamboni on acks instead of timers.
+        self._ack_floor = 0
 
     # ------------------------------------------------------------------ admin
     @property
@@ -148,9 +152,26 @@ class Sequencer:
         self.log.append(out)
         return out
 
+    @property
+    def ack_msn(self) -> int:
+        """Scribe-driven MSN: the compaction floor an ack authorizes.
+        Bounded by the collab-window MSN — the ack proves durability below
+        its refSeq, but state inside the live window must survive for
+        rebase regardless of what the scribe persisted."""
+        return min(self._ack_floor, self.min_seq)
+
     def mint_service(self, mtype: str, contents) -> SequencedMessage:
         """Service-originated sequenced message (summary acks/nacks — the
-        scribe's voice in the stream, ref scribe/lambda.ts sendSummaryAck)."""
+        scribe's voice in the stream, ref scribe/lambda.ts sendSummaryAck).
+
+        Summary acks carry the ack-derived MSN (``contents["msn"]``): the
+        signal device fleets compact (zamboni) on — the scribe's durable
+        floor plumbed back through the sequencer into the op stream."""
+        if mtype == MessageType.SUMMARY_ACK and isinstance(contents, dict):
+            ref = contents.get("refSeq")
+            if isinstance(ref, int):
+                self._ack_floor = max(self._ack_floor, ref)
+            contents.setdefault("msn", self.ack_msn)
         self._seq += 1
         out = SequencedMessage(
             client_id="__service__",
@@ -173,6 +194,7 @@ class Sequencer:
         return {
             "seq": self._seq,
             "nextShort": self._next_short,
+            "ackFloor": self._ack_floor,
             "clients": [
                 {
                     "clientId": c.client_id,
@@ -188,6 +210,7 @@ class Sequencer:
     def restore(state: dict) -> "Sequencer":
         s = Sequencer(starting_seq=state["seq"])
         s._next_short = state["nextShort"]
+        s._ack_floor = state.get("ackFloor", 0)
         for c in state["clients"]:
             s._clients[c["clientId"]] = ClientEntry(
                 client_id=c["clientId"],
