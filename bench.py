@@ -42,6 +42,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -1190,6 +1191,181 @@ def bench_latency(args) -> dict:
 # line is the headline JSON.
 # ---------------------------------------------------------------------------
 
+def bench_multichip_child(args) -> dict:
+    """One mesh-served fleet measurement at ``--devices N``: the full
+    serving pipeline — RowQueue staging -> StagingRing shard-layout upload
+    -> shard_map megastep dispatch -> per-shard error reduce — timed over a
+    pre-staged multi-slice workload.  The parent (``--config multichip``)
+    forces N virtual CPU devices via XLA_FLAGS when the accelerator is
+    absent; on real hardware the first N visible devices form the mesh."""
+    import jax
+
+    n_req = args.devices
+    devs = jax.devices()
+    if len(devs) < n_req:
+        return {
+            "n_devices": n_req, "ok": False, "skipped": True,
+            "reason": f"only {len(devs)} devices visible",
+        }
+    from fluidframework_tpu.models.doc_batch_engine import DocBatchEngine
+    from fluidframework_tpu.parallel.mesh import doc_mesh
+
+    mesh = doc_mesh(devs[:n_req])
+    D, B, S = args.docs, args.ops_per_step, args.steps
+    L = args.payload_len
+    ops, payloads, _min_seqs = generate_workload(
+        D, B, S, args.insert_len, L
+    )
+    # The generator emits doc-minor [S, B, F, D] (upload layout); the
+    # RowQueue staging path wants per-doc [B, F] blocks.
+    ops = np.ascontiguousarray(np.moveaxis(ops, -1, 1))
+    payloads = np.ascontiguousarray(np.moveaxis(payloads, -1, 1))
+    total_ops = S * D * B
+
+    def run_once():
+        eng = DocBatchEngine(
+            D, max_segments=args.segments, text_capacity=args.text_capacity,
+            max_insert_len=L, ops_per_step=B, mesh=mesh, use_mesh=True,
+            megastep_k=args.megastep_k,
+        )
+        for d in range(D):
+            q = eng.hosts[d].queue
+            for s in range(S):
+                q.extend_block(ops[s, d], payloads[s, d])
+            eng._busy.add(d)
+        t0 = time.perf_counter()
+        eng.step()  # drains every staged slice; recover() gate included
+        jax.block_until_ready(eng.state.text_end)
+        dt = time.perf_counter() - t0
+        assert not eng.errors().any(), "bench workload latched errors"
+        return dt, eng
+
+    run_once()  # warmup: compile + cache load outside every timer
+    best, eng = min(
+        (run_once() for _ in range(max(1, args.reps))), key=lambda r: r[0]
+    )
+    health = eng.health()
+    return {
+        "metric": "multichip_fleet_ops_per_sec",
+        "n_devices": n_req,
+        "ok": True,
+        "value": round(total_ops / best, 1),
+        "unit": "ops/s",
+        "total_ops": total_ops,
+        "docs": D,
+        "megastep_k": health.get("megastep_k"),
+        "steps_per_dispatch": health.get("steps_per_dispatch"),
+        "n_shards": health.get("n_shards"),
+        "platform": devs[0].platform,
+    }
+
+
+_MULTICHIP_COUNTS = (1, 2, 4, 8)
+_MULTICHIP_CHILD_TIMEOUT = 600.0
+
+
+def bench_multichip(args) -> dict:
+    """MULTICHIP headline: fleet ops/s through the mesh serving path at
+    1/2/4/8 devices, with scaling efficiency per count.
+
+    The fleet (total docs and ops) is held CONSTANT across device counts,
+    so ``scaling_efficiency`` = ops/s(N) / ops/s(1) measures what the
+    shard layer costs: on the CPU box the N devices are virtual (XLA host
+    platform device count — all counts share the same cores, so a healthy
+    mesh reads ~1.0 and anything below is partitioning overhead), while on
+    real accelerators each shard owns a chip and the same number reflects
+    strong-scaling speedup / N.  Emits one JSON line and (with
+    ``--artifact``) writes the full per-device table as the MULTICHIP
+    round artifact — per-count ops/s, efficiency, and the same
+    degraded/reduced_scale/backend_attempts flags as the BENCH rows."""
+    platform, probe_err, probe_attempts, degraded, reduced = (
+        _resolve_backend()
+    )
+
+    per_device: list[dict] = []
+    for n in _MULTICHIP_COUNTS:
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--config", "multichip-child", "--devices", str(n)]
+        if reduced:
+            cmd += ["--docs", "128", "--steps", "8", "--reps", "3",
+                    "--segments", "512", "--text-capacity", "8192"]
+        env = dict(os.environ)
+        if reduced:
+            env[_FORCE_CPU_ENV] = "1"
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "",
+                env.get("XLA_FLAGS", ""),
+            )
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        try:
+            r = subprocess.run(
+                cmd, capture_output=True, text=True,
+                timeout=_MULTICHIP_CHILD_TIMEOUT, env=env,
+            )
+            row = None
+            for line in reversed(r.stdout.strip().splitlines()):
+                try:
+                    parsed = json.loads(line)
+                except (json.JSONDecodeError, ValueError):
+                    continue
+                if isinstance(parsed, dict):
+                    row = parsed
+                    break
+            if row is None:
+                row = {"n_devices": n, "ok": False,
+                       "error": (r.stderr or "no JSON output").strip()[-300:]}
+        except subprocess.TimeoutExpired:
+            row = {"n_devices": n, "ok": False,
+                   "error": f"timed out after {_MULTICHIP_CHILD_TIMEOUT:.0f}s"}
+        except OSError as e:
+            row = {"n_devices": n, "ok": False, "error": str(e)}
+        per_device.append(row)
+
+    base = next(
+        (row.get("value") for row in per_device
+         if row.get("ok") and row.get("n_devices") == 1), None,
+    )
+    for row in per_device:
+        if row.get("ok") and base:
+            speedup = row["value"] / base
+            row["speedup"] = round(speedup, 3)
+            # Efficiency normalizes by the silicon actually added: real
+            # accelerators add a chip per device (speedup / N); virtual
+            # CPU devices all share the same cores (denominator 1 — the
+            # measure is shard-layer overhead, ~1.0 healthy).
+            row["scaling_efficiency"] = round(
+                speedup if reduced else speedup / row["n_devices"], 3
+            )
+    tail_ok = [row for row in per_device if row.get("ok")]
+    out = {
+        "metric": "multichip_fleet_ops_per_sec",
+        "value": tail_ok[-1]["value"] if tail_ok else None,
+        "unit": "ops/s",
+        "n_devices": tail_ok[-1]["n_devices"] if tail_ok else None,
+        "scaling_efficiency": (
+            tail_ok[-1].get("scaling_efficiency") if tail_ok else None
+        ),
+        "virtual_devices": bool(reduced),
+        "per_device": per_device,
+        "platform": platform or "cpu",
+    }
+    if probe_attempts:
+        out["backend_attempts"] = probe_attempts
+    if degraded:
+        out["degraded"] = True
+        if probe_err:
+            out["backend_error"] = probe_err
+    elif reduced:
+        out["reduced_scale"] = True
+    if getattr(args, "artifact", None):
+        with open(args.artifact, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    return out
+
+
 _CHILD_TIMEOUTS = {
     "1": 900.0, "2": 600.0, "3": 1500.0, "4": 600.0, "5": 900.0,
     "latency": 600.0, "headline": 1500.0,
@@ -1200,12 +1376,18 @@ _CHILD_TIMEOUTS = {
 _R2_HEADLINE_OPS = 433102224.6
 
 
-def _probe_backend(timeout_s: float = 180.0, attempts: int = 2):
+def _probe_backend(timeout_s: float = 180.0, attempts: int = 3):
     """Probe accelerator init in a throwaway subprocess.
 
     The r3 failure mode was both a raise (UNAVAILABLE) and a hang, so the
-    probe must be able to kill a wedged init.  Returns (platform, None) on
-    success or (None, error_string) after bounded retries."""
+    probe must be able to kill a wedged init.  Retries with EXPONENTIAL
+    backoff (10s, 20s, 40s, ... capped at 120s): every r05 headline ran
+    degraded off transient init wedges, so a degraded CPU fallback must be
+    the last resort after real retry pressure, not the first response.
+    Returns (platform, None, attempts_used) on success or
+    (None, error_string, attempts_used) once retries are exhausted — the
+    attempt count lands in artifacts as ``backend_attempts`` so degraded
+    rows show how hard the probe tried."""
     err = "unknown"
     for i in range(attempts):
         try:
@@ -1216,15 +1398,52 @@ def _probe_backend(timeout_s: float = 180.0, attempts: int = 2):
             )
             out = r.stdout.strip().splitlines()
             if r.returncode == 0 and out:
-                return out[-1], None
+                return out[-1], None, i + 1
             err = (r.stderr or "no output").strip()[-500:]
         except subprocess.TimeoutExpired:
             err = f"backend init timed out after {timeout_s:.0f}s"
         except OSError as e:
             err = str(e)
         if i + 1 < attempts:
-            time.sleep(20.0 * (i + 1))
-    return None, err
+            time.sleep(min(10.0 * (2 ** i), 120.0))
+    return None, err, attempts
+
+
+def _resolve_backend():
+    """Shared driver preamble: resolve the requested platform, probe the
+    accelerator (with retry/backoff) when one is expected, and derive the
+    degraded/reduced flags.  Returns
+    ``(platform, probe_err, probe_attempts, degraded, reduced)``.
+
+    An EXPLICITLY requested CPU run (JAX_PLATFORMS=cpu / FFTPU_PLATFORM=
+    cpu) skips accelerator probing entirely — no TPU init to time out —
+    and its rows are NOT degraded: the requested backend is present.
+    ``degraded`` (and ``backend_error``) mean exactly one thing: a
+    REQUESTED accelerator failed.  Scale still shrinks on CPU either way
+    (``reduced`` — full accelerator scale would burn whole timeouts on
+    one core)."""
+    requested = (
+        os.environ.get("JAX_PLATFORMS")
+        or os.environ.get("FFTPU_PLATFORM")
+        or ("cpu" if os.environ.get(_FORCE_CPU_ENV) else "")
+    ).split(",")[0].strip().lower()
+    if requested == "cpu":
+        platform, probe_err, probe_attempts = "cpu", None, 0
+        degraded = False
+    else:
+        platform, probe_err, probe_attempts = _probe_backend(
+            timeout_s=float(os.environ.get("FFTPU_BENCH_PROBE_TIMEOUT", "180")),
+            attempts=int(os.environ.get("FFTPU_BENCH_PROBE_ATTEMPTS", "3")),
+        )
+        # A probe answering "cpu" means the accelerator is absent (this
+        # image's platform list is axon,cpu).
+        if platform == "cpu":
+            probe_err = probe_err or (
+                "accelerator not present (probe returned cpu)"
+            )
+        degraded = platform is None or platform == "cpu"
+    reduced = degraded or platform == "cpu"
+    return platform, probe_err, probe_attempts, degraded, reduced
 
 
 def _run_child(key: str, degraded: bool, timeout_s: float):
@@ -1256,34 +1475,9 @@ def _run_child(key: str, degraded: bool, timeout_s: float):
 
 
 def _driver_main() -> None:
-    # An EXPLICITLY requested CPU run (JAX_PLATFORMS=cpu / FFTPU_PLATFORM=
-    # cpu) skips accelerator probing entirely — no TPU init to time out —
-    # and its rows are NOT degraded: the requested backend is present.
-    # ``degraded`` (and ``backend_error``) now mean exactly one thing: a
-    # REQUESTED accelerator failed, so CPU-box artifacts stop reading as
-    # uniformly broken.  Scale still shrinks on CPU either way (``reduced``
-    # — full accelerator scale would burn whole timeouts on one core).
-    requested = (
-        os.environ.get("JAX_PLATFORMS")
-        or os.environ.get("FFTPU_PLATFORM")
-        or ("cpu" if os.environ.get(_FORCE_CPU_ENV) else "")
-    ).split(",")[0].strip().lower()
-    if requested == "cpu":
-        platform, probe_err = "cpu", None
-        degraded = False
-    else:
-        platform, probe_err = _probe_backend(
-            timeout_s=float(os.environ.get("FFTPU_BENCH_PROBE_TIMEOUT", "180")),
-            attempts=int(os.environ.get("FFTPU_BENCH_PROBE_ATTEMPTS", "2")),
-        )
-        # A probe answering "cpu" means the accelerator is absent (this
-        # image's platform list is axon,cpu).
-        if platform == "cpu":
-            probe_err = probe_err or (
-                "accelerator not present (probe returned cpu)"
-            )
-        degraded = platform is None or platform == "cpu"
-    reduced = degraded or platform == "cpu"
+    platform, probe_err, probe_attempts, degraded, reduced = (
+        _resolve_backend()
+    )
     results: dict[str, dict] = {}
     consecutive_failures = 0
     order = ["1", "2", "3", "4", "5", "latency", "headline"]
@@ -1294,6 +1488,8 @@ def _driver_main() -> None:
                    "unit": _unit_name(key), "vs_baseline": None,
                    "error": err}
         res["platform"] = platform or "cpu"
+        if probe_attempts:
+            res["backend_attempts"] = probe_attempts
         if degraded:
             res["degraded"] = True
             if probe_err:
@@ -1355,7 +1551,13 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--config", default=None,
                    choices=["1", "2", "3", "4", "5", "latency", "headline",
-                            "all"])
+                            "multichip", "multichip-child", "all"])
+    p.add_argument("--devices", type=int, default=1,
+                   help="mesh device count for the multichip-child config")
+    p.add_argument("--artifact", default=None,
+                   help="with --config multichip: also write the full "
+                        "per-device table to this JSON file (the "
+                        "MULTICHIP round artifact)")
     p.add_argument("--docs", type=int, default=None)
     # (segments/text-capacity/steps also use None defaults so per-config
     # tuning never clobbers an explicitly requested value.)
@@ -1398,6 +1600,8 @@ def main() -> None:
         "5": bench_config5,
         "latency": bench_latency,
         "headline": bench_headline,
+        "multichip": bench_multichip,
+        "multichip-child": bench_multichip_child,
     }
     if args.config is None:
         if len(sys.argv) == 1:

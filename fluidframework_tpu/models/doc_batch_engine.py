@@ -76,6 +76,7 @@ from ..dds import kernel_backend as kb
 from ..dds.mergetree_ref import RefMergeTree
 from ..dds.shared_string import decode_obliterate_places
 from ..ops import mergetree_kernel as mk
+from ..parallel import mesh as pm
 from ..parallel.mesh import doc_mesh, shard_docs
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters
@@ -167,11 +168,18 @@ _fleet_megastep = functools.partial(jax.jit, donate_argnums=(0,))(
 )
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def _fleet_compact(state, min_seqs):
+def _fleet_compact_body(state, min_seqs):
+    # Module-level body: shared by the single-device jit below and the
+    # shard_map-wrapped mesh program (parallel.mesh.mesh_fleet_program
+    # caches by function identity, so the body must be stable).
     state = jax.vmap(mk.set_min_seq)(state, min_seqs)
     flag = jnp.any(state.ob_key >= 0)
     return jax.vmap(mk.compact, in_axes=(0, None))(state, flag)
+
+
+_fleet_compact = functools.partial(jax.jit, donate_argnums=(0,))(
+    _fleet_compact_body
+)
 
 
 _lane_apply_jit = jax.jit(mk.apply_ops)
@@ -236,6 +244,7 @@ class DocBatchEngine:
         readmit_after_steps: int = 0,
         poison_budget: int = 0,
         megastep_k: int = 1,
+        spare_slots: int = 0,
         telemetry=None,
     ) -> None:
         assert recovery in ("grow", "oracle", "off")
@@ -310,25 +319,68 @@ class DocBatchEngine:
             n_shards = 1
         # Device capacity rounds up to a mesh multiple (padding docs are
         # inert: their queues stay empty so they only ever apply noops).
-        self.capacity = -(-n_docs // n_shards) * n_shards
+        # ``spare_slots`` reserves extra free rows beyond the fleet so live
+        # migration always has landing slots on every shard.
+        self.n_shards = n_shards
+        self.capacity = -(-(n_docs + spare_slots) // n_shards) * n_shards
+        self.docs_per_shard = self.capacity // n_shards
+        # Device-row placement: doc -> slot (row index into the sharded
+        # state; shard = slot // docs_per_shard).  Docs distribute in
+        # contiguous blocks over ALL shards (identity when there are no
+        # spare slots), so the staging buffer is packed by doc placement
+        # and a shard-layout device_put splits it per chip; spare slots
+        # spread across shards as the per-shard free pool ``migrate_doc``
+        # lands in.
+        per = -(-n_docs // n_shards)  # docs per shard at construction
+        self._slot = np.array(
+            [
+                (d // per) * self.docs_per_shard + (d % per)
+                for d in range(n_docs)
+            ],
+            dtype=np.int64,
+        )
+        used = set(map(int, self._slot))
+        self._free_slots: dict[int, list[int]] = {
+            s: [] for s in range(n_shards)
+        }
+        for slot in range(self.capacity):
+            if slot not in used:
+                self._free_slots[slot // self.docs_per_shard].append(slot)
+        # Per-shard applied-op counters (host-side, no device readback):
+        # accumulated at drain time, the hot-shard detection signal.
+        self._shard_ops = np.zeros((n_shards,), np.int64)
 
         proto = mk.init_state(
             max_segments, remove_slots, prop_slots, text_capacity, ob_slots
         )
+        self._proto = proto  # pristine row: retires vacated migration slots
         self.state = jax.tree.map(
             lambda x: jnp.broadcast_to(x, (self.capacity,) + x.shape), proto
         )
         if self.mesh is not None:
-            docs_sharding = shard_docs(self.mesh)
-            self.state = jax.tree.map(
-                lambda x: jax.device_put(x, docs_sharding), self.state
-            )
+            self.state = pm.shard_fleet_state(self.state, self.mesh)
 
         # Module-level jitted programs (shared compile cache across engine
         # instances; one executable per geometry/batch shape).
         self._step = _fleet_step
         self._megastep = _fleet_megastep
         self._compact = _fleet_compact
+        if self.mesh is not None:
+            # shard_map-wrapped fleet programs: one donated dispatch steps
+            # every shard with zero hot-path collectives; each shard's
+            # obliterate gate is evaluated from its OWN docs, so one hot
+            # obliterate shard no longer de-specializes the whole fleet.
+            # Cached per (mesh, specs) — instances serving the same mesh
+            # share compiles (parallel.mesh.mesh_fleet_program).
+            specs = pm.fleet_state_specs(self.state)
+            self._state_specs = specs
+            self._megastep = pm.mesh_fleet_program(
+                mk.apply_megastep, self.mesh, specs
+            )
+            self._compact = pm.mesh_fleet_program(
+                _fleet_compact_body, self.mesh, specs,
+                arg_specs=(pm.P("docs"),),
+            )
         self._lane_apply = _lane_apply_jit
         self._lane_compact = _lane_compact_jit
         # Incremental busy set: doc indices whose host queue is nonempty,
@@ -868,6 +920,7 @@ class DocBatchEngine:
         ops: np.ndarray,
         payloads: np.ndarray,
         rows: list[int] | None = None,
+        slots: bool = False,
     ) -> list[int]:
         """Dequeue up to ops_per_step ops per listed doc into the padded
         arrays (``docs[j]`` fills row ``rows[j]``, default ``j``) — the
@@ -888,6 +941,10 @@ class DocBatchEngine:
             src_ops, src_payloads = h.queue.take(take)
             ops[r, :take] = src_ops
             payloads[r, :take] = src_payloads
+            if slots:
+                # Row IS the device slot here (full-fleet packing): charge
+                # the op count to its shard for hot-shard detection.
+                self._shard_ops[r // self.docs_per_shard] += take
             if not h.queue:
                 self._busy.discard(d)
             written.append(r)
@@ -897,7 +954,7 @@ class DocBatchEngine:
         if self._stage is None:
             self._stage = StagingRing(
                 self.megastep_k, self.capacity, self.ops_per_step,
-                mk.OP_FIELDS, self.max_insert_len,
+                mk.OP_FIELDS, self.max_insert_len, mesh=self.mesh,
             )
         return self._stage
 
@@ -941,19 +998,32 @@ class DocBatchEngine:
         K = self._select_k(busy, cohort=False)
         stage = self._staging()
         ops, payloads = stage.acquire(K, self.capacity)
+        # Pack by doc PLACEMENT: doc d's ops land in row slot(d), so each
+        # shard's slice of the staging buffer holds exactly its own docs
+        # and the shard-layout upload splits per chip with no reshuffle.
+        rows = [int(s) for s in self._slot[busy]]
         for k in range(K):
             stage.mark(
-                k, self._drain_into(busy, ops[k], payloads[k], rows=busy)
+                k,
+                self._drain_into(
+                    busy, ops[k], payloads[k], rows=rows, slots=True
+                ),
             )
             if k + 1 < K:
-                busy = [d for d in busy if d in self._busy]
-        if K == 1:
-            dev_ops, dev_payloads = jnp.asarray(ops[0]), jnp.asarray(payloads[0])
-            stage.launched(dev_ops, dev_payloads)
+                pairs = [
+                    (d, r) for d, r in zip(busy, rows) if d in self._busy
+                ]
+                busy = [d for d, _ in pairs]
+                rows = [r for _, r in pairs]
+        if self.mesh is None and K == 1:
+            dev_ops, dev_payloads = stage.upload(ops[0], payloads[0])
             self.state = self._step(self.state, dev_ops, dev_payloads)
         else:
-            dev_ops, dev_payloads = jnp.asarray(ops), jnp.asarray(payloads)
-            stage.launched(dev_ops, dev_payloads)
+            # The mesh path always dispatches the [K, D, B] megastep
+            # program (K=1 included — apply_megastep at K=1 is bit-
+            # identical to one apply_ops dispatch): one donated shard_map
+            # call steps every chip, zero hot-path collectives.
+            dev_ops, dev_payloads = stage.upload(ops, payloads)
             self.state = self._megastep(self.state, dev_ops, dev_payloads)
         self.full_steps += K
         self.counters.bump("megastep_dispatches")
@@ -1040,12 +1110,10 @@ class DocBatchEngine:
                 cur = [d for d in cur if d in self._busy]
         sub = self._gather_cohort(self.state, jnp.asarray(idx))
         if K == 1:
-            dev_ops, dev_payloads = jnp.asarray(ops[0]), jnp.asarray(payloads[0])
-            stage.launched(dev_ops, dev_payloads)
+            dev_ops, dev_payloads = stage.upload(ops[0], payloads[0])
             sub = self._step(sub, dev_ops, dev_payloads)
         else:
-            dev_ops, dev_payloads = jnp.asarray(ops), jnp.asarray(payloads)
-            stage.launched(dev_ops, dev_payloads)
+            dev_ops, dev_payloads = stage.upload(ops, payloads)
             sub = self._megastep(sub, dev_ops, dev_payloads)
         self.state = self._scatter_cohort(
             self.state, sub, jnp.asarray(idx), jnp.asarray(valid)
@@ -1073,18 +1141,23 @@ class DocBatchEngine:
                 ops[0, 0, :take] = src_ops
                 payloads[0, 0, :take] = src_payloads
                 stage.mark(0, [0])
-                dev_ops = jnp.asarray(ops[0, 0])
-                dev_payloads = jnp.asarray(payloads[0, 0])
-                stage.launched(dev_ops, dev_payloads)
+                dev_ops, dev_payloads = stage.upload(
+                    ops[0, 0], payloads[0, 0]
+                )
                 lane.state = self._lane_apply(
                     lane.state, dev_ops, dev_payloads
                 )
 
     def compact(self) -> None:
         """Advance MSNs and run zamboni eviction across the fleet."""
-        mins = [h.min_seq for h in self.hosts]
-        mins += [0] * (self.capacity - self.n_docs)
-        self.state = self._compact(self.state, jnp.asarray(mins, jnp.int32))
+        mins = np.zeros((self.capacity,), np.int32)
+        for d, h in enumerate(self.hosts):
+            mins[self._slot[d]] = h.min_seq
+        if self.mesh is not None:
+            mins_dev = jax.device_put(mins, shard_docs(self.mesh))
+        else:
+            mins_dev = jnp.asarray(mins)
+        self.state = self._compact(self.state, mins_dev)
         for d, lane in self.overflow.items():
             lane.state = self._lane_compact(
                 lane.state, jnp.asarray(self.hosts[d].min_seq, jnp.int32)
@@ -1100,15 +1173,23 @@ class DocBatchEngine:
         doc indices recovered this call.  Capacity bits grow-and-replay (or
         oracle-route); poison bits (ERR_POS_RANGE alone) quarantine."""
         recovered: list[int] = []
+        if self.mesh is not None and not self.overflow:
+            # Per-shard reduce instead of a cross-mesh [D] gather: each
+            # shard partial-sums its own latch rows and the host reads ONE
+            # scalar — the full error vector transfers only when it is
+            # actually nonzero (recovery itself, off the hot path).
+            if int(pm.error_count(self.state.error)) == 0:
+                return []
         err = np.asarray(self.state.error)
         for d in range(self.n_docs):
+            slot = int(self._slot[d])
             if (
                 d not in self.overflow
                 and d not in self.oracles
                 and d not in self.quarantine
-                and err[d]
+                and err[slot]
             ):
-                bits = int(err[d])
+                bits = int(err[slot])
                 if mk.is_capacity_error(bits):
                     self._recover_doc(d, bits, growths=0)
                 else:  # poison: ERR_POS_RANGE with no capacity bit
@@ -1117,7 +1198,7 @@ class DocBatchEngine:
                 # never re-triggers (its queue is empty and future ops route
                 # to the lane).
                 self.state = self.state._replace(
-                    error=self.state.error.at[d].set(0)
+                    error=self.state.error.at[slot].set(0)
                 )
                 recovered.append(d)
         for d, lane in list(self.overflow.items()):
@@ -1345,9 +1426,10 @@ class DocBatchEngine:
                 self._readmit_due[d] = self._step_count + interval
         h.queue.clear()
         self._busy.discard(d)
-        if d < self.capacity:
+        if d < self.n_docs:
+            slot = int(self._slot[d])
             self.state = self.state._replace(
-                error=self.state.error.at[d].set(0)
+                error=self.state.error.at[slot].set(0)
             )
         self.counters.bump("quarantines")
         if self.counters.logger is not None:
@@ -1372,8 +1454,9 @@ class DocBatchEngine:
             )
         except (ValueError, IndexError):
             return False
+        slot = int(self._slot[d])
         self.state = jax.tree.map(
-            lambda x, s: x.at[d].set(s), self.state, row
+            lambda x, s: x.at[slot].set(s), self.state, row
         )
         del self.quarantine[d]
         self.quarantine_reason.pop(d, None)
@@ -1389,6 +1472,167 @@ class DocBatchEngine:
         h.log = [m for m in h.log if m.seq > h.base_seq]
         self.counters.bump("readmissions")
         return True
+
+    # ---------------------------------------------------- placement/migration
+    def shard_of(self, doc_idx: int) -> int:
+        """The mesh shard currently hosting this doc's device row."""
+        return int(self._slot[doc_idx]) // self.docs_per_shard
+
+    def placement(self) -> dict[str, int]:
+        """doc key -> mesh shard: the summary-ownership alignment surface
+        (server.partition_manager.ScribePool.align_to_placement)."""
+        return {self.doc_keys[d]: self.shard_of(d) for d in range(self.n_docs)}
+
+    def shard_load(self) -> tuple[np.ndarray, np.ndarray]:
+        """Per-shard (applied ops since the last ``hot_shards`` reset,
+        currently queued ops) — host-side accounting only, no device
+        readback."""
+        depth = np.zeros((self.n_shards,), np.int64)
+        for d in range(self.n_docs):
+            q = len(self.hosts[d].queue)
+            if q:
+                depth[self.shard_of(d)] += q
+        return self._shard_ops.copy(), depth
+
+    def hot_shards(
+        self, factor: float = 2.0, reset: bool = False, load=None
+    ) -> list[int]:
+        """Shards whose load (applied + queued ops) exceeds ``factor`` x
+        the fleet mean — the live-migration trigger.  ``reset`` zeroes the
+        applied-op counters so the next window measures fresh traffic;
+        callers that already hold a ``shard_load()`` result pass its sum
+        as ``load`` to skip the O(n_docs) rewalk."""
+        if load is None:
+            ops, depth = self.shard_load()
+            load = ops + depth
+        if reset:
+            self._shard_ops[:] = 0
+        if self.n_shards <= 1 or not load.any():
+            return []
+        mean = float(load.mean())
+        return [int(s) for s in np.flatnonzero(load > factor * mean)]
+
+    def free_slots(self, shard: int) -> int:
+        return len(self._free_slots[shard])
+
+    def migrate_doc(self, d: int, dst_shard: int) -> bool:
+        """Live doc migration between mesh shards (hot-shard rebalancing).
+
+        The handoff is checkpoint + summary adoption — the same primitives
+        the recovery and scribe paths trust: the doc's device row exports
+        through ``kb.state_to_summary`` (the checkpoint codec), re-packs at
+        the batch geometry with ``kb.summary_to_state``, and scatters into
+        a free slot on the destination shard; the vacated slot retires to
+        the pristine proto row.  Observable state (text, annotations,
+        obliterate table, exported summary) is byte-identical before and
+        after.  Host-side queues, retained logs, and checkpoint floors
+        travel with the doc untouched — a doc may migrate MID-STREAM with
+        staged ops pending; they simply apply at the new slot on the next
+        step.  Returns False (doc stays put) when the doc is off the batch
+        path (lane/oracle/quarantine), already on ``dst_shard``, poisoned,
+        or the destination has no free slot.
+        """
+        if not (0 <= dst_shard < self.n_shards):
+            raise ValueError(f"no shard {dst_shard} in a {self.n_shards}-shard mesh")
+        if not (0 <= d < self.n_docs):
+            raise ValueError(f"no doc {d}")
+        if d in self.overflow or d in self.oracles or d in self.quarantine:
+            return False
+        src_slot = int(self._slot[d])
+        src_shard = src_slot // self.docs_per_shard
+        if src_shard == dst_shard or not self._free_slots[dst_shard]:
+            return False
+        h = self.hosts[d]
+        row = jax.tree.map(lambda x: np.asarray(x[src_slot]), self.state)
+        if int(row.error):
+            return False  # recover first; never migrate a latched row
+        self._sync_native_props(h)
+        summary = kb.state_to_summary(
+            row, {v: k for k, v in h.prop_slot.items()}
+        )
+        try:
+            new_row = kb.summary_to_state(
+                summary, self.geometry,
+                lambda p: self._prop_slot_for_geom(h, p, self.geometry),
+            )
+        except (ValueError, IndexError):
+            return False  # does not re-pack at batch geometry: stay put
+        dst_slot = self._free_slots[dst_shard].pop()
+        self.state = jax.tree.map(
+            lambda x, s: x.at[dst_slot].set(s), self.state, new_row
+        )
+        self.state = jax.tree.map(
+            lambda x, s: x.at[src_slot].set(s), self.state, self._proto
+        )
+        self._slot[d] = dst_slot
+        self._free_slots[src_shard].append(src_slot)
+        # Fresh row content (text pool re-packed): the watchdog must
+        # re-verify before the pre-filter may skip this doc again.
+        self._verified_digest.pop(d, None)
+        self.counters.bump("doc_migrations")
+        return True
+
+    def rebalance_hot_shards(
+        self, factor: float = 2.0, max_moves: int = 1
+    ) -> list[tuple[int, int, int]]:
+        """Detect hot shards and live-migrate their deepest-queued docs to
+        the coldest shards with free slots (one checkpoint + summary-
+        adoption handoff per move — ``migrate_doc``).  Returns the
+        ``(doc, src_shard, dst_shard)`` moves made; callers re-align the
+        scribe pool afterwards (``ScribePool.align_to_placement``) so
+        summary ownership follows the docs."""
+        ops, depth = self.shard_load()
+        load = ops + depth
+        hot = self.hot_shards(factor, reset=True, load=load)
+        if not hot:
+            return []
+        # Hysteresis: a doc whose OWN queue exceeds factor x the fleet
+        # mean IS the hotspot — migrating it just moves the hot shard
+        # (and would ping-pong it every interval, paying a full
+        # export/repack handoff each time).  Such docs are the
+        # hot-document-parallelism problem (ROADMAP), not a placement
+        # problem; skip them and move the deepest doc that actually
+        # rebalances.
+        mean = float(load.mean())
+        moves: list[tuple[int, int, int]] = []
+        for s in hot:
+            if len(moves) >= max_moves:
+                break
+            candidates = [
+                d for d in range(self.n_docs)
+                if self.shard_of(d) == s and not self._in_lane(d)
+                and len(self.hosts[d].queue) <= factor * mean
+            ]
+            if not candidates:
+                self.counters.bump("hot_shard_moves_skipped")
+                continue
+            d = max(candidates, key=lambda dd: len(self.hosts[dd].queue))
+            for dst in map(int, np.argsort(depth)):
+                if dst == s or not self._free_slots[dst]:
+                    continue
+                if self.migrate_doc(d, dst):
+                    depth[dst] += len(self.hosts[d].queue)
+                    moves.append((d, s, dst))
+                    break
+        if moves:
+            self.counters.bump("hot_shard_rebalances", len(moves))
+        return moves
+
+    def _sync_native_props(self, h: _DocHost) -> None:
+        """Fold the native encoder's C++ prop-interning table into the host
+        table, so checkpoints and migrations of native-mode docs carry REAL
+        property ids instead of private kernel slot numbers (ROADMAP:
+        native-path checkpoint fidelity).  No-op for object-path docs and
+        for native builds without the export; safe to call repeatedly —
+        both tables intern in first-seen stream order, so entries agree."""
+        if h.native is None:
+            return
+        for prop, slot in h.native.prop_table().items():
+            cur = h.prop_slot.setdefault(prop, slot)
+            if cur != slot:
+                raise RuntimeError(
+                    f"native/host prop table skew: id {prop} -> {slot} vs {cur}"
+                )
 
     # --------------------------------------------------------------- watchdog
     def watchdog(self, sample: int | None = None) -> list[int]:
@@ -1414,7 +1658,10 @@ class DocBatchEngine:
             self._digests = np.asarray(_fleet_digest(self.state))
             drifted = []
             for d in eligible:
-                mark = (int(self._digests[d]), self.hosts[d].last_seq)
+                mark = (
+                    int(self._digests[int(self._slot[d])]),
+                    self.hosts[d].last_seq,
+                )
                 if self._verified_digest.get(d) == mark:
                     self.counters.bump("watchdog_prefiltered")
                 else:
@@ -1450,7 +1697,8 @@ class DocBatchEngine:
                 # Passed: pin (digest, seq) so the pre-filter can skip this
                 # doc until its device state or ingested stream moves.
                 self._verified_digest[d] = (
-                    int(self._digests[d]), self.hosts[d].last_seq
+                    int(self._digests[int(self._slot[d])]),
+                    self.hosts[d].last_seq,
                 )
         return failed
 
@@ -1510,10 +1758,12 @@ class DocBatchEngine:
                     ln.state, {v: k for k, v in h.prop_slot.items()}
                 )
             else:
-                if err[d]:
+                slot = int(self._slot[d])
+                if err[slot]:
                     continue  # never checkpoint a poisoned row
+                self._sync_native_props(h)
                 summary = kb.state_to_summary(
-                    jax.tree.map(lambda x: x[d], host_state),
+                    jax.tree.map(lambda x, _s=slot: x[_s], host_state),
                     {v: k for k, v in h.prop_slot.items()},
                 )
             record = {
@@ -1647,8 +1897,9 @@ class DocBatchEngine:
                     )
                     self.overflow[d] = self._make_lane(state, geom, 1)
                 else:
+                    slot = int(self._slot[d])
                     self.state = jax.tree.map(
-                        lambda x, s: x.at[d].set(s), self.state, row
+                        lambda x, s: x.at[slot].set(s), self.state, row
                     )
             restored.append(d)
             self.counters.bump("docs_restored")
@@ -1675,6 +1926,18 @@ class DocBatchEngine:
         self.counters.ratio(
             "steps_per_dispatch", "megastep_slices", "megastep_dispatches"
         )
+        # Mesh/placement surface: per-shard load for hot-shard detection
+        # (applied since the last hot_shards reset + queued right now).
+        self.counters.gauge("n_shards", self.n_shards)
+        if self.n_shards > 1:
+            ops, depth = self.shard_load()
+            self.counters.gauge("shard_ops", [int(v) for v in ops])
+            self.counters.gauge(
+                "shard_queue_depth", [int(v) for v in depth]
+            )
+            self.counters.gauge(
+                "hot_shards", self.hot_shards(load=ops + depth)
+            )
         snap = self.counters.snapshot()
         snap.update(
             quarantined_docs=len(self.quarantine),
@@ -1691,7 +1954,8 @@ class DocBatchEngine:
     def doc_state(self, doc_idx: int) -> mk.DocState:
         if doc_idx in self.overflow:
             return self.overflow[doc_idx].state
-        return jax.tree.map(lambda x: x[doc_idx], self.state)
+        slot = int(self._slot[doc_idx])
+        return jax.tree.map(lambda x: x[slot], self.state)
 
     def text(self, doc_idx: int) -> str:
         if doc_idx in self.quarantine:
@@ -1706,6 +1970,9 @@ class DocBatchEngine:
         if doc_idx in self.oracles:
             return self.oracles[doc_idx].annotations()
         raw = mk.annotations(self.doc_state(doc_idx))
+        # Live native-path docs intern props in C++: fold the table in so
+        # the view names real prop ids (same sync the checkpoint takes).
+        self._sync_native_props(self.hosts[doc_idx])
         inv = {v: k for k, v in self.hosts[doc_idx].prop_slot.items()}
         return [{inv[p]: v for p, v in d.items()} for d in raw]
 
@@ -1714,9 +1981,9 @@ class DocBatchEngine:
         Quarantined docs read 0: they are isolated and serviceable — their
         degraded state surfaces through ``health()``, not as a latched
         error that would fail a convergence sweep."""
-        err = np.asarray(self.state.error).copy()
-        for d in range(self.n_docs, self.capacity):
-            err[d] = 0  # padding slots
+        by_slot = np.asarray(self.state.error)
+        err = np.zeros((self.capacity,), by_slot.dtype)
+        err[: self.n_docs] = by_slot[self._slot]  # doc-indexed view
         for d, lane in self.overflow.items():
             err[d] = int(lane.state.error)
         for d in self.oracles:

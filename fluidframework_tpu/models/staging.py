@@ -166,7 +166,17 @@ class StagingRing:
         op_fields: int,
         payload_len: int,
         depth: int = 2,
+        mesh=None,
+        doc_axis: str = "docs",
     ) -> None:
+        # Mesh-aware upload: with a mesh, ``upload`` device_puts the
+        # staging views with the SHARD layout (doc axis at dim -3), so each
+        # chip receives exactly its placement-packed slice of the buffer
+        # and the per-chip transfers overlap the previous dispatch
+        # independently.  The engines pack doc rows by device slot, so the
+        # buffer is contiguous per shard by construction.
+        self._mesh = mesh
+        self._doc_axis = doc_axis
         self.k_max = max(1, int(k_max))
         self._shape_ops = (self.k_max, rows, batch, op_fields)
         self._shape_payloads = (self.k_max, rows, batch, payload_len)
@@ -226,6 +236,32 @@ class StagingRing:
         buffer: the next acquire of this buffer waits for their transfers
         (not the consuming computation) before handing the memory back."""
         self._cur.inflight = device_arrays
+
+    def upload(self, ops_view, payloads_view) -> tuple:
+        """Upload the filled staging views and arm the reuse barrier in one
+        call.  Under a mesh, [.., D, B, *] views (ndim >= 3) device_put
+        with the shard layout — per-chip slices upload independently;
+        lane-sized views ([B, *]) and mesh-less rings take the plain
+        ``jnp.asarray`` path (zero-copy on CPU; the aliasing probe in
+        ``acquire`` keeps reuse safe either way)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._mesh is not None and ops_view.ndim >= 3:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            spec = PartitionSpec(
+                *([None] * (ops_view.ndim - 3)), self._doc_axis
+            )
+            sharding = NamedSharding(self._mesh, spec)
+            dev = (
+                jax.device_put(ops_view, sharding),
+                jax.device_put(payloads_view, sharding),
+            )
+        else:
+            dev = (jnp.asarray(ops_view), jnp.asarray(payloads_view))
+        self.launched(*dev)
+        return dev
 
     @staticmethod
     def _aliased(buf: _StageBuf, arrs) -> bool:

@@ -49,6 +49,7 @@ from ..dds.tree.editmanager import EditManager
 from ..dds.tree.field_kinds import OptionalChange
 from ..dds.tree.forest import ROOT_FIELD, Forest, Node
 from ..ops import tree_kernel as tk
+from ..parallel import mesh as pm
 from ..protocol.messages import MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters
 from .staging import RowQueue, StagingRing
@@ -195,8 +196,11 @@ _tree_step_jit = functools.partial(jax.jit, donate_argnums=(0,))(
 _tree_megastep_jit = functools.partial(jax.jit, donate_argnums=(0,))(
     tk.apply_nested_megastep
 )
+# Module-level body (stable identity: parallel.mesh caches its
+# shard_map-wrapped mesh programs by function).
+_tree_compact_body = jax.vmap(tk.compact_nested)
 _tree_compact_jit = functools.partial(jax.jit, donate_argnums=(0,))(
-    jax.vmap(tk.compact_nested)
+    _tree_compact_body
 )
 
 
@@ -255,22 +259,35 @@ class TreeBatchEngine:
         self._plans: dict[tuple, _TranslationPlan] = {}
         self._collector = _FlattenCollector()
         self._PLAN_CACHE_MAX = 4096
-        if mesh is not None:
-            n_shards = mesh.devices.size
-            assert n_docs % n_shards == 0, "pad n_docs to a mesh multiple"
+        # Fleet capacity rounds up to a mesh multiple (padding rows are
+        # inert: empty queues -> all-NOOP slices), mirroring the string
+        # engine; shard = doc // docs_per_shard (contiguous placement).
+        self.n_shards = mesh.devices.size if mesh is not None else 1
+        self.fleet_capacity = -(-n_docs // self.n_shards) * self.n_shards
+        self.docs_per_shard = self.fleet_capacity // self.n_shards
         proto = tk.init_nested_forest(capacity, pool_capacity)
         self.state = jax.tree.map(
-            lambda x: jnp.broadcast_to(x, (n_docs,) + x.shape), proto
+            lambda x: jnp.broadcast_to(
+                x, (self.fleet_capacity,) + x.shape
+            ),
+            proto,
         )
-        if mesh is not None:
-            from ..parallel.mesh import shard_docs
-
-            self.state = jax.tree.map(
-                lambda x: jax.device_put(x, shard_docs(mesh)), self.state
-            )
         self._step = _tree_step_jit
         self._megastep = _tree_megastep_jit
         self._compact = _tree_compact_jit
+        if mesh is not None:
+            # Partition-rule-matched placement + shard_map-wrapped fleet
+            # programs: one donated dispatch steps every shard, zero
+            # hot-path collectives (parallel.mesh; same machinery as the
+            # string engine).
+            self.state = pm.shard_fleet_state(self.state, mesh)
+            specs = pm.fleet_state_specs(self.state)
+            self._megastep = pm.mesh_fleet_program(
+                tk.apply_nested_megastep, mesh, specs
+            )
+            self._compact = pm.mesh_fleet_program(
+                _tree_compact_body, mesh, specs, arg_specs=()
+            )
         # Incremental busy set + preallocated double-buffered staging
         # (lazy), mirroring doc_batch_engine's megastep pipeline.
         self._busy: set[int] = set()
@@ -662,8 +679,8 @@ class TreeBatchEngine:
     def _staging(self) -> StagingRing:
         if self._stage is None:
             self._stage = StagingRing(
-                self.megastep_k, self.n_docs, self.ops_per_step,
-                tk.NESTED_OP_FIELDS, self.max_insert_len,
+                self.megastep_k, self.fleet_capacity, self.ops_per_step,
+                tk.NESTED_OP_FIELDS, self.max_insert_len, mesh=self.mesh,
             )
         return self._stage
 
@@ -731,35 +748,48 @@ class TreeBatchEngine:
                 )
                 self._rows_upper = np.where(
                     active,
-                    np.asarray(self.state.nrow).astype(np.int64) + queued,
+                    np.asarray(self.state.nrow)[: self.n_docs].astype(
+                        np.int64
+                    )
+                    + queued,
                     0,
                 )
                 self._pool_upper = np.where(
                     active,
-                    np.asarray(self.state.pool_end).astype(np.int64)
+                    np.asarray(self.state.pool_end)[: self.n_docs].astype(
+                        np.int64
+                    )
                     + queued_words,
                     0,
                 )
             busy = sorted(self._busy)
             K = self._select_k(busy)
             stage = self._staging()
-            ops, payloads = stage.acquire(K, self.n_docs)
+            ops, payloads = stage.acquire(K, self.fleet_capacity)
             for k in range(K):
                 stage.mark(k, self._drain_into(busy, ops[k], payloads[k]))
                 if k + 1 < K:
                     busy = [d for d in busy if d in self._busy]
-            if K == 1:
-                dev_ops = jnp.asarray(ops[0])
-                dev_payloads = jnp.asarray(payloads[0])
-                stage.launched(dev_ops, dev_payloads)
+            if self.mesh is None and K == 1:
+                dev_ops, dev_payloads = stage.upload(ops[0], payloads[0])
                 self.state = self._step(self.state, dev_ops, dev_payloads)
             else:
-                dev_ops, dev_payloads = jnp.asarray(ops), jnp.asarray(payloads)
-                stage.launched(dev_ops, dev_payloads)
+                # Mesh path: always the [K, D, B] shard_map megastep (K=1
+                # included — bit-identical to one batched dispatch), one
+                # donated call stepping every chip.
+                dev_ops, dev_payloads = stage.upload(ops, payloads)
                 self.state = self._megastep(self.state, dev_ops, dev_payloads)
             steps += K
             self.counters.bump("megastep_dispatches")
             self.counters.bump("megastep_slices", K)
+        if (
+            self.mesh is not None
+            and int(pm.error_count(self.state.error)) == 0
+        ):
+            # Per-shard latch reduce: one scalar readback instead of a
+            # cross-mesh [D] error gather on every step.
+            self.maybe_checkpoint()
+            return steps
         err = np.asarray(self.state.error)
         for d in range(self.n_docs):
             if err[d] and d not in self.fallbacks:
@@ -895,6 +925,14 @@ class TreeBatchEngine:
             round(hits / (hits + misses), 4) if hits + misses else 0.0,
         )
         self.counters.gauge("translation_plans", len(self._plans))
+        self.counters.gauge("n_shards", self.n_shards)
+        if self.n_shards > 1:
+            depth = [0] * self.n_shards
+            for d in range(self.n_docs):
+                q = len(self.hosts[d].queue)
+                if q:
+                    depth[self.shard_of(d)] += q
+            self.counters.gauge("shard_queue_depth", depth)
         snap = self.counters.snapshot()
         snap.update(
             fallback_docs=len(self.fallbacks),
@@ -926,5 +964,14 @@ class TreeBatchEngine:
         leaves, None for valueless nodes)."""
         return [n.get("v") for n in self.tree_json(doc_idx)]
 
+    def shard_of(self, doc_idx: int) -> int:
+        """The mesh shard hosting this doc's device row (contiguous
+        placement; the tree fleet has no migration yet)."""
+        return doc_idx // self.docs_per_shard
+
+    def placement(self) -> dict[str, int]:
+        """doc key -> mesh shard (ScribePool.align_to_placement surface)."""
+        return {self.doc_keys[d]: self.shard_of(d) for d in range(self.n_docs)}
+
     def errors(self) -> np.ndarray:
-        return np.asarray(self.state.error)
+        return np.asarray(self.state.error)[: self.n_docs]

@@ -54,6 +54,15 @@ def _ensure_built() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
         ctypes.c_int32,
     ]
+    # Prop-table export (checkpoint fidelity): absent from prebuilt .so
+    # files older than the symbol — gate, don't crash (prop_table()
+    # returns {} and checkpoints keep the legacy slot-number ids).
+    if hasattr(lib, "ing_prop_table"):
+        lib.ing_prop_table.restype = ctypes.c_int32
+        lib.ing_prop_table.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+        ]
     _lib_cache.append(lib)
     return lib
 
@@ -81,6 +90,30 @@ class NativeIngestEncoder:
     @property
     def min_seq(self) -> int:
         return int(self._lib.ing_min_seq(self._h))
+
+    def prop_table(self) -> dict[int, int]:
+        """The C++ property interning table as ``{prop_id: kernel slot}``.
+
+        Checkpoint fidelity (ROADMAP): the engine folds this into its host
+        table before summarizing a native-mode doc, so checkpoints carry
+        the documents' REAL annotation property ids — a restored doc's
+        annotations round-trip instead of surfacing private slot numbers.
+        Empty when the loaded library predates the export."""
+        if not hasattr(self._lib, "ing_prop_table"):
+            return {}
+        cap = 16
+        while True:
+            props = np.empty((cap,), np.int64)
+            slots = np.empty((cap,), np.int32)
+            n = self._lib.ing_prop_table(
+                self._h,
+                props.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                cap,
+            )
+            if n < cap:
+                return {int(props[i]): int(slots[i]) for i in range(n)}
+            cap *= 2
 
     def encode(self, data: bytes, max_rows: int = 0) -> tuple[np.ndarray, np.ndarray]:
         """Newline-separated JSON messages -> (ops[M, 8], payloads[M, L])."""

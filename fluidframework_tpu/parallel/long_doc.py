@@ -29,6 +29,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.mergetree_kernel import DocState
@@ -103,12 +104,12 @@ def make_sharded_ops(mesh: Mesh, state: DocState, axis: str = "segs"):
     document layout, each shard_map-jitted over the segment axis."""
     specs = _specs_for(state, axis)
 
-    @partial(jax.shard_map, mesh=mesh, in_specs=(specs, P(), P()), out_specs=P())
+    @partial(shard_map, mesh=mesh, in_specs=(specs, P(), P()), out_specs=P())
     def _visible_length(s: DocState, ref_seq, client):
         return jax.lax.psum(jnp.sum(_local_vis_lens(s, ref_seq, client, axis)), axis)
 
     @partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(specs, P(), P(), P()), out_specs=(P(), P()),
     )
     def _resolve(s: DocState, positions, ref_seq, client):
@@ -136,7 +137,7 @@ def make_sharded_ops(mesh: Mesh, state: DocState, axis: str = "segs"):
         )
 
     @partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(specs, P(), P(), P(), P(), P(), P()),
         out_specs=specs,
     )

@@ -5,8 +5,24 @@ documentId; each deli/lambda instance owns a disjoint doc set —
 SURVEY.md §2.6).  The TPU-native equivalent is a 1-D ``Mesh`` over a ``docs``
 axis: replica state arrays are sharded on their leading document dimension,
 op batches likewise, and the per-step computation is purely doc-parallel so
-XLA partitions it with zero collectives on the hot path (collectives appear
-only in aggregate metrics/reductions).
+the ``shard_map``-wrapped fleet programs below run with ZERO collectives on
+the hot path (collectives appear only in aggregate metrics/reductions, e.g.
+the per-shard error-latch reduce).
+
+Layers:
+
+- ``match_partition_rules``: regex partition-rule matching over a state
+  pytree's named leaves -> a pytree of ``PartitionSpec`` (scalars and
+  singleton leaves replicate; everything matching a doc rule shards on its
+  leading document dimension).
+- ``mesh_fleet_program``: wrap a per-doc fleet step (``apply_megastep`` /
+  ``apply_nested_megastep`` / compaction) in ``shard_map`` under the mesh
+  and ``jax.jit`` with the state donated — one dispatch steps every shard,
+  each shard's obliterate gate evaluated from ITS OWN docs (a hot
+  obliterate shard no longer de-specializes the whole fleet's trace).
+- ``error_count``: the per-shard reduce replacing the full [D] error-vector
+  gather on the recover() path — each shard contributes a partial sum, the
+  host reads one scalar.
 
 Multi-host pods extend the same mesh across hosts: the doc axis rides
 ICI within a slice and DCN across slices — no code change, just a larger
@@ -15,8 +31,13 @@ ICI within a slice and DCN across slices — no code change, just a larger
 
 from __future__ import annotations
 
+import functools
+import re
+
 import jax
+import jax.numpy as jnp
 import numpy as np
+from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
@@ -33,3 +54,106 @@ def shard_docs(mesh: Mesh, axis: str = "docs") -> NamedSharding:
 
 def replicate(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------------------
+# Partition-rule matching over named pytree leaves
+# ---------------------------------------------------------------------------
+
+def _key_str(k) -> str:
+    """One path entry -> its name (GetAttrKey/SequenceKey/DictKey/...)."""
+    for attr in ("name", "idx", "key"):
+        if hasattr(k, attr):
+            return str(getattr(k, attr))
+    return str(k)
+
+
+def named_leaves(tree) -> tuple[list[str], list, object]:
+    """``(names, leaves, treedef)`` with "a/b/0"-style leaf path names."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    names = ["/".join(_key_str(k) for k in path) for path, _ in flat]
+    return names, [leaf for _, leaf in flat], treedef
+
+
+def match_partition_rules(rules, tree, default: P = P()):
+    """A pytree of ``PartitionSpec`` matching ``tree``: first rule whose
+    regex matches the leaf's path name wins; 0-d and singleton leaves
+    always replicate (never partition scalars); unmatched leaves take
+    ``default`` (replicated)."""
+    names, leaves, treedef = named_leaves(tree)
+    specs = []
+    for name, leaf in zip(names, leaves):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            specs.append(P())
+            continue
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                specs.append(spec)
+                break
+        else:
+            specs.append(default)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+# The batched engines broadcast every replica leaf to [D, ...], so every
+# named leaf of a fleet state carries the leading document axis — per-doc
+# scalars included (they are [D] vectors in the batch).  Anything that ever
+# loses the doc axis (a future shared pool / global table) falls through to
+# the replicated default via the scalar/singleton guard or a non-match.
+FLEET_STATE_RULES: tuple = ((r".*", P("docs")),)
+
+
+def fleet_state_specs(state):
+    """Partition specs for a batched engine state pytree (leading doc dim
+    sharded over ``docs``, scalars/singletons replicated)."""
+    return match_partition_rules(FLEET_STATE_RULES, state)
+
+
+def shard_fleet_state(state, mesh: Mesh):
+    """Place a batched fleet state on the mesh per its matched specs."""
+    specs = fleet_state_specs(state)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs
+    )
+
+
+# ---------------------------------------------------------------------------
+# shard_map-wrapped fleet programs
+# ---------------------------------------------------------------------------
+
+def op_spec(ndim: int, axis: str = "docs") -> P:
+    """Spec for an op/payload tensor whose doc axis sits at ``ndim - 3``
+    ([..., D, B, F|L]): megastep rings [K, D, B, *] -> P(None, docs),
+    single slices [D, B, *] -> P(docs)."""
+    return P(*([None] * (ndim - 3)), axis)
+
+
+@functools.lru_cache(maxsize=None)
+def mesh_fleet_program(step_fn, mesh: Mesh, state_specs,
+                       arg_specs: tuple = (P(None, "docs"), P(None, "docs")),
+                       donate: bool = True):
+    """``jit(shard_map(step_fn))``: ONE donated dispatch steps the whole
+    fleet, each shard applying its own doc rows with no cross-shard
+    communication.  ``state_specs`` must be the hashable pytree
+    ``fleet_state_specs`` produces for the engine's state type (NamedTuple
+    of PartitionSpec) and ``arg_specs`` the specs of the non-state args
+    (default: a [K, D, B, *] megastep op ring pair), so the program cache
+    is shared by every engine instance serving the same mesh."""
+    mapped = shard_map(
+        step_fn,
+        mesh=mesh,
+        in_specs=(state_specs,) + tuple(arg_specs),
+        out_specs=state_specs,
+        check_rep=False,  # per-doc program: nothing is replicated to check
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+@jax.jit
+def error_count(error: jnp.ndarray) -> jnp.ndarray:
+    """Fleet error-latch probe as a per-shard reduce: each shard partial-
+    sums its own error rows and the host reads ONE scalar — the recover()
+    gate no longer gathers the full [D] error vector across the mesh every
+    step (the gather happens only when this count is nonzero)."""
+    return jnp.sum((error != 0).astype(jnp.int32))

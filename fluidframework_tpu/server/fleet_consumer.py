@@ -17,6 +17,12 @@ slices into one donated device dispatch, and the next ``pump()``'s staging
 overlaps the in-flight upload/dispatch — ``health()`` surfaces the realized
 amortization as ``steps_per_dispatch`` / ``megastep_k`` /
 ``staging_overlap_packs`` alongside the transport counters.
+
+With a mesh-served engine (``fleet_main --mesh N``) the same dispatch is a
+``shard_map`` program over an N-device docs mesh: staging packs by doc
+placement, uploads carry the shard layout, and ``health()`` adds the
+per-shard load surface (``shard_ops``/``shard_queue_depth``/``hot_shards``)
+that drives live doc migration (``engine.rebalance_hot_shards``).
 """
 
 from __future__ import annotations

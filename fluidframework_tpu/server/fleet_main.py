@@ -8,6 +8,10 @@ steps it continuously — wire bytes to device with no per-op Python
     python -m fluidframework_tpu.server.fleet_main \
         --host 127.0.0.1 --port 7070 --docs doc0,doc1,doc2
 
+``--mesh N`` serves the fleet sharded over an N-device docs mesh (shard_map
+megastep dispatch; composes with --megastep-k), ``--spare-slots``/
+``--rebalance-every`` enable live hot-shard doc migration.
+
 Emits one JSON status line per --status-every seconds (rows applied,
 bytes consumed, per-doc error flags) for process supervisors.
 ``--exit-after-rows`` bounds the run (tests / draining restarts).
@@ -81,6 +85,20 @@ def main(argv: list[str] | None = None) -> int:
                    help="max op slices fused into one device dispatch "
                         "(adaptive by queue depth; 1 = exact per-slice "
                         "dispatch, the pre-megastep behavior)")
+    p.add_argument("--mesh", type=int, default=0,
+                   help="serve the fleet sharded over an N-device docs "
+                        "mesh (shard_map megastep dispatch; 0 = single "
+                        "device, -1 = all visible devices).  Composes "
+                        "with --megastep-k: each dispatch is a [K, D, B] "
+                        "ring split per chip")
+    p.add_argument("--spare-slots", type=int, default=0,
+                   help="extra free device rows beyond the fleet (landing "
+                        "room for live hot-shard doc migration; rounds up "
+                        "per shard)")
+    p.add_argument("--rebalance-every", type=float, default=0.0,
+                   help="seconds between hot-shard checks: migrate the "
+                        "deepest-queued doc off any shard loaded over 2x "
+                        "the fleet mean (0 = no auto-rebalance)")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu); overrides the "
                         "image default and the FFTPU_PLATFORM env var")
@@ -107,13 +125,24 @@ def main(argv: list[str] | None = None) -> int:
         if args.checkpoint_dir is not None
         else None
     )
+    mesh = None
+    if args.mesh:
+        import jax
+
+        from ..parallel.mesh import doc_mesh
+
+        devices = jax.devices()
+        n_dev = len(devices) if args.mesh < 0 else min(args.mesh, len(devices))
+        mesh = doc_mesh(devices[:n_dev])
     eng = DocBatchEngine(
         len(doc_ids),
         max_segments=args.capacity,
         text_capacity=args.text_capacity,
         max_insert_len=args.max_insert_len,
         ops_per_step=args.ops_per_step,
-        use_mesh=False,
+        use_mesh=mesh is not None,
+        mesh=mesh,
+        spare_slots=args.spare_slots,
         recovery=args.recovery,
         checkpoint_store=store,
         checkpoint_every=args.checkpoint_every if store is not None else 0,
@@ -156,9 +185,27 @@ def main(argv: list[str] | None = None) -> int:
         )), flush=True)
 
     last_status = time.monotonic()
+    last_rebalance = time.monotonic()
     try:
         while True:
             staged = fc.pump()
+            if (
+                args.rebalance_every
+                and mesh is not None
+                and time.monotonic() - last_rebalance >= args.rebalance_every
+            ):
+                last_rebalance = time.monotonic()
+                moves = eng.rebalance_hot_shards()
+                if moves:
+                    # Summary ownership follows the docs: the supervisor
+                    # (or a colocated ScribePool) re-aligns from this line.
+                    print(json.dumps({
+                        "migrations": [
+                            {"doc": doc_ids[d], "from": s, "to": t}
+                            for d, s, t in moves
+                        ],
+                        "placement": eng.placement(),
+                    }), flush=True)
             if fc.dead_socks:
                 # A shard closed our firehose (restart/shutdown): exit
                 # nonzero so the supervisor restarts this tier — sleeping

@@ -86,13 +86,31 @@ class Partition:
 @dataclass
 class Topic:
     """A named topic with a fixed partition count; records route by document
-    id hash (kafka partition-by-key, lambdas-driver routing)."""
+    id hash (kafka partition-by-key, lambdas-driver routing).  ``place``
+    pins individual docs to explicit partitions — the mesh-alignment seam:
+    when a serving fleet places docs on device shards, pinning each doc's
+    partition to its shard makes summary ownership follow doc placement
+    (partition_manager.ScribePool.align_to_placement).  Unpinned docs keep
+    the hash route; re-pinning moves only a doc's FUTURE records (already
+    produced records stay where they landed — consumers drain them under
+    the ordinary at-least-once contract)."""
 
     name: str
     n_partitions: int = 4
     partitions: dict[int, Partition] = field(default_factory=dict)
+    placement: dict[str, int] = field(default_factory=dict)
+
+    def place(self, doc_id: str, partition: int) -> None:
+        if not (0 <= partition < self.n_partitions):
+            raise ValueError(
+                f"partition {partition} outside 0..{self.n_partitions - 1}"
+            )
+        self.placement[doc_id] = partition
 
     def partition_for(self, doc_id: str) -> int:
+        placed = self.placement.get(doc_id)
+        if placed is not None:
+            return placed
         return sum(doc_id.encode()) % self.n_partitions
 
     def partition(self, idx: int) -> Partition:
@@ -258,6 +276,11 @@ class ConsumerGroup:
         self.group_id = group_id
         self.members: list[str] = []
         self.generation = 0  # bumps on every rebalance
+        # Explicit partition pins (mesh alignment): a pinned partition is
+        # owned by exactly its pinned member while that member is alive;
+        # a pin to a dead/absent member falls back to round-robin, so a
+        # kill never strands a partition.
+        self.pins: dict[int, str] = {}
         self._offsets: dict[int, int] = {}
         # Records a resuming consumer could not read because compaction
         # already reclaimed them (committed offset below the truncated
@@ -284,15 +307,31 @@ class ConsumerGroup:
             self.members.remove(member_id)
             self.generation += 1
 
+    def pin(self, partition: int, member_id: str) -> None:
+        """Pin a partition to one member (placement alignment); overrides
+        round-robin while the member is alive, falls back when it is not."""
+        if self.pins.get(partition) != member_id:
+            self.pins[partition] = member_id
+            self.generation += 1
+
+    def unpin(self, partition: int) -> None:
+        if self.pins.pop(partition, None) is not None:
+            self.generation += 1
+
     def assignments(self, member_id: str) -> list[int]:
         ordered = sorted(self.members)
         if member_id not in ordered:
             return []
         rank = ordered.index(member_id)
-        return [
-            p for p in range(self.topic.n_partitions)
-            if p % len(ordered) == rank
-        ]
+        out = []
+        for p in range(self.topic.n_partitions):
+            owner = self.pins.get(p)
+            if owner is not None and owner in self.members:
+                if owner == member_id:
+                    out.append(p)
+            elif p % len(ordered) == rank:
+                out.append(p)
+        return out
 
     # --------------------------------------------------------------- offsets
     def committed(self, partition: int) -> int:

@@ -303,6 +303,47 @@ class ScribePool:
     def pump(self) -> int:
         return sum(m.pump() for m in list(self.members.values()))
 
+    def align_to_placement(self, placement: dict[str, int]) -> dict[int, str]:
+        """Align summary ownership to the serving fleet's doc placement
+        (DocBatchEngine/TreeBatchEngine ``placement()``: doc key -> mesh
+        shard).  Each doc pins to the topic partition of its shard
+        (``shard % n_partitions``) and each such partition pins to one
+        pool member — sorted member order maps to shard order — so the
+        scribe member summarizing a doc is the one co-located with the
+        chip serving it.
+
+        Safe to re-run after a live migration: the doc's FUTURE records
+        route to its new shard's partition, whose owner resumes the doc's
+        summary chain by summary adoption from the shared refs/object
+        store; records already in the old partition drain under the
+        ordinary at-least-once contract (acks are idempotent by seq
+        floor, so the handoff can never double-ack).  Pins to members
+        that later die fall back to round-robin (ConsumerGroup.pin).
+
+        Co-location is exact when ``n_partitions >= n_shards``.  With
+        fewer partitions than shards, shards collide on
+        ``shard % n_partitions``; each colliding partition pins ONCE, to
+        the lowest colliding shard's member (deterministic — never a
+        last-doc-wins flip-flop that churns the group generation), and
+        the higher shard's docs are summarized by that member
+        (consistent, merely not co-located).  Returns the
+        partition -> member ownership map."""
+        members = sorted(self.members)
+        n_parts = self.topic.n_partitions
+        part_shard: dict[int, int] = {}
+        for _doc, shard in placement.items():
+            p = shard % n_parts
+            part_shard[p] = min(shard, part_shard.get(p, shard))
+        ownership: dict[int, str] = {}
+        for p, shard in sorted(part_shard.items()):
+            if members:
+                owner = members[shard % len(members)]
+                self.group.pin(p, owner)
+                ownership[p] = owner
+        for doc, shard in sorted(placement.items()):
+            self.topic.place(doc, shard % n_parts)
+        return ownership
+
     def compact(self, extra_groups: tuple = ()) -> dict:
         """Pool-safe compaction: fold the SHARED refs union into one member
         before flooring, so a doc tracked only by a peer (or only on disk

@@ -490,4 +490,23 @@ int32_t ing_encode(void* h, const char* data, int64_t len,
   return out.n;
 }
 
+// Export the property interning table: writes up to max_entries
+// (prop_id, slot) pairs into out_props/out_slots and returns the entry
+// count.  This is the checkpoint-fidelity seam — the host folds these
+// REAL property ids into its own table before cutting a checkpoint of a
+// native-mode document, so restored annotations round-trip prop ids
+// instead of this encoder's private slot numbers.
+int32_t ing_prop_table(void* h, int64_t* out_props, int32_t* out_slots,
+                       int32_t max_entries) {
+  Encoder& e = *(Encoder*)h;
+  int32_t n = 0;
+  for (const auto& kv : e.prop_slot) {
+    if (n >= max_entries) break;
+    out_props[n] = kv.first;
+    out_slots[n] = kv.second;
+    ++n;
+  }
+  return n;
+}
+
 }  // extern "C"

@@ -141,3 +141,253 @@ def test_sharded_fleet_with_obliterates_and_recovery():
     assert eng.overflow or eng.oracles, "expected recovery lanes at S=8"
     for d in range(8):
         assert eng.text(d) == texts[d], f"doc {d} diverged"
+
+
+# ---------------------------------------------------------------------------
+# Shard-count invariance: the mesh-served megastep/staging path (PR 6)
+# ---------------------------------------------------------------------------
+
+from test_engine_checkpoint import _ins, _join, _op, _schedule  # noqa: E402
+
+
+def _string_engine(n_docs, mesh_on, **kw):
+    return DocBatchEngine(
+        n_docs, max_insert_len=8, ops_per_step=4, megastep_k=4,
+        use_mesh=mesh_on, **kw,
+    )
+
+
+def _rows_equal(a, b) -> bool:
+    flat_a = jax.tree.leaves(a)
+    flat_b = jax.tree.leaves(b)
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(flat_a, flat_b)
+    )
+
+
+def _drive_string(eng, sched, step_every=40):
+    for d in range(eng.n_docs):
+        eng.ingest(d, _join("w0", 0))
+    # Obliterate leg on docs 0/1: the sided window machinery must be
+    # shard-invariant too (per-shard gates under shard_map).
+    for d in (0, 1):
+        eng.ingest(d, _ins(401, 0, "abcdefgh"))
+        eng.ingest(d, _op(402, {"type": 4, "pos1": 2, "pos2": 5}, ref=401))
+        eng.ingest(d, _ins(403, 1, "xy", ref=402))
+    count = 0
+    for d, m, _p in sched:
+        eng.ingest(d, m)
+        count += 1
+        if count % step_every == 0:
+            eng.step()
+    eng.step()
+    return eng
+
+
+def _assert_fleets_identical(a, b, skip_rows=()):
+    assert sorted(a.quarantine) == sorted(b.quarantine)
+    for d in range(a.n_docs):
+        assert a.text(d) == b.text(d), f"doc {d} text diverged"
+        if d in a.quarantine or d in a.oracles or d in skip_rows:
+            continue
+        assert _rows_equal(a.doc_state(d), b.doc_state(d)), (
+            f"doc {d} state rows diverged"
+        )
+
+
+def test_shard_count_invariance_string_fleet():
+    """1-device vs 8-shard mesh: the megastep/staging serving path is
+    byte-identical — raw state rows included — through mixed traffic with
+    obliterates, a poison quarantine, readmission, and compaction."""
+    D, ROUNDS = 16, 10
+    sched = _schedule(D, ROUNDS, seed=7, poison=(5, 4))
+    single = _drive_string(_string_engine(D, False), sched)
+    mesh = _drive_string(_string_engine(D, True), sched)
+    assert mesh.n_shards == 8
+    assert len(mesh.state.seg_len.sharding.device_set) == 8
+    assert 5 in single.quarantine and 5 in mesh.quarantine
+    _assert_fleets_identical(single, mesh)
+    # Readmit on both paths, continue the stream, stay identical.
+    assert single.readmit(5) and mesh.readmit(5)
+    for eng in (single, mesh):
+        for d in range(D):
+            eng.ingest(d, _ins(1001, 0, "zz"))
+        eng.step()
+        eng.compact()
+    _assert_fleets_identical(single, mesh)
+    # The mesh run went through the shard_map megastep dispatch.
+    h = mesh.health()
+    assert h["megastep_dispatches"] >= 1 and h["n_shards"] == 8
+
+
+def test_shard_count_invariance_tree_fleet():
+    """Tree family: 1-device vs 8-shard mesh byte-identity through the
+    nested megastep path (padding rows included)."""
+    from fluidframework_tpu.parallel.mesh import doc_mesh as _dm
+
+    n_docs = 6  # deliberately NOT a mesh multiple: exercises padding
+    svc, expected = drive_tree_docs(n_docs, seed=29, steps=24)
+    engines = []
+    for mesh in (None, _dm()):
+        eng = TreeBatchEngine(n_docs, mesh=mesh, megastep_k=4)
+        for d in range(n_docs):
+            for msg in svc.document(f"doc{d}").sequencer.log:
+                eng.ingest(d, msg)
+        eng.step()
+        engines.append(eng)
+    single, mesh_eng = engines
+    assert mesh_eng.fleet_capacity == 8 and mesh_eng.n_shards == 8
+    for d in range(n_docs):
+        assert single.values(d) == expected[d]
+        assert mesh_eng.values(d) == expected[d]
+        assert _rows_equal(
+            jax.tree.map(lambda x: x[d], single.state),
+            jax.tree.map(lambda x: x[d], mesh_eng.state),
+        ), f"tree doc {d} state rows diverged"
+
+
+def test_midstream_migration_byte_identity():
+    """A doc live-migrated between shards mid-stream (checkpoint + summary
+    adoption handoff) converges byte-identically: observable state equals
+    the never-migrated mesh run's, and every other doc's raw rows stay
+    bit-equal.  Compaction and further steps run at the new placement."""
+    from fluidframework_tpu.dds import kernel_backend as kb
+
+    D, ROUNDS = 8, 12
+    sched = _schedule(D, ROUNDS, seed=3)
+    half = len(sched) // 2
+    moved = 2
+    a = _string_engine(D, True, spare_slots=8)  # migrating run
+    b = _string_engine(D, True, spare_slots=8)  # control run
+    for eng in (a, b):
+        for d in range(D):
+            eng.ingest(d, _join("w0", 0))
+        for d, m, _p in sched[:half]:
+            eng.ingest(d, m)
+        eng.step()
+    src = a.shard_of(moved)
+    dst = (src + 3) % a.n_shards
+    assert a.migrate_doc(moved, dst), "migration refused"
+    assert a.shard_of(moved) == dst and a.shard_of(moved) != b.shard_of(moved)
+    assert a.health()["doc_migrations"] == 1
+    # Mid-stream: the tail ingests and applies at the NEW placement.
+    for eng in (a, b):
+        for d, m, _p in sched[half:]:
+            eng.ingest(d, m)
+        eng.step()
+        eng.compact()
+        eng.step()
+    for d in range(D):
+        assert a.text(d) == b.text(d), f"doc {d} text diverged"
+        assert a.annotations(d) == b.annotations(d)
+        if d != moved:
+            assert _rows_equal(a.doc_state(d), b.doc_state(d)), d
+    # The migrated doc's canonical state (summary codec) is identical even
+    # though its pool layout re-packed at the handoff.
+    sa = kb.state_to_summary(jax.tree.map(np.asarray, a.doc_state(moved)))
+    sb = kb.state_to_summary(jax.tree.map(np.asarray, b.doc_state(moved)))
+    assert sa == sb
+    # Sharding survived the scatter/migration path.
+    assert len(a.state.seg_len.sharding.device_set) == 8
+
+
+def test_migration_summary_chain_continues(tmp_path):
+    """Scribe alignment follows a live migration: docs pin to their
+    shard's partition (Topic.place), partitions pin to pool members
+    (ConsumerGroup.pin), and after a doc migrates + re-align, the NEW
+    owner resumes the doc's summary chain by summary adoption — the
+    post-move commit parents onto the pre-move commit, no restart from
+    zero, no double-ack."""
+    from fluidframework_tpu.protocol.messages import (
+        MessageType,
+        SequencedMessage,
+    )
+    from fluidframework_tpu.runtime.summary import parse_scribe_ack
+    from fluidframework_tpu.server.ordered_log import DurableTopic
+    from fluidframework_tpu.server.partition_manager import ScribePool
+    from fluidframework_tpu.server.scribe import ScribeConfig
+
+    topic = DurableTopic(
+        "deltas", 8, str(tmp_path / "log"),
+        encode=lambda m: m.to_json(), decode=SequencedMessage.from_json,
+    )
+    doc_keys = [f"doc{i}" for i in range(8)]
+    eng = _string_engine(8, True, spare_slots=8, doc_keys=doc_keys)
+    pool = ScribePool(topic, str(tmp_path / "scribe"),
+                      config=ScribeConfig(max_ops=10))
+    pool.add_member("m0")
+    pool.add_member("m1")
+    ownership = pool.align_to_placement(eng.placement())
+    assert set(ownership) == set(range(8))  # every shard's partition pinned
+    # Every doc routes to its shard's partition, owned per sorted-member
+    # order — summary ownership follows doc placement.
+    for i, doc in enumerate(doc_keys):
+        assert topic.partition_for(doc) == eng.shard_of(i)
+
+    def stream(doc, seqs, seed=0):
+        rng = np.random.default_rng(seed)
+        length = 0
+        for s in seqs:
+            pos = int(rng.integers(0, length + 1))
+            topic.produce(doc, SequencedMessage(
+                seq=s, min_seq=0, ref_seq=s - 1, client_id="w0",
+                client_seq=s, type=MessageType.OP,
+                contents={"type": 0, "pos1": pos, "seg": "ab"},
+            ))
+            length += 2
+
+    def acks_for(doc):
+        out = []
+        for p in range(topic.n_partitions):
+            for rec in topic.partition(p).read(0):
+                ack = parse_scribe_ack(rec.payload)
+                if ack is not None and ack[0] == doc:
+                    out.append(ack)
+        return sorted(out, key=lambda a: a[1])  # by covered seq
+
+    for i, doc in enumerate(doc_keys):
+        topic.produce(doc, SequencedMessage(
+            seq=0, min_seq=0, ref_seq=0, client_id="w0", client_seq=0,
+            type=MessageType.JOIN, contents={"clientId": "w0", "short": 0},
+        ))
+        stream(doc, range(1, 15), seed=i)
+    pool.pump()
+    moved, moved_key = 2, doc_keys[2]
+    (first_ack,) = acks_for(moved_key)
+    assert first_ack[1] == 14
+    old_owner = ownership[eng.shard_of(moved)]
+
+    # Live migration + re-align: the doc's FUTURE records route to the
+    # new shard's partition, owned by the other member.
+    dst = next(
+        s for s in range(eng.n_shards)
+        if ownership.get(s) not in (None, old_owner) and eng.free_slots(s)
+    )
+    assert eng.migrate_doc(moved, dst)
+    ownership = pool.align_to_placement(eng.placement())
+    new_owner = ownership[dst]
+    assert new_owner != old_owner
+    assert topic.partition_for(moved_key) == dst
+
+    stream(moved_key, range(15, 30), seed=77)
+    pool.pump()
+    acks = acks_for(moved_key)
+    assert len(acks) == 2 and acks[-1][1] == 29
+    # Chain continuity: the post-move commit parents the pre-move commit.
+    _k, payload = pool.store.get(acks[-1][2])
+    assert payload["parent"] == first_ack[2]
+    assert pool.members[new_owner].health()["summaries_adopted"] >= 1
+    pool.close()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [11, 12, 13, 14, 15, 16])
+def test_shard_invariance_multiseed(seed):
+    """Slow sweep: shard-count invariance fuzz across seeds (megastep +
+    staging path, no faults — the fault legs run in tier-1 above)."""
+    D, ROUNDS = 12, 8
+    sched = _schedule(D, ROUNDS, seed=seed)
+    single = _drive_string(_string_engine(D, False), sched, step_every=23)
+    mesh = _drive_string(_string_engine(D, True), sched, step_every=23)
+    _assert_fleets_identical(single, mesh)

@@ -44,6 +44,62 @@ def test_rows_match_python_encoder_exactly():
         assert enc.min_seq == h.min_seq
 
 
+def test_native_checkpoint_round_trips_prop_ids(tmp_path):
+    """Checkpoint fidelity (ROADMAP): a native-mode doc's checkpoint must
+    carry its REAL annotation property ids — the C++ encoder interns
+    privately, and pre-plumbing the table out, summaries stored kernel
+    slot numbers that could never round-trip.  The restored doc (object
+    path, as documented) must report the original prop ids."""
+    import json
+
+    from fluidframework_tpu.server.ordered_log import CheckpointStore
+
+    def line(seq, ref, contents, typ="op"):
+        return json.dumps({
+            "type": typ, "sequenceNumber": seq,
+            "minimumSequenceNumber": 0, "referenceSequenceNumber": ref,
+            "clientId": "w0", "clientSequenceNumber": seq,
+            "contents": contents,
+        }).encode() + b"\n"
+
+    wire = b"".join([
+        line(0, 0, {"clientId": "w0", "short": 0}, typ="join"),
+        line(1, 0, {"type": 0, "pos1": 0, "seg": "abcdef"}),
+        # Two annotates with REAL prop ids far from slot numbers; the
+        # interleaving pins interning order (700 -> slot 0, 42 -> slot 1).
+        line(2, 1, {"type": 2, "pos1": 0, "pos2": 4, "props": {"700": 5}}),
+        line(3, 2, {"type": 2, "pos1": 2, "pos2": 6, "props": {"42": 9}}),
+    ])
+    store = CheckpointStore(str(tmp_path))
+    eng = DocBatchEngine(
+        1, max_insert_len=8, ops_per_step=4, use_mesh=False,
+        checkpoint_store=store, checkpoint_every=1, doc_keys=["n0"],
+    )
+    eng.ingest_lines(0, wire)
+    eng.step()
+    assert not eng.errors().any()
+    rec = store.load("n0")
+    assert rec is not None and rec["lane"] == "batch"
+    # The summary's prop keys are the wire ids, not private slot numbers.
+    seen = {
+        int(k)
+        for seg in rec["summary"]["segments"]
+        for k in seg["props"]
+    }
+    assert seen == {700, 42}, f"checkpoint stored {seen}"
+    assert rec["prop_slot"] == {"700": 0, "42": 1}
+    # Restore: annotations() reports the original ids with LWW values.
+    eng2 = DocBatchEngine(
+        1, max_insert_len=8, ops_per_step=4, use_mesh=False,
+        checkpoint_store=store, doc_keys=["n0"],
+    )
+    assert eng2.restore_from_checkpoints() == [0]
+    assert eng2.text(0) == "abcdef"
+    ann = eng2.annotations(0)
+    assert ann[0] == {700: 5} and ann[2] == {700: 5, 42: 9}
+    assert ann[4] == {42: 9}
+
+
 def test_engine_via_ingest_lines_converges():
     n = 6
     svc, expected = drive_docs(n, seed=9, rounds=4)

@@ -192,3 +192,34 @@ def test_no_workers_queues_until_one_joins():
     pm.add_worker("a")
     pm.pump()
     assert [m.seq for m in pm.ops_of("doc0") if m.type == MessageType.OP] == [2]
+
+
+def test_consumer_group_pins_and_topic_placement():
+    """Mesh-alignment primitives: ``Topic.place`` overrides the hash route
+    for pinned docs only; ``ConsumerGroup.pin`` gives a partition to one
+    member while it lives and falls back to round-robin when it dies."""
+    from fluidframework_tpu.server.ordered_log import ConsumerGroup, Topic
+
+    topic = Topic("deltas", n_partitions=4)
+    hash_route = topic.partition_for("docA")
+    topic.place("docA", (hash_route + 1) % 4)
+    assert topic.partition_for("docA") == (hash_route + 1) % 4
+    assert topic.partition_for("docB") == sum(b"docB") % 4  # unpinned
+    try:
+        topic.place("docA", 7)
+    except ValueError:
+        pass
+    else:  # pragma: no cover
+        raise AssertionError("out-of-range placement accepted")
+
+    group = ConsumerGroup(topic, "g")
+    group.join("a")
+    group.join("b")
+    group.pin(1, "b")
+    group.pin(3, "b")
+    assert group.assignments("b") == [1, 3]
+    assert group.assignments("a") == [0, 2]
+    # The pinned member dies: its pins fall back to round-robin, nothing
+    # is stranded.
+    group.leave("b")
+    assert group.assignments("a") == [0, 1, 2, 3]
