@@ -66,6 +66,7 @@ from __future__ import annotations
 
 import functools
 import json
+import time
 from dataclasses import dataclass, field
 
 import jax
@@ -75,11 +76,12 @@ import numpy as np
 from ..dds import kernel_backend as kb
 from ..dds.mergetree_ref import RefMergeTree
 from ..dds.shared_string import decode_obliterate_places
+from ..observability.flight_recorder import RecompileWatchdog, instant, span
 from ..ops import mergetree_kernel as mk
 from ..parallel import mesh as pm
 from ..parallel.mesh import doc_mesh, shard_docs
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
-from ..utils.telemetry import HealthCounters
+from ..utils.telemetry import HealthCounters, Histogram, SampledTelemetryHelper
 from .staging import RowQueue, StagingRing
 
 
@@ -246,6 +248,7 @@ class DocBatchEngine:
         megastep_k: int = 1,
         spare_slots: int = 0,
         telemetry=None,
+        latency_sample_every: int = 16,
     ) -> None:
         assert recovery in ("grow", "oracle", "off")
         self.n_docs = n_docs
@@ -310,6 +313,24 @@ class DocBatchEngine:
         # at a fixed cadence forever).
         self._readmit_interval: dict[int, int] = {}
         self.counters = HealthCounters(telemetry)
+        # Sampled hot-path timing through the reference's sampled-telemetry
+        # shape (one event per N steps; flush_all drains the tail at
+        # shutdown / status-snapshot time via ``flush_telemetry``).
+        self.sampled = (
+            SampledTelemetryHelper(telemetry, "engine_step", sample_every=64)
+            if telemetry is not None
+            else None
+        )
+        # Op end-to-end latency: sequencer stamp time -> applied-on-device
+        # readback, sampled every ``latency_sample_every`` staged ops (the
+        # per-message cost of full tracking would show on the feed path).
+        # Pending samples resolve at the step() sync boundary (recover()'s
+        # error readback proves the dispatches that drained them retired).
+        self.latency_sample_every = max(1, latency_sample_every)
+        self.op_latency = Histogram()
+        self._doc_latency: dict[int, Histogram] = {}
+        self._lat_tick = 0
+        self._lat_pending: list[tuple[float, int]] = []
 
         if use_mesh:
             self.mesh = mesh if mesh is not None else doc_mesh()
@@ -322,6 +343,7 @@ class DocBatchEngine:
         # ``spare_slots`` reserves extra free rows beyond the fleet so live
         # migration always has landing slots on every shard.
         self.n_shards = n_shards
+        self._shard_latency = [Histogram() for _ in range(n_shards)]
         self.capacity = -(-(n_docs + spare_slots) // n_shards) * n_shards
         self.docs_per_shard = self.capacity // n_shards
         # Device-row placement: doc -> slot (row index into the sharded
@@ -383,6 +405,18 @@ class DocBatchEngine:
             )
         self._lane_apply = _lane_apply_jit
         self._lane_compact = _lane_compact_jit
+        # Recompile watchdog: executable-cache growth on any fleet program
+        # after warmup = a megastep trace de-specialized mid-serve (counted
+        # in health() as ``recompiles``; each emits an instant trace
+        # event).  Polled once per step() — one int read per program.
+        self.recompile_watchdog = RecompileWatchdog()
+        for prog_name, prog in (
+            ("fleet_step", self._step),
+            ("fleet_megastep", self._megastep),
+            ("fleet_compact", self._compact),
+            ("lane_apply", self._lane_apply),
+        ):
+            self.recompile_watchdog.register(prog_name, prog)
         # Incremental busy set: doc indices whose host queue is nonempty,
         # maintained by ingest/drain/quarantine — step() never rescans the
         # whole host array (O(busy) per loop iteration, not O(capacity)).
@@ -438,6 +472,7 @@ class DocBatchEngine:
             return
         h.last_seq = max(h.last_seq, msg.seq)
         h.ops_since_ckpt += 1
+        self._lat_sample(doc_idx, msg.timestamp)
         if h.boot_counting:
             # Post-summary tail actually replayed on a boot-from-checkpoint/
             # summary consumer (the skipped prefix counts separately above;
@@ -492,6 +527,12 @@ class DocBatchEngine:
 
     # -------------------------------------------------------- batched ingest
     def ingest_batch(self, doc_idxs, msgs) -> int:
+        """Flight-recorded entry over ``_ingest_batch`` (the ``ingest``
+        phase of a trace; a free no-op while no recorder is installed)."""
+        with span("ingest", msgs=len(doc_idxs)):
+            return self._ingest_batch(doc_idxs, msgs)
+
+    def _ingest_batch(self, doc_idxs, msgs) -> int:
         """Columnar ingest fast path: decode a whole wire batch into
         [N, OP_FIELDS] op rows + payload rows with vectorized numpy and
         land them in the per-doc RowQueues as block copies — Python is
@@ -552,6 +593,7 @@ class DocBatchEngine:
                 continue
             h.last_seq = max(h.last_seq, msg.seq)
             h.ops_since_ckpt += 1
+            self._lat_sample(d, msg.timestamp)
             if h.boot_counting:
                 counters.bump("boot_replay_len")
             if self.recovery != "off":
@@ -769,12 +811,19 @@ class DocBatchEngine:
                 self.max_insert_len, self.geometry["prop_slots"]
             )
             h.mode = "native"
-        ops, payloads = h.native.encode(data)
-        if self.recovery != "off":
-            h.raw_log.append(data)
-        # Native row output lands as one block copy per chunk — the doc
-        # lane "gather" is a slice assignment, never a per-row Python loop.
-        h.queue.extend_block(ops, payloads)
+        with span("ingest", doc=doc_idx, bytes=len(data)):
+            ops, payloads = h.native.encode(data)
+            if self.recovery != "off":
+                h.raw_log.append(data)
+            # Native row output lands as one block copy per chunk — the doc
+            # lane "gather" is a slice assignment, never a per-row Python
+            # loop.
+            h.queue.extend_block(ops, payloads)
+        if len(ops):
+            # One latency sample per chunk (the C++ decode exposes no wire
+            # timestamps): stamp 0.0 = receipt time, so the sample covers
+            # staging -> device apply, not the sequencer hop.
+            self._lat_sample(doc_idx, 0.0, force=True)
         if h.queue:
             self._busy.add(doc_idx)
         h.min_seq = max(h.min_seq, h.native.min_seq)
@@ -908,6 +957,58 @@ class DocBatchEngine:
             h.prop_slot[prop] = slot
         return h.prop_slot[prop]
 
+    # ------------------------------------------------------------- op latency
+    def _lat_sample(self, doc_idx: int, stamp: float, force: bool = False) -> None:
+        """Maybe sample one staged op's e2e latency: record its sequencer
+        stamp time (wall clock; 0.0 = unstamped synthetic streams, which
+        fall back to receipt time) to resolve at the next step() sync
+        boundary.  Gated to every ``latency_sample_every``-th staged op so
+        the per-message feed cost stays one int increment."""
+        self._lat_tick += 1
+        if not force and self._lat_tick % self.latency_sample_every:
+            return
+        if len(self._lat_pending) < 4096:  # bound a step-starved feed
+            self._lat_pending.append(
+                (stamp if stamp > 0 else time.time(), doc_idx)
+            )
+
+    def _lat_flush(self) -> None:
+        """Resolve pending latency samples at the applied-on-device
+        boundary (end of step(), after the error-latch readback proved the
+        dispatches retired) into the per-doc and per-shard histograms."""
+        if not self._lat_pending:
+            return
+        now = time.time()
+        for stamp, d in self._lat_pending:
+            lat = max(0.0, now - stamp)
+            self.op_latency.record(lat)
+            if 0 <= d < self.n_docs:
+                self._shard_latency[self.shard_of(d)].record(lat)
+                h = self._doc_latency.get(d)
+                if h is None:
+                    h = self._doc_latency[d] = Histogram()
+                h.record(lat)
+        self._lat_pending.clear()
+
+    def latency_histograms(self) -> dict[str, Histogram]:
+        """Mergeable op-latency histograms for the metrics plane: the
+        fleet aggregate plus one per mesh shard."""
+        out = {"op_latency": self.op_latency}
+        if self.n_shards > 1:
+            for s, h in enumerate(self._shard_latency):
+                out[f"op_latency_shard{s}"] = h
+        return out
+
+    def doc_latency(self, doc_idx: int) -> Histogram | None:
+        return self._doc_latency.get(doc_idx)
+
+    def flush_telemetry(self) -> None:
+        """Drain residual sampled-telemetry buckets (status snapshot /
+        shutdown hook): tail samples below ``sample_every`` must reach the
+        sink before the process goes away."""
+        if self.sampled is not None:
+            self.sampled.flush_all()
+
     # ------------------------------------------------------------------- step
     def pending_ops(self) -> int:
         return sum(len(h.queue) for h in self.hosts) + sum(
@@ -1017,14 +1118,16 @@ class DocBatchEngine:
                 rows = [r for _, r in pairs]
         if self.mesh is None and K == 1:
             dev_ops, dev_payloads = stage.upload(ops[0], payloads[0])
-            self.state = self._step(self.state, dev_ops, dev_payloads)
+            with span("dispatch", kind="full", k=K):
+                self.state = self._step(self.state, dev_ops, dev_payloads)
         else:
             # The mesh path always dispatches the [K, D, B] megastep
             # program (K=1 included — apply_megastep at K=1 is bit-
             # identical to one apply_ops dispatch): one donated shard_map
             # call steps every chip, zero hot-path collectives.
             dev_ops, dev_payloads = stage.upload(ops, payloads)
-            self.state = self._megastep(self.state, dev_ops, dev_payloads)
+            with span("dispatch", kind="full", k=K, shards=self.n_shards):
+                self.state = self._megastep(self.state, dev_ops, dev_payloads)
         self.full_steps += K
         self.counters.bump("megastep_dispatches")
         self.counters.bump("megastep_slices", K)
@@ -1041,6 +1144,7 @@ class DocBatchEngine:
         checkpoint boundaries below.  Afterwards, any latched overflow
         bits are recovered (grow-and-replay or oracle routing), so
         ``errors()`` is all-zero on return unless recovery is off."""
+        t0 = time.perf_counter() if self.sampled is not None else 0.0
         steps = 0
         while self._busy:
             busy = sorted(self._busy)
@@ -1062,6 +1166,13 @@ class DocBatchEngine:
             if self.readmit_after_steps:
                 self._maybe_readmit()
         self.maybe_checkpoint()
+        # Sync boundary housekeeping (host-side, O(programs + samples)):
+        # resolve e2e latency samples, poll for mid-serve recompiles, and
+        # feed the sampled step timing when a telemetry sink is attached.
+        self._lat_flush()
+        self.recompile_watchdog.poll()
+        if self.sampled is not None:
+            self.sampled.record(time.perf_counter() - t0, "step")
         return steps
 
     def _maybe_readmit(self) -> None:
@@ -1111,10 +1222,12 @@ class DocBatchEngine:
         sub = self._gather_cohort(self.state, jnp.asarray(idx))
         if K == 1:
             dev_ops, dev_payloads = stage.upload(ops[0], payloads[0])
-            sub = self._step(sub, dev_ops, dev_payloads)
+            with span("dispatch", kind="cohort", k=K, lanes=Kc):
+                sub = self._step(sub, dev_ops, dev_payloads)
         else:
             dev_ops, dev_payloads = stage.upload(ops, payloads)
-            sub = self._megastep(sub, dev_ops, dev_payloads)
+            with span("dispatch", kind="cohort", k=K, lanes=Kc):
+                sub = self._megastep(sub, dev_ops, dev_payloads)
         self.state = self._scatter_cohort(
             self.state, sub, jnp.asarray(idx), jnp.asarray(valid)
         )
@@ -1144,9 +1257,10 @@ class DocBatchEngine:
                 dev_ops, dev_payloads = stage.upload(
                     ops[0, 0], payloads[0, 0]
                 )
-                lane.state = self._lane_apply(
-                    lane.state, dev_ops, dev_payloads
-                )
+                with span("dispatch", kind="lane"):
+                    lane.state = self._lane_apply(
+                        lane.state, dev_ops, dev_payloads
+                    )
 
     def compact(self) -> None:
         """Advance MSNs and run zamboni eviction across the fleet."""
@@ -1178,9 +1292,12 @@ class DocBatchEngine:
             # shard partial-sums its own latch rows and the host reads ONE
             # scalar — the full error vector transfers only when it is
             # actually nonzero (recovery itself, off the hot path).
-            if int(pm.error_count(self.state.error)) == 0:
+            with span("readback", kind="error_count"):
+                clean = int(pm.error_count(self.state.error)) == 0
+            if clean:
                 return []
-        err = np.asarray(self.state.error)
+        with span("readback", kind="error_vector"):
+            err = np.asarray(self.state.error)
         for d in range(self.n_docs):
             slot = int(self._slot[d])
             if (
@@ -1570,6 +1687,9 @@ class DocBatchEngine:
         # re-verify before the pre-filter may skip this doc again.
         self._verified_digest.pop(d, None)
         self.counters.bump("doc_migrations")
+        instant(
+            "migrate_doc", doc=self.doc_keys[d], src=src_shard, dst=dst_shard
+        )
         return True
 
     def rebalance_hot_shards(
@@ -1616,6 +1736,7 @@ class DocBatchEngine:
                     break
         if moves:
             self.counters.bump("hot_shard_rebalances", len(moves))
+            instant("rebalance", moves=len(moves), hot_shards=len(hot))
         return moves
 
     def _sync_native_props(self, h: _DocHost) -> None:
@@ -1778,7 +1899,10 @@ class DocBatchEngine:
             if geometry is not None:
                 record["geometry"] = geometry
                 record["growths"] = growths
-            self.checkpoint_store.save(self.doc_keys[d], h.last_seq, record)
+            with span("checkpoint", doc=self.doc_keys[d], lane=lane):
+                self.checkpoint_store.save(
+                    self.doc_keys[d], h.last_seq, record
+                )
             h.base_seq = h.last_seq
             h.base_summary = summary
             h.log = [m for m in h.log if m.seq > h.base_seq]
@@ -1937,6 +2061,32 @@ class DocBatchEngine:
             )
             self.counters.gauge(
                 "hot_shards", self.hot_shards(load=ops + depth)
+            )
+        # Observability surface: program cache misses (recompiles, warmup
+        # included), growth after first specialization (despecializations,
+        # the mid-serve alarm), and sampled op e2e latency (sequencer
+        # stamp -> applied-on-device), ms percentiles.
+        self.counters.gauge("recompiles", self.recompile_watchdog.recompiles)
+        self.counters.gauge(
+            "despecializations", self.recompile_watchdog.despecializations
+        )
+        self.counters.gauge("latency_samples", self.op_latency.count)
+        if self.op_latency.count:
+            self.counters.gauge(
+                "latency_p50_ms",
+                round(self.op_latency.percentile(0.5) * 1e3, 3),
+            )
+            self.counters.gauge(
+                "latency_p99_ms",
+                round(self.op_latency.percentile(0.99) * 1e3, 3),
+            )
+        if self.n_shards > 1:
+            self.counters.gauge(
+                "shard_latency_p99_ms",
+                [
+                    round(h.percentile(0.99) * 1e3, 3) if h.count else 0.0
+                    for h in self._shard_latency
+                ],
             )
         snap = self.counters.snapshot()
         snap.update(

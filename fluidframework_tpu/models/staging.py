@@ -247,6 +247,9 @@ class StagingRing:
         import jax
         import jax.numpy as jnp
 
+        from ..observability.flight_recorder import span
+
+        nbytes = ops_view.nbytes + payloads_view.nbytes
         if self._mesh is not None and ops_view.ndim >= 3:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -254,12 +257,22 @@ class StagingRing:
                 *([None] * (ops_view.ndim - 3)), self._doc_axis
             )
             sharding = NamedSharding(self._mesh, spec)
-            dev = (
-                jax.device_put(ops_view, sharding),
-                jax.device_put(payloads_view, sharding),
-            )
+            # One span per shard-layout transfer: the device_put splits the
+            # staging view per chip, so the span carries the shard count
+            # and per-shard byte share for the trace.
+            with span(
+                "upload",
+                shards=int(self._mesh.devices.size),
+                bytes=nbytes,
+                bytes_per_shard=nbytes // int(self._mesh.devices.size),
+            ):
+                dev = (
+                    jax.device_put(ops_view, sharding),
+                    jax.device_put(payloads_view, sharding),
+                )
         else:
-            dev = (jnp.asarray(ops_view), jnp.asarray(payloads_view))
+            with span("upload", shards=1, bytes=nbytes):
+                dev = (jnp.asarray(ops_view), jnp.asarray(payloads_view))
         self.launched(*dev)
         return dev
 

@@ -48,6 +48,7 @@ from ..dds.tree.changeset import (
 from ..dds.tree.editmanager import EditManager
 from ..dds.tree.field_kinds import OptionalChange
 from ..dds.tree.forest import ROOT_FIELD, Forest, Node
+from ..observability.flight_recorder import RecompileWatchdog, span
 from ..ops import tree_kernel as tk
 from ..parallel import mesh as pm
 from ..protocol.messages import MessageType, SequencedMessage
@@ -288,6 +289,15 @@ class TreeBatchEngine:
             self._compact = pm.mesh_fleet_program(
                 _tree_compact_body, mesh, specs, arg_specs=()
             )
+        # Recompile watchdog (same contract as the string engine): cache
+        # growth after warmup = a trace de-specialized mid-serve.
+        self.recompile_watchdog = RecompileWatchdog()
+        for prog_name, prog in (
+            ("tree_step", self._step),
+            ("tree_megastep", self._megastep),
+            ("tree_compact", self._compact),
+        ):
+            self.recompile_watchdog.register(prog_name, prog)
         # Incremental busy set + preallocated double-buffered staging
         # (lazy), mirroring doc_batch_engine's megastep pipeline.
         self._busy: set[int] = set()
@@ -772,25 +782,34 @@ class TreeBatchEngine:
                     busy = [d for d in busy if d in self._busy]
             if self.mesh is None and K == 1:
                 dev_ops, dev_payloads = stage.upload(ops[0], payloads[0])
-                self.state = self._step(self.state, dev_ops, dev_payloads)
+                with span("dispatch", kind="tree", k=K):
+                    self.state = self._step(
+                        self.state, dev_ops, dev_payloads
+                    )
             else:
                 # Mesh path: always the [K, D, B] shard_map megastep (K=1
                 # included — bit-identical to one batched dispatch), one
                 # donated call stepping every chip.
                 dev_ops, dev_payloads = stage.upload(ops, payloads)
-                self.state = self._megastep(self.state, dev_ops, dev_payloads)
+                with span("dispatch", kind="tree", k=K,
+                          shards=self.n_shards):
+                    self.state = self._megastep(
+                        self.state, dev_ops, dev_payloads
+                    )
             steps += K
             self.counters.bump("megastep_dispatches")
             self.counters.bump("megastep_slices", K)
-        if (
-            self.mesh is not None
-            and int(pm.error_count(self.state.error)) == 0
-        ):
+        self.recompile_watchdog.poll()
+        if self.mesh is not None:
             # Per-shard latch reduce: one scalar readback instead of a
             # cross-mesh [D] error gather on every step.
-            self.maybe_checkpoint()
-            return steps
-        err = np.asarray(self.state.error)
+            with span("readback", kind="error_count"):
+                clean = int(pm.error_count(self.state.error)) == 0
+            if clean:
+                self.maybe_checkpoint()
+                return steps
+        with span("readback", kind="error_vector"):
+            err = np.asarray(self.state.error)
         for d in range(self.n_docs):
             if err[d] and d not in self.fallbacks:
                 # Capacity/range overflow on device: replay on the host.
@@ -925,6 +944,10 @@ class TreeBatchEngine:
             round(hits / (hits + misses), 4) if hits + misses else 0.0,
         )
         self.counters.gauge("translation_plans", len(self._plans))
+        self.counters.gauge("recompiles", self.recompile_watchdog.recompiles)
+        self.counters.gauge(
+            "despecializations", self.recompile_watchdog.despecializations
+        )
         self.counters.gauge("n_shards", self.n_shards)
         if self.n_shards > 1:
             depth = [0] * self.n_shards

@@ -32,6 +32,11 @@ def status_snapshot(eng, doc_ids, rows=0, bytes_consumed=0, **extra) -> dict:
     ``steps_per_dispatch``, ``staging_overlap_packs``).  Module-level so
     tests and tools can assert on the exact shape ``main`` emits."""
     errs = eng.errors()
+    # Status is a drain point: flush residual sampled-telemetry buckets so
+    # tail samples below sample_every reach the sink with the snapshot.
+    flush = getattr(eng, "flush_telemetry", None)
+    if flush is not None:
+        flush()
     out = {
         "rows": rows,
         "bytes": bytes_consumed,
@@ -102,6 +107,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu); overrides the "
                         "image default and the FFTPU_PLATFORM env var")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus /metrics + JSON /status on this "
+                        "port (0 = ephemeral, reported in the readiness "
+                        "line; omit = off).  Aggregates engine health, "
+                        "op-latency histograms, per-shard queue depth, "
+                        "recompile count, and transport counters")
+    p.add_argument("--trace", default=None,
+                   help="record a flight-recorder trace of the serving "
+                        "path (ingest/upload/dispatch/readback spans) and "
+                        "dump it as Chrome trace-event JSON to this path "
+                        "on exit (Perfetto-loadable)")
+    p.add_argument("--trace-capacity", type=int, default=65536,
+                   help="flight-recorder ring capacity in events (old "
+                        "events overwrite; the dump reports drops)")
     args = p.parse_args(argv)
 
     # Platform pinning must land before any backend initializes (some
@@ -170,6 +189,11 @@ def main(argv: list[str] | None = None) -> int:
         from .scribe import SummaryRecordStore
 
         boot_store = SummaryRecordStore.open(args.scribe_dir)
+    recorder = None
+    if args.trace:
+        from ..observability import FlightRecorder, install
+
+        recorder = install(FlightRecorder(args.trace_capacity))
     fc = FleetConsumer(args.host, args.port, eng, doc_ids,
                        boot_store=boot_store)
     if fc.booted_docs:
@@ -177,6 +201,18 @@ def main(argv: list[str] | None = None) -> int:
             "bootedFromSummary": [doc_ids[d] for d in fc.booted_docs],
             "health": eng.health(),
         }), flush=True)
+    metrics_srv = None
+    if args.metrics_port is not None:
+        # The scrapeable fleet surface: /metrics (Prometheus text) +
+        # /status (JSON) over the live engine/consumer state — a soak run
+        # is inspectable with curl, no debugger attached.
+        from ..observability import MetricsPlane, MetricsServer
+
+        plane = MetricsPlane()
+        plane.register("fleet", fc.health)
+        plane.register("latency", eng.latency_histograms)
+        metrics_srv = MetricsServer(plane, port=args.metrics_port).start()
+        print(json.dumps({"metricsPort": metrics_srv.port}), flush=True)
 
     def status(**extra) -> None:
         print(json.dumps(status_snapshot(
@@ -238,6 +274,17 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     finally:
         fc.close()
+        flush = getattr(eng, "flush_telemetry", None)
+        if flush is not None:
+            flush()  # shutdown drain: no tail samples silently dropped
+        if metrics_srv is not None:
+            metrics_srv.stop()
+        if recorder is not None:
+            n = recorder.export_chrome_trace(args.trace)
+            print(json.dumps({
+                "trace": args.trace, "events": n,
+                "dropped": recorder.dropped,
+            }), flush=True)
 
 
 if __name__ == "__main__":

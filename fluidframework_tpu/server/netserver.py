@@ -27,6 +27,7 @@ import argparse
 import json
 import socketserver
 import threading
+import time
 from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -370,6 +371,27 @@ class _AlfredHandler(BaseHTTPRequestHandler):
         server: HttpFront = self.server.owner  # type: ignore[attr-defined]
         parts, q = self._route()
         with server.lock:
+            if parts in (["metrics"], ["status"]):
+                # Ordering-tier observability surface: the same /metrics
+                # (Prometheus text) + /status (JSON) shape the fleet tier
+                # serves, aggregating per-doc sequencer log depth, pending
+                # delivery, and connected-client counts.
+                from ..observability.metrics_plane import render_prometheus
+
+                stats = server.service_stats()
+                if parts == ["status"]:
+                    self._json(200, stats)
+                else:
+                    body = render_prometheus(stats).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                return
             if (
                 parts[:1] != ["doc"]
                 or len(parts) < 3
@@ -485,10 +507,28 @@ class HttpFront:
     def __init__(self, service: LocalService, lock: threading.RLock, port: int = 0) -> None:
         self.service = service
         self.lock = lock
+        self._started = time.monotonic()
         self._http = ThreadingHTTPServer(("127.0.0.1", port), _AlfredHandler)
         self._http.owner = self  # type: ignore[attr-defined]
         self.port = self._http.server_address[1]
         self._thread = threading.Thread(target=self._http.serve_forever, daemon=True)
+
+    def service_stats(self) -> dict:
+        """Ordering-core aggregate for /metrics + /status (caller holds the
+        lock): per-doc sequencer log depth, pending delivery, clients —
+        the ordered-log depth surface of the metrics plane."""
+        docs = {}
+        for doc_id, doc in self.service._docs.items():
+            docs[doc_id] = {
+                "log_depth": len(doc.sequencer.log),
+                "pending": doc.pending_count,
+                "clients": len(doc.sequencer.clients()),
+            }
+        return {
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "n_docs": len(docs),
+            "docs": docs,
+        }
 
     def start(self) -> "HttpFront":
         self._thread.start()

@@ -50,6 +50,7 @@ import json
 import os
 from typing import Any, Callable
 
+from ..observability.flight_recorder import span
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
 from ..runtime.summary import make_scribe_ack, parse_scribe_ack
 from ..utils.telemetry import HealthCounters, Logger
@@ -725,16 +726,19 @@ class ScribeLambda:
             if start < part.base:
                 self.group.truncated_records_skipped += part.base - start
                 start = part.base
-            for rec in part.read(start):
-                msg = rec.payload
-                ack = parse_scribe_ack(msg)
-                if ack is not None:
-                    self._on_ack(*ack, offset=None)
-                elif isinstance(msg, SequencedMessage):
-                    self._fold(rec.doc_id, msg, rec.offset)
-                    touched.add(rec.doc_id)
-                start = rec.offset + 1
-                n += 1
+            # One fold span per partition batch (NOT per record: fold is
+            # the scribe's per-message hot path).
+            with span("scribe.fold", partition=p):
+                for rec in part.read(start):
+                    msg = rec.payload
+                    ack = parse_scribe_ack(msg)
+                    if ack is not None:
+                        self._on_ack(*ack, offset=None)
+                    elif isinstance(msg, SequencedMessage):
+                        self._fold(rec.doc_id, msg, rec.offset)
+                        touched.add(rec.doc_id)
+                    start = rec.offset + 1
+                    n += 1
             self._positions[p] = next_offsets[p] = start
         for doc in sorted(touched):
             ad = self.docs.get(doc)
@@ -806,33 +810,39 @@ class ScribeLambda:
             # folded.
             p = self.topic.partition_for(doc_id)
             at_offset = self._positions.get(p, self.group.committed(p))
-        ad.flush()
-        if ad.failed is not None:  # flush may detect a poisoned kernel state
-            return None
-        record = ad.record()
-        cache = self._channel_sha.setdefault(doc_id, {})
-        entries: dict[str, str] = {}
-        for key, val in record.items():
-            sha = cache.get(key)
-            if sha is None or key in ad.changed or sha not in self.store:
-                sha = self.store.write_snapshot(val)
-            else:
-                # Unchanged channel: reuse the previous commit's subtree sha
-                # without re-serializing (the client-side summary-handle
-                # incrementality, server-side).
-                self.counters.bump("summary_handles_reused")
-            entries[key] = sha
-            cache[key] = sha
-        root = self.store.put_tree(entries)
-        chain = self.chains.setdefault(doc_id, GitSnapshotStore(self.store))
-        commit = chain.save_root(ad.last_seq, root)
-        # The objects must be ON DISK before the commit sha is externalized
-        # (the ack tells the world the log below is reclaimable; a power
-        # cut must not leave the ack durable and the objects in the page
-        # cache).
-        self.store.sync()
-        self.topic.produce(doc_id, make_scribe_ack(doc_id, ad.last_seq, commit))
-        self._on_ack(doc_id, ad.last_seq, commit, offset=at_offset)
+        with span("scribe.summarize", doc=doc_id):
+            ad.flush()
+            if ad.failed is not None:  # flush may detect a poisoned state
+                return None
+            record = ad.record()
+            cache = self._channel_sha.setdefault(doc_id, {})
+            entries: dict[str, str] = {}
+            for key, val in record.items():
+                sha = cache.get(key)
+                if sha is None or key in ad.changed or sha not in self.store:
+                    sha = self.store.write_snapshot(val)
+                else:
+                    # Unchanged channel: reuse the previous commit's subtree
+                    # sha without re-serializing (the client-side
+                    # summary-handle incrementality, server-side).
+                    self.counters.bump("summary_handles_reused")
+                entries[key] = sha
+                cache[key] = sha
+            root = self.store.put_tree(entries)
+            chain = self.chains.setdefault(
+                doc_id, GitSnapshotStore(self.store)
+            )
+            commit = chain.save_root(ad.last_seq, root)
+            # The objects must be ON DISK before the commit sha is
+            # externalized (the ack tells the world the log below is
+            # reclaimable; a power cut must not leave the ack durable and
+            # the objects in the page cache).
+            self.store.sync()
+        with span("scribe.ack", doc=doc_id):
+            self.topic.produce(
+                doc_id, make_scribe_ack(doc_id, ad.last_seq, commit)
+            )
+            self._on_ack(doc_id, ad.last_seq, commit, offset=at_offset)
         # Everything folded for this doc is now covered by the acked
         # summary: stop pinning the durable commit floor.
         self._uncovered.pop(doc_id, None)
@@ -931,12 +941,22 @@ class ScribeLambda:
             for doc, ad in self.docs.items()
             if ad.last_seq
         ]
+        # Ordered-log depth per assigned partition: records sequenced past
+        # this scribe's read position (the fold backlog) — the metrics
+        # plane's ordered-log surface for the summarization tier.
+        depth = [
+            max(0, self.topic.partition(p).head
+                - self._positions.get(p, self.group.committed(p)))
+            for p in self.group.assignments(self.member_id)
+        ]
         snap.update(
             tracked_docs=len(self.docs),
             acked_docs=len(self.refs),
             summary_age_seqs=max(ages, default=0),
             failed_docs=sum(1 for ad in self.docs.values() if ad.failed),
             truncated_records_skipped=self.group.truncated_records_skipped,
+            log_depth=depth,
+            log_lag=sum(depth),
             git_sharing_ratio=round(
                 1.0 - self.store.stored / self.store.writes, 4
             ) if self.store.writes else 0.0,

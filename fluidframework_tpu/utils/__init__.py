@@ -7,6 +7,7 @@ telemetry-utils, packages/common/core-interfaces config contracts.
 from .config import CachedConfigProvider, ConfigTypes, MonitoringContext
 from .id_compressor import IdCompressor, IdCreationRange
 from .telemetry import (
+    Histogram,
     Logger,
     PerformanceEvent,
     SampledTelemetryHelper,
@@ -16,6 +17,7 @@ from .telemetry import (
 __all__ = [
     "CachedConfigProvider",
     "ConfigTypes",
+    "Histogram",
     "IdCompressor",
     "IdCreationRange",
     "Logger",

@@ -12,6 +12,7 @@ delivered to a sink callable, so tests can assert on them (ref mockLogger.ts).
 
 from __future__ import annotations
 
+import math
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -89,13 +90,19 @@ def create_child_logger(
 class PerformanceEvent:
     """A span: start/end/cancel with duration, used around phases like
     container load and summarize (ref logger.ts:690). Context-manager form
-    reports success on clean exit, error on exception."""
+    reports success on clean exit, error on exception.
+
+    The end event carries ``startTime`` (wall-clock seconds at span start)
+    alongside the existing ``duration``, so spans can be PLACED on a
+    timeline, not just sized.  Additive only: every pre-existing field
+    keeps its name and meaning."""
 
     def __init__(self, logger: Logger, event_name: str, **props: Any) -> None:
         self.logger = logger
         self.event_name = event_name
         self.props = props
         self._start = time.perf_counter()
+        self.start_time = time.time()  # wall clock: timeline placement
         self._done = False
 
     def end(self, **props: Any) -> None:
@@ -105,6 +112,7 @@ class PerformanceEvent:
         self.logger.performance(
             f"{self.event_name}_end",
             time.perf_counter() - self._start,
+            startTime=self.start_time,
             **{**self.props, **props},
         )
 
@@ -113,7 +121,9 @@ class PerformanceEvent:
             return
         self._done = True
         self.logger.error(
-            f"{self.event_name}_cancel", error, **{**self.props, **props}
+            f"{self.event_name}_cancel", error,
+            startTime=self.start_time,
+            **{**self.props, **props},
         )
 
     def __enter__(self) -> "PerformanceEvent":
@@ -215,3 +225,103 @@ class SampledTelemetryHelper:
             min=b.min_s,
             max=b.max_s,
         )
+
+    def flush_all(self) -> int:
+        """Flush every residual bucket (shutdown / status-snapshot hook):
+        tail samples below ``sample_every`` must never be silently dropped
+        when the process drains.  Returns the buckets flushed."""
+        pending = [k for k, b in self._buckets.items() if b.count > 0]
+        for key in pending:
+            self.flush(key)
+        return len(pending)
+
+
+class Histogram:
+    """Log-bucketed, mergeable latency histogram with percentile queries.
+
+    Values bucket at geometric boundaries ``base * growth**i`` (sparse
+    dict of counts, so an idle histogram is a few machine words); exact
+    ``count``/``sum``/``min``/``max`` ride alongside, and ``percentile``
+    answers from the bucket cumulative clamped to the observed [min, max]
+    — the result is within one bucket (a factor of ``growth``) of the
+    exact order statistic, single-sample case exact.  Two histograms with
+    the same (base, growth) layout merge by bucket-count addition, so
+    per-doc / per-shard histograms roll up into fleet aggregates without
+    re-touching samples.  Recording costs one ``math.log`` + one dict
+    update: cheap enough for sampled per-op latency, kept OFF per-message
+    paths regardless.
+    """
+
+    __slots__ = ("base", "growth", "_lg", "count", "sum", "min", "max",
+                 "_buckets")
+
+    def __init__(self, base: float = 1e-6, growth: float = 2 ** 0.25) -> None:
+        if base <= 0 or growth <= 1:
+            raise ValueError("base must be > 0 and growth > 1")
+        self.base = base
+        self.growth = growth
+        self._lg = math.log(growth)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._buckets: dict[int, int] = {}
+
+    def record(self, value: float) -> None:
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        # Bucket i covers (base*growth**(i-1), base*growth**i]; everything
+        # at or below base lands in bucket 0.
+        i = 0 if v <= self.base else math.ceil(
+            math.log(v / self.base) / self._lg - 1e-12
+        )
+        self._buckets[i] = self._buckets.get(i, 0) + 1
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other`` into this histogram (same layout required)."""
+        if (self.base, self.growth) != (other.base, other.growth):
+            raise ValueError("histogram layouts differ; cannot merge")
+        self.count += other.count
+        self.sum += other.sum
+        self.min = min(self.min, other.min)
+        self.max = max(self.max, other.max)
+        for i, c in other._buckets.items():
+            self._buckets[i] = self._buckets.get(i, 0) + c
+        return self
+
+    def percentile(self, q: float) -> float | None:
+        """The q-quantile (q in [0, 1]); None while empty."""
+        if self.count == 0:
+            return None
+        if not (0.0 <= q <= 1.0):
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        target = max(1, math.ceil(q * self.count))
+        cum = 0
+        for i in sorted(self._buckets):
+            cum += self._buckets[i]
+            if cum >= target:
+                upper = self.base * self.growth ** i
+                return min(max(upper, self.min), self.max)
+        return self.max  # unreachable; defensive
+
+    def percentiles(self, qs=(0.5, 0.9, 0.99)) -> dict[float, float | None]:
+        return {q: self.percentile(q) for q in qs}
+
+    def snapshot(self) -> dict[str, Any]:
+        """Plain-dict view (status lines, JSON artifacts)."""
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(0.5),
+            "p90": self.percentile(0.9),
+            "p99": self.percentile(0.99),
+        }
