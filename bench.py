@@ -714,7 +714,29 @@ def bench_headline(args) -> dict:
         out["recorder_overhead"] = _recorder_overhead(args.megastep_k)
     except Exception as e:  # noqa: BLE001 — the probe must never sink the headline
         out["recorder_overhead"] = {"error": repr(e)[-200:]}
+    try:
+        out["static_analysis"] = _static_analysis_probe()
+    except Exception as e:  # noqa: BLE001 — the probe must never sink the headline
+        out["static_analysis"] = {"error": repr(e)[-200:]}
     return out
+
+
+def _static_analysis_probe() -> dict:
+    """fftpu-check over the package (pure AST, ~seconds): the artifact
+    records that the tree the numbers came from was hazard-clean — and the
+    per-rule counts + baseline size when it wasn't."""
+    from pathlib import Path
+
+    from fluidframework_tpu.analysis.cli import run_all
+
+    result = run_all(Path(__file__).resolve().parent / "fluidframework_tpu")
+    return {
+        "clean": not result["findings"],
+        "counts": result["counts"],
+        "n_baselined": len(result["suppressed"]),
+        "n_stale_baseline": len(result["stale_baseline"]),
+        "n_modules": result["n_modules"],
+    }
 
 
 def bench_config1(args) -> dict:

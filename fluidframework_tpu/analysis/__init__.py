@@ -1,0 +1,25 @@
+"""fftpu-check: AST/import-graph static analysis over the package.
+
+Reference parity: the Fluid repo machine-enforces its architecture
+(``layerInfo.json`` + the ``layer-check`` build command, SURVEY §1).  This
+package is that idea widened to the hazard classes this repro's own history
+documents: the PR 4 staging-aliasing bug was a use-after-donate, the PR 7
+recompile watchdog only catches trace despecialization at *runtime*, and
+byte-identity convergence (BASELINE.json's core invariant) dies silently to
+any nondeterministic host-path construct.  Five passes, pure AST (no JAX
+import), findings suppressible via a committed ``baseline.json``:
+
+- ``layer_check``    — downward-only imports per ``layers.json``
+- ``jit_safety``     — trace hazards reachable from jit/shard_map entries
+- ``donation``       — use-after-donate of ``donate_argnums`` arguments
+- ``determinism``    — nondeterministic constructs in byte-identity paths
+- ``threads``        — unlocked cross-thread attribute mutation
+
+Run ``fftpu-check fluidframework_tpu/`` (registered in pyproject), or see
+``tests/test_analysis.py::test_package_is_clean`` — the tier-1 gate that
+keeps every future PR clean.
+"""
+
+from .core import Baseline, Finding, PackageIndex, load_package  # noqa: F401
+
+__all__ = ["Baseline", "Finding", "PackageIndex", "load_package"]

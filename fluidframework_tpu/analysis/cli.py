@@ -1,0 +1,153 @@
+"""``fftpu-check``: run every pass over the package, apply the baseline.
+
+Usage::
+
+    fftpu-check fluidframework_tpu/            # exit 0 iff clean
+    fftpu-check fluidframework_tpu/ --json     # machine-readable (bench/CI)
+    fftpu-check pkg/ --rules layer-check,determinism
+    fftpu-check pkg/ --no-baseline             # include suppressed findings
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 usage/config error.
+
+The default layers/baseline configs are the committed
+``<pkg>/analysis/layers.json`` and ``<pkg>/analysis/baseline.json``; both
+are overridable so tests (and other repos) can point at fixtures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from . import determinism, donation, jit_safety, layer_check, threads
+from .core import Baseline, Finding, load_package
+
+PASSES = ("layer-check", "jit-safety", "donation", "determinism", "threads")
+
+
+def run_all(
+    pkg_dir: Path | str,
+    layers_path: Path | str | None = None,
+    baseline_path: Path | str | None = None,
+    rules: list | None = None,
+) -> dict:
+    """Run the suite; -> {"findings", "suppressed", "stale_baseline",
+    "counts", "n_modules"} with findings sorted by (file, line)."""
+    pkg_dir = Path(pkg_dir).resolve()
+    if not pkg_dir.is_dir():
+        raise FileNotFoundError(f"not a package directory: {pkg_dir}")
+    if layers_path is None:
+        layers_path = pkg_dir / "analysis" / "layers.json"
+    if baseline_path is None:
+        cand = pkg_dir / "analysis" / "baseline.json"
+        baseline_path = cand if cand.exists() else None
+
+    index = load_package(pkg_dir)
+    layers_cfg = json.loads(Path(layers_path).read_text())
+    layer_map = layer_check.load_layers(layers_path)
+    det_scope = layers_cfg.get("determinism_scope", [])
+
+    selected = set(rules or PASSES)
+    unknown = selected - set(PASSES)
+    if unknown:
+        raise ValueError(f"unknown pass(es): {sorted(unknown)} (know {PASSES})")
+
+    findings: list[Finding] = []
+    if "layer-check" in selected:
+        findings += layer_check.run(index, layer_map)
+    if "jit-safety" in selected:
+        findings += jit_safety.run(index)
+    if "donation" in selected:
+        findings += donation.run(index)
+    if "determinism" in selected:
+        findings += determinism.run(index, det_scope)
+    if "threads" in selected:
+        findings += threads.run(index)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+
+    baseline = Baseline.load(baseline_path) if baseline_path else Baseline()
+    unsuppressed, suppressed, stale = baseline.apply(findings)
+    counts: dict = {}
+    for f in unsuppressed:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "findings": unsuppressed,
+        "suppressed": suppressed,
+        "stale_baseline": stale,
+        "counts": counts,
+        "n_modules": len(index.modules),
+    }
+
+
+def main(argv: list | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fftpu-check",
+        description="layer-check + JAX-safety static analysis (pure AST)",
+    )
+    p.add_argument("package", nargs="?", default="fluidframework_tpu",
+                   help="package directory to analyze")
+    p.add_argument("--layers", default=None, help="layers.json override")
+    p.add_argument("--baseline", default=None, help="baseline.json override")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report suppressed findings too")
+    p.add_argument("--rules", default=None,
+                   help=f"comma-separated subset of {','.join(PASSES)}")
+    p.add_argument("--json", dest="as_json", action="store_true",
+                   help="machine-readable output (bench/CI artifacts)")
+    args = p.parse_args(argv)
+
+    try:
+        result = run_all(
+            args.package,
+            layers_path=args.layers,
+            baseline_path=args.baseline,
+            rules=args.rules.split(",") if args.rules else None,
+        )
+    except SyntaxError as e:
+        # A malformed file in the analyzed tree is a usage-class error
+        # (exit 2), not a crash: report the offending file:line.
+        print(f"fftpu-check: cannot parse {e.filename}:{e.lineno}: {e.msg}",
+              file=sys.stderr)
+        return 2
+    except (FileNotFoundError, ValueError, json.JSONDecodeError,
+            UnicodeDecodeError, OSError) as e:
+        print(f"fftpu-check: {e}", file=sys.stderr)
+        return 2
+
+    shown = list(result["findings"])
+    if args.no_baseline:
+        shown += result["suppressed"]
+        shown.sort(key=lambda f: (f.file, f.line, f.rule))
+
+    if args.as_json:
+        print(json.dumps({
+            "clean": not result["findings"],
+            "n_modules": result["n_modules"],
+            "counts": result["counts"],
+            "n_suppressed": len(result["suppressed"]),
+            "stale_baseline": result["stale_baseline"],
+            "findings": [f.to_json() for f in shown],
+        }, indent=2))
+    else:
+        for f in shown:
+            print(f.render())
+        for e in result["stale_baseline"]:
+            print(
+                f"stale-baseline  {e.get('file')}  entry no longer matches "
+                f"anything: {e.get('rule')} {e.get('detail')!r} — remove it"
+            )
+        n = len(result["findings"])
+        print(
+            f"fftpu-check: {result['n_modules']} modules, "
+            f"{n} finding{'s' if n != 1 else ''}, "
+            f"{len(result['suppressed'])} baselined, "
+            f"{len(result['stale_baseline'])} stale baseline entr"
+            f"{'ies' if len(result['stale_baseline']) != 1 else 'y'}"
+        )
+    return 1 if result["findings"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,295 @@
+"""Shared machinery for the fftpu-check passes.
+
+Everything here is pure stdlib ``ast``: the passes must run on a box with
+no JAX installed (CI lint tier) and must never import the code under
+analysis (importing the package would pull in jax + device init, and an
+import-time crash in analyzed code would take the analyzer down with it).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+# --------------------------------------------------------------------------
+# Findings + baseline
+# --------------------------------------------------------------------------
+
+@dataclass
+class Finding:
+    """One analyzer hit.
+
+    ``detail`` is the stable fingerprint half: baseline entries match on
+    ``(rule, file, detail)`` and deliberately NOT on ``line``, so a vetted
+    suppression survives unrelated edits shifting line numbers.
+    """
+
+    rule: str
+    file: str  # posix path relative to the package root's parent
+    line: int
+    message: str
+    hint: str = ""
+    detail: str = ""
+
+    def key(self) -> tuple:
+        return (self.rule, self.file, self.detail or self.message)
+
+    def render(self) -> str:
+        loc = f"{self.file}:{self.line}"
+        out = f"{self.rule}  {loc}  {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "file": self.file,
+            "line": self.line,
+            "message": self.message,
+            "hint": self.hint,
+            "detail": self.detail or self.message,
+        }
+
+
+class Baseline:
+    """Committed suppressions for vetted legacy findings.
+
+    Schema (``analysis/baseline.json``)::
+
+        {"version": 1,
+         "suppressions": [
+            {"rule": ..., "file": ..., "detail": ..., "rationale": ...},
+         ]}
+
+    Every entry MUST carry a non-empty rationale — the analyzer refuses a
+    baseline with silent entries (a suppression nobody can explain is a
+    finding in itself).  Entries that no longer match any finding are
+    reported as *stale* so the baseline shrinks as fixes land.
+    """
+
+    def __init__(self, entries: list[dict] | None = None) -> None:
+        self.entries = entries or []
+        for e in self.entries:
+            if not str(e.get("rationale", "")).strip():
+                raise ValueError(
+                    f"baseline entry without rationale: "
+                    f"{e.get('rule')} {e.get('file')} {e.get('detail')!r}"
+                )
+
+    @classmethod
+    def load(cls, path: Path | str) -> "Baseline":
+        data = json.loads(Path(path).read_text())
+        return cls(data.get("suppressions", []))
+
+    @staticmethod
+    def entry_key(e: dict) -> tuple:
+        return (e.get("rule"), e.get("file"), e.get("detail"))
+
+    def apply(
+        self, findings: list[Finding]
+    ) -> tuple[list[Finding], list[Finding], list[dict]]:
+        """-> (unsuppressed, suppressed, stale_entries)."""
+        index = {self.entry_key(e): e for e in self.entries}
+        used: set = set()
+        keep: list[Finding] = []
+        quiet: list[Finding] = []
+        for f in findings:
+            if f.key() in index:
+                used.add(f.key())
+                quiet.append(f)
+            else:
+                keep.append(f)
+        stale = [e for e in self.entries if self.entry_key(e) not in used]
+        return keep, quiet, stale
+
+
+# --------------------------------------------------------------------------
+# Package loading
+# --------------------------------------------------------------------------
+
+@dataclass
+class Module:
+    path: Path
+    rel: str          # "fluidframework_tpu/server/scribe.py"
+    modname: str      # "fluidframework_tpu.server.scribe"
+    subpackage: str   # "server" ("<root>" for top-level modules)
+    tree: ast.Module
+    source: str
+
+    def segment(self, node: ast.AST, limit: int = 60) -> str:
+        """Source text of a node, squashed for finding details."""
+        try:
+            seg = ast.get_source_segment(self.source, node) or ""
+        except Exception:
+            seg = ""
+        seg = " ".join(seg.split())
+        return seg[:limit] + ("…" if len(seg) > limit else "")
+
+    def aliases(self) -> dict:
+        """Memoized ``alias_map`` — the passes resolve names per function
+        and recomputing the import table per function is quadratic."""
+        cached = getattr(self, "_aliases", None)
+        if cached is None:
+            cached = alias_map(self)
+            object.__setattr__(self, "_aliases", cached)
+        return cached
+
+
+@dataclass
+class PackageIndex:
+    pkg_dir: Path
+    name: str
+    modules: list[Module] = field(default_factory=list)
+
+    def by_modname(self, name: str) -> Module | None:
+        for m in self.modules:
+            if m.modname == name:
+                return m
+        return None
+
+    @property
+    def subpackages(self) -> set:
+        return {m.subpackage for m in self.modules if m.subpackage != "<root>"}
+
+
+def load_package(pkg_dir: Path | str) -> PackageIndex:
+    pkg_dir = Path(pkg_dir).resolve()
+    idx = PackageIndex(pkg_dir=pkg_dir, name=pkg_dir.name)
+    root = pkg_dir.parent
+    for path in sorted(pkg_dir.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        source = path.read_text()
+        tree = ast.parse(source, filename=str(path))
+        rel = path.relative_to(root).as_posix()
+        parts = path.relative_to(root).with_suffix("").parts
+        modname = ".".join(parts[:-1] + (parts[-1],))
+        if parts[-1] == "__init__":
+            modname = ".".join(parts[:-1])
+        if path.parent == pkg_dir:
+            sub = "<root>"  # top-level module / the package __init__
+        else:
+            sub = path.relative_to(pkg_dir).parts[0]
+        idx.modules.append(
+            Module(path=path, rel=rel, modname=modname, subpackage=sub,
+                   tree=tree, source=source)
+        )
+    return idx
+
+
+# --------------------------------------------------------------------------
+# Import resolution
+# --------------------------------------------------------------------------
+
+@dataclass
+class ResolvedImport:
+    target: str        # fully-qualified module (or symbol) name
+    line: int
+    type_checking: bool
+
+
+def _type_checking_lines(tree: ast.Module) -> set:
+    """Line ranges of ``if TYPE_CHECKING:`` bodies (imports there are
+    erased at runtime — the sanctioned way to type-hint across layers).
+    Only the exact guard counts: ``if not TYPE_CHECKING:`` or
+    ``if TYPE_CHECKING or X:`` bodies DO run and get no exemption."""
+    def is_guard(test: ast.AST) -> bool:
+        if isinstance(test, ast.Name):
+            return test.id == "TYPE_CHECKING"
+        if isinstance(test, ast.Attribute):
+            return test.attr == "TYPE_CHECKING"
+        return False
+
+    out: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.If) and is_guard(node.test):
+            for sub in node.body:
+                for n in ast.walk(sub):
+                    if hasattr(n, "lineno"):
+                        out.add(n.lineno)
+    return out
+
+
+def iter_imports(mod: Module) -> list[ResolvedImport]:
+    """Every import in the module resolved to absolute dotted names.
+
+    Relative imports resolve against the module's own package path; for
+    ``from PKG import name`` each alias resolves one level deeper (the
+    alias may itself be a subpackage — ``from fluidframework_tpu import
+    parallel``)."""
+    tc = _type_checking_lines(mod.tree)
+    # Package path the relative imports resolve against.
+    is_pkg_init = mod.path.name == "__init__.py"
+    self_pkg = mod.modname if is_pkg_init else mod.modname.rsplit(".", 1)[0]
+    out: list[ResolvedImport] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out.append(ResolvedImport(a.name, node.lineno, node.lineno in tc))
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = node.module or ""
+            else:
+                comps = self_pkg.split(".")
+                comps = comps[: len(comps) - (node.level - 1)]
+                base = ".".join(comps + ([node.module] if node.module else []))
+            for a in node.names:
+                target = f"{base}.{a.name}" if base else a.name
+                out.append(ResolvedImport(target, node.lineno, node.lineno in tc))
+    return out
+
+
+def alias_map(mod: Module) -> dict:
+    """Local name -> fully-qualified dotted target, for resolving
+    ``mk.apply_ops`` / ``jnp.any`` / ``partial`` style references."""
+    is_pkg_init = mod.path.name == "__init__.py"
+    self_pkg = mod.modname if is_pkg_init else mod.modname.rsplit(".", 1)[0]
+    out: dict = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+                if a.asname:
+                    out[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = node.module or ""
+            else:
+                comps = self_pkg.split(".")
+                comps = comps[: len(comps) - (node.level - 1)]
+                base = ".".join(comps + ([node.module] if node.module else []))
+            for a in node.names:
+                target = f"{base}.{a.name}" if base else a.name
+                out[a.asname or a.name] = target
+    return out
+
+
+def dotted_name(expr: ast.AST) -> str | None:
+    """``a.b.c`` attribute/name chain as a string, else None."""
+    parts: list[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve(expr: ast.AST, aliases: dict) -> str | None:
+    """Resolve an expression to a fully-qualified dotted name using the
+    module's import aliases (``mk.apply_ops`` ->
+    ``fluidframework_tpu.ops.mergetree_kernel.apply_ops``)."""
+    dn = dotted_name(expr)
+    if dn is None:
+        return None
+    head, _, rest = dn.partition(".")
+    fq = aliases.get(head, head)
+    return f"{fq}.{rest}" if rest else fq

@@ -33,7 +33,7 @@ from .sequence_intervals import (
     transform_position,
 )
 from .shared_string import decode_obliterate_places as _decode_obliterate_places
-from ..runtime.channel import Channel, MessageCollection
+from ..protocol.channel import Channel, MessageCollection
 
 # Default merge-tree backend for channel-hosted SharedStrings: None -> the
 # Python oracle.  Tests swap in the TPU kernel backend here to run the whole

@@ -16,7 +16,7 @@ from __future__ import annotations
 from typing import Any
 
 from .channels import ChannelTypeFactory, PendingOverlayChannel
-from ..runtime.channel import Channel, MessageCollection
+from ..protocol.channel import Channel, MessageCollection
 
 
 def _split_path(path: str) -> list[str]:

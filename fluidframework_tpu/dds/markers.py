@@ -33,8 +33,9 @@ from __future__ import annotations
 
 from typing import Any
 
-MARKER_CP_BASE = 0xE000
-MARKER_CP_END = 0xF900  # exclusive
+# The plane boundaries are a protocol-level contract shared with the device
+# text-pool materializer (re-exported here for existing importers).
+from ..protocol.marker_plane import MARKER_CP_BASE, MARKER_CP_END  # noqa: F401
 
 # ReferenceType bitmask (ref merge-tree/src/ops.ts ReferenceType).
 REF_SIMPLE = 0x0

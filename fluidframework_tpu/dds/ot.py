@@ -24,7 +24,7 @@ import json
 from collections import deque
 from typing import Any
 
-from ..runtime.channel import Channel, MessageCollection
+from ..protocol.channel import Channel, MessageCollection
 
 
 class SharedOTChannel(Channel):

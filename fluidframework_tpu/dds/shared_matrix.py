@@ -331,7 +331,7 @@ class SharedMatrix:
 # Channel-boundary form
 # ---------------------------------------------------------------------------
 
-from ..runtime.channel import Channel, MessageCollection  # noqa: E402
+from ..protocol.channel import Channel, MessageCollection  # noqa: E402
 
 
 class SharedMatrixChannel(Channel):

@@ -36,7 +36,7 @@ import uuid as _uuid
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
-from ..runtime.channel import Channel, MessageCollection
+from ..protocol.channel import Channel, MessageCollection
 from .channels import ChannelTypeFactory, PendingOverlayChannel
 
 

@@ -185,13 +185,18 @@ class UniformChunk:
         return UniformChunk(shape=template, columns=columns, count=len(nodes))
 
     def decode(self) -> list[Node]:
+        # One bulk host conversion per COLUMN (tolist == elementwise
+        # .item(): python scalars out), not one sync per element per row —
+        # the per-element form is the jit-host-sync-loop antipattern
+        # fftpu-check flags, and decode() runs once per chunk per summary
+        # load with count x columns elements.
+        cols = [
+            np.asarray(c).tolist() if isinstance(c, np.ndarray) else c
+            for c in self.columns
+        ]
         out = []
         for i in range(self.count):
-            values = [
-                (c[i].item() if isinstance(c, np.ndarray) else c[i])
-                for c in self.columns
-            ]
-            out.append(_fill_shape(self.shape, iter(values)))
+            out.append(_fill_shape(self.shape, iter(c[i] for c in cols)))
         return out
 
     def to_json(self) -> dict:

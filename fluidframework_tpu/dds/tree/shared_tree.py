@@ -29,7 +29,7 @@ from __future__ import annotations
 from contextlib import contextmanager
 from typing import Any, Callable
 
-from ...runtime.channel import Channel, MessageCollection
+from ...protocol.channel import Channel, MessageCollection
 from ...utils.id_compressor import IdCompressor, IdCreationRange
 from .changeset import (
     Commit,

@@ -1040,7 +1040,7 @@ def visible_text(
     filtered here — markers hold positions but contribute no text, the
     reference's getText/getLength split.  ``raw=True`` keeps them so
     string indices equal positions."""
-    from ..dds.markers import MARKER_CP_BASE, MARKER_CP_END
+    from ..protocol.marker_plane import MARKER_CP_BASE, MARKER_CP_END
 
     nseg, vis = _host_vis(s, ref_seq, view_client)
     text = np.asarray(s.text)

@@ -6,7 +6,7 @@ the concrete side of the IChannelFactory plugin boundary,
 datastore-definitions/src/channel.ts:140,203,233,294).
 """
 
-from .channel import Channel, ChannelFactory, ChannelDeltaConnection
+from ..protocol.channel import Channel, ChannelFactory, ChannelDeltaConnection
 from .datastore import DataStoreRuntime
 from .container_runtime import ContainerRuntime
 from .op_lifecycle import (

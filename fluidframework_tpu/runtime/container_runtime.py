@@ -22,7 +22,7 @@ from typing import Any
 
 from ..driver.definitions import DriverError
 from ..protocol.messages import MessageType, Nack, SequencedMessage
-from .channel import MessageEnvelope, bunch_contiguous
+from ..protocol.channel import MessageEnvelope, bunch_contiguous
 from .datastore import DataStoreRuntime
 from .op_lifecycle import (
     DuplicateBatchDetector,

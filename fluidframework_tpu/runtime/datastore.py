@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from typing import Any, Callable
 
-from .channel import (
+from ..protocol.channel import (
     Channel,
     ChannelDeltaConnection,
     ChannelFactory,

@@ -47,60 +47,79 @@ class Deployment:
     supervise: bool = False
     _stopping: bool = field(default=False, repr=False)
     _thread: threading.Thread | None = field(default=None, repr=False)
+    # Guards shard records (proc/port/http_port/restarts) against the
+    # supervisor thread's respawn writes: without it a router could read a
+    # torn port mid-restart (fftpu-check thread-unlocked-write).  The
+    # supervisor holds it across a whole respawn, so routing calls block
+    # until the fresh port is real rather than returning the dead one.
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
     # ------------------------------------------------------------- routing
     def endpoint_for(self, doc_id: str) -> tuple[str, int, int]:
-        s = self.shards[shard_index(doc_id, len(self.shards))]
-        return ("127.0.0.1", s.port, s.http_port)
+        with self._lock:
+            s = self.shards[shard_index(doc_id, len(self.shards))]
+            return ("127.0.0.1", s.port, s.http_port)
 
     def manifest(self) -> dict:
-        return {
-            "shards": [
-                {
-                    "name": s.name,
-                    "port": s.port,
-                    "httpPort": s.http_port,
-                    "pid": s.proc.pid if s.proc else None,
-                    "restarts": s.restarts,
-                }
-                for s in self.shards
-            ]
-        }
+        with self._lock:
+            return {
+                "shards": [
+                    {
+                        "name": s.name,
+                        "port": s.port,
+                        "httpPort": s.http_port,
+                        "pid": s.proc.pid if s.proc else None,
+                        "restarts": s.restarts,
+                    }
+                    for s in self.shards
+                ]
+            }
 
     # ----------------------------------------------------------- lifecycle
     def stop(self) -> None:
         # Quiesce the supervisor FIRST: otherwise it can respawn a shard
         # concurrently with (or after) the termination sweep, leaking a
-        # live child bound to the shard's ports.
+        # live child bound to the shard's ports.  The flag is set OUTSIDE
+        # _lock deliberately — the supervisor may be holding the lock
+        # across a 30s readiness wait, and it checks the flag to abort;
+        # a plain monotonic bool store is the one cross-thread write here
+        # that needs no lock (join() below is the ordering barrier).
         self._stopping = True
         if self._thread is not None:
             # _spawn aborts within one attempt cycle once _stopping is set
             # (readiness polls 1s slices with abort checks; worst case one
             # communicate() timeout of ~10s still applies).
             self._thread.join(timeout=60)
-        for s in self.shards:
-            if s.proc is not None and s.proc.poll() is None:
-                s.proc.terminate()
-        for s in self.shards:
-            if s.proc is not None:
-                try:
-                    s.proc.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    s.proc.kill()
+        with self._lock:
+            for s in self.shards:
+                if s.proc is not None and s.proc.poll() is None:
+                    s.proc.terminate()
+            for s in self.shards:
+                if s.proc is not None:
+                    try:
+                        s.proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        s.proc.kill()
 
     def _supervise_loop(self) -> None:
         while not self._stopping:
             for s in self.shards:
                 if self._stopping:
                     break
-                if s.proc is not None and s.proc.poll() is not None:
-                    # Crashed member: relaunch on the SAME ports so clients
-                    # reconnect without re-routing (compose restart policy).
-                    s.restarts += 1
-                    try:
-                        _spawn(s, abort=lambda: self._stopping)
-                    except Exception:
-                        pass  # next tick retries; the supervisor never dies
+                with self._lock:
+                    if self._stopping:
+                        break
+                    if s.proc is not None and s.proc.poll() is not None:
+                        # Crashed member: relaunch on the SAME ports so
+                        # clients reconnect without re-routing (compose
+                        # restart policy).  Held lock spans the respawn:
+                        # routing sees the old record or the fresh one,
+                        # never a half-written port pair.
+                        s.restarts += 1
+                        try:
+                            _spawn(s, abort=lambda: self._stopping)
+                        except Exception:
+                            pass  # next tick retries; supervisor never dies
             time.sleep(0.2)
 
 

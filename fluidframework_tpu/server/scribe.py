@@ -498,7 +498,9 @@ _JIT_CACHE: dict[tuple, Callable] = {}
 
 
 def _map_apply_jit(mpk):
-    key = ("map", id(mpk))
+    # Keyed by module name, not id(): stable across interpreter runs
+    # (fftpu-check det-id-ordering), and modules are singletons anyway.
+    key = ("map", mpk.__name__)
     if key not in _JIT_CACHE:
         import jax
 
@@ -507,7 +509,7 @@ def _map_apply_jit(mpk):
 
 
 def _matrix_apply_jit(mxk):
-    key = ("matrix", id(mxk))
+    key = ("matrix", mxk.__name__)
     if key not in _JIT_CACHE:
         import jax
 
@@ -915,7 +917,11 @@ class ScribeLambda:
             part = self.topic.partition(p)
             floors = [self.group.committed(p)]
             floors += [g.committed(p) for g in extra_groups]
-            for doc in set(self.docs) | set(self.refs):
+            # Sorted: the floor fold itself is a min (order-free), but a
+            # byte-identity path must not iterate in hash order on
+            # principle — a future side effect in this loop would diverge
+            # per replica (fftpu-check det-set-iteration).
+            for doc in sorted(set(self.docs) | set(self.refs)):
                 if self.topic.partition_for(doc) != p:
                     continue
                 ref = self.refs.get(doc)

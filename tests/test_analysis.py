@@ -1,0 +1,794 @@
+"""fftpu-check static-analysis suite tests.
+
+Three tiers:
+
+1. Per-pass fixture tests — a known-bad snippet fires the rule, its
+   known-good twin stays silent (all five passes).
+2. Baseline round-trip — add / suppress / expire, rationale enforcement.
+3. Self-hosting gates — ``test_package_is_clean`` runs the whole suite on
+   the real package (tier-1: every future PR is checked), and seeded
+   violations on a copy of the real tree make the CLI exit nonzero with
+   the right rule id.
+
+Everything is pure AST — no JAX import, so this file runs in seconds even
+on the 2-core CI box.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from fluidframework_tpu.analysis import cli as check_cli
+from fluidframework_tpu.analysis.core import Baseline, load_package
+from fluidframework_tpu.analysis import (
+    determinism, donation, jit_safety, layer_check, threads,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "fluidframework_tpu"
+
+FIXTURE_LAYERS = {
+    "layers": [
+        {"name": "low", "packages": ["low"]},
+        {"name": "high", "packages": ["high"]},
+    ],
+    "determinism_scope": ["fixturepkg/low/"],
+}
+
+
+def make_pkg(tmp_path: Path, files: dict) -> Path:
+    """Write a throwaway package tree; returns its directory."""
+    pkg = tmp_path / "fixturepkg"
+    for rel, body in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(body)
+    for d in {p.parent for p in pkg.rglob("*.py")} | {pkg}:
+        init = d / "__init__.py"
+        if not init.exists():
+            init.write_text("")
+    (pkg / "analysis").mkdir(exist_ok=True)
+    (pkg / "analysis" / "layers.json").write_text(json.dumps(FIXTURE_LAYERS))
+    return pkg
+
+
+def rules_of(findings) -> list:
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# Pass 1: layer-check
+# ---------------------------------------------------------------------------
+
+def test_layer_check_flags_upward_import(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/util.py": "from ..high import svc\n",
+        "high/svc.py": "X = 1\n",
+    })
+    found = layer_check.run(load_package(pkg),
+                            layer_check.load_layers(pkg / "analysis/layers.json"))
+    assert [f.rule for f in found] == ["layer-upward-import"]
+    assert found[0].file == "fixturepkg/low/util.py"
+    assert found[0].line == 1
+    assert "fixturepkg.high.svc" in found[0].detail
+
+
+def test_layer_check_good_twin_silent(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/util.py": "X = 1\n",
+        "high/svc.py": "from ..low import util\nfrom ..low.util import X\n",
+    })
+    found = layer_check.run(load_package(pkg),
+                            layer_check.load_layers(pkg / "analysis/layers.json"))
+    assert found == []
+
+
+def test_layer_check_type_checking_imports_exempt(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/util.py": (
+            "from typing import TYPE_CHECKING\n"
+            "if TYPE_CHECKING:\n"
+            "    from ..high import svc\n"
+        ),
+        "high/svc.py": "X = 1\n",
+    })
+    found = layer_check.run(load_package(pkg),
+                            layer_check.load_layers(pkg / "analysis/layers.json"))
+    assert found == []
+
+
+def test_layer_check_inverted_type_checking_guard_not_exempt(tmp_path):
+    """``if not TYPE_CHECKING:`` bodies RUN — the exemption only covers the
+    exact positive guard."""
+    pkg = make_pkg(tmp_path, {
+        "low/util.py": (
+            "from typing import TYPE_CHECKING\n"
+            "if not TYPE_CHECKING:\n"
+            "    from ..high import svc\n"
+        ),
+        "high/svc.py": "X = 1\n",
+    })
+    found = layer_check.run(load_package(pkg),
+                            layer_check.load_layers(pkg / "analysis/layers.json"))
+    assert [f.rule for f in found] == ["layer-upward-import"]
+
+
+def test_layer_check_lazy_function_local_import_still_counts(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/util.py": "def f():\n    from ..high import svc\n    return svc\n",
+        "high/svc.py": "X = 1\n",
+    })
+    found = layer_check.run(load_package(pkg),
+                            layer_check.load_layers(pkg / "analysis/layers.json"))
+    assert [f.rule for f in found] == ["layer-upward-import"]
+
+
+def test_layer_check_undeclared_subpackage(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/util.py": "X = 1\n",
+        "rogue/new_thing.py": "Y = 2\n",
+    })
+    found = layer_check.run(load_package(pkg),
+                            layer_check.load_layers(pkg / "analysis/layers.json"))
+    assert [f.rule for f in found] == ["layer-undeclared-package"]
+    assert "rogue" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# Pass 2: jit-safety
+# ---------------------------------------------------------------------------
+
+def test_jit_branch_on_tracer_fires_and_shape_branch_does_not(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/kern.py": (
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def bad(x):\n"
+            "    if x > 0:\n"            # traced -> finding
+            "        return x\n"
+            "    return -x\n"
+            "@jax.jit\n"
+            "def good(x):\n"
+            "    if x.shape[0] > 2:\n"   # static metadata -> silent
+            "        return x * 2\n"
+            "    return x\n"
+        ),
+    })
+    found = jit_safety.run(load_package(pkg))
+    assert [f.rule for f in found] == ["jit-branch-on-tracer"]
+    assert found[0].line == 5
+    assert "bad" in found[0].detail
+
+
+def test_jit_taint_propagates_through_call_chain(tmp_path):
+    # Entry wraps f via functools.partial(jax.jit, ...); f calls helper g;
+    # g branches on the traced argument -> flagged inside g.
+    pkg = make_pkg(tmp_path, {
+        "low/kern.py": (
+            "import functools\n"
+            "import jax\n"
+            "def g(v):\n"
+            "    while v < 3:\n"
+            "        v = v + 1\n"
+            "    return v\n"
+            "def f(state, n):\n"
+            "    return g(state) + n\n"
+            "prog = functools.partial(jax.jit, donate_argnums=(0,))(f)\n"
+        ),
+    })
+    found = jit_safety.run(load_package(pkg))
+    assert [f.rule for f in found] == ["jit-branch-on-tracer"]
+    assert found[0].line == 4
+    assert "g" in found[0].detail
+
+
+def test_jit_isinstance_narrowing_suppresses_static_arm(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/kern.py": (
+            "import jax\n"
+            "@jax.jit\n"
+            "def dual(x, flag):\n"
+            "    if isinstance(flag, bool):\n"
+            "        y = x * 2 if flag else x\n"   # static arm: fine
+            "        return y\n"
+            "    return jax.lax.cond(flag, lambda v: v * 2, lambda v: v, x)\n"
+        ),
+    })
+    assert jit_safety.run(load_package(pkg)) == []
+
+
+def test_jit_static_comprehension_branch_is_silent(tmp_path):
+    """A comprehension over static data is branchable; one over traced data
+    taints its result."""
+    pkg = make_pkg(tmp_path, {
+        "low/kern.py": (
+            "import jax\n"
+            "@jax.jit\n"
+            "def good(x):\n"
+            "    ks = [i * 2 for i in range(4)]\n"
+            "    if ks:\n"
+            "        return x\n"
+            "    return x\n"
+        ),
+    })
+    assert jit_safety.run(load_package(pkg)) == []
+    pkg2 = make_pkg(tmp_path / "b", {
+        "low/kern.py": (
+            "import jax\n"
+            "@jax.jit\n"
+            "def bad(xs):\n"
+            "    ys = [v + 1 for v in xs]\n"
+            "    if ys[0]:\n"
+            "        return xs\n"
+            "    return xs\n"
+        ),
+    })
+    assert [f.rule for f in jit_safety.run(load_package(pkg2))] == \
+        ["jit-branch-on-tracer"]
+
+
+def test_jit_bound_method_entry(tmp_path):
+    """``self._prog = jax.jit(self._step, ...)`` registers the method as a
+    jit entry — hazards inside it are not silently dropped."""
+    pkg = make_pkg(tmp_path, {
+        "low/eng.py": (
+            "import jax\n"
+            "class Eng:\n"
+            "    def __init__(self):\n"
+            "        self._prog = jax.jit(self._step, donate_argnums=(0,))\n"
+            "    def _step(self, state):\n"
+            "        if state > 0:\n"
+            "            return state\n"
+            "        return -state\n"
+        ),
+    })
+    found = jit_safety.run(load_package(pkg))
+    assert [f.rule for f in found] == ["jit-branch-on-tracer"]
+    assert "_step" in found[0].detail
+
+
+def test_jit_np_on_tracer(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/kern.py": (
+            "import jax\n"
+            "import numpy as np\n"
+            "import jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def bad(x):\n"
+            "    return np.cumsum(x)\n"
+            "@jax.jit\n"
+            "def good(x):\n"
+            "    scale = np.float32(4.0)\n"   # np on a constant: fine
+            "    return jnp.cumsum(x) * scale\n"
+        ),
+    })
+    found = jit_safety.run(load_package(pkg))
+    assert [f.rule for f in found] == ["jit-np-on-tracer"]
+    assert found[0].line == 6
+
+
+def test_jit_host_sync(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/kern.py": (
+            "import jax\n"
+            "@jax.jit\n"
+            "def bad(x):\n"
+            "    return float(x) + 1\n"
+        ),
+    })
+    found = jit_safety.run(load_package(pkg))
+    assert [f.rule for f in found] == ["jit-host-sync"]
+
+
+def test_jit_unhashable_static(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/kern.py": (
+            "import jax\n"
+            "def f(x, opts):\n"
+            "    return x\n"
+            "prog = jax.jit(f, static_argnames=('opts',))\n"
+            "def caller(x):\n"
+            "    bad = prog(x, opts=['a', 'b'])\n"
+            "    good = prog(x, opts=('a', 'b'))\n"
+            "    return bad, good\n"
+        ),
+    })
+    found = jit_safety.run(load_package(pkg))
+    assert [f.rule for f in found] == ["jit-unhashable-static"]
+    assert found[0].line == 6
+
+
+def test_host_sync_loop_and_bulk_twin(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/host.py": (
+            "import numpy as np\n"
+            "def bad(cols, n):\n"
+            "    out = []\n"
+            "    for i in range(n):\n"
+            "        out.append([c[i].item() for c in cols])\n"
+            "    return out\n"
+            "def good(cols, n):\n"
+            "    lists = [np.asarray(c).tolist() for c in cols]\n"
+            "    return [[c[i] for c in lists] for i in range(n)]\n"
+        ),
+    })
+    found = jit_safety.run(load_package(pkg))
+    assert [f.rule for f in found] == ["jit-host-sync-loop"]
+    assert found[0].line == 5
+
+
+# ---------------------------------------------------------------------------
+# Pass 3: donation
+# ---------------------------------------------------------------------------
+
+DONATE_HEADER = (
+    "import functools\n"
+    "import jax\n"
+    "def step(state, ops):\n"
+    "    return state\n"
+    "prog = functools.partial(jax.jit, donate_argnums=(0,))(step)\n"
+)
+
+
+def test_donation_use_after_dispatch(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/eng.py": DONATE_HEADER + (
+            "def bad(state, ops):\n"
+            "    out = prog(state, ops)\n"
+            "    return state, out\n"       # state is donated: finding
+            "def good(state, ops):\n"
+            "    state = prog(state, ops)\n"  # rebind kills the donation
+            "    return state\n"
+        ),
+    })
+    found = donation.run(load_package(pkg))
+    assert [f.rule for f in found] == ["donate-use-after-dispatch"]
+    assert "bad" in found[0].detail and "`state`" in found[0].message
+
+
+def test_donation_loop_carried(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/eng.py": DONATE_HEADER + (
+            "def bad(state, batches):\n"
+            "    for ops in batches:\n"
+            "        out = prog(state, ops)\n"  # 2nd iter uses donated state
+            "    return out\n"
+            "def good(state, batches):\n"
+            "    for ops in batches:\n"
+            "        state = prog(state, ops)\n"
+            "    return state\n"
+        ),
+    })
+    found = donation.run(load_package(pkg))
+    assert [f.rule for f in found] == ["donate-use-after-dispatch"]
+    assert "bad" in found[0].detail
+
+
+def test_donation_self_attribute_program(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/eng.py": (
+            "import jax\n"
+            "class Engine:\n"
+            "    def __init__(self, fn, mesh):\n"
+            "        self._prog = mesh_fleet_program(fn, mesh)\n"
+            "    def bad_step(self, ops):\n"
+            "        new = self._prog(self._state, ops)\n"
+            "        n = self._state.nseg\n"     # read before rebind
+            "        self._state = new\n"
+            "        return n\n"
+            "    def good_step(self, ops):\n"
+            "        self._state = self._prog(self._state, ops)\n"
+            "        return self._state.nseg\n"
+            "def mesh_fleet_program(fn, mesh):\n"
+            "    return fn\n"
+        ),
+    })
+    found = donation.run(load_package(pkg))
+    assert [f.rule for f in found] == ["donate-use-after-dispatch"]
+    assert "bad_step" in found[0].detail
+
+
+def test_donation_call_inside_if_test(tmp_path):
+    """The if-test evaluates before its arms: a donating call there poisons
+    uses in either branch body."""
+    pkg = make_pkg(tmp_path, {
+        "low/eng.py": DONATE_HEADER + (
+            "def bad(state, ops):\n"
+            "    if prog(state, ops) is None:\n"
+            "        return state.nseg\n"
+            "    return 0\n"
+        ),
+    })
+    found = donation.run(load_package(pkg))
+    assert [f.rule for f in found] == ["donate-use-after-dispatch"]
+    assert "bad" in found[0].detail
+
+
+# ---------------------------------------------------------------------------
+# Pass 4: determinism
+# ---------------------------------------------------------------------------
+
+def test_determinism_rules_fire_in_scope_only(tmp_path):
+    fold_bad = (
+        "import time, random\n"
+        "def fold(self):\n"
+        "    acc = []\n"
+        "    pending = set()\n"
+        "    for d in pending:\n"            # det-set-iteration
+        "        acc.append(d)\n"
+        "    acc.sort(key=lambda x: id(x))\n"  # det-id-ordering
+        "    stamp = time.time()\n"            # det-wallclock
+        "    salt = random.random()\n"         # det-random
+        "    h = hash('doc')\n"                # det-hash-builtin
+        "    return acc, stamp, salt, h\n"
+    )
+    pkg = make_pkg(tmp_path, {
+        "low/fold.py": fold_bad,
+        "high/serving.py": fold_bad,  # out of scope: silent
+    })
+    scope = ["fixturepkg/low/"]
+    found = determinism.run(load_package(pkg), scope)
+    assert rules_of(found) == [
+        "det-hash-builtin", "det-id-ordering", "det-random",
+        "det-set-iteration", "det-wallclock",
+    ]
+    assert all(f.file == "fixturepkg/low/fold.py" for f in found)
+
+
+def test_determinism_sorted_and_minmax_are_silent(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/fold.py": (
+            "def fold(docs, refs):\n"
+            "    seen = set(docs) | set(refs)\n"
+            "    lo = min(seen)\n"
+            "    for d in sorted(seen):\n"
+            "        lo = d\n"
+            "    return [x for x in sorted(seen)], lo\n"
+        ),
+    })
+    assert determinism.run(load_package(pkg), ["fixturepkg/low/"]) == []
+
+
+def test_determinism_rebind_to_sorted_is_silent(tmp_path):
+    """The fix the rule's own hint recommends must not itself be flagged:
+    rebinding a set-typed local to sorted(...) kills its set-typedness."""
+    pkg = make_pkg(tmp_path, {
+        "low/fold.py": (
+            "def f(items):\n"
+            "    docs = set(items)\n"
+            "    docs = sorted(docs)\n"
+            "    out = []\n"
+            "    for d in docs:\n"
+            "        out.append(d)\n"
+            "    return out\n"
+        ),
+    })
+    assert determinism.run(load_package(pkg), ["fixturepkg/low/"]) == []
+
+
+def test_determinism_per_use_flow(tmp_path):
+    """Verdicts are per-use: iterating the set BEFORE a later rebind still
+    fires; a loop over a plain parameter isn't retro-tainted by a later
+    set assignment to the same name."""
+    pkg = make_pkg(tmp_path, {
+        "low/a.py": (
+            "def f(xs):\n"
+            "    s = set(xs)\n"
+            "    out = []\n"
+            "    for d in s:\n"         # real hazard: before the rebind
+            "        out.append(d)\n"
+            "    s = sorted(s)\n"
+            "    return s\n"
+        ),
+        "low/b.py": (
+            "def g(s):\n"
+            "    out = []\n"
+            "    for x in s:\n"          # plain parameter: fine
+            "        out.append(x)\n"
+            "    s = set(out)\n"
+            "    return sorted(s)\n"
+        ),
+    })
+    found = determinism.run(load_package(pkg), ["fixturepkg/low/"])
+    assert [(f.file, f.rule) for f in found] == \
+        [("fixturepkg/low/a.py", "det-set-iteration")]
+
+
+def test_determinism_set_typed_attribute(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/fold.py": (
+            "class Scribe:\n"
+            "    def __init__(self):\n"
+            "        self.docs: set[str] = set()\n"
+            "    def fold(self):\n"
+            "        return list(self.docs)\n"   # materializes in hash order
+        ),
+    })
+    found = determinism.run(load_package(pkg), ["fixturepkg/low/"])
+    assert [f.rule for f in found] == ["det-set-iteration"]
+
+
+# ---------------------------------------------------------------------------
+# Pass 5: threads
+# ---------------------------------------------------------------------------
+
+THREAD_BAD = (
+    "import threading\n"
+    "class Worker:\n"
+    "    def __init__(self):\n"
+    "        self.count = 0\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._thread = threading.Thread(target=self._run, daemon=True)\n"
+    "    def _run(self):\n"
+    "        while True:\n"
+    "            self.count += 1\n"
+    "    def snapshot(self):\n"
+    "        return self.count\n"
+)
+
+THREAD_GOOD = THREAD_BAD.replace(
+    "        while True:\n"
+    "            self.count += 1\n",
+    "        while True:\n"
+    "            with self._lock:\n"
+    "                self.count += 1\n",
+)
+
+
+def test_threads_unlocked_write_fires_and_locked_twin_silent(tmp_path):
+    pkg_bad = make_pkg(tmp_path / "bad", {"low/w.py": THREAD_BAD})
+    found = threads.run(load_package(pkg_bad))
+    assert [f.rule for f in found] == ["thread-unlocked-write"]
+    assert ".count" in found[0].message and "_run" in found[0].detail
+
+    pkg_good = make_pkg(tmp_path / "good", {"low/w.py": THREAD_GOOD})
+    assert threads.run(load_package(pkg_good)) == []
+
+
+def test_threads_lock_inherited_through_call_edge(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/w.py": (
+            "import threading\n"
+            "class Worker:\n"
+            "    def __init__(self):\n"
+            "        self.jobs = 0\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._thread = threading.Thread(target=self._run)\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self._bump()\n"       # callee under the lock
+            "    def _bump(self):\n"
+            "        self.jobs += 1\n"
+            "    def read(self):\n"
+            "        return self.jobs\n"
+        ),
+    })
+    assert threads.run(load_package(pkg)) == []
+
+
+def test_threads_other_class_same_attr_name_is_not_a_race(tmp_path):
+    """A thread-side ``self.count`` write in Writer must not match another
+    class's own ``self.count`` — different objects, no shared state."""
+    pkg = make_pkg(tmp_path, {
+        "low/w.py": (
+            "import threading\n"
+            "class Writer:\n"
+            "    def __init__(self):\n"
+            "        self.count = 0\n"
+            "        self._t = threading.Thread(target=self._run)\n"
+            "    def _run(self):\n"
+            "        self.count += 1\n"
+            "class Unrelated:\n"
+            "    def __init__(self):\n"
+            "        self.count = 5\n"
+            "    def peek(self):\n"
+            "        return self.count\n"
+        ),
+    })
+    assert threads.run(load_package(pkg)) == []
+
+
+def test_threads_module_function_target(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/w.py": (
+            "import threading\n"
+            "def _drain(shard):\n"
+            "    shard.offset = 1\n"
+            "def start(shard):\n"
+            "    threading.Thread(target=_drain, args=(shard,)).start()\n"
+            "def peek(shard):\n"
+            "    return shard.offset\n"
+        ),
+    })
+    found = threads.run(load_package(pkg))
+    assert [f.rule for f in found] == ["thread-unlocked-write"]
+    assert ".offset" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# Baseline round-trip
+# ---------------------------------------------------------------------------
+
+def _one_finding_pkg(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/util.py": "from ..high import svc\n",
+        "high/svc.py": "X = 1\n",
+    })
+    return pkg
+
+
+def test_baseline_add_suppress_expire(tmp_path):
+    pkg = _one_finding_pkg(tmp_path)
+    result = check_cli.run_all(pkg)
+    assert [f.rule for f in result["findings"]] == ["layer-upward-import"]
+
+    # Add: suppress exactly that finding.
+    f = result["findings"][0]
+    baseline = pkg / "analysis" / "baseline.json"
+    baseline.write_text(json.dumps({"suppressions": [{
+        "rule": f.rule, "file": f.file, "detail": f.detail,
+        "rationale": "fixture: vetted legacy edge",
+    }]}))
+    result = check_cli.run_all(pkg)
+    assert result["findings"] == [] and len(result["suppressed"]) == 1
+    assert result["stale_baseline"] == []
+
+    # Expire: fix the source; the entry must surface as stale.
+    (pkg / "low" / "util.py").write_text("X = 1\n")
+    result = check_cli.run_all(pkg)
+    assert result["findings"] == []
+    assert len(result["stale_baseline"]) == 1
+    assert result["stale_baseline"][0]["rule"] == "layer-upward-import"
+
+
+def test_baseline_requires_rationale():
+    with pytest.raises(ValueError, match="rationale"):
+        Baseline([{"rule": "r", "file": "f", "detail": "d"}])
+
+
+def test_baseline_matching_ignores_line_numbers(tmp_path):
+    pkg = _one_finding_pkg(tmp_path)
+    f = check_cli.run_all(pkg)["findings"][0]
+    (pkg / "analysis" / "baseline.json").write_text(json.dumps({"suppressions": [{
+        "rule": f.rule, "file": f.file, "detail": f.detail,
+        "rationale": "fixture: vetted",
+    }]}))
+    # Shift the import down 5 lines: still suppressed.
+    src = pkg / "low" / "util.py"
+    src.write_text("# pad\n" * 5 + src.read_text())
+    result = check_cli.run_all(pkg)
+    assert result["findings"] == [] and len(result["suppressed"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    pkg = _one_finding_pkg(tmp_path)
+    assert check_cli.main([str(pkg)]) == 1
+    capsys.readouterr()
+    assert check_cli.main([str(pkg), "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["clean"] is False
+    assert out["counts"] == {"layer-upward-import": 1}
+    assert out["findings"][0]["file"] == "fixturepkg/low/util.py"
+
+    (pkg / "low" / "util.py").write_text("X = 1\n")
+    assert check_cli.main([str(pkg)]) == 0
+    capsys.readouterr()
+    assert check_cli.main([str(pkg), "--rules", "nonsense"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_syntax_error_is_exit_2(tmp_path, capsys):
+    pkg = _one_finding_pkg(tmp_path)
+    (pkg / "low" / "broken.py").write_text("def f(:\n")
+    assert check_cli.main([str(pkg)]) == 2
+    assert "broken.py" in capsys.readouterr().err
+
+
+def test_cli_rules_subset(tmp_path, capsys):
+    pkg = _one_finding_pkg(tmp_path)
+    # Only non-layer passes: the upward import is out of the subset.
+    assert check_cli.main([str(pkg), "--rules", "determinism,threads"]) == 0
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# Self-hosting gates (the real package)
+# ---------------------------------------------------------------------------
+
+def test_package_is_clean():
+    """Tier-1 gate: zero unsuppressed findings on the committed tree, no
+    stale baseline entries (the baseline only shrinks), every suppression
+    carries a rationale (Baseline refuses otherwise)."""
+    result = check_cli.run_all(PKG)
+    assert result["n_modules"] > 100
+    pretty = "\n".join(f.render() for f in result["findings"])
+    assert not result["findings"], f"unsuppressed findings:\n{pretty}"
+    assert not result["stale_baseline"], (
+        f"stale baseline entries (remove them): {result['stale_baseline']}"
+    )
+
+
+def _copy_pkg(tmp_path: Path) -> Path:
+    dst = tmp_path / "fluidframework_tpu"
+    shutil.copytree(
+        PKG, dst,
+        ignore=shutil.ignore_patterns("__pycache__", "*.pyc", "*.so"),
+    )
+    return dst
+
+
+SEEDINGS = [
+    # (target rel path, transform, expected rule, pass to run)
+    ("utils/config.py",
+     lambda s: s + "\nfrom ..server import scribe as _seeded\n",
+     "layer-upward-import", "layer-check"),
+    ("server/scribe.py",
+     lambda s: s.replace("for doc in sorted(set(self.docs) | set(self.refs)):",
+                         "for doc in set(self.docs) | set(self.refs):"),
+     "det-set-iteration", "determinism"),
+    ("models/doc_batch_engine.py",
+     lambda s: s + (
+         "\n\ndef _seeded_bad(state, ops, pays):\n"
+         "    out = _fleet_megastep(state, ops, pays)\n"
+         "    return state.text_end, out\n"
+     ),
+     "donate-use-after-dispatch", "donation"),
+    ("models/doc_batch_engine.py",
+     lambda s: s + (
+         "\n\n@jax.jit\ndef _seeded_branch(state):\n"
+         "    if state.text_end > 0:\n"
+         "        return state\n"
+         "    return state\n"
+     ),
+     "jit-branch-on-tracer", "jit-safety"),
+    ("server/launcher.py",
+     lambda s: s.replace(
+         "            time.sleep(0.2)",
+         "            self.shards[0].restarts += 1\n            time.sleep(0.2)"),
+     "thread-unlocked-write", "threads"),
+]
+
+
+@pytest.mark.parametrize("rel,transform,rule,passname",
+                         SEEDINGS, ids=[s[2] for s in SEEDINGS])
+def test_seeded_violation_fails_the_real_tree(tmp_path, rel, transform, rule,
+                                              passname):
+    """Acceptance: seeding each hazard class into a copy of the committed
+    tree exits nonzero with the correct rule id and file:line.  Each case
+    runs only its own pass (the full-suite clean run is
+    test_package_is_clean; this keeps tier-1 inside its budget)."""
+    pkg = _copy_pkg(tmp_path)
+    target = pkg / rel
+    src = target.read_text()
+    seeded = transform(src)
+    assert seeded != src, "seeding transform did not apply"
+    target.write_text(seeded)
+    result = check_cli.run_all(pkg, rules=[passname])
+    hits = [f for f in result["findings"] if f.rule == rule]
+    assert hits, (
+        f"seeded {rule} in {rel} not caught; findings: "
+        + ", ".join(f"{f.rule}@{f.file}:{f.line}" for f in result["findings"])
+    )
+    assert any(f.file.endswith(rel) and f.line > 0 for f in hits)
+
+
+def test_console_entry_point_runs():
+    """`python -m fluidframework_tpu.analysis.cli <pkg>` (the console-script
+    body) exits 0 on the committed tree."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "fluidframework_tpu.analysis.cli", str(PKG)],
+        capture_output=True, text=True, cwd=str(REPO), timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 findings" in proc.stdout
