@@ -1557,6 +1557,45 @@ def bench_multichip(args) -> dict:
     return out
 
 
+def bench_soak(args) -> dict:
+    """``--config soak``: the chaos/soak harness over the full serving
+    stack (testing/chaos.py) — Zipf-popularity traffic with connect/
+    disconnect churn driven through a seeded fault schedule (fleet
+    kill/restart, torn sockets, nack storms, scribe crash mid-fold,
+    delayed partition fsyncs) against the admission-controlled netserver
+    front + checkpointed device fleet + ScribePool.  Invariants (byte
+    identity vs a fault-free oracle replay, no double-acks, bounded queue
+    depth/RSS) are HARD assertions — a violation fails the config rather
+    than skewing a number.  Emits the SLO row: p50/p99 op latency UNDER
+    FAULT plus shed/pause/backoff counters (the SOAK round artifact via
+    ``--artifact``)."""
+    from fluidframework_tpu.testing.chaos import run_soak
+
+    platform, probe_err, probe_attempts, degraded, reduced = (
+        _resolve_backend()
+    )
+    seed = int(os.environ.get("FFTPU_SOAK_SEED", "10"))
+    ticks = args.steps if args.steps_explicit else int(
+        os.environ.get("FFTPU_SOAK_TICKS", "240")
+    )
+    n_docs = args.docs if args.docs_explicit else 6
+    out = run_soak(seed=seed, ticks=ticks, n_docs=n_docs)
+    out["platform"] = platform or "cpu"
+    if probe_attempts:
+        out["backend_attempts"] = probe_attempts
+    if degraded:
+        out["degraded"] = True
+        if probe_err:
+            out["backend_error"] = probe_err
+    elif reduced:
+        out["reduced_scale"] = True
+    if getattr(args, "artifact", None):
+        with open(args.artifact, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    return out
+
+
 _CHILD_TIMEOUTS = {
     "1": 900.0, "2": 600.0, "3": 1500.0, "4": 600.0, "5": 900.0,
     "latency": 600.0, "headline": 1500.0,
@@ -1596,7 +1635,13 @@ def _probe_backend(timeout_s: float = 180.0, attempts: int = 3):
         except OSError as e:
             err = str(e)
         if i + 1 < attempts:
-            time.sleep(min(10.0 * (2 ** i), 120.0))
+            # Full jitter on the 10/20/40s ladder: many bench processes
+            # racing a shared backend must not resynchronize their retries
+            # into a thundering herd (same policy as the client nack
+            # backoff in loader/connection_manager.py).
+            import random as _random
+
+            time.sleep(_random.uniform(0.0, min(10.0 * (2 ** i), 120.0)))
     return None, err, attempts
 
 
@@ -1742,7 +1787,7 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--config", default=None,
                    choices=["1", "2", "3", "4", "5", "latency", "headline",
-                            "multichip", "multichip-child", "all"])
+                            "multichip", "multichip-child", "soak", "all"])
     p.add_argument("--devices", type=int, default=1,
                    help="mesh device count for the multichip-child config")
     p.add_argument("--artifact", default=None,
@@ -1803,10 +1848,17 @@ def main() -> None:
         "headline": bench_headline,
         "multichip": bench_multichip,
         "multichip-child": bench_multichip_child,
+        "soak": bench_soak,
     }
     def _emit(res: dict) -> None:
         # Every config row carries the observability attachment
         # (latency_p50_ms / latency_p99_ms / phase_shares — ISSUE 7).
+        # The soak row is exempt: its p50/p99 are measured UNDER FAULT on
+        # the real stack — attaching the synthetic probe's numbers next to
+        # them would invite reading the wrong column.
+        if res.get("metric", "").startswith("soak_"):
+            print(json.dumps(res), flush=True)
+            return
         print(json.dumps(_attach_observability(res, args.megastep_k)),
               flush=True)
 

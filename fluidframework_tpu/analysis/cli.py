@@ -21,10 +21,13 @@ import json
 import sys
 from pathlib import Path
 
-from . import determinism, donation, jit_safety, layer_check, threads
+from . import determinism, donation, jit_safety, layer_check, swallowed, threads
 from .core import Baseline, Finding, load_package
 
-PASSES = ("layer-check", "jit-safety", "donation", "determinism", "threads")
+PASSES = (
+    "layer-check", "jit-safety", "donation", "determinism", "threads",
+    "swallowed-exception",
+)
 
 
 def run_all(
@@ -65,6 +68,10 @@ def run_all(
         findings += determinism.run(index, det_scope)
     if "threads" in selected:
         findings += threads.run(index)
+    if "swallowed-exception" in selected:
+        findings += swallowed.run(
+            index, layer_map, layers_cfg.get("swallowed_scope")
+        )
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
 
     baseline = Baseline.load(baseline_path) if baseline_path else Baseline()

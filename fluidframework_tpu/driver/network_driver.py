@@ -141,9 +141,14 @@ class NetworkDeltaConnection(DeltaConnection):
             self._listener(_seq_from_dict(item["msg"]))
             return True
         if kind == "nack":
-            # The connection is invalid after a nack (ref: server closes the
-            # socket; client reconnects).
-            self.disconnect()
+            # A protocol nack invalidates the connection (ref: server
+            # closes the socket; client reconnects).  An ADMISSION nack
+            # (canRetry, retryAfter set) sheds the op BEFORE the sequencer
+            # saw it: the connection and the client's clientSeq stream are
+            # both still valid — keep the socket, hand the nack up, and
+            # let the sender back off retryAfter and resubmit in place.
+            if not item.get("canRetry", False):
+                self.disconnect()
             if self._nack_listener is not None:
                 self._nack_listener(
                     Nack(

@@ -215,6 +215,43 @@ class Container:
         self.disconnect()
         self.connect()
 
+    def reconnect_with_backoff(
+        self,
+        sleep: Callable[[float], None] | None = None,
+        max_attempts: int = 16,
+    ) -> int:
+        """Reconnect honoring the nack/backoff contract: wait the advisory
+        jittered delay (floored at the server's ``retryAfter``) before each
+        attempt, retry transient failures, and give up when the connection
+        manager's cumulative-backoff deadline is exhausted.  Pending local
+        ops replay on the successful rejoin (the existing reconnect
+        machinery).  Returns the attempts taken; ``sleep`` is injectable so
+        deterministic harnesses can virtualize the clock."""
+        import time as _time
+
+        from ..driver.definitions import DriverError
+
+        sleep = _time.sleep if sleep is None else sleep
+        self.disconnect()
+        last: Exception | None = None
+        for attempt in range(1, max_attempts + 1):
+            self.delta_manager.wait_backoff(sleep)  # raises once exhausted
+            try:
+                self.connect()
+                return attempt
+            except (DriverError, OSError) as e:
+                if isinstance(e, DriverError) and not e.can_retry:
+                    # Fatal rejection (auth, protocol): no amount of
+                    # waiting readmits this client.
+                    raise
+                # The next iteration's wait_backoff computes an escalated
+                # delay itself (next_backoff_s is consumed/zeroed).
+                last = e
+        raise DriverError(
+            f"reconnect failed after {max_attempts} attempts: {last}",
+            can_retry=False,
+        )
+
     def escalate_to_write(self) -> None:
         """read → write escalation (ref connectionManager read/write modes):
         reconnect in write mode; parked local edits replay on join."""

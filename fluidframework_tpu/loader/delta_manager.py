@@ -134,6 +134,30 @@ class DeltaManager:
     def inbound_backlog(self) -> int:
         return len(self._pause_buffer)
 
+    # -------------------------------------------------------- nack backoff
+    def wait_backoff(self, sleep: Callable[[float], None]) -> float:
+        """Consume the connection manager's advisory reconnect delay (the
+        jittered, retry_after-floored value the last nack produced) through
+        the host-supplied clock; returns the delay waited.  Raises once the
+        cumulative backoff crosses the manager's deadline — a host looping
+        on this primitive cannot retry forever against a front that keeps
+        shedding it (the admission contract's client half)."""
+        cm = self.connection_manager
+        if cm.backoff_exhausted:
+            from ..driver.definitions import DriverError
+
+            raise DriverError(
+                f"reconnect backoff deadline exhausted after "
+                f"{cm.backoff.spent_s:.1f}s of accumulated waiting",
+                can_retry=False,
+            )
+        delay = cm.next_backoff_s
+        if delay <= 0.0:
+            delay = cm.backoff.next_delay(cm.last_retry_after_s)
+        sleep(delay)
+        cm.backoff.consume(delay)  # only time actually waited counts
+        cm.next_backoff_s = 0.0
+        return delay
 
     # ---------------------------------------- document adapter (runtime side)
     def connect(

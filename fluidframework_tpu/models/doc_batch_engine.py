@@ -82,7 +82,7 @@ from ..parallel import mesh as pm
 from ..parallel.mesh import doc_mesh, shard_docs
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters, Histogram, SampledTelemetryHelper
-from .staging import RowQueue, StagingRing
+from .staging import OverloadGate, RowQueue, StagingRing
 
 
 @dataclass
@@ -249,6 +249,8 @@ class DocBatchEngine:
         spare_slots: int = 0,
         telemetry=None,
         latency_sample_every: int = 16,
+        overload_high_watermark: int = 0,
+        overload_low_watermark: int = 0,
     ) -> None:
         assert recovery in ("grow", "oracle", "off")
         self.n_docs = n_docs
@@ -258,6 +260,16 @@ class DocBatchEngine:
         # donated dispatch (adaptive per dispatch — see _select_k).  K=1
         # preserves the per-slice dispatch behavior exactly.
         self.megastep_k = max(1, megastep_k)
+        # Ingest watermarks (credit-based flow control): the megastep
+        # budget is what one fused dispatch retires per doc; a queue deeper
+        # than ``overload_high`` watermarks the doc as overloaded (the
+        # consumer pauses its partition) until it drains to
+        # ``overload_low``.  Defaults: 8x / 1x the budget.
+        budget = self.megastep_k * ops_per_step
+        self.overload_gate = OverloadGate(
+            high=overload_high_watermark or 8 * budget,
+            low=overload_low_watermark or budget,
+        )
         self.recovery = recovery
         self.max_growths = max_growths
         self.hosts = [
@@ -1014,6 +1026,29 @@ class DocBatchEngine:
         return sum(len(h.queue) for h in self.hosts) + sum(
             len(l.queue) for l in self.overflow.values()
         )
+
+    # --------------------------------------------------------- flow control
+    def update_overload(self) -> tuple[list[int], list[int]]:
+        """Advance the ingest watermark hysteresis; -> (docs newly over the
+        high watermark, docs drained back under the low watermark).  The
+        consumer calls this once per pump and pauses/resumes per-partition
+        reads on the deltas; the gate's paused set IS the engine's overload
+        state (``health()['overload']``)."""
+        return self.overload_gate.update(
+            self._busy, lambda d: len(self.hosts[d].queue)
+        )
+
+    def ingest_watermarks(self) -> dict:
+        """The flow-control contract numbers: one megastep dispatch retires
+        ``megastep_budget`` rows per doc; pause at ``high``, resume at
+        ``low``."""
+        return self.overload_gate.watermarks(
+            self.megastep_k * self.ops_per_step
+        )
+
+    @property
+    def overloaded(self) -> bool:
+        return bool(self.overload_gate.paused)
 
     def _drain_into(
         self,
@@ -2049,6 +2084,12 @@ class DocBatchEngine:
         )
         self.counters.ratio(
             "steps_per_dispatch", "megastep_slices", "megastep_dispatches"
+        )
+        # Flow-control surface (graceful degradation; shared shape with
+        # the tree engine via OverloadGate.emit_gauges).
+        self.overload_gate.emit_gauges(
+            self.counters, self.megastep_k * self.ops_per_step,
+            max((len(self.hosts[d].queue) for d in self._busy), default=0),
         )
         # Mesh/placement surface: per-shard load for hot-shard detection
         # (applied since the last hot_shards reset + queued right now).

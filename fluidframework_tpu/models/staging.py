@@ -129,6 +129,64 @@ class RowQueue:
         self.head = self.tail = 0
 
 
+class OverloadGate:
+    """Per-doc ingest watermark hysteresis (credit-based flow control).
+
+    Both batched engines expose their staged-op pressure through one of
+    these: a doc whose RowQueue depth reaches ``high`` (sized in multiples
+    of the megastep budget — what one K-slice dispatch can retire) enters
+    the paused set, and leaves only when its depth drains to ``low`` — so
+    a consumer pausing/resuming per-partition reads at the gate never
+    flaps at the boundary.  ``update`` is O(busy + paused) per call and is
+    meant to run once per pump, not per message.
+    """
+
+    __slots__ = ("high", "low", "paused", "events")
+
+    def __init__(self, high: int, low: int) -> None:
+        assert 0 < low < high, (low, high)
+        self.high = high
+        self.low = low
+        self.paused: set[int] = set()
+        self.events = 0  # pause transitions (the overload_events counter)
+
+    def update(self, busy, depth_of) -> tuple[list[int], list[int]]:
+        """-> (newly paused docs, newly resumed docs).  ``busy`` is the
+        candidate set for NEW pauses (a doc over the high watermark is
+        necessarily busy); ``depth_of(doc) -> int`` reads queue depth."""
+        to_pause = [
+            d for d in busy
+            if d not in self.paused and depth_of(d) >= self.high
+        ]
+        for d in to_pause:
+            self.paused.add(d)
+        self.events += len(to_pause)
+        to_resume = [d for d in self.paused if depth_of(d) <= self.low]
+        for d in to_resume:
+            self.paused.discard(d)
+        return to_pause, to_resume
+
+    def watermarks(self, megastep_budget: int) -> dict:
+        """The flow-control contract numbers (ingest_watermarks surface
+        shared by both engines)."""
+        return {
+            "megastep_budget": megastep_budget,
+            "high": self.high,
+            "low": self.low,
+        }
+
+    def emit_gauges(self, counters, megastep_budget: int,
+                    queue_depth_max: int) -> None:
+        """The engines' shared health() surface for graceful degradation:
+        is any doc over its watermark, how many, how deep, and how many
+        pause transitions the gate has taken over the run."""
+        counters.gauge("megastep_budget", megastep_budget)
+        counters.gauge("overload", int(bool(self.paused)))
+        counters.gauge("overloaded_docs", len(self.paused))
+        counters.gauge("overload_events", self.events)
+        counters.gauge("queue_depth_max", queue_depth_max)
+
+
 class _StageBuf:
     __slots__ = ("ops", "payloads", "dirty", "inflight")
 

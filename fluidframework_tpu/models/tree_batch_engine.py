@@ -53,7 +53,7 @@ from ..ops import tree_kernel as tk
 from ..parallel import mesh as pm
 from ..protocol.messages import MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters
-from .staging import RowQueue, StagingRing
+from .staging import OverloadGate, RowQueue, StagingRing
 
 
 @dataclass
@@ -225,6 +225,8 @@ class TreeBatchEngine:
         megastep_k: int = 1,
         plan_cache: bool = True,
         telemetry=None,
+        overload_high_watermark: int = 0,
+        overload_low_watermark: int = 0,
     ) -> None:
         self.n_docs = n_docs
         self.capacity = capacity
@@ -235,6 +237,14 @@ class TreeBatchEngine:
         # slices fuse into one donated dispatch; K=1 is the exact
         # per-slice path.
         self.megastep_k = max(1, megastep_k)
+        # Ingest watermarks (same flow-control contract as the string
+        # engine): pause a doc's feed at 8x the megastep budget, resume
+        # once a dispatch's worth remains.
+        budget = self.megastep_k * ops_per_step
+        self.overload_gate = OverloadGate(
+            high=overload_high_watermark or 8 * budget,
+            low=overload_low_watermark or budget,
+        )
         self.hosts = [
             _TreeHost(queue=RowQueue(tk.NESTED_OP_FIELDS, max_insert_len))
             for _ in range(n_docs)
@@ -680,6 +690,23 @@ class TreeBatchEngine:
     def pending_ops(self) -> int:
         return sum(len(h.queue) for h in self.hosts)
 
+    # --------------------------------------------------------- flow control
+    def update_overload(self) -> tuple[list[int], list[int]]:
+        """Ingest watermark hysteresis (see doc_batch_engine): -> (newly
+        paused docs, newly resumed docs)."""
+        return self.overload_gate.update(
+            self._busy, lambda d: len(self.hosts[d].queue)
+        )
+
+    def ingest_watermarks(self) -> dict:
+        return self.overload_gate.watermarks(
+            self.megastep_k * self.ops_per_step
+        )
+
+    @property
+    def overloaded(self) -> bool:
+        return bool(self.overload_gate.paused)
+
     def device_fraction(self) -> float:
         """Fraction of ingested commits applied on the device path."""
         total = sum(h.total_commits for h in self.hosts)
@@ -947,6 +974,12 @@ class TreeBatchEngine:
         self.counters.gauge("recompiles", self.recompile_watchdog.recompiles)
         self.counters.gauge(
             "despecializations", self.recompile_watchdog.despecializations
+        )
+        # Flow-control surface (shared shape with the string engine via
+        # OverloadGate.emit_gauges).
+        self.overload_gate.emit_gauges(
+            self.counters, self.megastep_k * self.ops_per_step,
+            max((len(self.hosts[d].queue) for d in self._busy), default=0),
         )
         self.counters.gauge("n_shards", self.n_shards)
         if self.n_shards > 1:

@@ -27,6 +27,7 @@ that drives live doc migration (``engine.rebalance_hot_shards``).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import selectors
 import socket
@@ -71,6 +72,16 @@ class FleetConsumer:
         # restart/shutdown): the consumer is dead for those docs and its
         # supervisor should restart it.
         self.dead_socks: set[int] = set()
+        # Credit-based flow control: docs over the engine's high ingest
+        # watermark have their socket UNREGISTERED from the selector (no
+        # reads, socket kept open) until the queue drains below the low
+        # watermark — the backlog backs up into the kernel buffer and the
+        # server's outbound queue, where admission control sees it and
+        # starts shedding producers.  The engine's OverloadGate owns the
+        # hysteresis; this set mirrors which sockets are parked.
+        self.paused_socks: set[int] = set()
+        self.pump_pauses = 0
+        self.pump_resumes = 0
         self._sel = selectors.DefaultSelector()  # epoll: no FD_SETSIZE cap
         try:
             for doc_id in doc_ids:
@@ -132,6 +143,10 @@ class FleetConsumer:
         acked = False
         if len(self.dead_socks) == len(self._socks):
             return 0
+        # Resume first: queues drained by step() between pumps may have
+        # fallen below the low watermark — re-register those sockets so
+        # this very select sees their backlog.
+        self._apply_flow_control()
         ready = self._sel.select(wait_s)
         for key, _events in ready:
             idx, sock = key.data, key.fileobj
@@ -169,6 +184,11 @@ class FleetConsumer:
             acked = acked or b'"type":"summaryAck"' in feed
             staged += self.engine.ingest_lines(idx, feed)
         self.rows_staged += staged
+        if staged:
+            # Pause any doc this pass pushed over its high watermark BEFORE
+            # the next select, so one hot doc stops accumulating host-side
+            # the moment the megastep budget falls behind.
+            self._apply_flow_control()
         if acked:
             # Compact collab windows on the ack, not on a timer: the
             # scribe's durable floor just advanced, and every host's
@@ -176,6 +196,29 @@ class FleetConsumer:
             self.engine.compact()
             self.engine.counters.bump("msn_compactions")
         return staged
+
+    def _apply_flow_control(self) -> None:
+        """Advance the engine's watermark hysteresis and park/re-arm the
+        affected firehose sockets (per-partition pause/resume).  A paused
+        socket stays open — its unread broadcast accumulates in the kernel
+        buffer and the shard's outbound queue, which is exactly the signal
+        the front's admission control sheds producers on."""
+        to_pause, to_resume = self.engine.update_overload()
+        for d in to_pause:
+            if d in self.dead_socks or d in self.paused_socks:
+                continue
+            self.paused_socks.add(d)
+            self.pump_pauses += 1
+            with contextlib.suppress(KeyError, ValueError):
+                self._sel.unregister(self._socks[d])
+        for d in to_resume:
+            if d not in self.paused_socks:
+                continue
+            self.paused_socks.discard(d)
+            if d in self.dead_socks:
+                continue
+            self.pump_resumes += 1
+            self._sel.register(self._socks[d], selectors.EVENT_READ, d)
 
     def step(self) -> int:
         """Apply everything staged as one batched device step (the engine
@@ -191,6 +234,9 @@ class FleetConsumer:
             rows_staged=self.rows_staged,
             bytes_consumed=self.bytes_consumed,
             booted_docs=len(self.booted_docs),
+            paused_docs=len(self.paused_socks),
+            pump_pauses=self.pump_pauses,
+            pump_resumes=self.pump_resumes,
         )
         return out
 
@@ -199,6 +245,11 @@ class FleetConsumer:
         raises if the stream stays idle for ``max_idle_pumps`` passes."""
         idle = 0
         while self.rows_staged < expected_rows:
+            if self.paused_socks:
+                # A doc hit its ingest watermark: drain the backlog on
+                # device so the gate can re-arm its socket (the serving
+                # loop's step() plays this role in production).
+                self.step()
             if self.pump() == 0:
                 idle += 1
                 if idle >= max_idle_pumps:
@@ -211,19 +262,15 @@ class FleetConsumer:
 
     def _mark_dead(self, idx: int, sock: socket.socket) -> None:
         self.dead_socks.add(idx)
-        try:
+        # A paused (already-unregistered) socket can die too: suppress the
+        # double-unregister, keep the dead mark.
+        with contextlib.suppress(KeyError, ValueError):
             self._sel.unregister(sock)
-        except (KeyError, ValueError):
-            pass
 
     def close(self) -> None:
         for s in self._socks:
-            try:
+            with contextlib.suppress(OSError):
                 s.close()
-            except OSError:
-                pass
         self._socks = []
-        try:
+        with contextlib.suppress(OSError, AttributeError):
             self._sel.close()
-        except (OSError, AttributeError):
-            pass

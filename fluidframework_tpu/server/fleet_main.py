@@ -37,13 +37,18 @@ def status_snapshot(eng, doc_ids, rows=0, bytes_consumed=0, **extra) -> dict:
     flush = getattr(eng, "flush_telemetry", None)
     if flush is not None:
         flush()
+    health = eng.health()
     out = {
         "rows": rows,
         "bytes": bytes_consumed,
         "errors": int(errs.sum()),
-        "health": eng.health(),
+        "health": health,
         **extra,
     }
+    if health.get("overload"):
+        # Sustained-overload visibility at the top of the status line (the
+        # supervisor's graceful-degradation signal, next to error state).
+        out["overload"] = True
     if errs.any():
         out["errorDocs"] = [
             doc_ids[i] for i in range(len(doc_ids)) if errs[i]
@@ -217,7 +222,14 @@ def main(argv: list[str] | None = None) -> int:
     def status(**extra) -> None:
         print(json.dumps(status_snapshot(
             eng, doc_ids, rows=fc.rows_staged,
-            bytes_consumed=fc.bytes_consumed, **extra,
+            bytes_consumed=fc.bytes_consumed,
+            # Consumer-side flow control (the engine's overload gauges
+            # ride inside health): which partitions are paused right now
+            # and how often the gate cycled.
+            paused_docs=len(fc.paused_socks),
+            pump_pauses=fc.pump_pauses,
+            pump_resumes=fc.pump_resumes,
+            **extra,
         )), flush=True)
 
     last_status = time.monotonic()
@@ -254,7 +266,10 @@ def main(argv: list[str] | None = None) -> int:
                     doc_ids[i] for i in fc.dead_socks
                 ))
                 return 1
-            if staged:
+            if staged or fc.paused_socks:
+                # Paused partitions mean staged backlog over the watermark:
+                # keep stepping so the gate can re-arm those sockets, even
+                # when this pump read nothing (flow control, not idleness).
                 fc.step()
             else:
                 time.sleep(args.idle_sleep)

@@ -21,6 +21,7 @@ or programmatically:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import select
@@ -39,12 +40,35 @@ class Shard:
     http_port: int = 0
     proc: subprocess.Popen | None = None
     restarts: int = 0
+    # Crash-loop bookkeeping (supervisor-owned, read under the deployment
+    # lock): recent crash timestamps inside the detection window, whether
+    # the current death has been counted (``proc`` stays set while the
+    # respawn backoff runs — readers keep a stable handle), the earliest
+    # monotonic time the next respawn may run, whether the budget is
+    # exhausted (respawns stop), and the last spawn failure.
+    crash_times: list = field(default_factory=list)
+    crash_acked: bool = False
+    next_restart_at: float = 0.0
+    backoff_s: float = 0.0
+    crash_looped: bool = False
+    last_error: str = ""
 
 
 @dataclass
 class Deployment:
     shards: list[Shard]
     supervise: bool = False
+    # Restart budget (crash-loop detection): more than ``restart_budget``
+    # crashes inside ``crash_window_s`` marks the shard crash-looped and
+    # the supervisor STOPS respawning it — an endlessly dying member must
+    # surface in the manifest, not burn ports/CPU relaunching forever.
+    # Respawns inside the window back off exponentially
+    # (``restart_backoff_s`` doubling up to ``max_restart_backoff_s``);
+    # a shard that stays up past the window resets both.
+    restart_budget: int = 5
+    crash_window_s: float = 60.0
+    restart_backoff_s: float = 0.5
+    max_restart_backoff_s: float = 8.0
     _stopping: bool = field(default=False, repr=False)
     _thread: threading.Thread | None = field(default=None, repr=False)
     # Guards shard records (proc/port/http_port/restarts) against the
@@ -68,8 +92,17 @@ class Deployment:
                         "name": s.name,
                         "port": s.port,
                         "httpPort": s.http_port,
-                        "pid": s.proc.pid if s.proc else None,
+                        # A live pid only: a crash-looped / dying shard's
+                        # stale pid must not read as a running member.
+                        "pid": (
+                            s.proc.pid
+                            if s.proc is not None and s.proc.poll() is None
+                            else None
+                        ),
                         "restarts": s.restarts,
+                        "crashLooped": s.crash_looped,
+                        **({"lastError": s.last_error}
+                           if s.last_error else {}),
                     }
                     for s in self.shards
                 ]
@@ -101,6 +134,27 @@ class Deployment:
                     except subprocess.TimeoutExpired:
                         s.proc.kill()
 
+    def _record_crash(self, s: Shard, now: float) -> bool:
+        """Account one crash (process death OR failed spawn) against the
+        shard's sliding-window budget; returns False when the budget
+        tripped (shard marked crash-looped, no further respawns).  On
+        True, ``next_restart_at``/``backoff_s`` hold the escalated
+        respawn schedule (first crash after a quiet window restarts
+        immediately)."""
+        s.crash_times = [
+            t for t in s.crash_times if now - t < self.crash_window_s
+        ] + [now]
+        if len(s.crash_times) > self.restart_budget:
+            s.crash_looped = True
+            return False
+        if len(s.crash_times) == 1:
+            s.backoff_s = self.restart_backoff_s
+            s.next_restart_at = now
+        else:
+            s.next_restart_at = now + s.backoff_s
+            s.backoff_s = min(2 * s.backoff_s, self.max_restart_backoff_s)
+        return True
+
     def _supervise_loop(self) -> None:
         while not self._stopping:
             for s in self.shards:
@@ -109,17 +163,46 @@ class Deployment:
                 with self._lock:
                     if self._stopping:
                         break
-                    if s.proc is not None and s.proc.poll() is not None:
-                        # Crashed member: relaunch on the SAME ports so
-                        # clients reconnect without re-routing (compose
-                        # restart policy).  Held lock spans the respawn:
-                        # routing sees the old record or the fresh one,
-                        # never a half-written port pair.
+                    if s.crash_looped:
+                        continue
+                    now = time.monotonic()
+                    if (
+                        not s.crash_acked
+                        and s.proc is not None
+                        and s.proc.poll() is not None
+                    ):
+                        # Crash acknowledged (once per death): budget
+                        # check over the sliding window — a shard that
+                        # keeps dying is crash-looping, so STOP respawning
+                        # it and surface that in the manifest instead of
+                        # hammering the same ports forever.  Repeat
+                        # crashes inside the window respawn only after an
+                        # exponentially backed-off delay; the first crash
+                        # after a quiet period restarts immediately.
+                        s.crash_acked = True
+                        self._record_crash(s, now)
+                        continue
+                    if s.crash_acked and now >= s.next_restart_at:
+                        # Respawn on the SAME ports so clients reconnect
+                        # without re-routing (compose restart policy).
+                        # Held lock spans the respawn: routing sees the
+                        # old record or the fresh one, never a
+                        # half-written port pair.
                         s.restarts += 1
                         try:
                             _spawn(s, abort=lambda: self._stopping)
-                        except Exception:
-                            pass  # next tick retries; supervisor never dies
+                            s.last_error = ""
+                            s.crash_acked = False
+                        except Exception as e:
+                            # A failed spawn IS a crash for budget
+                            # purposes: a shard dying before its
+                            # readiness line must trip crash_looped the
+                            # same as one dying after it — otherwise it
+                            # respawns forever at the backoff cap.  The
+                            # due tick retries (supervisor never dies);
+                            # the failure is visible in the manifest.
+                            s.last_error = repr(e)[-200:]
+                            self._record_crash(s, time.monotonic())
             time.sleep(0.2)
 
 
@@ -185,11 +268,10 @@ def _spawn(shard: Shard, attempts: int = 10, abort=None) -> None:
 
 
 def _drain(stream) -> None:
-    try:
+    # Suppress, not handle: the pipe closing mid-iteration IS shutdown.
+    with contextlib.suppress(ValueError, OSError):
         for _line in stream:
             pass
-    except (ValueError, OSError):
-        pass  # stream closed at shutdown
 
 
 def launch(config: dict, supervise: bool = False) -> Deployment:
@@ -203,7 +285,14 @@ def launch(config: dict, supervise: bool = False) -> Deployment:
         )
         for i, entry in enumerate(config.get("shards", [{}]))
     ]
-    dep = Deployment(shards=shards, supervise=supervise)
+    dep = Deployment(
+        shards=shards,
+        supervise=supervise,
+        restart_budget=int(config.get("restartBudget", 5)),
+        crash_window_s=float(config.get("crashWindowS", 60.0)),
+        restart_backoff_s=float(config.get("restartBackoffS", 0.5)),
+        max_restart_backoff_s=float(config.get("maxRestartBackoffS", 8.0)),
+    )
     try:
         for s in shards:
             _spawn(s)

@@ -32,7 +32,7 @@ from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from ..protocol.messages import SequencedMessage, UnsequencedMessage
+from ..protocol.messages import MessageType, SequencedMessage, UnsequencedMessage
 from .local_service import LocalService
 
 
@@ -75,7 +75,11 @@ class _QueuedWriter:
     Broadcast fan-out runs under the service lock; a consumer draining
     slower than the stream produces would otherwise block the whole plane
     on a full socket buffer (the reference's socket.io fronts buffer
-    outbound the same way)."""
+    outbound the same way).  ``backlog`` is the admission controller's
+    consumer-pressure signal: a fleet that paused this partition at its
+    ingest watermark stops draining the socket, the kernel buffer fills,
+    the writer thread blocks, and the depth here starts counting — the
+    downstream credit deficit made visible to the front."""
 
     def __init__(self, session: "_ClientSession") -> None:
         self._session = session
@@ -84,6 +88,11 @@ class _QueuedWriter:
         self._closed = False
         self._thread = threading.Thread(target=self._drain, daemon=True)
         self._thread.start()
+
+    @property
+    def backlog(self) -> int:
+        """Queued-but-unsent chunk count (len() on a deque is atomic)."""
+        return len(self._q)
 
     def send_raw(self, data: bytes) -> None:
         with self._cv:
@@ -114,36 +123,45 @@ class _NexusHandler(socketserver.StreamRequestHandler):
         server: NetworkServer = self.server.owner  # type: ignore[attr-defined]
         session = _ClientSession(self)
         try:
-            for raw in self.rfile:
-                line = raw.strip()
-                if not line:
-                    continue
-                try:
-                    req = json.loads(line)
-                except json.JSONDecodeError:
-                    session.send({"t": "error", "reason": "bad json", "canRetry": False})
-                    continue
-                kind = req.get("t")
-                if kind == "connect":
-                    server.handle_connect(session, req)
-                elif kind == "consume":
-                    server.handle_consume(session, req)
-                elif kind == "submit":
-                    server.handle_submit(session, req)
-                elif kind == "signal":
-                    server.handle_signal(session, req)
-                elif kind == "sync":
-                    # Echo AFTER everything already broadcast on this socket:
-                    # the client's deterministic quiescence marker.
-                    session.send({"t": "sync", "n": req.get("n", 0)})
-                elif kind == "disconnect":
-                    break
-                else:
-                    session.send(
-                        {"t": "error", "reason": f"unknown op {kind!r}", "canRetry": False}
-                    )
+            self._read_loop(server, session)
+        except OSError:
+            # Torn peer mid-read (abrupt client death, chaos torn-socket):
+            # normal teardown, counted for the overload/chaos surface —
+            # the finally broadcasts the leave via drop_session.
+            with server.lock:
+                server.torn_sockets += 1
         finally:
             server.drop_session(session)
+
+    def _read_loop(self, server: "NetworkServer", session) -> None:
+        for raw in self.rfile:
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except json.JSONDecodeError:
+                session.send({"t": "error", "reason": "bad json", "canRetry": False})
+                continue
+            kind = req.get("t")
+            if kind == "connect":
+                server.handle_connect(session, req)
+            elif kind == "consume":
+                server.handle_consume(session, req)
+            elif kind == "submit":
+                server.handle_submit(session, req)
+            elif kind == "signal":
+                server.handle_signal(session, req)
+            elif kind == "sync":
+                # Echo AFTER everything already broadcast on this socket:
+                # the client's deterministic quiescence marker.
+                session.send({"t": "sync", "n": req.get("n", 0)})
+            elif kind == "disconnect":
+                break
+            else:
+                session.send(
+                    {"t": "error", "reason": f"unknown op {kind!r}", "canRetry": False}
+                )
 
 
 class NetworkServer:
@@ -159,9 +177,20 @@ class NetworkServer:
         service: LocalService | None = None,
         port: int = 0,
         lock: threading.RLock | None = None,
+        admission=None,
     ) -> None:
         self.service = service if service is not None else LocalService()
         self.lock = lock if lock is not None else threading.RLock()
+        # Optional submit admission control (server/admission.py): when
+        # set, overloaded documents nack submits with a load-derived
+        # retryAfter instead of ticketing them (deli's throttling nack).
+        self.admission = admission
+        # doc_id -> live firehose writers (the consumer-backlog signal).
+        self._doc_consumers: dict[str, list[_QueuedWriter]] = {}
+        # Peers that vanished mid-read without a disconnect handshake
+        # (abrupt client death / chaos torn sockets) — a fault-visibility
+        # counter, surfaced through service_stats.
+        self.torn_sockets = 0
 
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -281,6 +310,7 @@ class NetworkServer:
             # path must never block on this socket's buffer.
             writer = _QueuedWriter(session)
             session.consumer_writer = writer
+            self._doc_consumers.setdefault(doc_id, []).append(writer)
             # Envelope ack first; everything after it on this socket is raw.
             writer.send_raw((json.dumps({"t": "consuming", "doc": doc_id}) + "\n").encode())
             # Catch-up: the already-delivered prefix (pending-delivery msgs
@@ -295,12 +325,64 @@ class NetworkServer:
                 lambda msg, w=writer: w.send_raw(msg.wire_line()),
             )
 
+    def consumer_backlog(self, doc_id: str) -> int:
+        """Deepest outbound firehose queue for the document (caller holds
+        the lock): the downstream-credit signal the admission check reads."""
+        writers = self._doc_consumers.get(doc_id)
+        if not writers:
+            return 0
+        return max(w.backlog for w in writers)
+
+    @staticmethod
+    def doc_pressure(doc) -> int:
+        """The admission check's sequencer-side load signal: un-broadcast
+        backlog OR the uncompacted collab-window depth (seq - MSN),
+        whichever is deeper.  The network front broadcasts synchronously
+        (pending_count is ~always 0 here), so the window is the signal
+        that actually moves: it grows while any connected client lags
+        applying — ingest outrunning the fleet — and recovers as client
+        refSeqs (and therefore the MSN) catch up."""
+        seqr = doc.sequencer
+        return max(doc.pending_count, seqr.seq - seqr.min_seq)
+
     def handle_submit(self, session: _ClientSession, req: dict) -> None:
         with self.lock:
             if session.doc_id is None:
                 session.send({"t": "error", "reason": "submit before connect", "canRetry": False})
                 return
             doc = self.service.document(session.doc_id)
+            if self.admission is not None and (
+                req["msg"].get("type", MessageType.OP) != MessageType.NOOP
+            ):
+                # NOOPs always admit: they carry no content, advance the
+                # sender's refSeq (and therefore the MSN), and are exactly
+                # how a backed-off client helps the collab window — and
+                # the overload — shrink.  Shedding them would livelock the
+                # window signal at its high watermark.
+                retry = self.admission.admit(
+                    session.doc_id,
+                    pending=self.doc_pressure(doc),
+                    consumer_backlog=self.consumer_backlog(session.doc_id),
+                )
+                if retry is not None:
+                    # Shed at the door: the op never reaches the sequencer,
+                    # so the client's clientSeq is still valid — it backs
+                    # off retryAfter and resubmits THE SAME op on the SAME
+                    # connection (canRetry; no teardown, no rejoin churn).
+                    # The nack needs only the id pair: shedding must stay
+                    # cheap under the very overload it exists for, so the
+                    # wire decode happens only for ADMITTED ops.
+                    wire = req["msg"]
+                    session.send({
+                        "t": "nack",
+                        "clientId": wire.get("clientId"),
+                        "clientSeq": wire.get("clientSequenceNumber", 0),
+                        "reason": "overloaded: submit shed by admission "
+                                  "control",
+                        "retryAfter": retry,
+                        "canRetry": True,
+                    })
+                    return
             msg = UnsequencedMessage.from_json(json.dumps(req["msg"]))
             doc.submit(msg)
             doc.process_all()  # network mode: broadcast as ticketed
@@ -317,6 +399,10 @@ class NetworkServer:
         with self.lock:
             if session.consumer_writer is not None:
                 session.consumer_writer.close()
+                if session.doc_id is not None:
+                    writers = self._doc_consumers.get(session.doc_id, [])
+                    if session.consumer_writer in writers:
+                        writers.remove(session.consumer_writer)
             if session.doc_id is not None and session.client_id is not None:
                 doc = self.service.document(session.doc_id)
                 doc.disconnect(session.client_id)
@@ -504,9 +590,18 @@ class _AlfredHandler(BaseHTTPRequestHandler):
 
 
 class HttpFront:
-    def __init__(self, service: LocalService, lock: threading.RLock, port: int = 0) -> None:
+    def __init__(
+        self,
+        service: LocalService,
+        lock: threading.RLock,
+        port: int = 0,
+        nexus: "NetworkServer | None" = None,
+    ) -> None:
         self.service = service
         self.lock = lock
+        # The co-deployed TCP front (when any): source of the per-doc
+        # consumer-backlog and admission/overload surfaces in stats.
+        self.nexus = nexus
         self._started = time.monotonic()
         self._http = ThreadingHTTPServer(("127.0.0.1", port), _AlfredHandler)
         self._http.owner = self  # type: ignore[attr-defined]
@@ -518,17 +613,32 @@ class HttpFront:
         lock): per-doc sequencer log depth, pending delivery, clients —
         the ordered-log depth surface of the metrics plane."""
         docs = {}
+        nexus = self.nexus
+        admission = nexus.admission if nexus is not None else None
         for doc_id, doc in self.service._docs.items():
-            docs[doc_id] = {
+            row = {
                 "log_depth": len(doc.sequencer.log),
                 "pending": doc.pending_count,
+                "window": doc.sequencer.seq - doc.sequencer.min_seq,
                 "clients": len(doc.sequencer.clients()),
             }
-        return {
+            if nexus is not None:
+                row["consumer_backlog"] = nexus.consumer_backlog(doc_id)
+            if admission is not None:
+                row.update(admission.doc_stats(doc_id))
+            docs[doc_id] = row
+        out = {
             "uptime_s": round(time.monotonic() - self._started, 3),
             "n_docs": len(docs),
             "docs": docs,
         }
+        if nexus is not None:
+            out["torn_sockets"] = nexus.torn_sockets
+        if admission is not None:
+            # Graceful-degradation surface: the front's overload state and
+            # shed-op totals, scrapeable (/metrics) and curl-able (/status).
+            out["admission"] = admission.stats()
+        return out
 
     def start(self) -> "HttpFront":
         self._thread.start()
@@ -543,9 +653,12 @@ class ServicePlane:
     """Both fronts over one shared core: the deployable unit (tinylicious
     analog).  ``ports`` are assigned when 0 (tests use ephemeral ports)."""
 
-    def __init__(self, port: int = 0, http_port: int = 0) -> None:
-        self.nexus = NetworkServer(port=port)
-        self.http = HttpFront(self.nexus.service, self.nexus.lock, port=http_port)
+    def __init__(self, port: int = 0, http_port: int = 0, admission=None) -> None:
+        self.nexus = NetworkServer(port=port, admission=admission)
+        self.http = HttpFront(
+            self.nexus.service, self.nexus.lock, port=http_port,
+            nexus=self.nexus,
+        )
 
     @property
     def service(self) -> LocalService:
@@ -565,11 +678,30 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--port", type=int, default=7070)
     p.add_argument("--http-port", type=int, default=0)
+    p.add_argument("--max-pending", type=int, default=0,
+                   help="admission control: nack submits with retryAfter "
+                        "when a doc's sequencer pressure (un-broadcast "
+                        "backlog or uncompacted collab-window depth, "
+                        "seq - MSN) exceeds this (0 = no admission "
+                        "control)")
+    p.add_argument("--max-consumer-backlog", type=int, default=0,
+                   help="admission control: nack submits when a doc's "
+                        "deepest firehose consumer backlog exceeds this "
+                        "(0 = signal disabled)")
     args = p.parse_args()
     http_port = args.http_port
     if not http_port:
         http_port = args.port + 1 if args.port else 0  # ephemeral stays ephemeral
-    plane = ServicePlane(port=args.port, http_port=http_port)
+    admission = None
+    if args.max_pending or args.max_consumer_backlog:
+        from .admission import AdmissionConfig, AdmissionController
+
+        admission = AdmissionController(AdmissionConfig(
+            max_pending=args.max_pending,
+            max_consumer_backlog=args.max_consumer_backlog,
+        ))
+    plane = ServicePlane(port=args.port, http_port=http_port,
+                         admission=admission)
     plane.start()
     # Readiness line for process supervisors / tests.
     print(json.dumps({"port": plane.nexus.port, "httpPort": plane.http.port}), flush=True)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -180,12 +181,21 @@ class DurablePartition(Partition):
                 f.truncate(min(good_bytes, os.path.getsize(path)))
         self._file = open(path, "a")
 
+    # Chaos fault hook (testing/chaos.py "delayed partition fsync"): when
+    # > 0, every durable append stalls this long AFTER the flush —
+    # simulating slow durable media.  Correctness must not depend on append
+    # latency (acks externalize only after their own fsync elsewhere), so
+    # the soak asserts the stack merely slows down, never diverges.
+    fault_flush_delay_s: float = 0.0
+
     def append(self, doc_id: str, payload: Any) -> int:
         off = super().append(doc_id, payload)
         self._file.write(
             json.dumps({"doc": doc_id, "payload": self._encode(payload)}) + "\n"
         )
         self._file.flush()
+        if self.fault_flush_delay_s > 0.0:
+            time.sleep(self.fault_flush_delay_s)
         return off
 
     def truncate_below(self, offset: int) -> int:
@@ -250,6 +260,14 @@ class DurableTopic(Topic):
         """Eagerly open every partition (reload all segments on recovery)."""
         for i in range(self.n_partitions):
             self.partition(i)
+
+    def set_fault_flush_delay(self, delay_s: float) -> None:
+        """Chaos fault hook: stall every partition's durable appends by
+        ``delay_s`` (0 clears) — the 'slow disk' schedule event."""
+        self.open_all()
+        for p in self.partitions.values():
+            if isinstance(p, DurablePartition):
+                p.fault_flush_delay_s = delay_s
 
     def close(self) -> None:
         for p in self.partitions.values():

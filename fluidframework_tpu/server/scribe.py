@@ -60,6 +60,13 @@ from .ordered_log import ConsumerGroup, Topic, atomic_json_dump
 FAMILIES = ("doc_batch", "tree_batch", "map_batch", "matrix_batch")
 
 
+class ChaosCrash(RuntimeError):
+    """Deliberate mid-fold crash (testing/chaos.py scribe fault): raised
+    from inside ``pump`` BEFORE any offset commit, so everything the
+    incarnation folded past the committed floor dies with it — the exact
+    crash point the at-least-once discipline exists for."""
+
+
 class ScribeConfig:
     """RunningSummarizer-style heuristics, per document (ref
     ISummaryConfiguration): summarize once ``max_ops`` ops OR ``max_bytes``
@@ -584,6 +591,12 @@ class ScribeLambda:
         # (missing/unloadable commit): _ref_for must not resurrect them
         # from disk — the drop forces a full replay on purpose.
         self._dropped_refs: set[str] = set()
+        # Chaos fault hook: when > 0, pump raises ChaosCrash after folding
+        # this many more records — mid-fold, before any offset commit.
+        self.chaos_abort_after_folds = 0
+        # Partitions this member folded last pump: a GAIN (rebalance /
+        # first pump) triggers stale-replica validation — see pump().
+        self._owned: set[int] = set()
         self._restore()
 
     # ---------------------------------------------------------------- restore
@@ -675,6 +688,46 @@ class ScribeLambda:
                 self.refs[doc_id] = dict(ref)
         return ref
 
+    def _disk_ref(self, doc_id: str) -> dict | None:
+        """The doc's ref as PERSISTED (shared refs.json), bypassing this
+        member's in-memory view — the in-memory ref can itself be stale
+        for docs whose partitions a peer owned (we never consume their
+        ack records), which is exactly when the truth matters."""
+        if not os.path.exists(self._refs_path):
+            return None
+        try:
+            with open(self._refs_path) as f:
+                return json.load(f).get(doc_id)
+        except (json.JSONDecodeError, OSError):
+            return None
+
+    def _validate_replicas_on_gain(self, gained: set) -> None:
+        """Rebalance hygiene: taking over a partition, drop any in-memory
+        replica whose PERSISTED acked floor ran ahead of what this member
+        folded.  Such a replica went stale while a peer owned the
+        partition (we restored it at an old summary and never folded — we
+        do not consume ack records for partitions we don't own), and the
+        committed floor has already advanced past the ops it is missing:
+        folding the tail onto it would silently gap the state (quorum
+        KeyErrors / position errors at best, a corrupt next summary at
+        worst).  Dropping it makes the next op re-adopt the CURRENT acked
+        summary — the partition-handoff resume, now crash-shape-proof."""
+        for doc_id in list(self.docs):
+            if self.topic.partition_for(doc_id) not in gained:
+                continue
+            ad = self.docs[doc_id]
+            ref = self._disk_ref(doc_id)
+            if ref is None or int(ref["seq"]) <= ad.last_seq:
+                # Current (or ahead: crash re-read resumes over it) — and
+                # with no fresher ref there is nothing safer to adopt.
+                continue
+            del self.docs[doc_id]
+            self.chains.pop(doc_id, None)
+            self._channel_sha.pop(doc_id, None)
+            self._uncovered.pop(doc_id, None)
+            self.refs[doc_id] = dict(ref)  # adopt the fresh floor
+            self.counters.bump("stale_replicas_dropped")
+
     def _adopt_summary(self, doc_id: str, family: str):
         """A doc's starting replica for this member: loaded from its latest
         acked summary when one is reachable (shared refs + object store) —
@@ -722,7 +775,16 @@ class ScribeLambda:
         n = 0
         next_offsets: dict[int, int] = {}
         touched: set[str] = set()
-        for p in self.group.assignments(self.member_id):
+        assigned = set(self.group.assignments(self.member_id))
+        gained = assigned - self._owned
+        if gained:
+            # Newly-owned partitions (rebalance, or the first pump): any
+            # in-memory replica that went stale while a peer owned its
+            # partition must re-adopt the peer's acked summary, not have
+            # the tail folded onto missing state.
+            self._validate_replicas_on_gain(gained)
+        self._owned = assigned
+        for p in sorted(assigned):
             part = self.topic.partition(p)
             start = self._positions.get(p, self.group.committed(p))
             if start < part.base:
@@ -739,6 +801,17 @@ class ScribeLambda:
                     elif isinstance(msg, SequencedMessage):
                         self._fold(rec.doc_id, msg, rec.offset)
                         touched.add(rec.doc_id)
+                    if self.chaos_abort_after_folds > 0:
+                        self.chaos_abort_after_folds -= 1
+                        if self.chaos_abort_after_folds == 0:
+                            # Crash mid-fold, AFTER folding this record
+                            # and BEFORE any position/offset commit: the
+                            # folded-but-unsummarized state dies with the
+                            # member and must be re-read exactly.
+                            raise ChaosCrash(
+                                f"injected crash mid-fold (partition {p},"
+                                f" offset {rec.offset})"
+                            )
                     start = rec.offset + 1
                     n += 1
             self._positions[p] = next_offsets[p] = start

@@ -27,7 +27,7 @@ import pytest
 from fluidframework_tpu.analysis import cli as check_cli
 from fluidframework_tpu.analysis.core import Baseline, load_package
 from fluidframework_tpu.analysis import (
-    determinism, donation, jit_safety, layer_check, threads,
+    determinism, donation, jit_safety, layer_check, swallowed, threads,
 )
 
 REPO = Path(__file__).resolve().parent.parent
@@ -613,6 +613,119 @@ def test_threads_module_function_target(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Pass 6: swallowed-exception
+# ---------------------------------------------------------------------------
+
+SWALLOWED_LAYERS = {
+    "layers": [
+        {"name": "state", "packages": ["low"]},
+        {"name": "host", "packages": ["mid"]},
+        {"name": "service", "packages": ["high"]},
+    ],
+    "determinism_scope": [],
+}
+
+
+def _swallowed_pkg(tmp_path, files):
+    pkg = make_pkg(tmp_path, files)
+    (pkg / "analysis" / "layers.json").write_text(json.dumps(SWALLOWED_LAYERS))
+    return pkg
+
+
+def test_swallowed_exception_fires_in_host_and_service_layers(tmp_path):
+    body = (
+        "def f(g):\n"
+        "    try:\n"
+        "        g()\n"
+        "    except (OSError, ValueError):\n"
+        "        pass\n"
+    )
+    pkg = _swallowed_pkg(tmp_path, {
+        "low/util.py": body,   # state layer: out of scope by design
+        "mid/drv.py": body,    # host layer: flagged
+        "high/svc.py": body,   # service layer: flagged
+    })
+    found = swallowed.run(
+        load_package(pkg),
+        layer_check.load_layers(pkg / "analysis/layers.json"),
+    )
+    assert [f.rule for f in found] == ["swallowed-exception"] * 2
+    assert sorted(f.file for f in found) == [
+        "fixturepkg/high/svc.py", "fixturepkg/mid/drv.py",
+    ]
+    assert all("except (OSError, ValueError): pass in f" == f.detail
+               for f in found)
+    assert all(f.line == 4 for f in found)
+
+
+def test_swallowed_exception_good_twins_silent(tmp_path):
+    pkg = _swallowed_pkg(tmp_path, {
+        # Counting, re-raising, returning, suppress(): all observable or
+        # explicitly-intentional — none is a silent swallow.
+        "high/svc.py": (
+            "import contextlib\n"
+            "def counted(g, c):\n"
+            "    try:\n"
+            "        g()\n"
+            "    except OSError:\n"
+            "        c.errors += 1\n"
+            "def reraised(g):\n"
+            "    try:\n"
+            "        g()\n"
+            "    except OSError:\n"
+            "        raise RuntimeError('boom')\n"
+            "def returned(g):\n"
+            "    try:\n"
+            "        g()\n"
+            "    except OSError:\n"
+            "        return None\n"
+            "def suppressed(g):\n"
+            "    with contextlib.suppress(OSError):\n"
+            "        g()\n"
+        ),
+    })
+    found = swallowed.run(
+        load_package(pkg),
+        layer_check.load_layers(pkg / "analysis/layers.json"),
+    )
+    assert found == []
+
+
+def test_swallowed_exception_bare_except_and_module_level(tmp_path):
+    pkg = _swallowed_pkg(tmp_path, {
+        "mid/drv.py": (
+            "try:\n"
+            "    import optional_thing\n"
+            "except ImportError:\n"
+            "    pass\n"
+        ),
+    })
+    found = swallowed.run(
+        load_package(pkg),
+        layer_check.load_layers(pkg / "analysis/layers.json"),
+    )
+    assert [f.detail for f in found] == [
+        "except ImportError: pass in <module>"
+    ]
+
+
+def test_swallowed_exception_explicit_scope_must_name_real_layers(tmp_path):
+    """The committed layers.json pins ``swallowed_scope`` explicitly: a
+    layer reshuffle that orphans a scoped name must fail loudly, never
+    silently narrow the pass to nothing."""
+    pkg = make_pkg(tmp_path, {"low/util.py": "X = 1\n"})
+    with pytest.raises(ValueError, match="unknown layer"):
+        swallowed.run(
+            load_package(pkg),
+            layer_check.load_layers(pkg / "analysis/layers.json"),
+            scope_names=["host", "service"],
+        )
+    # And the real package's layers.json does pin it.
+    real_cfg = json.loads((PKG / "analysis" / "layers.json").read_text())
+    assert real_cfg.get("swallowed_scope") == ["host", "service"]
+
+
+# ---------------------------------------------------------------------------
 # Baseline round-trip
 # ---------------------------------------------------------------------------
 
@@ -757,6 +870,15 @@ SEEDINGS = [
          "            time.sleep(0.2)",
          "            self.shards[0].restarts += 1\n            time.sleep(0.2)"),
      "thread-unlocked-write", "threads"),
+    ("server/fleet_main.py",
+     lambda s: s + (
+         "\n\ndef _seeded_swallow(fc):\n"
+         "    try:\n"
+         "        fc.step()\n"
+         "    except RuntimeError:\n"
+         "        pass\n"
+     ),
+     "swallowed-exception", "swallowed-exception"),
 ]
 
 

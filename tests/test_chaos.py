@@ -1,0 +1,497 @@
+"""End-to-end backpressure + chaos harness tests (ISSUE 10).
+
+Tier-1 pieces:
+
+- seeded chaos schedules are deterministic and JSON round-trip;
+- ``Nack.retry_after`` really crosses the wire (submit shed by admission
+  control -> client receives the exact float, connection survives);
+- credit-based flow control: with ingest deliberately outrunning the
+  megastep budget, the consumer pauses the partition at the high
+  watermark, staged depth stays bounded, the front's /metrics exposes the
+  overload surface, and everything drains byte-identically once stepping
+  resumes;
+- the loader honors the nack/backoff contract: jittered retry_after-
+  floored reconnect delays, a deadline, and pending-op replay on
+  readmission;
+- the chaos smoke: a short seeded schedule (fleet member kill + torn
+  sockets/disconnect churn + a nack storm) over the real composed stack
+  converges byte-identical to a fault-free oracle replay with no
+  double-acks.
+
+Full multi-seed soak schedules (every fault kind, longer runs) ride behind
+``-m slow``.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from fluidframework_tpu.server.admission import AdmissionConfig, AdmissionController
+from fluidframework_tpu.testing.chaos import (
+    ChaosEvent,
+    ChaosSchedule,
+    make_schedule,
+    run_chaos,
+    run_soak,
+)
+
+DOCS = ["cd0", "cd1", "cd2"]
+
+
+# ---------------------------------------------------------------------------
+# Schedule determinism
+# ---------------------------------------------------------------------------
+
+def test_schedule_seeded_deterministic_and_round_trips():
+    a = make_schedule(11, 40, DOCS)
+    b = make_schedule(11, 40, DOCS)
+    c = make_schedule(12, 40, DOCS)
+    assert a.to_json() == b.to_json()
+    assert a.to_json() != c.to_json()
+    back = ChaosSchedule.from_json(a.to_json())
+    assert back.seed == 11 and back.events == a.events
+    kinds = {e.kind for e in a.events}
+    assert {"fleet_kill", "torn_socket", "nack_storm", "scribe_kill",
+            "scribe_crash", "fsync_delay", "fsync_clear"} <= kinds
+    assert all(0 < e.tick < 40 for e in a.events)
+
+
+# ---------------------------------------------------------------------------
+# Nack.retry_after on the wire
+# ---------------------------------------------------------------------------
+
+def test_nack_retry_after_round_trips_wire():
+    """The wire contract for admission nacks: the shed submit comes back
+    as a nack carrying the server's load-derived retryAfter float and
+    canRetry — the connection survives, and resubmitting the SAME op
+    (same clientSeq) then sequences."""
+    from fluidframework_tpu.dds.shared_string import SharedString
+    from fluidframework_tpu.driver.network_driver import NetworkDeltaConnection
+    from fluidframework_tpu.server.netserver import ServicePlane
+
+    admission = AdmissionController(AdmissionConfig(base_retry_after_s=1.375))
+    plane = ServicePlane(admission=admission).start()
+    nacks = []
+    try:
+        ss = SharedString(client_id="w0")
+        conn = NetworkDeltaConnection(
+            "127.0.0.1", plane.nexus.port, "dr", "w0", "write",
+            listener=ss.process, nack_listener=nacks.append,
+            signal_listener=None,
+        )
+        conn.sync()
+        assert ss.short_client >= 0
+        admission.force_overload("dr", 1)
+        ss.insert_text(0, "hello")
+        (msg,) = ss.take_outbox()
+        conn.submit(msg)
+        conn.sync()
+        # The shed came back as a retryable nack with the EXACT float the
+        # server computed — the previously dead field, live on the wire.
+        assert len(nacks) == 1
+        assert nacks[0].retry_after == 1.375
+        assert nacks[0].client_id == "w0"
+        assert conn.connected, "admission nack must not tear the connection"
+        assert admission.stats()["shed_ops"] == 1
+        # Same op, same clientSeq, resubmitted in place: sequences fine.
+        conn.submit(msg)
+        conn.sync()
+        assert ss.text == "hello"
+        assert len(nacks) == 1
+        conn.disconnect()
+    finally:
+        plane.stop()
+
+
+def test_protocol_nack_still_tears_down():
+    """Sequencer nacks (no canRetry) keep the reconnect-on-nack contract:
+    the driver drops the connection before delivering the nack."""
+    from fluidframework_tpu.driver.network_driver import NetworkDeltaConnection
+    from fluidframework_tpu.protocol.messages import UnsequencedMessage
+    from fluidframework_tpu.server.netserver import ServicePlane
+
+    plane = ServicePlane().start()
+    nacks = []
+    try:
+        conn = NetworkDeltaConnection(
+            "127.0.0.1", plane.nexus.port, "dt", "w0", "write",
+            listener=lambda m: None, nack_listener=nacks.append,
+            signal_listener=None,
+        )
+        conn.sync()
+        # clientSeq 5 out of order -> sequencer nack (not retryable).
+        conn.submit(UnsequencedMessage(
+            client_id="w0", client_seq=5, ref_seq=0,
+            contents={"type": 0, "pos1": 0, "seg": "x"},
+        ))
+        for _ in range(200):
+            conn.pump(block_s=0.05)
+            if nacks:
+                break
+        assert nacks and nacks[0].retry_after == 0.0  # protocol, not load
+        assert not conn.connected
+    finally:
+        plane.stop()
+
+
+# ---------------------------------------------------------------------------
+# Credit-based flow control end to end
+# ---------------------------------------------------------------------------
+
+def test_backpressure_bounds_queue_depth_and_surfaces_overload():
+    """Ingest deliberately outruns the megastep budget: the consumer must
+    pause the partition at the high watermark (staged depth bounded), the
+    engine must surface ``overload`` in health, the front's /metrics must
+    expose consumer backlog + admission state, and once stepping resumes
+    everything drains byte-identically."""
+    from fluidframework_tpu.dds.shared_string import SharedString
+    from fluidframework_tpu.driver.network_driver import _Http
+    from fluidframework_tpu.models.doc_batch_engine import DocBatchEngine
+    from fluidframework_tpu.observability.metrics_plane import parse_prometheus
+    from fluidframework_tpu.server.fleet_consumer import FleetConsumer
+    from fluidframework_tpu.server.netserver import ServicePlane
+
+    admission = AdmissionController(AdmissionConfig(
+        max_pending=100000, max_consumer_backlog=100000,
+    ))
+    plane = ServicePlane(admission=admission).start()
+    fc = None
+    try:
+        with plane.nexus.lock:
+            doc = plane.service.document("bp")
+            ss = SharedString(client_id="w0")
+            doc.connect(ss.client_id, ss.process)
+            doc.process_all()
+
+        eng = DocBatchEngine(
+            1, max_segments=2048, text_capacity=16384, max_insert_len=8,
+            ops_per_step=4, megastep_k=1, use_mesh=False, recovery="off",
+        )
+        gate = eng.overload_gate
+        assert eng.ingest_watermarks() == {
+            "megastep_budget": 4, "high": 32, "low": 4,
+        }
+        fc = FleetConsumer("127.0.0.1", plane.nexus.port, eng, ["bp"])
+
+        def feed(n):
+            with plane.nexus.lock:
+                for _ in range(n):
+                    ss.insert_text(0, "ab")
+                    for m in ss.take_outbox():
+                        doc.submit(m)
+                doc.process_all()
+
+        # Flood WITHOUT stepping: depth must stop at the watermark, not
+        # track the flood (slack covers in-flight wire bytes a single
+        # pump can still stage before the gate pauses the partition).
+        total = 0
+        for _ in range(40):
+            feed(8)
+            total += 8
+            fc.pump(wait_s=0.02)
+        depth = len(eng.hosts[0].queue)
+        assert depth <= gate.high + 64, f"unbounded staging: {depth}"
+        assert depth < total, "pause never engaged"
+        assert fc.pump_pauses >= 1 and fc.paused_socks == {0}
+        assert eng.overloaded and eng.health()["overload"] == 1
+        assert eng.health()["megastep_budget"] == 4
+        status, text = _Http("127.0.0.1", plane.http.port).request(
+            "GET", "/status"
+        )
+        assert status == 200
+        assert "admission" in text  # overload + shed_ops surface
+        import http.client
+
+        hc = http.client.HTTPConnection("127.0.0.1", plane.http.port)
+        hc.request("GET", "/metrics")
+        metrics = parse_prometheus(hc.getresponse().read().decode())
+        hc.close()
+        assert ("fftpu_admission_overload", ()) in metrics
+        assert ("fftpu_docs_bp_consumer_backlog", ()) in metrics
+
+        # Resume: stepping drains below the low watermark, the socket
+        # re-arms, and the fleet converges byte-identically.
+        for _ in range(400):
+            fc.step()
+            fc.pump(wait_s=0.02)
+            if fc.rows_staged >= total and not eng.pending_ops():
+                break
+        fc.step()
+        assert fc.pump_resumes >= 1 and not fc.paused_socks
+        assert not eng.overloaded
+        assert eng.text(0) == ss.text
+        assert eng.health()["overload_events"] >= 1
+    finally:
+        if fc is not None:
+            fc.close()
+        plane.stop()
+
+
+def test_lagging_client_window_drives_admission():
+    """The --max-pending signal on the synchronously-broadcasting front is
+    the uncompacted collab window (seq - MSN): a write client that joins
+    and then never advances its refSeq pins the MSN, the window grows with
+    every other submit, the front sheds past the threshold, and the
+    laggard catching up (one submit at the current head) readmits."""
+    from fluidframework_tpu.dds.shared_string import SharedString
+    from fluidframework_tpu.driver.network_driver import NetworkDeltaConnection
+    from fluidframework_tpu.protocol.messages import UnsequencedMessage
+    from fluidframework_tpu.server.netserver import ServicePlane
+
+    admission = AdmissionController(AdmissionConfig(
+        max_pending=8, max_consumer_backlog=0, base_retry_after_s=0.01,
+    ))
+    plane = ServicePlane(admission=admission).start()
+    nacks = []
+    try:
+        ss = SharedString(client_id="fast")
+        a = NetworkDeltaConnection(
+            "127.0.0.1", plane.nexus.port, "lw", "fast", "write",
+            listener=ss.process, nack_listener=nacks.append,
+            signal_listener=None,
+        )
+        # The laggard: joins the quorum, then never submits — its refSeq
+        # stays pinned at its join, so the MSN cannot advance.
+        b = NetworkDeltaConnection(
+            "127.0.0.1", plane.nexus.port, "lw", "lag", "write",
+            listener=lambda m: None, nack_listener=None,
+            signal_listener=None,
+        )
+        a.sync()
+        assert ss.short_client >= 0
+
+        shed_at = None
+        for i in range(20):
+            ss.insert_text(0, "x")
+            (m,) = ss.take_outbox()
+            a.submit(m)
+            a.sync()
+            if nacks:
+                shed_at = i
+                break
+        assert shed_at is not None, "window never tripped admission"
+        assert nacks[0].retry_after > 0 and a.connected
+        with plane.nexus.lock:
+            doc = plane.service.document("lw")
+            assert plane.nexus.doc_pressure(doc) >= 8  # at/over threshold
+
+        # The laggard catches up with a NOOP keepalive (always admitted —
+        # the reference's refSeq-advance path): its refSeq -> MSN -> the
+        # window collapses -> producers readmit.
+        from fluidframework_tpu.protocol.messages import MessageType
+
+        b.submit(UnsequencedMessage(
+            client_id="lag", client_seq=1, ref_seq=ss._ref_seq,
+            type=MessageType.NOOP,
+        ))
+        b.sync()
+        a.submit(m)  # the shed op, same clientSeq, resubmitted in place
+        a.sync()
+        assert len(nacks) == 1  # admitted this time
+        assert ss.text.count("x") == shed_at + 1
+        a.disconnect()
+        b.disconnect()
+    finally:
+        plane.stop()
+
+
+def test_slow_consumer_backlog_drives_admission_shedding():
+    """The credit chain, server-side: a firehose consumer that stops
+    draining (a paused fleet partition) backs the broadcast up into the
+    shard's outbound queue; once that backlog crosses the admission
+    threshold, NEW submits for the document are shed with retryAfter —
+    downstream backpressure reaches the producers with no side channel.
+
+    The consumer's stall is made deterministic by blocking the queued
+    writer's ``send_raw`` exactly the way a full kernel socket buffer
+    would block the drain thread — relying on real TCP buffers here is
+    box-dependent (loopback auto-tuning can absorb megabytes)."""
+    import socket as sk
+    import threading as th
+
+    from fluidframework_tpu.dds.shared_string import SharedString
+    from fluidframework_tpu.server.netserver import ServicePlane
+
+    admission = AdmissionController(AdmissionConfig(
+        max_pending=100000, max_consumer_backlog=64,
+        base_retry_after_s=0.125,
+    ))
+    plane = ServicePlane(admission=admission).start()
+    consumer = None
+    unblock = th.Event()
+    try:
+        consumer = sk.create_connection(("127.0.0.1", plane.nexus.port))
+        consumer.sendall(b'{"t": "consume", "doc": "sc"}\n')
+        ack = b""
+        while not ack.endswith(b"\n"):
+            ack += consumer.recv(1)
+        assert b"consuming" in ack
+        with plane.nexus.lock:
+            (writer,) = plane.nexus._doc_consumers["sc"]
+            # From here the drain thread blocks on its next send — the
+            # consumer has stopped granting credit.
+            writer._session.send_raw = lambda data: unblock.wait()
+
+            doc = plane.service.document("sc")
+            ss = SharedString(client_id="w0")
+            doc.connect(ss.client_id, ss.process)
+            doc.process_all()
+
+        shed = None
+        for _ in range(200):
+            with plane.nexus.lock:
+                ss.insert_text(0, "abcdefgh")
+                (m,) = ss.take_outbox()
+                retry = admission.admit(
+                    "sc",
+                    pending=doc.pending_count,
+                    consumer_backlog=plane.nexus.consumer_backlog("sc"),
+                )
+                if retry is not None:
+                    shed = retry
+                    break
+                doc.submit(m)
+                doc.process_all()
+        assert shed is not None, "backlog never crossed the threshold"
+        assert shed >= 0.125  # load-derived, floored at the base
+        with plane.nexus.lock:
+            assert plane.nexus.consumer_backlog("sc") >= 63
+        stats = admission.stats()
+        assert stats["overload"] == 1 and stats["shed_ops"] == 1
+        assert admission.doc_stats("sc")["overload"] == 1
+    finally:
+        unblock.set()
+        if consumer is not None:
+            consumer.close()
+        plane.stop()
+
+
+# ---------------------------------------------------------------------------
+# Loader honors the backoff contract
+# ---------------------------------------------------------------------------
+
+def test_loader_backoff_jitter_deadline_and_pending_replay():
+    """Container path: an admission nack tears the runtime link (reference
+    reconnect-on-nack), ``reconnect_with_backoff`` waits a jittered delay
+    floored at the server's retryAfter, pending local ops replay on the
+    rejoin, and an exhausted deadline raises instead of spinning."""
+    from fluidframework_tpu.dds.channels import default_registry
+    from fluidframework_tpu.driver.definitions import DriverError
+    from fluidframework_tpu.loader import Container
+    from fluidframework_tpu.testing.network_env import NetworkTestService
+
+    net = NetworkTestService()
+    net.plane.nexus.admission = admission = AdmissionController(
+        AdmissionConfig(base_retry_after_s=0.25)
+    )
+    try:
+        d = Container.create_detached(default_registry(), container_id="boot")
+        ds = d.runtime.create_datastore("root")
+        ds.create_channel("sharedString", "text")
+        d.attach("doc", net.factory, "boot")
+        net.process_all()
+        text = d.runtime.datastore("root").get_channel("text")
+        text.insert_text(0, "base")
+        d.runtime.flush()
+        net.process_all()
+
+        # Shed the next submit: the flush is nacked, the runtime drops the
+        # link, the op parks as pending.
+        admission.force_overload("doc", 1)
+        text.insert_text(4, "+more")
+        d.runtime.flush()
+        for _ in range(100):
+            if not d.connected:
+                break
+            net.factory.sync_all()
+        assert not d.connected
+        cm = d.delta_manager.connection_manager
+        assert cm.last_retry_after_s == 0.25
+        assert d.runtime.pending_op_count > 0
+
+        # Reconnect honoring the contract through a virtual clock.
+        waited = []
+        attempts = d.reconnect_with_backoff(sleep=waited.append)
+        assert attempts == 1
+        assert len(waited) == 1 and waited[0] >= 0.25  # retryAfter floor
+        net.process_all()
+        assert text.text == "base+more"  # pending op replayed on rejoin
+        assert d.runtime.pending_op_count == 0
+        c2 = Container.load("doc", net.factory, default_registry(), "checker")
+        net.process_all()
+        assert c2.runtime.datastore("root").get_channel("text").text == "base+more"
+
+        # Deadline: a manager that has burned its budget raises rather
+        # than retrying forever.
+        cm.backoff.deadline_s = 0.0
+        cm.backoff.spent_s = 1.0
+        d.disconnect()
+        with pytest.raises(DriverError, match="deadline exhausted"):
+            d.reconnect_with_backoff(sleep=lambda s: None)
+    finally:
+        net.close()
+
+
+def test_backoff_policy_full_jitter_seeded():
+    from fluidframework_tpu.loader.connection_manager import BackoffPolicy
+
+    a = BackoffPolicy(rng=random.Random(3), deadline_s=100.0)
+    b = BackoffPolicy(rng=random.Random(3), deadline_s=100.0)
+    da = [a.next_delay() for _ in range(6)]
+    assert da == [b.next_delay() for _ in range(6)]  # seeded = reproducible
+    caps = [0.5 * 2 ** i for i in range(6)]
+    assert all(0 < d <= min(8.0, c) for d, c in zip(da, caps))
+    # retry_after is a floor, never a shortcut.
+    assert b.next_delay(retry_after=5.0) >= 5.0
+    # Full jitter actually varies (not the old deterministic ladder).
+    assert len({round(d, 6) for d in da}) > 1
+
+
+# ---------------------------------------------------------------------------
+# The chaos smoke (tier-1) + soak (slow)
+# ---------------------------------------------------------------------------
+
+def test_chaos_smoke_converges_byte_identical():
+    """The ISSUE 10 acceptance smoke: one fleet member kill/restart, torn
+    sockets + churn, and a nack storm over the real composed stack — the
+    fleet converges byte-identical to a fault-free oracle replay, no
+    double-acks, queue depth bounded, and the shed/backoff counters prove
+    the faults actually fired."""
+    schedule = ChaosSchedule(seed=7, events=[
+        ChaosEvent(6, "nack_storm", "cd0", 5),
+        ChaosEvent(10, "torn_socket", "cd1"),
+        ChaosEvent(14, "fleet_kill"),
+        ChaosEvent(20, "torn_socket", "cd0"),
+    ])
+    report = run_chaos(seed=7, ticks=28, n_docs=3, schedule=schedule,
+                       churn_rate=0.1)
+    inv = report["invariants"]
+    assert inv["converged_docs"] == 3
+    assert inv["double_acks"] == 0
+    assert inv["max_queue_depth"] <= inv["queue_depth_bound"]
+    c = report["counters"]
+    assert c["fleet_restarts"] == 1
+    assert c["torn_sockets"] == 2
+    assert c["writer_replacements"] >= 1
+    assert report["admission"]["shed_ops"] >= 1
+    assert c["nack_backoffs"] >= 1  # writers really backed off and resubmitted
+    assert c["ops_sequenced"] > 100
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [10, 21, 33])
+def test_soak_full_schedule_multi_seed(seed):
+    """Full fault palette (scribe kill + crash mid-fold + fsync delay on
+    top of the smoke's kinds), longer runs, several seeds — the soak
+    configuration bench.py --config soak commits as the SOAK artifact."""
+    out = run_soak(seed=seed, ticks=120, n_docs=5, events_per_kind=1)
+    inv = out["invariants"]
+    assert inv["converged_docs"] == 5 and inv["double_acks"] == 0
+    assert inv["max_queue_depth"] <= inv["queue_depth_bound"]
+    assert out["counters"]["scribe_kills"] >= 1
+    assert out["counters"]["scribe_crashes"] >= 1
+    assert out["counters"]["fleet_restarts"] >= 1
+    assert out["p99_ms"] is not None and out["p99_ms"] > 0
+    assert out["max_rss_mb"] < out["rss_bound_mb"]

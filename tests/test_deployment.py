@@ -138,3 +138,42 @@ def test_launcher_supervise_restarts_crashed_shard():
         assert dep.shards[0].port == port  # stable endpoint
     finally:
         dep.stop()
+
+
+def test_launcher_restart_budget_detects_crash_loop():
+    """A shard that keeps dying must trip the restart budget: the
+    supervisor backs off between respawns, then stops respawning and marks
+    the shard crash-looped in the manifest — never an unconditional
+    immediate relaunch loop hammering the same ports forever."""
+    import time
+
+    dep = launch({
+        "shards": [{"name": "s0"}],
+        "restartBudget": 2,
+        "crashWindowS": 120.0,
+        "restartBackoffS": 0.05,
+        "maxRestartBackoffS": 0.2,
+    }, supervise=True)
+    try:
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            with dep._lock:
+                looped = dep.shards[0].crash_looped
+                proc = dep.shards[0].proc
+            if looped:
+                break
+            if proc is not None and proc.poll() is None:
+                proc.kill()  # the "crash", repeatedly
+            time.sleep(0.1)
+        m = dep.manifest()["shards"][0]
+        assert m["crashLooped"] is True, "budget never tripped"
+        assert m["pid"] is None
+        # Respawns stopped AT the budget (initial launch is not a crash).
+        assert m["restarts"] <= 2
+        time.sleep(1.0)  # and it STAYS down
+        with dep._lock:
+            s = dep.shards[0]
+            assert s.proc is None or s.proc.poll() is not None
+            assert s.restarts == m["restarts"]  # no further respawns
+    finally:
+        dep.stop()

@@ -541,6 +541,70 @@ def test_multi_scribe_rebalance_kill_midstream(tmp_path):
 
 # ---------------------------------------------------------------- detection
 
+def test_stale_restored_replica_readopts_on_partition_gain(tmp_path):
+    """The r10 chaos-soak regression: a member restored long ago holds an
+    in-memory replica at an OLD summary; a peer then advances the doc
+    (new joins + ops, fresh acked summaries, committed floor moves past
+    those records).  When the stale member later GAINS the partition
+    (peer killed), folding the tail onto its stale state would gap the
+    replica (quorum KeyErrors, corrupt summaries) because the missing
+    records sit below the committed floor and are never re-read.  The
+    owner must instead drop the stale replica and re-adopt the persisted
+    acked summary."""
+    from fluidframework_tpu.dds.mergetree_ref import RefMergeTree
+    from fluidframework_tpu.server.partition_manager import ScribePool
+
+    topic = _durable_topic(tmp_path)
+    pool = ScribePool(topic, str(tmp_path / "scribe"),
+                      config=ScribeConfig(max_ops=10))
+    a = pool.add_member("a")
+
+    def seg(s):
+        return chr(65 + s % 26) + chr(97 + s % 26)
+
+    _join("d0", topic, client="w0", short=0)
+    all_ops = []
+    for s in range(1, 15):
+        all_ops.append(_op("d0", topic, s, {"type": 0, "pos1": 0,
+                                            "seg": seg(s)}))
+    a.pump()  # summary + ack at 14 -> refs.json written
+    assert _acks_for(topic, "d0") == [("d0", 14, _acks_for(topic, "d0")[0][2])]
+
+    # Member b restores NOW: replica at seq 14.  One partition, dealt to
+    # "a" (first in sorted membership) — b idles while a advances the doc.
+    b = pool.add_member("b")
+    assert b.docs["d0"].last_seq == 14
+    assert pool.group.assignments("b") == []
+    _join("d0", topic, client="w1", short=1)  # a NEW client b never sees
+    for s in range(15, 31):
+        all_ops.append(_op("d0", topic, s, {"type": 0, "pos1": 0,
+                                            "seg": seg(s)}, client="w1"))
+    pool.pump()  # a folds + summarizes at 30; committed floor passes it
+    assert [s for _d, s, _c in _acks_for(topic, "d0")] == [14, 30]
+    assert b.docs["d0"].last_seq == 14  # still stale in memory
+
+    # The stale member takes over: it must re-adopt, not fold onto 14.
+    pool.kill_member("a")
+    for s in range(31, 36):
+        all_ops.append(_op("d0", topic, s, {"type": 0, "pos1": 0,
+                                            "seg": seg(s)}, client="w1"))
+    pool.pump()
+    assert b.counters.get("stale_replicas_dropped") == 1
+    ad = b.docs["d0"]
+    assert ad.failed is None
+    assert ad.base_seq == 30 and ad.last_seq == 35
+
+    # Byte identity against a fault-free oracle replay of the full log.
+    oracle = RefMergeTree()
+    for i, m in enumerate(all_ops):
+        oracle.apply_insert(m.contents["pos1"], m.contents["seg"], m.seq,
+                            0 if m.client_id == "w0" else 1, m.ref_seq)
+    assert ad.tree.visible_text() == oracle.visible_text()
+    # And the successor's next summary chains cleanly (no double-acks).
+    assert b.summarize("d0") is not None
+    assert [s for _d, s, _c in _acks_for(topic, "d0")] == [14, 30, 35]
+
+
 def test_family_detection():
     assert detect_family({"type": 0, "pos1": 0, "seg": "x"}) == "doc_batch"
     assert detect_family({"type": "set", "key": "k", "value": 1}) == "map_batch"
