@@ -739,10 +739,138 @@ def _static_analysis_probe() -> dict:
     }
 
 
+def _seg_replay_rate(args, n_shards: int) -> dict:
+    """Config-1's trace through the SEGMENT-PARALLEL serving path: one hot
+    document, its merge-tree segment arrays block-sharded over a ``segs``
+    mesh axis of ``n_shards`` devices, applied by the seg-parallel megastep
+    (ops.mergetree_kernel.apply_megastep_seg under shard_map) — the 2-D
+    docs x segs answer to the worst number on the board (one viral doc
+    serializing a lane).  The warmup half grows the doc (with periodic
+    re-blocks: growth from empty lands on the tail shard until a rebalance
+    spreads it); the timed half replays on the balanced layout, exactly as
+    production serves a long-lived hot doc between rebalance points.
+    Reports the seg-path rate, the single-lane rate ON THE SAME TRACE, the
+    ratio, and a full byte-identity check of the final states (the
+    single-lane path is the oracle)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.ops import mergetree_kernel as mk
+    from fluidframework_tpu.parallel import mesh as pm
+
+    devs = jax.devices()
+    if len(devs) < n_shards:
+        return {
+            "segment_shards": n_shards, "ok": False,
+            "reason": f"only {len(devs)} devices visible",
+        }
+    mesh = pm.docs_segs_mesh(devs[:n_shards], seg_shards=n_shards)
+    B = args.ops_per_step
+    ops, payloads, _min_seqs, real_ops = generate_multiwriter(
+        1, B, 2 * args.steps, 4, args.insert_len, args.payload_len
+    )
+    # Doc-minor [S, B, F, 1] -> single-doc [S, B, F].
+    ops3 = np.ascontiguousarray(ops[..., 0])
+    pays3 = np.ascontiguousarray(payloads[..., 0])
+    w = args.steps
+    # Host-side proto: the single-lane runner donates its state, so every
+    # rep re-uploads a fresh copy from numpy.
+    proto = jax.tree.map(np.asarray, mk.init_state(
+        max_segments=args.segments, remove_slots=4, prop_slots=2,
+        text_capacity=args.text_capacity,
+    ))
+
+    # Single-lane oracle runner: the same [K, B] scan shape, one device.
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def single_run(s, o, p):
+        def body(st, xs):
+            return mk.apply_ops(st, xs[0], xs[1], False), None
+
+        out, _ = jax.lax.scan(body, s, (o, p))
+        return out
+
+    s_local = args.segments // n_shards
+    specs = pm.seg_state_specs(proto)
+    prog = pm.mesh_seg_program(mk.apply_megastep_seg, mesh, specs)
+
+    def seg_warm_state():
+        """Grow the doc through the warmup half with a re-block per
+        quarter (bounds the tail-shard skew), ending balanced."""
+        st = pm.shard_seg_state(
+            mk.seg_shard_state(proto, n_shards, s_local), mesh
+        )
+        q = max(1, w // 4)
+        for i in range(0, w, q):
+            # Clamp to the warmup half: an unclamped last chunk would
+            # re-apply the first timed slice(s) whenever w % q != 0,
+            # double-applying ops on the seg path only.
+            end = min(i + q, w)
+            st = prog(
+                st, jnp.asarray(ops3[i:end]), jnp.asarray(pays3[i:end])
+            )
+            st = pm.shard_seg_state(
+                mk.seg_rebalance_state(
+                    jax.tree.map(np.asarray, st), s_local=s_local
+                ),
+                mesh,
+            )
+        return st
+
+    dev_t = (jnp.asarray(ops3[w:]), jnp.asarray(pays3[w:]))
+    # Warm the TIMED [w, B, F] shape once: seg_warm_state compiles only
+    # q-sized chunks, so with --reps 1 the first timed dispatch would pay
+    # the full jit(shard_map) compile inside the timer — while the
+    # single-lane runner's warmup call already uses its timed shape.
+    jax.block_until_ready(prog(seg_warm_state(), *dev_t).text_end)
+    best_seg = float("inf")
+    seg_final = None
+    for _rep in range(max(1, min(args.reps, 3))):
+        st = seg_warm_state()
+        jax.block_until_ready(st.text_end)
+        t0 = time.perf_counter()
+        st = prog(st, *dev_t)
+        jax.block_until_ready(st.text_end)
+        best_seg = min(best_seg, time.perf_counter() - t0)
+        seg_final = st
+    best_single = float("inf")
+    single_final = None
+    for _rep in range(max(1, min(args.reps, 3))):
+        st = single_run(
+            jax.tree.map(jnp.asarray, proto),
+            jnp.asarray(ops3[:w]), jnp.asarray(pays3[:w]),
+        )
+        jax.block_until_ready(st.text_end)
+        t0 = time.perf_counter()
+        st = single_run(st, *dev_t)
+        jax.block_until_ready(st.text_end)
+        best_single = min(best_single, time.perf_counter() - t0)
+        single_final = st
+    timed_ops = real_ops // 2
+    a = mk.canonical_doc(single_final)
+    b = mk.canonical_doc(mk.seg_gather_state(jax.tree.map(np.asarray, seg_final)))
+    identical = all(np.array_equal(a[k], b[k]) for k in a)
+    seg_rate = timed_ops / best_seg
+    single_rate = timed_ops / best_single
+    return {
+        "segment_shards": n_shards,
+        "ok": True,
+        "seg_ops_per_sec": round(seg_rate, 1),
+        "singlelane_ops_per_sec": round(single_rate, 1),
+        "seg_speedup": round(seg_rate / single_rate, 3),
+        "seg_identity": bool(identical),
+        "errors": int(np.asarray(seg_final.error)),
+    }
+
+
 def bench_config1(args) -> dict:
     """Config 1: SharedString single-doc replay (BASELINE.md row 1): one
     document, 4 concurrent writers, sequential device scan — the per-doc
-    replay rate (ref client.replay.spec.ts workloads)."""
+    replay rate (ref client.replay.spec.ts workloads).  With
+    ``--seg-shards N`` the row also records the SEGMENT-PARALLEL replay of
+    the same trace over an N-shard segs axis (``seg_ops_per_sec`` /
+    ``seg_speedup`` / byte-identity vs the single lane)."""
     args = _copy_args(args)
     if not args.segments_explicit:
         # A long replay on ONE doc: segment count grows with the whole
@@ -758,6 +886,15 @@ def bench_config1(args) -> dict:
         )
 
     out = _mergetree_run(args, 1, gen, "config1_singledoc_replay_ops_per_sec")
+    if args.seg_shards > 1:
+        try:
+            seg = _seg_replay_rate(args, args.seg_shards)
+            out["segment"] = seg
+            if seg.get("ok"):
+                out["segment_shards"] = seg["segment_shards"]
+                out["seg_ops_per_sec"] = seg["seg_ops_per_sec"]
+        except Exception as e:  # noqa: BLE001 — probe must not sink the row
+            out["segment"] = {"error": repr(e)[-300:]}
     out["ingest_ops_per_sec"], out["engine_health"] = _string_ingest_rate(
         1, rounds=64, writers=4, megastep_k=args.megastep_k
     )
@@ -1399,9 +1536,22 @@ def bench_multichip_child(args) -> dict:
             "reason": f"only {len(devs)} devices visible",
         }
     from fluidframework_tpu.models.doc_batch_engine import DocBatchEngine
-    from fluidframework_tpu.parallel.mesh import doc_mesh
+    from fluidframework_tpu.parallel.mesh import doc_mesh, docs_segs_mesh
 
-    mesh = doc_mesh(devs[:n_req])
+    seg_width = min(args.seg_shards, n_req) if args.seg_shards > 1 else 0
+    if seg_width > 1:
+        # The 2-D mesh point: docs x segs over the same devices — the
+        # fleet shards over both axes flattened, the seg replay carves
+        # the segs axis.
+        mesh = docs_segs_mesh(devs[:n_req], seg_width)
+        # docs_segs_mesh clamps the requested width to a divisor of the
+        # device count; record/replay the CLAMPED width so the seg point
+        # matches the mesh_shape it sits next to in the artifact.
+        from fluidframework_tpu.parallel.mesh import SEG_AXIS
+
+        seg_width = int(dict(mesh.shape)[SEG_AXIS])
+    else:
+        mesh = doc_mesh(devs[:n_req])
     D, B, S = args.docs, args.ops_per_step, args.steps
     L = args.payload_len
     ops, payloads, _min_seqs = generate_workload(
@@ -1436,7 +1586,7 @@ def bench_multichip_child(args) -> dict:
         (run_once() for _ in range(max(1, args.reps))), key=lambda r: r[0]
     )
     health = eng.health()
-    return {
+    row = {
         "metric": "multichip_fleet_ops_per_sec",
         "n_devices": n_req,
         "ok": True,
@@ -1449,6 +1599,23 @@ def bench_multichip_child(args) -> dict:
         "n_shards": health.get("n_shards"),
         "platform": devs[0].platform,
     }
+    if args.seg_shards > 1:
+        # The hot-doc segment-parallel point at this device count: the
+        # whole segs axis serves ONE viral doc (config1's shape), recorded
+        # next to the fleet number so the artifact carries the full 2-D
+        # story per count.
+        row["mesh_shape"] = {k: int(v) for k, v in dict(mesh.shape).items()}
+        try:
+            seg_args = _copy_args(args)
+            seg_args.segments = max(args.segments, 4096)
+            seg_args.text_capacity = max(args.text_capacity, 65536)
+            row["segment"] = _seg_replay_rate(seg_args, max(seg_width, 1))
+            if row["segment"].get("ok"):
+                row["segment_shards"] = row["segment"]["segment_shards"]
+                row["seg_ops_per_sec"] = row["segment"]["seg_ops_per_sec"]
+        except Exception as e:  # noqa: BLE001 — probe must not sink the row
+            row["segment"] = {"error": repr(e)[-300:]}
+    return row
 
 
 _MULTICHIP_COUNTS = (1, 2, 4, 8)
@@ -1477,6 +1644,8 @@ def bench_multichip(args) -> dict:
     for n in _MULTICHIP_COUNTS:
         cmd = [sys.executable, os.path.abspath(__file__),
                "--config", "multichip-child", "--devices", str(n)]
+        if args.seg_shards > 1:
+            cmd += ["--seg-shards", str(args.seg_shards)]
         if reduced:
             cmd += ["--docs", "128", "--steps", "8", "--reps", "3",
                     "--segments", "512", "--text-capacity", "8192"]
@@ -1542,6 +1711,26 @@ def bench_multichip(args) -> dict:
         "per_device": per_device,
         "platform": platform or "cpu",
     }
+    if args.seg_shards > 1:
+        # Headline surface of the 2-D point: the last successful count's
+        # segment-parallel rate, and whether EVERY count's final state was
+        # byte-identical to the single-lane oracle.
+        seg_rows = [
+            row for row in per_device
+            if isinstance(row.get("segment"), dict) and row["segment"].get("ok")
+        ]
+        # The ACTUAL (clamped) width of the row the headline rate comes
+        # from — the child clamps the requested width to a divisor of its
+        # device count, so args.seg_shards can disagree with every row.
+        out["segment_shards"] = (
+            seg_rows[-1]["segment"]["segment_shards"]
+            if seg_rows else args.seg_shards
+        )
+        if seg_rows:
+            out["seg_ops_per_sec"] = seg_rows[-1]["segment"]["seg_ops_per_sec"]
+            out["seg_identity"] = all(
+                row["segment"].get("seg_identity") for row in seg_rows
+            )
     if probe_attempts:
         out["backend_attempts"] = probe_attempts
     if degraded:
@@ -1805,6 +1994,13 @@ def main() -> None:
     p.add_argument("--insert-len", type=int, default=4)
     p.add_argument("--payload-len", type=int, default=8)
     p.add_argument("--compact-every", type=int, default=4)
+    p.add_argument("--seg-shards", type=int, default=0,
+                   help="record the segment-parallel hot-doc path: config1 "
+                        "adds a seg-sharded replay of its trace over an "
+                        "N-shard segs axis (seg_ops_per_sec + byte-identity "
+                        "vs the single lane); multichip builds a 2-D "
+                        "docs x segs mesh per device count and attaches "
+                        "the seg point to every row")
     p.add_argument("--megastep-k", type=int, default=8,
                    help="max op slices fused into one device dispatch in "
                         "the engine-level probes (1 = per-slice dispatch, "

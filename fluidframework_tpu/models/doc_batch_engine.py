@@ -82,7 +82,7 @@ from ..parallel import mesh as pm
 from ..parallel.mesh import doc_mesh, shard_docs
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters, Histogram, SampledTelemetryHelper
-from .staging import OverloadGate, RowQueue, StagingRing
+from .staging import OverloadGate, RowQueue, StagingRing, upload_replicated
 
 
 @dataclass
@@ -129,6 +129,28 @@ class _OverflowLane:
     geometry: dict[str, int]
     growths: int
     queue: RowQueue = None
+
+
+@dataclass
+class _SegmentLane:
+    """A HOT document promoted to the segment-parallel serving path: its
+    merge-tree segment arrays block-shard over the mesh's ``segs`` axis
+    (per-segment work splits across shards; text/scalars/ob table
+    replicate), served by the seg-parallel megastep
+    (ops.mergetree_kernel.apply_megastep_seg) with the single-lane kernel
+    as the byte-identity oracle.  Inserts land shard-local; the layout
+    re-blocks at rebalance points (``rebalance_segments``)."""
+
+    state: mk.DocState   # seg-sharded layout, device-resident
+    n_shards: int
+    s_local: int         # per-shard segment capacity
+    queue: RowQueue = None
+    rebalances: int = 0
+    ops_since_rebalance: int = 0
+    # Bumped at every state reassignment (dispatch/rebalance/compact): the
+    # watchdog's host-side change mark — the slot-digest pre-filter cannot
+    # vouch for a lane doc, and hot docs are the most expensive to replay.
+    version: int = 0
 
 
 def _i32(v) -> int:
@@ -251,6 +273,11 @@ class DocBatchEngine:
         latency_sample_every: int = 16,
         overload_high_watermark: int = 0,
         overload_low_watermark: int = 0,
+        seg_shards: int = 0,
+        seg_lane_segments: int = 0,
+        seg_lane_text_capacity: int = 0,
+        seg_rebalance_every: int = 0,
+        max_seg_lanes: int = 4,
     ) -> None:
         assert recovery in ("grow", "oracle", "off")
         self.n_docs = n_docs
@@ -345,11 +372,27 @@ class DocBatchEngine:
         self._lat_pending: list[tuple[float, int]] = []
 
         if use_mesh:
-            self.mesh = mesh if mesh is not None else doc_mesh()
+            if mesh is not None:
+                self.mesh = mesh
+            elif seg_shards > 1:
+                # The 2-D docs x segs serving mesh: cold docs shard over
+                # BOTH axes flattened (every device), hot docs carve the
+                # segs axis via segment lanes.
+                self.mesh = pm.docs_segs_mesh(seg_shards=seg_shards)
+            else:
+                self.mesh = doc_mesh()
             n_shards = self.mesh.devices.size
+            self.seg_shards = int(dict(self.mesh.shape).get(pm.SEG_AXIS, 1))
         else:
             self.mesh = None
             n_shards = 1
+            self.seg_shards = 1
+        # Segment-lane knobs (hot-doc opt-in; see _SegmentLane).
+        self.seg_lanes: dict[int, _SegmentLane] = {}
+        self.seg_lane_segments = seg_lane_segments
+        self.seg_lane_text_capacity = seg_lane_text_capacity
+        self.seg_rebalance_every = seg_rebalance_every
+        self.max_seg_lanes = max_seg_lanes
         # Device capacity rounds up to a mesh multiple (padding docs are
         # inert: their queues stay empty so they only ever apply noops).
         # ``spare_slots`` reserves extra free rows beyond the fleet so live
@@ -399,22 +442,40 @@ class DocBatchEngine:
         self._step = _fleet_step
         self._megastep = _fleet_megastep
         self._compact = _fleet_compact
+        self._seg_megastep = None
+        self._seg_compact = None
         if self.mesh is not None:
             # shard_map-wrapped fleet programs: one donated dispatch steps
             # every shard with zero hot-path collectives; each shard's
             # obliterate gate is evaluated from its OWN docs, so one hot
             # obliterate shard no longer de-specializes the whole fleet.
             # Cached per (mesh, specs) — instances serving the same mesh
-            # share compiles (parallel.mesh.mesh_fleet_program).
-            specs = pm.fleet_state_specs(self.state)
+            # share compiles (parallel.mesh.mesh_fleet_program).  On a
+            # docs x segs mesh the doc dim shards over BOTH axes flattened.
+            da = pm.fleet_doc_axes(self.mesh)
+            specs = pm.fleet_state_specs(self.state, da)
             self._state_specs = specs
             self._megastep = pm.mesh_fleet_program(
-                mk.apply_megastep, self.mesh, specs
+                mk.apply_megastep, self.mesh, specs,
+                arg_specs=(pm.P(None, da), pm.P(None, da)),
             )
             self._compact = pm.mesh_fleet_program(
                 _fleet_compact_body, self.mesh, specs,
-                arg_specs=(pm.P("docs"),),
+                arg_specs=(pm.P(da),),
             )
+            if self.seg_shards > 1:
+                # Segment-lane programs: one donated dispatch applies a
+                # [K, B] op ring to one seg-sharded hot doc, per-segment
+                # work split over the segs axis (two collective hops
+                # inside — mk.apply_megastep_seg).
+                seg_specs = pm.seg_state_specs(self._proto)
+                self._seg_megastep = pm.mesh_seg_program(
+                    mk.apply_megastep_seg, self.mesh, seg_specs
+                )
+                self._seg_compact = pm.mesh_seg_program(
+                    mk.compact_seg, self.mesh, seg_specs,
+                    arg_specs=(pm.P(),),
+                )
         self._lane_apply = _lane_apply_jit
         self._lane_compact = _lane_compact_jit
         # Recompile watchdog: executable-cache growth on any fleet program
@@ -429,6 +490,9 @@ class DocBatchEngine:
             ("lane_apply", self._lane_apply),
         ):
             self.recompile_watchdog.register(prog_name, prog)
+        if self._seg_megastep is not None:
+            self.recompile_watchdog.register("seg_megastep", self._seg_megastep)
+            self.recompile_watchdog.register("seg_compact", self._seg_compact)
         # Incremental busy set: doc indices whose host queue is nonempty,
         # maintained by ingest/drain/quarantine — step() never rescans the
         # whole host array (O(busy) per loop iteration, not O(capacity)).
@@ -533,6 +597,9 @@ class DocBatchEngine:
         if doc_idx in self.overflow:
             self.overflow[doc_idx].queue.extend_rows(rows)
             return
+        if doc_idx in self.seg_lanes:
+            self.seg_lanes[doc_idx].queue.extend_rows(rows)
+            return
         h.queue.extend_rows(rows)
         if h.queue:
             self._busy.add(doc_idx)
@@ -592,6 +659,7 @@ class DocBatchEngine:
                 or d in self.quarantine
                 or d in self.oracles
                 or d in self.overflow
+                or d in self.seg_lanes
                 or h.mode == "native"
             ):
                 counters.bump("ingest_fallback_msgs")
@@ -745,7 +813,10 @@ class DocBatchEngine:
         doc_arr = np.asarray(doc_of, np.int64)
         live = np.ones((total,), bool)
         for d in set(doc_of):
-            if d in self.quarantine or d in self.oracles or d in self.overflow:
+            if (
+                d in self.quarantine or d in self.oracles
+                or d in self.overflow or d in self.seg_lanes
+            ):
                 live[doc_arr == d] = False
         # Stable doc-sort: one extend_block per doc, original order kept.
         order = np.argsort(doc_arr, kind="stable")
@@ -779,6 +850,7 @@ class DocBatchEngine:
             doc_idx in self.oracles
             or doc_idx in self.overflow
             or doc_idx in self.quarantine
+            or doc_idx in self.seg_lanes
             or self.hosts[doc_idx].restored
         )
 
@@ -801,7 +873,7 @@ class DocBatchEngine:
             # through the columnar fast path (ingest_batch routes lane
             # docs message by message itself, so semantics match).
             self._normalize_native(h)
-            lane = self.overflow.get(doc_idx)
+            lane = self.overflow.get(doc_idx) or self.seg_lanes.get(doc_idx)
             before = len(lane.queue) if lane else len(h.queue)
             msgs = [
                 SequencedMessage.from_json(line.decode())
@@ -812,7 +884,7 @@ class DocBatchEngine:
             self.ingest_batch([doc_idx] * len(msgs), msgs)
             if doc_idx in self.oracles or doc_idx in self.quarantine:
                 return n_msgs
-            lane = self.overflow.get(doc_idx)
+            lane = self.overflow.get(doc_idx) or self.seg_lanes.get(doc_idx)
             return (len(lane.queue) if lane else len(h.queue)) - before
         assert h.mode != "obj", (
             f"doc {doc_idx} already fed through the object path; "
@@ -1023,8 +1095,10 @@ class DocBatchEngine:
 
     # ------------------------------------------------------------------- step
     def pending_ops(self) -> int:
-        return sum(len(h.queue) for h in self.hosts) + sum(
-            len(l.queue) for l in self.overflow.values()
+        return (
+            sum(len(h.queue) for h in self.hosts)
+            + sum(len(l.queue) for l in self.overflow.values())
+            + sum(len(l.queue) for l in self.seg_lanes.values())
         )
 
     # --------------------------------------------------------- flow control
@@ -1033,9 +1107,22 @@ class DocBatchEngine:
         high watermark, docs drained back under the low watermark).  The
         consumer calls this once per pump and pauses/resumes per-partition
         reads on the deltas; the gate's paused set IS the engine's overload
-        state (``health()['overload']``)."""
+        state (``health()['overload']``).  Lane docs (segment-sharded or
+        overflow) queue on their lane, not the batch host, so the gate
+        reads the combined depth — otherwise promotion (which empties
+        ``h.queue`` into the lane) would instantly resume a paused hot doc
+        and its lane queue would grow unboundedly."""
         return self.overload_gate.update(
-            self._busy, lambda d: len(self.hosts[d].queue)
+            self._busy | set(self.seg_lanes) | set(self.overflow),
+            self._queue_depth,
+        )
+
+    def _queue_depth(self, d: int) -> int:
+        """Total staged-but-unapplied rows for doc ``d``: its batch host
+        queue plus any seg/overflow lane queue (the flow-control signal)."""
+        lane = self.seg_lanes.get(d) or self.overflow.get(d)
+        return len(self.hosts[d].queue) + (
+            len(lane.queue) if lane is not None else 0
         )
 
     def ingest_watermarks(self) -> dict:
@@ -1091,6 +1178,10 @@ class DocBatchEngine:
             self._stage = StagingRing(
                 self.megastep_k, self.capacity, self.ops_per_step,
                 mk.OP_FIELDS, self.max_insert_len, mesh=self.mesh,
+                doc_axis=(
+                    pm.fleet_doc_axes(self.mesh)
+                    if self.mesh is not None else "docs"
+                ),
             )
         return self._stage
 
@@ -1188,6 +1279,7 @@ class DocBatchEngine:
             else:
                 steps += self._full_step(busy)
         self._step_lanes()
+        self._step_seg_lanes()
         self._step_count += 1
         if self.recovery != "off":
             self.recover()
@@ -1297,6 +1389,189 @@ class DocBatchEngine:
                         lane.state, dev_ops, dev_payloads
                     )
 
+    # -------------------------------------------------------- segment lanes
+    def _step_seg_lanes(self) -> None:
+        """Drain every segment lane with [K, B] seg-parallel megastep
+        dispatches, re-blocking any lane past its rebalance budget."""
+        for d, lane in list(self.seg_lanes.items()):
+            self._drain_seg_lane(d, lane)
+            if (
+                self.seg_rebalance_every
+                and lane.ops_since_rebalance >= self.seg_rebalance_every
+            ):
+                self.rebalance_segments(d)
+
+    def _drain_seg_lane(self, d: int, lane: _SegmentLane) -> None:
+        """Apply ONE lane's staged ops as [K, B] seg megasteps: ops/
+        payloads upload REPLICATED over the segs axis (each shard applies
+        every op to its own segment block) and the dispatch spans carry
+        the 2-D layout for the flight recorder.  The [K, B] buffers are
+        fresh per dispatch — at K*B*(OP_FIELDS+L) int32 they are tiny next
+        to the dispatch itself (phase_shares pins dispatch at ~99%), so
+        the fleet ring's reuse machinery is not worth threading in here."""
+        B = self.ops_per_step
+        while lane.queue:
+            need = -(-len(lane.queue) // B)
+            K = min(self.megastep_k, self._pow2_floor(max(need, 1)))
+            ops = np.zeros((K, B, mk.OP_FIELDS), np.int32)
+            payloads = np.zeros((K, B, self.max_insert_len), np.int32)
+            taken = 0
+            for k in range(K):
+                take = min(B, len(lane.queue))
+                if not take:
+                    break
+                src_ops, src_payloads = lane.queue.take(take)
+                ops[k, :take] = src_ops
+                payloads[k, :take] = src_payloads
+                taken += take
+            dev_ops, dev_payloads = upload_replicated(ops, payloads, self.mesh)
+            with span(
+                "dispatch", kind="seg", k=K, doc=self.doc_keys[d],
+                seg_shards=lane.n_shards,
+            ):
+                lane.state = self._seg_megastep(
+                    lane.state, dev_ops, dev_payloads
+                )
+            lane.version += 1
+            lane.ops_since_rebalance += taken
+            self.counters.bump("megastep_dispatches")
+            self.counters.bump("megastep_slices", K)
+
+    def segment_sharded(self) -> dict[str, int]:
+        """doc key -> segment shard count for every promoted hot doc: the
+        2-D placement surface (fleet status / supervisors)."""
+        return {
+            self.doc_keys[d]: lane.n_shards
+            for d, lane in self.seg_lanes.items()
+        }
+
+    def enable_segment_sharding(
+        self, d: int, s_local: int = 0, text_capacity: int = 0
+    ) -> bool:
+        """Promote a hot doc onto the segment-parallel path: its device row
+        re-blocks into the seg-sharded layout (``mk.seg_shard_state`` — live
+        segments split into contiguous runs over the segs axis, text/
+        scalars/ob table replicated) and future ops apply segment-parallel.
+        The batch slot stays RESERVED (pristine) so placement/scribe
+        alignment are untouched and demotion lands back in place.  Staged
+        ops move to the lane queue — promotion is legal MID-STREAM.
+        Returns False when seg serving is off, the doc is off the batch
+        path, the lane budget is spent, or the state does not block."""
+        if self.seg_shards <= 1 or self._seg_megastep is None:
+            return False
+        if not (0 <= d < self.n_docs):
+            raise ValueError(f"no doc {d}")
+        if (
+            d in self.seg_lanes or d in self.overflow
+            or d in self.oracles or d in self.quarantine
+        ):
+            return False
+        if len(self.seg_lanes) >= self.max_seg_lanes:
+            self.counters.bump("seg_promotions_skipped")
+            return False
+        slot = int(self._slot[d])
+        row = jax.tree.map(lambda x: np.asarray(x[slot]), self.state)
+        if int(row.error):
+            return False  # recover first; never promote a latched row
+        s_local = (
+            s_local or self.seg_lane_segments or self.geometry["max_segments"]
+        )
+        tc = (
+            text_capacity or self.seg_lane_text_capacity
+            or self.geometry["text_capacity"]
+        )
+        try:
+            blocked = mk.seg_shard_state(row, self.seg_shards, s_local, tc)
+        except ValueError:
+            return False
+        lane = _SegmentLane(
+            state=pm.shard_seg_state(blocked, self.mesh),
+            n_shards=self.seg_shards, s_local=s_local,
+            queue=RowQueue(mk.OP_FIELDS, self.max_insert_len),
+        )
+        h = self.hosts[d]
+        if h.queue:
+            ops_p, payloads_p = h.queue.pending()
+            lane.queue.extend_block(ops_p.copy(), payloads_p.copy())
+            h.queue.clear()
+        self._busy.discard(d)
+        self.seg_lanes[d] = lane
+        # Retire the batch row to the pristine proto (slot reserved).
+        self.state = jax.tree.map(
+            lambda x, s: x.at[slot].set(s), self.state, self._proto
+        )
+        self._verified_digest.pop(d, None)
+        self.counters.bump("seg_promotions")
+        instant(
+            "seg_promote", doc=self.doc_keys[d], shards=self.seg_shards,
+            s_local=s_local,
+        )
+        return True
+
+    def disable_segment_sharding(self, d: int) -> bool:
+        """Demote a segment-sharded doc back into its reserved batch row
+        (the migrate_doc handoff: gather -> summary export -> re-pack at
+        batch geometry).  Staged lane ops apply first so nothing is lost.
+        Returns False when the gathered state no longer fits the batch
+        geometry (the doc stays segment-sharded and serviceable)."""
+        lane = self.seg_lanes.get(d)
+        if lane is None:
+            return False
+        if lane.queue:
+            self._drain_seg_lane(d, lane)
+        host = jax.tree.map(np.asarray, lane.state)
+        if int(host.error):
+            return False  # recover() handles latched lanes
+        gathered = mk.seg_gather_state(host)
+        h = self.hosts[d]
+        self._sync_native_props(h)
+        summary = kb.state_to_summary(
+            gathered, {v: k for k, v in h.prop_slot.items()}
+        )
+        try:
+            row = kb.summary_to_state(
+                summary, self.geometry,
+                lambda p: self._prop_slot_for_geom(h, p, self.geometry),
+            )
+        except (ValueError, IndexError):
+            return False
+        slot = int(self._slot[d])
+        self.state = jax.tree.map(
+            lambda x, s: x.at[slot].set(s), self.state, row
+        )
+        del self.seg_lanes[d]
+        self._verified_digest.pop(d, None)
+        self.counters.bump("seg_demotions")
+        instant("seg_demote", doc=self.doc_keys[d])
+        return True
+
+    def rebalance_segments(self, d: int) -> bool:
+        """Re-block a segment lane so every shard holds an even share of
+        the live segments again (inserts land shard-local between rebalance
+        points, so runs skew toward the hot shard over time).  Gather +
+        re-shard, byte- and order-preserving (``mk.seg_rebalance_state``,
+        the compaction gather's fill conventions)."""
+        lane = self.seg_lanes.get(d)
+        if lane is None:
+            return False
+        if int(np.asarray(lane.state.error)):
+            # One scalar readback, not the tree-wide gather below: a
+            # latched lane is re-tried every step while it waits for
+            # recover() (or forever under recovery='off').
+            return False
+        with span(
+            "seg_rebalance", doc=self.doc_keys[d], shards=lane.n_shards
+        ):
+            host = jax.tree.map(np.asarray, lane.state)
+            blocked = mk.seg_rebalance_state(host, s_local=lane.s_local)
+            lane.state = pm.shard_seg_state(blocked, self.mesh)
+        lane.version += 1
+        lane.rebalances += 1
+        lane.ops_since_rebalance = 0
+        self.counters.bump("seg_rebalances")
+        instant("seg_rebalance", doc=self.doc_keys[d])
+        return True
+
     def compact(self) -> None:
         """Advance MSNs and run zamboni eviction across the fleet."""
         mins = np.zeros((self.capacity,), np.int32)
@@ -1307,6 +1582,11 @@ class DocBatchEngine:
         else:
             mins_dev = jnp.asarray(mins)
         self.state = self._compact(self.state, mins_dev)
+        for d, lane in self.seg_lanes.items():
+            lane.state = self._seg_compact(
+                lane.state, jnp.asarray(self.hosts[d].min_seq, jnp.int32)
+            )
+            lane.version += 1
         for d, lane in self.overflow.items():
             lane.state = self._lane_compact(
                 lane.state, jnp.asarray(self.hosts[d].min_seq, jnp.int32)
@@ -1322,37 +1602,39 @@ class DocBatchEngine:
         doc indices recovered this call.  Capacity bits grow-and-replay (or
         oracle-route); poison bits (ERR_POS_RANGE alone) quarantine."""
         recovered: list[int] = []
-        if self.mesh is not None and not self.overflow:
+        batch_clean = False
+        if self.mesh is not None:
             # Per-shard reduce instead of a cross-mesh [D] gather: each
             # shard partial-sums its own latch rows and the host reads ONE
             # scalar — the full error vector transfers only when it is
-            # actually nonzero (recovery itself, off the hot path).
+            # actually nonzero (recovery itself, off the hot path).  Lane
+            # errors are per-lane scalars checked below, so an active seg
+            # or overflow lane must not force the batch-state gather.
             with span("readback", kind="error_count"):
-                clean = int(pm.error_count(self.state.error)) == 0
-            if clean:
-                return []
-        with span("readback", kind="error_vector"):
-            err = np.asarray(self.state.error)
-        for d in range(self.n_docs):
-            slot = int(self._slot[d])
-            if (
-                d not in self.overflow
-                and d not in self.oracles
-                and d not in self.quarantine
-                and err[slot]
-            ):
-                bits = int(err[slot])
-                if mk.is_capacity_error(bits):
-                    self._recover_doc(d, bits, growths=0)
-                else:  # poison: ERR_POS_RANGE with no capacity bit
-                    self._quarantine_doc(d, f"error bits {bits:#x}")
-                # Retire the batch slot: clear the latched bits so the slot
-                # never re-triggers (its queue is empty and future ops route
-                # to the lane).
-                self.state = self.state._replace(
-                    error=self.state.error.at[slot].set(0)
-                )
-                recovered.append(d)
+                batch_clean = int(pm.error_count(self.state.error)) == 0
+        if not batch_clean:
+            with span("readback", kind="error_vector"):
+                err = np.asarray(self.state.error)
+            for d in range(self.n_docs):
+                slot = int(self._slot[d])
+                if (
+                    d not in self.overflow
+                    and d not in self.oracles
+                    and d not in self.quarantine
+                    and err[slot]
+                ):
+                    bits = int(err[slot])
+                    if mk.is_capacity_error(bits):
+                        self._recover_doc(d, bits, growths=0)
+                    else:  # poison: ERR_POS_RANGE with no capacity bit
+                        self._quarantine_doc(d, f"error bits {bits:#x}")
+                    # Retire the batch slot: clear the latched bits so the
+                    # slot never re-triggers (its queue is empty and future
+                    # ops route to the lane).
+                    self.state = self.state._replace(
+                        error=self.state.error.at[slot].set(0)
+                    )
+                    recovered.append(d)
         for d, lane in list(self.overflow.items()):
             bits = int(lane.state.error)
             if bits:
@@ -1360,6 +1642,19 @@ class DocBatchEngine:
                     self._recover_doc(d, bits, growths=lane.growths)
                 else:
                     self._quarantine_doc(d, f"error bits {bits:#x}")
+                recovered.append(d)
+        for d, lane in list(self.seg_lanes.items()):
+            bits = int(np.asarray(lane.state.error))
+            if bits:
+                # A latched segment lane leaves the seg path entirely: the
+                # retained log replays into a standard overflow lane (grow)
+                # or quarantine — staged lane rows ride the log, so nothing
+                # is lost.  Re-promotion is the supervisor's call.
+                self.seg_lanes.pop(d)
+                if mk.is_capacity_error(bits):
+                    self._recover_doc(d, bits, growths=0)
+                else:
+                    self._quarantine_doc(d, f"error bits {bits:#x} (seg lane)")
                 recovered.append(d)
         if recovered:
             # One structured health event per recovery action (no-op
@@ -1551,6 +1846,7 @@ class DocBatchEngine:
             self._oracle_apply_validated(tree, h, msg)
         tree.update_min_seq(h.min_seq)
         self.overflow.pop(d, None)
+        self.seg_lanes.pop(d, None)
         flaps = self._flaps[d] = self._flaps.get(d, 0) + 1
         if self.poison_budget and flaps > self.poison_budget:
             # Flapping: the doc keeps getting re-poisoned after clean
@@ -1688,7 +1984,10 @@ class DocBatchEngine:
             raise ValueError(f"no shard {dst_shard} in a {self.n_shards}-shard mesh")
         if not (0 <= d < self.n_docs):
             raise ValueError(f"no doc {d}")
-        if d in self.overflow or d in self.oracles or d in self.quarantine:
+        if (
+            d in self.overflow or d in self.oracles
+            or d in self.quarantine or d in self.seg_lanes
+        ):
             return False
         src_slot = int(self._slot[d])
         src_shard = src_slot // self.docs_per_shard
@@ -1735,7 +2034,11 @@ class DocBatchEngine:
         adoption handoff per move — ``migrate_doc``).  Returns the
         ``(doc, src_shard, dst_shard)`` moves made; callers re-align the
         scribe pool afterwards (``ScribePool.align_to_placement``) so
-        summary ownership follows the docs."""
+        summary ownership follows the docs.  A shard hot because of ONE doc
+        whose own queue exceeds the fleet mean cannot be rebalanced by
+        placement; with a segs axis available that doc is promoted to the
+        segment-parallel path instead and appears in the result with
+        ``dst_shard == -1`` (its placement slot stays reserved)."""
         ops, depth = self.shard_load()
         load = ops + depth
         hot = self.hot_shards(factor, reset=True, load=load)
@@ -1760,6 +2063,23 @@ class DocBatchEngine:
             ]
             if not candidates:
                 self.counters.bump("hot_shard_moves_skipped")
+                # The skipped case IS the hot-document problem: a doc whose
+                # own queue exceeds the fleet mean cannot be placed away.
+                # With a segs axis available, promote it to the
+                # segment-parallel path instead of leaving it serialized.
+                if self.seg_shards > 1:
+                    hot_docs = sorted(
+                        (
+                            d for d in range(self.n_docs)
+                            if self.shard_of(d) == s and not self._in_lane(d)
+                            and len(self.hosts[d].queue) > factor * mean
+                        ),
+                        key=lambda dd: -len(self.hosts[dd].queue),
+                    )
+                    for d in hot_docs:
+                        if self.enable_segment_sharding(d):
+                            moves.append((d, s, -1))
+                            break
                 continue
             d = max(candidates, key=lambda dd: len(self.hosts[dd].queue))
             for dst in map(int, np.argsort(depth)):
@@ -1802,6 +2122,7 @@ class DocBatchEngine:
             if not (
                 d in self.overflow or d in self.oracles or d in self.quarantine
             )
+            and not (d in self.seg_lanes and self.seg_lanes[d].queue)
             and self.hosts[d].mode == "obj"
             and not self.hosts[d].queue
         ]
@@ -1814,10 +2135,22 @@ class DocBatchEngine:
             self._digests = np.asarray(_fleet_digest(self.state))
             drifted = []
             for d in eligible:
-                mark = (
-                    int(self._digests[int(self._slot[d])]),
-                    self.hosts[d].last_seq,
-                )
+                if d in self.seg_lanes:
+                    # A segment lane's state lives off the batch rows, so
+                    # the slot digest is pristine-stale; the lane's host-
+                    # side version stamp (bumped at every dispatch/
+                    # rebalance/compact) vouches instead — without it every
+                    # sweep would oracle-replay exactly the fleet's
+                    # longest-log docs.
+                    mark = (
+                        "seg", self.seg_lanes[d].version,
+                        self.hosts[d].last_seq,
+                    )
+                else:
+                    mark = (
+                        int(self._digests[int(self._slot[d])]),
+                        self.hosts[d].last_seq,
+                    )
                 if self._verified_digest.get(d) == mark:
                     self.counters.bump("watchdog_prefiltered")
                 else:
@@ -1849,6 +2182,14 @@ class DocBatchEngine:
                 self.counters.bump("watchdog_mismatches")
                 self._quarantine_doc(d, "watchdog: device/oracle divergence")
                 failed.append(d)
+            elif d in self.seg_lanes:
+                # Passed: pin the lane's host-side change mark so the next
+                # sweep skips this doc until a dispatch/rebalance/compact
+                # moves its state or the stream advances.
+                self._verified_digest[d] = (
+                    "seg", self.seg_lanes[d].version,
+                    self.hosts[d].last_seq,
+                )
             elif self._digests is not None:
                 # Passed: pin (digest, seq) so the pre-filter can skip this
                 # doc until its device state or ingested stream moves.
@@ -1886,6 +2227,7 @@ class DocBatchEngine:
                 d not in self.quarantine
                 and d not in self.oracles
                 and d not in self.overflow
+                and d not in self.seg_lanes
                 for d in due
             )
             else None
@@ -1893,11 +2235,30 @@ class DocBatchEngine:
         err = np.asarray(host_state.error) if host_state is not None else None
         for d in due:
             h = self.hosts[d]
-            if h.queue or (d in self.overflow and self.overflow[d].queue):
+            if (
+                h.queue
+                or (d in self.overflow and self.overflow[d].queue)
+                or (d in self.seg_lanes and self.seg_lanes[d].queue)
+            ):
                 continue  # staged-but-unapplied ops: state is mid-step
             lane = "batch"
             geometry = None
-            if d in self.quarantine:
+            if d in self.seg_lanes:
+                # A segment lane checkpoints through the same summary codec
+                # as everything else (gather the live prefixes first).  The
+                # record restores as a batch row — or the fitted-overflow
+                # path when it outgrew the batch geometry — and the
+                # supervisor re-promotes if the doc is still hot.
+                ln = self.seg_lanes[d]
+                seg_host = jax.tree.map(np.asarray, ln.state)
+                if int(seg_host.error):
+                    continue  # never checkpoint a latched lane
+                self._sync_native_props(h)
+                summary = kb.state_to_summary(
+                    mk.seg_gather_state(seg_host),
+                    {v: k for k, v in h.prop_slot.items()},
+                )
+            elif d in self.quarantine:
                 lane = "quarantine"
                 summary = self.quarantine[d].export_summary()
             elif d in self.oracles:
@@ -2089,11 +2450,42 @@ class DocBatchEngine:
         # the tree engine via OverloadGate.emit_gauges).
         self.overload_gate.emit_gauges(
             self.counters, self.megastep_k * self.ops_per_step,
-            max((len(self.hosts[d].queue) for d in self._busy), default=0),
+            max(
+                (
+                    self._queue_depth(d)
+                    for d in self._busy | set(self.seg_lanes)
+                    | set(self.overflow)
+                ),
+                default=0,
+            ),
         )
         # Mesh/placement surface: per-shard load for hot-shard detection
         # (applied since the last hot_shards reset + queued right now).
         self.counters.gauge("n_shards", self.n_shards)
+        # 2-D docs x segs surface: the segs-axis width, how many hot docs
+        # are segment-sharded right now, and the per-shard live-segment
+        # occupancy across all lanes (the rebalance trigger signal).
+        # seg_promotions / seg_demotions / seg_rebalances counters ride the
+        # snapshot; everything here reaches fleet status and /metrics.
+        self.counters.gauge("segment_shards", self.seg_shards)
+        self.counters.gauge("segment_sharded_docs", len(self.seg_lanes))
+        if self.seg_lanes:
+            occ = np.zeros((self.seg_shards,), np.int64)
+            for lane in self.seg_lanes.values():
+                occ += mk.seg_occupancy(lane.state)
+            self.counters.gauge("seg_occupancy", [int(v) for v in occ])
+            self.counters.gauge(
+                "seg_lane_rebalances",
+                sum(lane.rebalances for lane in self.seg_lanes.values()),
+            )
+        elif self.seg_shards > 1:
+            # Gauges persist in the snapshot: zero them once the last lane
+            # demotes, or a supervisor alarming on occupancy skew keeps
+            # seeing the final promoted-state values forever.
+            self.counters.gauge(
+                "seg_occupancy", [0] * self.seg_shards
+            )
+            self.counters.gauge("seg_lane_rebalances", 0)
         if self.n_shards > 1:
             ops, depth = self.shard_load()
             self.counters.gauge("shard_ops", [int(v) for v in ops])
@@ -2143,6 +2535,13 @@ class DocBatchEngine:
 
     # ------------------------------------------------------------------ views
     def doc_state(self, doc_idx: int) -> mk.DocState:
+        if doc_idx in self.seg_lanes:
+            # Gather the per-shard live prefixes back into the canonical
+            # single-doc layout (byte-identical to what the single-lane
+            # kernel would hold — the seg path's oracle contract).
+            return mk.seg_gather_state(
+                jax.tree.map(np.asarray, self.seg_lanes[doc_idx].state)
+            )
         if doc_idx in self.overflow:
             return self.overflow[doc_idx].state
         slot = int(self._slot[doc_idx])
@@ -2177,6 +2576,8 @@ class DocBatchEngine:
         err[: self.n_docs] = by_slot[self._slot]  # doc-indexed view
         for d, lane in self.overflow.items():
             err[d] = int(lane.state.error)
+        for d, lane in self.seg_lanes.items():
+            err[d] = int(np.asarray(lane.state.error))
         for d in self.oracles:
             err[d] = 0
         for d in self.quarantine:

@@ -356,6 +356,35 @@ class StagingRing:
         return False
 
 
+def upload_replicated(ops: np.ndarray, payloads: np.ndarray, mesh=None) -> tuple:
+    """Replicated upload for SEGMENT-LANE op rings: a seg-sharded hot doc's
+    [K, B] slices must reach every shard of the segment axis whole (each
+    shard applies every op to its own segment block), so the device layout
+    is replication — the other half of the 2-D docs x segs shard layout
+    (``StagingRing.upload`` ships the doc-axis half).  Plain ``jnp.asarray``
+    off-mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..observability.flight_recorder import span
+
+    nbytes = ops.nbytes + payloads.nbytes
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from ..ops.mergetree_kernel import SEG_AXIS
+
+        rep = NamedSharding(mesh, PartitionSpec())
+        # Label with the SEG width (not the full 2-D device count) so
+        # upload(kind=seg) spans correlate with the dispatch spans'
+        # seg_shards tag in the flight trace.
+        seg_width = int(dict(mesh.shape).get(SEG_AXIS, mesh.devices.size))
+        with span("upload", kind="seg", shards=seg_width, bytes=nbytes):
+            return jax.device_put(ops, rep), jax.device_put(payloads, rep)
+    with span("upload", kind="seg", shards=1, bytes=nbytes):
+        return jnp.asarray(ops), jnp.asarray(payloads)
+
+
 def _transfer_done(arr) -> bool:
     """Non-blocking transfer-completion probe (best effort: absent on some
     jax versions/backends, where the caller just blocks)."""

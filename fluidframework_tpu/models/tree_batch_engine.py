@@ -292,9 +292,15 @@ class TreeBatchEngine:
             # hot-path collectives (parallel.mesh; same machinery as the
             # string engine).
             self.state = pm.shard_fleet_state(self.state, mesh)
-            specs = pm.fleet_state_specs(self.state)
+            # On a docs x segs mesh the doc dim shards over BOTH axes
+            # flattened — the program specs must match the placement
+            # shard_fleet_state derives from the mesh, or the first
+            # donated dispatch reshards the fleet.
+            da = pm.fleet_doc_axes(mesh)
+            specs = pm.fleet_state_specs(self.state, da)
             self._megastep = pm.mesh_fleet_program(
-                tk.apply_nested_megastep, mesh, specs
+                tk.apply_nested_megastep, mesh, specs,
+                arg_specs=(pm.P(None, da), pm.P(None, da)),
             )
             self._compact = pm.mesh_fleet_program(
                 _tree_compact_body, mesh, specs, arg_specs=()
@@ -718,6 +724,10 @@ class TreeBatchEngine:
             self._stage = StagingRing(
                 self.megastep_k, self.fleet_capacity, self.ops_per_step,
                 tk.NESTED_OP_FIELDS, self.max_insert_len, mesh=self.mesh,
+                doc_axis=(
+                    pm.fleet_doc_axes(self.mesh)
+                    if self.mesh is not None else "docs"
+                ),
             )
         return self._stage
 
@@ -982,6 +992,11 @@ class TreeBatchEngine:
             max((len(self.hosts[d].queue) for d in self._busy), default=0),
         )
         self.counters.gauge("n_shards", self.n_shards)
+        # Rebalance parity gap, surfaced: the tree fleet detects hot shards
+        # but cannot migrate docs (rebalance_hot_shards is a counted
+        # no-op), so the count is always present for supervisors to alarm
+        # on — zero means "no imbalance seen", not "unmonitored".
+        self.counters.bump("migrations_unsupported", 0)
         if self.n_shards > 1:
             depth = [0] * self.n_shards
             for d in range(self.n_docs):
@@ -1028,6 +1043,46 @@ class TreeBatchEngine:
     def placement(self) -> dict[str, int]:
         """doc key -> mesh shard (ScribePool.align_to_placement surface)."""
         return {self.doc_keys[d]: self.shard_of(d) for d in range(self.n_docs)}
+
+    def hot_shards(
+        self, factor: float = 2.0, reset: bool = False, load=None
+    ) -> list[int]:
+        """Shards whose queued-op load exceeds ``factor`` x the fleet mean —
+        the same detection surface as the string engine (which also folds in
+        applied-op counters; the tree fleet only tracks queue depth).
+        ``reset``/``load`` are accepted for signature parity with
+        ``DocBatchEngine.hot_shards`` (engine-agnostic supervisors) and
+        ignored: there are no applied-op counters to reset, and queue
+        depth is recomputed each call."""
+        if self.n_shards <= 1:
+            return []
+        depth = np.zeros((self.n_shards,), np.int64)
+        for d in range(self.n_docs):
+            q = len(self.hosts[d].queue)
+            if q:
+                depth[self.shard_of(d)] += q
+        if not depth.any():
+            return []
+        return [int(s) for s in np.flatnonzero(depth > factor * depth.mean())]
+
+    def rebalance_hot_shards(
+        self, factor: float = 2.0, max_moves: int = 1
+    ) -> list[tuple[int, int, int]]:
+        """Parity surface with ``DocBatchEngine.rebalance_hot_shards`` —
+        but the tree fleet has slot-fixed placement (no slot indirection,
+        no ``migrate_doc``), so this is a COUNTED no-op: hot shards are
+        detected and ``migrations_unsupported`` is bumped per detection so
+        fleet supervisors can alarm on sustained imbalance instead of the
+        previous silent nothing.  Returns [] always."""
+        hot = self.hot_shards(factor)
+        if hot:
+            self.counters.bump("migrations_unsupported", len(hot))
+            if self.counters.logger is not None:
+                self.counters.logger.error(
+                    "tree_rebalance_unsupported",
+                    f"hot shards {hot} (tree fleet cannot migrate docs)",
+                )
+        return []
 
     def errors(self) -> np.ndarray:
         return np.asarray(self.state.error)[: self.n_docs]

@@ -459,10 +459,20 @@ def _obliterate_new_segment(s: DocState, k, key, client, ref_seq):
     remove slots (sorted ascending, NO_REMOVE padded), its
     obliteratePrecedingInsertion stamp key (-1 none), and whether the
     candidate stamps overflowed the R slots."""
+    return _obliterate_swallow(s, _ob_anchor_indices(s), k, key, client, ref_seq)
+
+
+def _obliterate_swallow(s: DocState, anchors, k, key, client, ref_seq):
+    """Swallow analysis shared by the single-lane and segment-parallel
+    inserts: ``anchors`` carries the (start idx, found, end idx, found)
+    tuple in whatever index space ``k`` lives in (absolute for the single
+    lane, global for the sharded layout).  Everything here reads only the
+    replicated obliterate window table, so the sharded path can run it
+    identically on every shard."""
     R = len(s.rem_keys)
     OB = s.ob_key.shape[0]
     used = s.ob_key >= 0
-    s_idx, s_found, e_idx, e_found = _ob_anchor_indices(s)
+    s_idx, s_found, e_idx, e_found = anchors
     # New segment lands at k: inside the anchor window iff strictly after
     # the start anchor and at/before the end anchor (pre-insert indices).
     inside = used & s_found & e_found & (s_idx < k) & (e_idx >= k)
@@ -616,9 +626,10 @@ def _do_remove(s: DocState, op, payload) -> DocState:
     )
 
 
-def _do_annotate(s: DocState, op, payload) -> DocState:
+def _annotate_marked(s: DocState, mark, op) -> DocState:
+    """The annotate LWW write against an already-computed mark mask
+    (shared by the single-lane and segment-parallel paths)."""
     key, prop_slot, value = op[1], op[6], op[7]
-    s, mark = _mark_range(s, op)
     prop_keys = list(s.prop_keys)
     prop_vals = list(s.prop_vals)
     for p in range(len(prop_keys)):
@@ -628,6 +639,50 @@ def _do_annotate(s: DocState, op, payload) -> DocState:
         prop_keys[p] = jnp.where(win, key, prop_keys[p])
         prop_vals[p] = jnp.where(win, value, prop_vals[p])
     return s._replace(prop_keys=tuple(prop_keys), prop_vals=tuple(prop_vals))
+
+
+def _do_annotate(s: DocState, op, payload) -> DocState:
+    s, mark = _mark_range(s, op)
+    return _annotate_marked(s, mark, op)
+
+
+def _obliterate_visit(s: DocState, vis, key, client, ref_seq):
+    """The obliterate marking visit rule (ref nodeMap mergeTree.ts:2990-3001
+    + markRemoved splice, walking RemoteObliteratePerspective for remote
+    ops), shared by the single-lane and segment-parallel paths (purely
+    element-wise over the segment axis): a REMOTE obliterate visits — and
+    splices into — every window segment except those dead in both views:
+    acked-removed AND invisible at the op's refSeq AND not a local pending
+    insert.  A LOCAL obliterate marks exactly the segments visible to the
+    op's (local) perspective.  Returns (visit, skip) masks."""
+    rem_min = _min_tree(s.rem_keys)
+    has_acked_rem = rem_min < LOCAL_BASE
+    is_local_ins = s.ins_key >= LOCAL_BASE
+    # Concurrent-inserted segments are spliced even when acked-removed (the
+    # obliterater's replica swallowed them at insert time), unless an older
+    # remove stamp from the same client already covers them (then the extra
+    # stamp would be unobservable and the issuer never added it).
+    ins_conc = ~((s.ins_key <= ref_seq) | (s.ins_client == client))
+    # The issuer swallowed a concurrent insert at INSERT time by appending
+    # its OLDEST covering pending obliterate; our stamp already exists there
+    # iff some same-client stamp came from an obliterate pending at the
+    # issuer when the insert arrived: ins_seq < k <= key (== key is an
+    # earlier op of the same grouped batch, sharing our sequence number).
+    same_client_stamp = _any_tree(
+        [
+            (c == client) & (k > s.ins_key) & (k <= key)
+            for k, c in zip(s.rem_keys, s.rem_clients)
+        ]
+    )
+    visit = jnp.where(
+        key >= LOCAL_BASE,
+        vis,
+        ~has_acked_rem | vis | is_local_ins | (ins_conc & ~same_client_stamp),
+    )
+    # Last-obliterater-wins: never mark a local pending insert whose newest
+    # preceding obliterate is an (even newer) local pending one.
+    skip = (s.ins_key >= LOCAL_BASE) & (s.seg_obpre >= LOCAL_BASE) & (key < LOCAL_BASE)
+    return visit, skip
 
 
 def _do_obliterate(s: DocState, op, payload) -> DocState:
@@ -658,39 +713,7 @@ def _do_obliterate(s: DocState, op, payload) -> DocState:
     lo = s_idx + (side1 == SIDE_AFTER).astype(I32)
     hi = e_idx - (side2 == SIDE_BEFORE).astype(I32)
     idx = jnp.arange(s.seg_len.shape[0], dtype=I32)
-    # Marking visit rule (ref nodeMap mergeTree.ts:2990-3001 + markRemoved
-    # splice, walking RemoteObliteratePerspective for remote ops): a REMOTE
-    # obliterate visits — and splices into — every window segment except
-    # those dead in both views: acked-removed AND invisible at the op's
-    # refSeq AND not a local pending insert.  A LOCAL obliterate marks
-    # exactly the segments visible to the op's (local) perspective.
-    rem_min = _min_tree(s.rem_keys)
-    has_acked_rem = rem_min < LOCAL_BASE
-    is_local_ins = s.ins_key >= LOCAL_BASE
-    # Concurrent-inserted segments are spliced even when acked-removed (the
-    # obliterater's replica swallowed them at insert time), unless an older
-    # remove stamp from the same client already covers them (then the extra
-    # stamp would be unobservable and the issuer never added it).
-    ins_conc = ~((s.ins_key <= ref_seq) | (s.ins_client == client))
-    # The issuer swallowed a concurrent insert at INSERT time by appending
-    # its OLDEST covering pending obliterate; our stamp already exists there
-    # iff some same-client stamp came from an obliterate pending at the
-    # issuer when the insert arrived: ins_seq < k <= key (== key is an
-    # earlier op of the same grouped batch, sharing our sequence number).
-    same_client_stamp = _any_tree(
-        [
-            (c == client) & (k > s.ins_key) & (k <= key)
-            for k, c in zip(s.rem_keys, s.rem_clients)
-        ]
-    )
-    visit = jnp.where(
-        key >= LOCAL_BASE,
-        vis,
-        ~has_acked_rem | vis | is_local_ins | (ins_conc & ~same_client_stamp),
-    )
-    # Last-obliterater-wins: never mark a local pending insert whose newest
-    # preceding obliterate is an (even newer) local pending one.
-    skip = (s.ins_key >= LOCAL_BASE) & (s.seg_obpre >= LOCAL_BASE) & (key < LOCAL_BASE)
+    visit, skip = _obliterate_visit(s, vis, key, client, ref_seq)
     mark = valid & _alive(s) & (idx >= lo) & (idx <= hi) & visit & ~skip
     # Splice the stamp into the first free remove slot (segments covered by
     # earlier removes already occupy lower slots).
@@ -853,6 +876,616 @@ def apply_megastep(
         return st, None
 
     out, _ = jax.lax.scan(body, s, (ops, payloads))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Segment-parallel apply (the docs x segs serving path)
+# --------------------------------------------------------------------------
+#
+# One viral document serializes a whole lane: the [S] per-segment arrays are
+# the per-op cost, and a hot doc's S is the largest on the box.  The
+# segment-parallel variant block-shards those arrays over a named mesh axis
+# (default "segs") — shard k owns the k-th contiguous run of the GLOBAL
+# segment order, per-shard live counts vary (``nseg`` becomes int32[n_shards],
+# one live count per shard), and the global order is the concatenation of the
+# per-shard live prefixes.  The text pool, every scalar, and the obliterate
+# window table stay REPLICATED, so stamp/uid/text values are bit-identical to
+# the single-lane kernel and a gather of the live prefixes reproduces the
+# single-lane state exactly (the byte-identity fuzz contract; the single-lane
+# path is the oracle).
+#
+# Per op, the collective structure is the two-hop scheme of
+# parallel/long_doc.py ("Parallel Batch-Dynamic Trees via Change
+# Propagation" / "Data Structures for Mergeable Trees", PAPERS.md):
+#
+#   hop 1: all_gather of per-shard visible totals (and live counts) turns
+#          local prefix sums into global coordinates,
+#   local: masked prefix-sum / containment search inside the shard,
+#   hop 2: pmin/psum combines per-shard one-hot candidates into the global
+#          insert index / anchor index / owner decision.
+#
+# Mutations are OWNER-LOCAL: exactly one shard owns the op's landing
+# segment, and the O(S_local) suffix shift of ``_open_slot`` runs under a
+# real ``lax.cond`` on that shard only — legal here because a segment lane
+# is a single-document program (no vmap to degrade the cond to a select).
+# Range ops (remove/annotate/obliterate) are purely-local mask updates once
+# the global prefix is known.  Inserts land shard-local; the layout re-blocks
+# only at rebalance points (``seg_rebalance_state`` below, reusing the
+# compaction gather's fill conventions).
+#
+# These functions use named-axis collectives and MUST run inside a
+# ``shard_map`` over the segment axis (parallel.mesh.mesh_seg_program).
+
+SEG_AXIS = "segs"
+
+# Route the shard-local containment searches through the blocked Pallas
+# kernel (ops/pallas_kernels.py) instead of the jnp membership mask.  The
+# jnp/lax form is the oracle; the Pallas form streams the segment axis
+# through VMEM on TPU (a long doc's shard still holds 100k+ segments).
+# Trace-time flag: set it before the first segment-lane dispatch compiles.
+SEG_RESOLVE_PALLAS = False
+
+
+def _seg_prefix(s: DocState, vis, axis: str):
+    """Hop 1: (vlen, excl_global, total, char_off) — one all_gather of
+    per-shard visible totals turns the local exclusive prefix into global
+    perspective-visible coordinates."""
+    vlen = jnp.where(vis, s.seg_len, 0)
+    totals = jax.lax.all_gather(jnp.sum(vlen), axis)  # [n_shards]
+    my = jax.lax.axis_index(axis)
+    char_off = jnp.sum(jnp.where(jnp.arange(totals.shape[0]) < my, totals, 0))
+    excl = jnp.cumsum(vlen) - vlen + char_off
+    return vlen, excl, jnp.sum(totals), char_off
+
+
+def _seg_index_base(s: DocState, axis: str):
+    """Hop 1b: (idx_off, nseg_total, counts) — the global segment-index
+    base of this shard (global order = concatenation of per-shard live
+    prefixes) from one all_gather of the live counts."""
+    counts = jax.lax.all_gather(s.nseg, axis)  # [n_shards]
+    my = jax.lax.axis_index(axis)
+    idx_off = jnp.sum(jnp.where(jnp.arange(counts.shape[0]) < my, counts, 0))
+    return idx_off, jnp.sum(counts), counts
+
+
+def _seg_first_true(mask, idx_off, default, axis: str):
+    """Hop 2: global index of the first set bit across shards (pmin of the
+    per-shard one-hot candidates), else ``default``.  ``mask`` must only be
+    set inside the shard's live prefix."""
+    has = jnp.any(mask)
+    big = jnp.asarray(2**31 - 1, I32)
+    cand = jnp.where(has, idx_off + jnp.argmax(mask).astype(I32), big)
+    best = jax.lax.pmin(cand, axis)
+    return jnp.where(best == big, default, best)
+
+
+def _seg_contains(vlen, q_local, strict: bool):
+    """Shard-local containment search: (local index, hit) of the visible
+    segment containing the local-coordinate query (``strict`` excludes
+    boundary hits — the split predicate).  Behind ``SEG_RESOLVE_PALLAS``
+    the blocked Pallas kernel is the fused inner loop; the jnp form is the
+    oracle and the non-TPU fallback."""
+    if SEG_RESOLVE_PALLAS:
+        from .pallas_kernels import resolve_positions_blocked
+
+        idx, off, hit = resolve_positions_blocked(vlen, q_local[None])
+        idx, off, hit = idx[0], off[0], hit[0] != 0
+        if strict:
+            hit = hit & (off > 0)
+        return idx.astype(I32), hit
+    prefix = jnp.cumsum(vlen) - vlen
+    if strict:
+        inside = (prefix < q_local) & (q_local < prefix + vlen)
+    else:
+        inside = (vlen > 0) & (prefix <= q_local) & (q_local < prefix + vlen)
+    return jnp.argmax(inside).astype(I32), jnp.any(inside)
+
+
+def _open_slot_seg(s: DocState, k, do, new: _NewSeg, axis: str) -> DocState:
+    """Owner-local ``_open_slot``: ``do`` is a SHARD-LOCAL scalar (exactly
+    one shard owns the insert), so the O(S_local) suffix shift runs under a
+    real branch on the owning shard only — the non-owners skip the heavy
+    gather/select entirely.  Shard capacity overflow latches
+    ERR_SEG_OVERFLOW globally (psum), exactly like the single-lane latch;
+    host recovery re-blocks or re-provisions."""
+    S = s.seg_len.shape[0]
+    overflow = do & (s.nseg >= S)
+    do = do & ~overflow
+    R, Pn = len(s.rem_keys), len(s.prop_keys)
+    flat = (
+        s.seg_start, s.seg_len, s.ins_key, s.ins_client, s.seg_uid,
+        s.seg_obpre, *s.rem_keys, *s.rem_clients, *s.prop_keys, *s.prop_vals,
+    )
+    vals = (
+        new.seg_start, new.seg_len, new.ins_key, new.ins_client, new.seg_uid,
+        new.seg_obpre, *new.rem_keys, *new.rem_clients, *new.prop_keys,
+        *new.prop_vals,
+    )
+    shifted = jax.lax.cond(
+        do,
+        lambda t: tuple(_shift_right(a, k, v) for a, v in zip(t, vals)),
+        lambda t: t,
+        flat,
+    )
+    err = jax.lax.psum(jnp.where(overflow, ERR_SEG_OVERFLOW, 0), axis)
+    return s._replace(
+        seg_start=shifted[0], seg_len=shifted[1], ins_key=shifted[2],
+        ins_client=shifted[3], seg_uid=shifted[4], seg_obpre=shifted[5],
+        rem_keys=tuple(shifted[6 : 6 + R]),
+        rem_clients=tuple(shifted[6 + R : 6 + 2 * R]),
+        prop_keys=tuple(shifted[6 + 2 * R : 6 + 2 * R + Pn]),
+        prop_vals=tuple(shifted[6 + 2 * R + Pn :]),
+        nseg=s.nseg + do.astype(I32),
+        error=s.error | err,
+    )
+
+
+def _ensure_boundary_seg(s: DocState, pos, ref_seq, client, axis: str) -> DocState:
+    """Distributed ``_ensure_boundary``: the containing segment (if any) is
+    strictly inside exactly one shard; that shard splits locally.  The split
+    uid allocation and obliterate anchor side-moves replay identically on
+    every shard from the replicated uid_next / ob table plus one psum
+    broadcast of the split segment's old uid."""
+    vis = _visible(s, ref_seq, client)
+    vlen, excl, _total, char_off = _seg_prefix(s, vis, axis)
+    k, hit = _seg_contains(vlen, pos - char_off, strict=True)
+    do = jax.lax.psum(hit.astype(I32), axis) > 0
+    off = pos - excl[k]
+    old_uid = jax.lax.psum(jnp.where(hit, s.seg_uid[k], 0), axis)
+    right_uid = s.uid_next
+    right = _NewSeg(
+        seg_start=s.seg_start[k] + off,
+        seg_len=s.seg_len[k] - off,
+        ins_key=s.ins_key[k],
+        ins_client=s.ins_client[k],
+        seg_uid=right_uid,
+        seg_obpre=s.seg_obpre[k],
+        rem_keys=tuple(a[k] for a in s.rem_keys),
+        rem_clients=tuple(a[k] for a in s.rem_clients),
+        prop_keys=tuple(a[k] for a in s.prop_keys),
+        prop_vals=tuple(a[k] for a in s.prop_vals),
+    )
+    s2 = _open_slot_seg(s, k + 1, hit, right, axis)
+    # Trim the left half (owner only; pre-overflow ``hit``/``do`` exactly as
+    # the single-lane path uses its pre-overflow ``do``).
+    new_len = jnp.where(hit, off, s2.seg_len[k])
+    moved_start = do & (s2.ob_start_uid == old_uid) & (s2.ob_start_side == SIDE_AFTER)
+    moved_end = do & (s2.ob_end_uid == old_uid) & (s2.ob_end_side == SIDE_AFTER)
+    return s2._replace(
+        seg_len=s2.seg_len.at[k].set(new_len),
+        uid_next=s2.uid_next + do.astype(I32),
+        ob_start_uid=jnp.where(moved_start, right_uid, s2.ob_start_uid),
+        ob_end_uid=jnp.where(moved_end, right_uid, s2.ob_end_uid),
+    )
+
+
+def _ob_anchor_indices_seg(s: DocState, idx_off, axis: str):
+    """``_ob_anchor_indices`` in global coordinates: local uid matches (uids
+    are globally unique, so at most one shard hits per anchor), one psum
+    pair combines the per-shard one-hots."""
+    alive = _alive(s)
+    m_start = (s.ob_start_uid[:, None] == s.seg_uid[None, :]) & alive[None, :]
+    m_end = (s.ob_end_uid[:, None] == s.seg_uid[None, :]) & alive[None, :]
+    ls = jnp.argmax(m_start, axis=1).astype(I32)
+    le = jnp.argmax(m_end, axis=1).astype(I32)
+    fs = m_start.any(axis=1)
+    fe = m_end.any(axis=1)
+    s_idx = jax.lax.psum(jnp.where(fs, idx_off + ls, 0), axis)
+    e_idx = jax.lax.psum(jnp.where(fe, idx_off + le, 0), axis)
+    s_found = jax.lax.psum(fs.astype(I32), axis) > 0
+    e_found = jax.lax.psum(fe.astype(I32), axis) > 0
+    return s_idx, s_found, e_idx, e_found
+
+
+def _do_insert_seg(s: DocState, op, payload, ob_flag: bool, axis: str) -> DocState:
+    pos, key, client, ref_seq = op[4], op[1], op[2], op[3]
+    text_len = op[6]
+    s = _ensure_boundary_seg(s, pos, ref_seq, client, axis)
+    vis = _visible(s, ref_seq, client)
+    vlen, excl, total, _off = _seg_prefix(s, vis, axis)
+    idx_off, nseg_total, counts = _seg_index_base(s, axis)
+    # Boundary walk in global coordinates: the stop mask is local, the
+    # first stop across shards comes from one pmin (hop 2).
+    stop = _alive(s) & (excl >= pos) & ((vlen > 0) | _tiebreak(s, key))
+    k_g = _seg_first_true(stop, idx_off, nseg_total, axis)
+    my = jax.lax.axis_index(axis)
+    append = k_g >= nseg_total
+    # Appends land on the LAST shard (any other placement would interleave
+    # the new segment before a later shard's run and break global order).
+    is_owner = jnp.where(
+        append,
+        my == counts.shape[0] - 1,
+        (idx_off <= k_g) & (k_g < idx_off + s.nseg),
+    )
+    k_local = jnp.where(append, s.nseg, k_g - idx_off).astype(I32)
+
+    # Payload lands in the REPLICATED text pool: every shard appends the
+    # same bytes at the same (replicated) text_end, so seg_start values are
+    # global offsets bit-identical to the single-lane pool.
+    T = s.text.shape[0]
+    tpos = jnp.arange(payload.shape[0], dtype=I32)
+    text_over = s.text_end + text_len > T
+    dst = jnp.where((tpos < text_len) & ~text_over, s.text_end + tpos, T)
+    text = s.text.at[dst].set(payload, mode="drop")
+
+    if ob_flag:
+        anchors = _ob_anchor_indices_seg(s, idx_off, axis)
+        new_rem_k, new_rem_c, obpre, rem_over = _obliterate_swallow(
+            s, anchors, k_g, key, client, ref_seq
+        )
+    else:
+        new_rem_k, new_rem_c, obpre, rem_over = _no_obliterate_swallow(s)
+    Pn = len(s.prop_keys)
+    zero = jnp.zeros((), I32)
+    new = _NewSeg(
+        seg_start=s.text_end,
+        seg_len=text_len,
+        ins_key=key,
+        ins_client=client,
+        seg_uid=s.uid_next,
+        seg_obpre=obpre,
+        rem_keys=new_rem_k,
+        rem_clients=new_rem_c,
+        prop_keys=tuple(jnp.full((), -1, I32) for _ in range(Pn)),
+        prop_vals=tuple(zero for _ in range(Pn)),
+    )
+    ok = ~text_over & (pos <= total)
+    s = _open_slot_seg(s, k_local, ok & is_owner, new, axis)
+    return s._replace(
+        text=jnp.where(text_over, s.text, text),
+        text_end=s.text_end + jnp.where(ok, text_len, 0),
+        uid_next=s.uid_next + ok.astype(I32),
+        error=s.error
+        | jnp.where(text_over, ERR_TEXT_OVERFLOW, 0)
+        | jnp.where(pos > total, ERR_POS_RANGE, 0)
+        | jnp.where(ok & rem_over, ERR_REM_OVERFLOW, 0),
+    )
+
+
+def _mark_range_seg(s: DocState, op, axis: str):
+    """Distributed ``_mark_range``: split at both boundaries, then the
+    in-range mask is a purely-local comparison against the global prefix."""
+    pos1, pos2, client, ref_seq = op[4], op[5], op[2], op[3]
+    s = _ensure_boundary_seg(s, pos1, ref_seq, client, axis)
+    s = _ensure_boundary_seg(s, pos2, ref_seq, client, axis)
+    vis = _visible(s, ref_seq, client)
+    vlen, excl, total, _off = _seg_prefix(s, vis, axis)
+    mark = vis & (excl >= pos1) & (excl + vlen <= pos2) & (vlen > 0)
+    s = s._replace(error=s.error | jnp.where(pos2 > total, ERR_POS_RANGE, 0))
+    return s, mark
+
+
+def _do_remove_seg(s: DocState, op, payload, axis: str) -> DocState:
+    key, client = op[1], op[2]
+    s, mark = _mark_range_seg(s, op, axis)
+    rem_keys, rem_clients, over_l = _splice_remove_stamp(s, mark, key, client)
+    overflow = jax.lax.psum(over_l.astype(I32), axis) > 0
+    return s._replace(
+        rem_keys=rem_keys,
+        rem_clients=rem_clients,
+        error=s.error | jnp.where(overflow, ERR_REM_OVERFLOW, 0),
+    )
+
+
+def _do_annotate_seg(s: DocState, op, payload, axis: str) -> DocState:
+    s, mark = _mark_range_seg(s, op, axis)
+    return _annotate_marked(s, mark, op)
+
+
+def _do_obliterate_seg(s: DocState, op, payload, axis: str) -> DocState:
+    """Distributed ``_do_obliterate``: anchors resolve with the two hops,
+    the visit/skip masks and the remove-stamp splice are local, and the
+    obliterate window record replays identically on every shard from the
+    psum-broadcast anchor uids."""
+    key, client, ref_seq = op[1], op[2], op[3]
+    pos1, pos2, side1, side2 = op[4], op[5], op[6], op[7]
+    start_pos = pos1 + side1
+    end_pos = pos2 + side2
+    vis = _visible(s, ref_seq, client)
+    _vlen, _excl, total, _off = _seg_prefix(s, vis, axis)
+    valid = (0 <= pos1) & (pos1 <= pos2) & (pos2 < total) & (start_pos <= end_pos)
+    s = _ensure_boundary_seg(s, jnp.where(valid, start_pos, 0), ref_seq, client, axis)
+    s = _ensure_boundary_seg(s, jnp.where(valid, end_pos, 0), ref_seq, client, axis)
+    vis = _visible(s, ref_seq, client)
+    vlen, _excl2, _t2, char_off = _seg_prefix(s, vis, axis)
+    idx_off, nseg_total, _counts = _seg_index_base(s, axis)
+    ks, hs = _seg_contains(vlen, pos1 - char_off, strict=False)
+    ke, he = _seg_contains(vlen, pos2 - char_off, strict=False)
+    s_found = jax.lax.psum(hs.astype(I32), axis) > 0
+    e_found = jax.lax.psum(he.astype(I32), axis) > 0
+    s_idx = jnp.where(
+        s_found, jax.lax.psum(jnp.where(hs, idx_off + ks, 0), axis), nseg_total
+    )
+    e_idx = jnp.where(
+        e_found, jax.lax.psum(jnp.where(he, idx_off + ke, 0), axis), nseg_total
+    )
+    start_uid = jax.lax.psum(jnp.where(hs, s.seg_uid[ks], 0), axis)
+    end_uid = jax.lax.psum(jnp.where(he, s.seg_uid[ke], 0), axis)
+    lo = s_idx + (side1 == SIDE_AFTER).astype(I32)
+    hi = e_idx - (side2 == SIDE_BEFORE).astype(I32)
+    # Global index of local slot j inside the live prefix is idx_off + j
+    # (dead slots are gated by the alive mask below).
+    gidx = idx_off + jnp.arange(s.seg_len.shape[0], dtype=I32)
+    visit, skip = _obliterate_visit(s, vis, key, client, ref_seq)
+    mark = valid & _alive(s) & (gidx >= lo) & (gidx <= hi) & visit & ~skip
+    rem_keys, rem_clients, over_l = _splice_remove_stamp(s, mark, key, client)
+    rem_over = jax.lax.psum(over_l.astype(I32), axis) > 0
+    free = s.ob_key < 0
+    slot = _first_true(free, jnp.asarray(0, I32))
+    has_free = jnp.any(free)
+    rec = valid & has_free
+
+    def put(arr, val):
+        return arr.at[slot].set(jnp.where(rec, val, arr[slot]))
+
+    return s._replace(
+        rem_keys=rem_keys,
+        rem_clients=rem_clients,
+        ob_key=put(s.ob_key, key),
+        ob_client=put(s.ob_client, client),
+        ob_start_uid=put(s.ob_start_uid, start_uid),
+        ob_end_uid=put(s.ob_end_uid, end_uid),
+        ob_start_side=put(s.ob_start_side, side1),
+        ob_end_side=put(s.ob_end_side, side2),
+        ob_ref_seq=put(s.ob_ref_seq, ref_seq),
+        error=s.error
+        | jnp.where(~valid, ERR_POS_RANGE, 0)
+        | jnp.where(valid & ~has_free, ERR_OB_OVERFLOW, 0)
+        | jnp.where(rem_over, ERR_REM_OVERFLOW, 0),
+    )
+
+
+def apply_op_seg(
+    s: DocState, op: jnp.ndarray, payload: jnp.ndarray, ob_flag: bool,
+    axis: str = SEG_AXIS,
+) -> DocState:
+    """Segment-parallel ``apply_op``.  ``ob_flag`` must be a PYTHON bool
+    (the scan level hoists the runtime gate — see ``apply_ops_seg``); ACK is
+    the single-lane branch verbatim (purely element-wise over local arrays
+    plus replicated ob-table rewrites)."""
+    kind = op[0]
+    branches = [
+        lambda s, op, p: s,  # NOOP
+        lambda s, op, p: _do_insert_seg(s, op, p, ob_flag, axis),
+        lambda s, op, p: _do_remove_seg(s, op, p, axis),
+        lambda s, op, p: _do_annotate_seg(s, op, p, axis),
+        _do_ack,
+        (lambda s, op, p: _do_obliterate_seg(s, op, p, axis))
+        if ob_flag
+        else (lambda s, op, p: s),
+    ]
+    return jax.lax.switch(kind, branches, s, op, payload)
+
+
+def apply_ops_seg(
+    s: DocState, ops: jnp.ndarray, payloads: jnp.ndarray, ob_flag=None,
+    axis: str = SEG_AXIS,
+) -> DocState:
+    """Segment-parallel ``apply_ops``: one op batch for ONE document, in
+    order, per-segment work sharded over ``axis``.  The runtime obliterate
+    gate hoists to whole-scan level exactly like ``apply_ops`` (the flag is
+    replicated, so every shard takes the same branch and the collectives
+    inside stay matched)."""
+    if ob_flag is None:
+        ob_flag = jnp.any(s.ob_key >= 0) | jnp.any(ops[:, 0] == OpKind.OBLITERATE)
+
+    def scan_spec(st: DocState, flag: bool) -> DocState:
+        def step(carry, xs):
+            op, payload = xs
+            return apply_op_seg(carry, op, payload, flag, axis), None
+
+        out, _ = jax.lax.scan(step, st, (ops, payloads))
+        return out
+
+    if isinstance(ob_flag, bool):
+        return scan_spec(s, ob_flag)
+    return jax.lax.cond(
+        ob_flag,
+        lambda st: scan_spec(st, True),
+        lambda st: scan_spec(st, False),
+        s,
+    )
+
+
+def apply_megastep_seg(
+    s: DocState, ops: jnp.ndarray, payloads: jnp.ndarray, axis: str = SEG_AXIS
+) -> DocState:
+    """Segment-parallel megastep: apply a [K, B] op ring to ONE seg-sharded
+    document in one fused program (lax.scan over the K slice axis, per-slice
+    obliterate gate carried on device — the single-doc analog of
+    ``apply_megastep``).
+
+    This is a ``shard_map`` BODY over the segment axis
+    (parallel.mesh.mesh_seg_program dispatches it): ``s`` arrives as the
+    local shard view of a seg-sharded state — per-segment arrays [S_local],
+    ``nseg`` boxed as int32[1] (this shard's live count), text/scalars/ob
+    table replicated — and ops/payloads arrive replicated.
+    """
+    s = s._replace(nseg=s.nseg[0])
+
+    def body(st: DocState, xs):
+        o, p = xs
+        flag = jnp.any(st.ob_key >= 0) | jnp.any(o[..., 0] == OpKind.OBLITERATE)
+        st = apply_ops_seg(st, o, p, flag, axis)
+        return st, None
+
+    out, _ = jax.lax.scan(body, s, (ops, payloads))
+    return out._replace(nseg=out.nseg[None])
+
+
+def compact_seg(
+    s: DocState, min_seq: jnp.ndarray, axis: str = SEG_AXIS
+) -> DocState:
+    """Zamboni on the seg-sharded layout (``shard_map`` body, like
+    ``apply_megastep_seg``): ``set_min_seq`` is replicated arithmetic and
+    eviction is a purely shard-local stable compaction — order is preserved
+    within each shard, so the global concatenation order is preserved."""
+    s = s._replace(nseg=s.nseg[0])
+    out = compact(set_min_seq(s, min_seq))
+    return out._replace(nseg=out.nseg[None])
+
+
+# ----------------------------------------------------- host-side seg packing
+
+# Dead-slot fill per per-segment field, shared by ``seg_shard_state`` and
+# ``seg_gather_state`` (tuple-typed fields fill every element array).
+# These MUST match the compaction gather's fills (``compact``'s dead-slot
+# conventions) for gather-after-shard to be the identity the byte-identity
+# fuzz asserts.
+_SEG_FILL = {
+    "seg_start": 0, "seg_len": 0, "ins_key": 0, "ins_client": -1,
+    "seg_uid": -1, "seg_obpre": -1,
+    "rem_keys": NO_REMOVE, "rem_clients": -1,
+    "prop_keys": -1, "prop_vals": 0,
+}
+
+
+def _seg_repack(state: DocState, pack) -> dict:
+    """Apply ``pack(arr, fill)`` to every per-segment field of ``state``
+    per ``_SEG_FILL`` — the one place the fill conventions are spelled."""
+    out = {}
+    for f, fill in _SEG_FILL.items():
+        v = getattr(state, f)
+        out[f] = (
+            tuple(pack(a, fill) for a in v)
+            if isinstance(v, tuple) else pack(v, fill)
+        )
+    return out
+
+
+def seg_shard_state(
+    state: DocState,
+    n_shards: int,
+    s_local: int | None = None,
+    text_capacity: int | None = None,
+) -> DocState:
+    """Host-side re-block of a single-doc DocState into the seg-sharded
+    layout: the live segments split into ``n_shards`` balanced contiguous
+    runs, per-segment arrays become [n_shards * s_local] (block-shard over
+    the segment axis), ``nseg`` becomes int32[n_shards] per-shard live
+    counts, and the text pool / scalars / obliterate table replicate
+    verbatim (text offsets stay GLOBAL, so ``seg_gather_state`` round-trips
+    byte-identically).  ``text_capacity`` optionally grows the replicated
+    pool for a hot doc.  Leaves are numpy; the caller device_puts them with
+    ``parallel.mesh.shard_seg_state``."""
+    state = jax.tree.map(np.asarray, state)
+    nseg = int(state.nseg)
+    S_old = state.seg_len.shape[0]
+    if s_local is None:
+        s_local = -(-S_old // n_shards)
+    base, extra = divmod(nseg, n_shards)
+    counts = [base + (1 if i < extra else 0) for i in range(n_shards)]
+    if max(counts) > s_local:
+        raise ValueError(
+            f"{nseg} live segments do not block into {n_shards} shards of "
+            f"{s_local} slots"
+        )
+    starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+
+    def blk(arr: np.ndarray, fill: int) -> np.ndarray:
+        out = np.full((n_shards * s_local,), fill, np.int32)
+        for i in range(n_shards):
+            out[i * s_local : i * s_local + counts[i]] = arr[
+                starts[i] : starts[i] + counts[i]
+            ]
+        return out
+
+    T_old = state.text.shape[0]
+    T = text_capacity if text_capacity is not None else T_old
+    if T < int(state.text_end):
+        raise ValueError(f"text_capacity {T} < text_end {int(state.text_end)}")
+    text = np.zeros((T,), np.int32)
+    keep = min(T, T_old)
+    text[:keep] = state.text[:keep]
+    return state._replace(
+        text=text,
+        nseg=np.asarray(counts, np.int32),
+        **_seg_repack(state, blk),
+    )
+
+
+def seg_gather_state(state: DocState, max_segments: int | None = None) -> DocState:
+    """Inverse of ``seg_shard_state`` (the compaction gather's fill
+    conventions): concatenate the per-shard live prefixes back into one
+    single-doc DocState in global segment order.  Because the text pool,
+    stamps, and uids are replicated/global, the result is byte-identical
+    to what the single-lane kernel would have produced — this is both the
+    rebalance gather and the byte-identity fuzz surface."""
+    state = jax.tree.map(np.asarray, state)
+    counts = state.nseg.astype(np.int64)
+    n_shards = int(counts.shape[0])
+    s_local = state.seg_len.shape[0] // n_shards
+    total = int(counts.sum())
+    S = max_segments if max_segments is not None else state.seg_len.shape[0]
+    if total > S:
+        raise ValueError(f"{total} live segments exceed capacity {S}")
+
+    def gat(arr: np.ndarray, fill: int) -> np.ndarray:
+        out = np.full((S,), fill, np.int32)
+        w = 0
+        for i in range(n_shards):
+            c = int(counts[i])
+            out[w : w + c] = arr[i * s_local : i * s_local + c]
+            w += c
+        return out
+
+    return state._replace(
+        nseg=np.asarray(total, np.int32),
+        **_seg_repack(state, gat),
+    )
+
+
+def seg_rebalance_state(
+    state: DocState, s_local: int | None = None, text_capacity: int | None = None
+) -> DocState:
+    """Re-block a seg-sharded state so every shard holds an even share of
+    the live segments again (inserts land shard-local between rebalance
+    points, so runs skew over time).  Gather + re-shard, both order- and
+    byte-preserving."""
+    n_shards = int(np.asarray(state.nseg).shape[0])
+    if s_local is None:
+        s_local = np.asarray(state.seg_len).shape[0] // n_shards
+    return seg_shard_state(
+        seg_gather_state(state), n_shards, s_local, text_capacity
+    )
+
+
+def seg_occupancy(state: DocState) -> np.ndarray:
+    """Per-shard live segment counts (the occupancy gauge)."""
+    return np.asarray(state.nseg).astype(np.int64)
+
+
+def canonical_doc(state: DocState) -> dict:
+    """The live content of a SINGLE-DOC state as plain numpy — padding
+    slots excluded (they hold shift remnants) — the byte-identity
+    comparison surface for the segment-parallel fuzz.  Seg-sharded states
+    gather first (``seg_gather_state``)."""
+    state = jax.tree.map(np.asarray, state)
+    n = int(state.nseg)
+    te = int(state.text_end)
+    out = {
+        "text": state.text[:te].copy(),
+        "text_end": te,
+        "nseg": n,
+        "uid_next": int(state.uid_next),
+        "min_seq": int(state.min_seq),
+        "error": int(state.error),
+        "ob_key": state.ob_key.copy(),
+        "ob_client": state.ob_client.copy(),
+        "ob_start_uid": state.ob_start_uid.copy(),
+        "ob_end_uid": state.ob_end_uid.copy(),
+        "ob_start_side": state.ob_start_side.copy(),
+        "ob_end_side": state.ob_end_side.copy(),
+        "ob_ref_seq": state.ob_ref_seq.copy(),
+    }
+    for name in (
+        "seg_start", "seg_len", "ins_key", "ins_client", "seg_uid", "seg_obpre"
+    ):
+        out[name] = getattr(state, name)[:n].copy()
+    for name in ("rem_keys", "rem_clients", "prop_keys", "prop_vals"):
+        for i, a in enumerate(getattr(state, name)):
+            out[f"{name}{i}"] = a[:n].copy()
     return out
 
 

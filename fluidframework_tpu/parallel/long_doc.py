@@ -21,6 +21,15 @@ across chips — sequence parallelism for collaborative text.
 Inserts migrate between shards only at rebalance points (the zamboni
 compaction pass already gathers live segments; a sharded rebalance
 re-blocks them), so the hot query path stays at the two hops above.
+
+PROMOTED (PR 11): the serving engines now run this design end to end —
+``ops.mergetree_kernel.apply_megastep_seg`` is the segment-parallel apply
+(full op semantics, byte-identical to the single-lane kernel),
+``parallel.mesh.seg_state_specs``/``docs_segs_mesh`` carry the layout and
+the 2-D mesh, and ``DocBatchEngine`` segment lanes serve hot docs with it.
+This module remains the read-side query plane (visible_length / resolve /
+mark over an equal-block layout with replicated nseg) and the design
+reference for the two-hop scheme.
 """
 
 from __future__ import annotations
@@ -32,6 +41,7 @@ import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability.flight_recorder import span
 from ..ops.mergetree_kernel import DocState
 from ..protocol.stamps import NO_REMOVE
 
@@ -162,24 +172,37 @@ def make_sharded_ops(mesh: Mesh, state: DocState, axis: str = "segs"):
             rem_keys=tuple(new_rem_keys), rem_clients=tuple(new_rem_clients)
         )
 
+    n_shards = int(mesh.shape[axis])
+    # jit the shard_map programs and span AROUND the jitted call: a span
+    # inside the traced body fires once at trace time and never again
+    # (the compiled executable dispatches without re-entering Python), so
+    # it would record compile cost, not per-dispatch collective hops.
+    jit_visible = jax.jit(_visible_length)
+    jit_resolve = jax.jit(_resolve)
+    jit_mark = jax.jit(_mark_range)
+
     def visible_length(s, ref_seq, client):
-        return _visible_length(s, jnp.asarray(ref_seq, I32), jnp.asarray(client, I32))
+        # One trace span per collective program dispatch: the hop-1
+        # all-gather + hop-2 psum pair lives inside the jitted program,
+        # so the span is the host-visible record of the two-hop cost.
+        with span("seg_collective", op="visible_length", shards=n_shards):
+            return jit_visible(
+                s, jnp.asarray(ref_seq, I32), jnp.asarray(client, I32)
+            )
 
     def resolve_positions(s, positions, ref_seq, client):
-        return _resolve(
-            s, jnp.asarray(positions, I32),
-            jnp.asarray(ref_seq, I32), jnp.asarray(client, I32),
-        )
+        with span("seg_collective", op="resolve", shards=n_shards):
+            return jit_resolve(
+                s, jnp.asarray(positions, I32),
+                jnp.asarray(ref_seq, I32), jnp.asarray(client, I32),
+            )
 
     def mark_range(s, p1, p2, op_key, op_client, ref_seq, client):
-        return _mark_range(
-            s, jnp.asarray(p1, I32), jnp.asarray(p2, I32),
-            jnp.asarray(op_key, I32), jnp.asarray(op_client, I32),
-            jnp.asarray(ref_seq, I32), jnp.asarray(client, I32),
-        )
+        with span("seg_collective", op="mark_range", shards=n_shards):
+            return jit_mark(
+                s, jnp.asarray(p1, I32), jnp.asarray(p2, I32),
+                jnp.asarray(op_key, I32), jnp.asarray(op_client, I32),
+                jnp.asarray(ref_seq, I32), jnp.asarray(client, I32),
+            )
 
-    return (
-        jax.jit(visible_length),
-        jax.jit(resolve_positions),
-        jax.jit(mark_range),
-    )
+    return (visible_length, resolve_positions, mark_range)

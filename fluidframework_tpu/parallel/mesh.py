@@ -41,15 +41,50 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+# The seg-axis NAME is owned by the kernel whose collectives bind to it
+# (ops.mergetree_kernel's all_gather/psum/pmin inside apply_megastep_seg);
+# re-exported here so mesh construction and the kernel can never disagree.
+from ..ops.mergetree_kernel import SEG_AXIS
+
+
 def doc_mesh(devices=None, axis: str = "docs") -> Mesh:
     """A 1-D mesh over all (or the given) devices for document parallelism."""
     devs = np.asarray(devices if devices is not None else jax.devices())
     return Mesh(devs.reshape(-1), (axis,))
 
 
-def shard_docs(mesh: Mesh, axis: str = "docs") -> NamedSharding:
+def docs_segs_mesh(
+    devices=None, seg_shards: int = 1, doc_axis: str = "docs",
+    seg_axis: str = SEG_AXIS,
+) -> Mesh:
+    """The 2-D docs x segs mesh: documents place over rows, a hot
+    document's merge-tree segments block-shard over the ``segs`` columns.
+    ``seg_shards`` clamps to the largest divisor of the device count at or
+    below the request (the mesh must factor).  Cold docs still use every
+    device — their fleet state shards over BOTH axes flattened
+    (``fleet_doc_axes``); only hot docs carve the segs axis."""
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    n = devs.size
+    seg = max(1, min(int(seg_shards), n))
+    while n % seg:
+        seg -= 1
+    return Mesh(devs.reshape(n // seg, seg), (doc_axis, seg_axis))
+
+
+def fleet_doc_axes(mesh: Mesh):
+    """The PartitionSpec ENTRY for a fleet state's leading doc dimension on
+    this mesh: the plain docs axis on a 1-D mesh, both axes flattened on a
+    docs x segs mesh (cold docs keep using every device)."""
+    if SEG_AXIS in mesh.axis_names:
+        return ("docs", SEG_AXIS)
+    return "docs"
+
+
+def shard_docs(mesh: Mesh, axis=None) -> NamedSharding:
     """Sharding for arrays with a leading document dimension."""
-    return NamedSharding(mesh, P(axis))
+    return NamedSharding(
+        mesh, P(axis if axis is not None else fleet_doc_axes(mesh))
+    )
 
 
 def replicate(mesh: Mesh) -> NamedSharding:
@@ -104,18 +139,85 @@ def match_partition_rules(rules, tree, default: P = P()):
 FLEET_STATE_RULES: tuple = ((r".*", P("docs")),)
 
 
-def fleet_state_specs(state):
+def fleet_state_specs(state, doc_axes="docs"):
     """Partition specs for a batched engine state pytree (leading doc dim
-    sharded over ``docs``, scalars/singletons replicated)."""
-    return match_partition_rules(FLEET_STATE_RULES, state)
+    sharded over ``doc_axes`` — the plain docs axis, or both axes of a
+    docs x segs mesh via ``fleet_doc_axes`` — scalars/singletons
+    replicated)."""
+    rules = FLEET_STATE_RULES if doc_axes == "docs" else ((r".*", P(doc_axes)),)
+    return match_partition_rules(rules, state)
 
 
 def shard_fleet_state(state, mesh: Mesh):
     """Place a batched fleet state on the mesh per its matched specs."""
-    specs = fleet_state_specs(state)
+    specs = fleet_state_specs(state, fleet_doc_axes(mesh))
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), state, specs
     )
+
+
+# ---------------------------------------------------------------------------
+# Segment-axis partition rules (hot docs on the docs x segs mesh)
+# ---------------------------------------------------------------------------
+
+def seg_state_specs(state, axis: str = SEG_AXIS):
+    """Partition specs for a SEG-SHARDED single-doc ``DocState``
+    (ops.mergetree_kernel.seg_shard_state layout): per-segment arrays and
+    the per-shard live-count vector block-shard over ``axis``; the text
+    pool, scalars, and the obliterate window table replicate — the
+    ``_specs_for`` layout of parallel/long_doc.py promoted to the serving
+    path (where ``nseg`` must be per-shard because inserts land
+    shard-local)."""
+    from ..ops.mergetree_kernel import DocState
+
+    s, r = P(axis), P()
+    return DocState(
+        text=r, text_end=r, nseg=s,
+        seg_start=s, seg_len=s, ins_key=s, ins_client=s,
+        seg_uid=s, seg_obpre=s,
+        rem_keys=(s,) * len(state.rem_keys),
+        rem_clients=(s,) * len(state.rem_clients),
+        prop_keys=(s,) * len(state.prop_keys),
+        prop_vals=(s,) * len(state.prop_vals),
+        uid_next=r, ob_key=r, ob_client=r, ob_start_uid=r, ob_end_uid=r,
+        ob_start_side=r, ob_end_side=r, ob_ref_seq=r,
+        min_seq=r, error=r,
+    )
+
+
+def shard_seg_state(state, mesh: Mesh, axis: str = SEG_AXIS):
+    """Place a seg-sharded single-doc state on the mesh per its specs."""
+    specs = seg_state_specs(state, axis)
+    return jax.tree.map(
+        lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)), state, specs
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def mesh_seg_program(step_fn, mesh: Mesh, state_specs,
+                     arg_specs: tuple = (P(), P()), donate: bool = False):
+    """``jit(shard_map(step_fn))`` over the SEGMENT axis: one dispatch
+    applies a [K, B] op ring to one seg-sharded hot document, the
+    per-segment work split across the segs shards with the two collective
+    hops inside (ops.mergetree_kernel.apply_megastep_seg).  Cached per
+    (fn, mesh, specs) like ``mesh_fleet_program`` so every segment lane
+    serving the same mesh shares one compile.
+
+    ``donate`` defaults OFF, deliberately: with donation, an executable
+    for this program RELOADED from the persistent XLA compile cache
+    returns permuted/garbage output buffers whenever the obliterate
+    branch executes (jax 0.4.37, CPU; freshly-compiled executables are
+    always correct, and tests/test_segment_parallel.py guards the
+    byte-identity contract that caught it).  Re-enable only with the
+    persistent cache off or after the upstream aliasing bug is fixed."""
+    mapped = shard_map(
+        step_fn,
+        mesh=mesh,
+        in_specs=(state_specs,) + tuple(arg_specs),
+        out_specs=state_specs,
+        check_rep=False,  # replicated leaves are replicated by construction
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
 # ---------------------------------------------------------------------------

@@ -55,6 +55,15 @@ def status_snapshot(eng, doc_ids, rows=0, bytes_consumed=0, **extra) -> dict:
         ]
     if eng.quarantine:
         out["quarantinedDocs"] = sorted(doc_ids[d] for d in eng.quarantine)
+    # 2-D docs x segs placement surface: which docs are segment-sharded and
+    # over how many shards (supervisors pair this with eng.placement() —
+    # a seg-sharded doc keeps its reserved batch slot, so scribe alignment
+    # is unchanged; the segs axis is the extra dimension).
+    seg = getattr(eng, "segment_sharded", None)
+    if seg is not None:
+        sharded = seg()
+        if sharded:
+            out["segmentSharded"] = sharded
     return out
 
 
@@ -109,6 +118,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="seconds between hot-shard checks: migrate the "
                         "deepest-queued doc off any shard loaded over 2x "
                         "the fleet mean (0 = no auto-rebalance)")
+    p.add_argument("--seg-shards", type=int, default=0,
+                   help="with --mesh: carve a segs axis of this width out "
+                        "of the device mesh (docs x segs) so hot docs can "
+                        "promote to segment-parallel serving; composes "
+                        "with --rebalance-every (a shard hot from ONE doc "
+                        "promotes that doc instead of migrating it)")
+    p.add_argument("--seg-rebalance-every", type=int, default=0,
+                   help="ops applied on a segment lane between segment "
+                        "re-blocks (0 = manual)")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. cpu); overrides the "
                         "image default and the FFTPU_PLATFORM env var")
@@ -153,11 +171,14 @@ def main(argv: list[str] | None = None) -> int:
     if args.mesh:
         import jax
 
-        from ..parallel.mesh import doc_mesh
+        from ..parallel.mesh import doc_mesh, docs_segs_mesh
 
         devices = jax.devices()
         n_dev = len(devices) if args.mesh < 0 else min(args.mesh, len(devices))
-        mesh = doc_mesh(devices[:n_dev])
+        if args.seg_shards > 1:
+            mesh = docs_segs_mesh(devices[:n_dev], args.seg_shards)
+        else:
+            mesh = doc_mesh(devices[:n_dev])
     eng = DocBatchEngine(
         len(doc_ids),
         max_segments=args.capacity,
@@ -175,6 +196,7 @@ def main(argv: list[str] | None = None) -> int:
         readmit_after_steps=args.readmit_after_steps,
         poison_budget=args.poison_budget,
         megastep_k=args.megastep_k,
+        seg_rebalance_every=args.seg_rebalance_every,
     )
     if store is not None:
         # Restart path: restore durable checkpoints BEFORE consuming, so

@@ -57,6 +57,7 @@ _DEVICE_MODULES = {
     "test_overflow_recovery",
     "test_pallas_kernels",
     "test_scribe",
+    "test_segment_parallel",
     "test_shared_map",
     "test_tree_batch_engine",
     "test_tree_kernel",

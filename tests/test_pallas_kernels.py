@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from fluidframework_tpu.ops.pallas_kernels import (
+    resolve_positions_blocked,
     resolve_positions_pallas,
     resolve_positions_reference,
 )
@@ -55,3 +56,55 @@ def test_pallas_resolve_all_invisible():
     pi, po, ph = resolve_positions_pallas(lens, qs, interpret=True)
     assert not np.asarray(pi).any() and not np.asarray(po).any()
     assert not np.asarray(ph).any()
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13, 14])
+def test_resolve_triple_parity_fuzz(seed):
+    """The three entries — ``resolve_positions_pallas`` (interpret),
+    ``resolve_positions_blocked`` (the backend-dispatching entry the
+    segment-parallel kernel calls behind its flag), and
+    ``resolve_positions_reference`` (the oracle) — agree on random
+    perspectives, out-of-range and NEGATIVE query positions included
+    (the seg path queries local coordinates that go negative for earlier
+    shards' positions)."""
+    rng = np.random.default_rng(seed)
+    for _trial in range(6):
+        # Sizes draw from a fixed palette: resolve_positions_* jit-compile
+        # per (S, Q) signature, so free-random sizes would turn the fuzz
+        # into a compile benchmark.
+        n_segs = int(rng.choice([1, 65, 517, 899]))
+        lens, qs = random_case(rng, n_segs, n_queries=41)
+        # Mix in out-of-range high and negative queries deliberately.
+        extra = np.asarray(
+            [-1, -7, int(lens.sum()), int(lens.sum()) + 5], np.int32
+        )
+        qs = np.concatenate([qs, extra])
+        ri, ro, rh = resolve_positions_reference(lens, qs)
+        bi, bo, bh = resolve_positions_blocked(lens, qs)
+        pi, po, ph = resolve_positions_pallas(lens, qs, interpret=True)
+        for got_i, got_o, got_h in ((bi, bo, bh), (pi, po, ph)):
+            np.testing.assert_array_equal(np.asarray(ri), np.asarray(got_i))
+            np.testing.assert_array_equal(np.asarray(ro), np.asarray(got_o))
+            np.testing.assert_array_equal(
+                np.asarray(rh).astype(np.int32),
+                np.asarray(got_h).astype(np.int32),
+            )
+        # Misses never report a hit; hits land inside their segment.
+        hits = np.asarray(rh).astype(bool)
+        if hits.any():
+            gi = np.asarray(ri)[hits]
+            off = np.asarray(ro)[hits]
+            assert (off >= 0).all() and (off < lens[gi]).all()
+        assert not np.asarray(rh)[np.asarray(qs) < 0].any()
+
+
+def test_blocked_is_reference_off_tpu():
+    """On non-TPU backends the blocked entry must BE the jnp oracle (the
+    CPU test mesh semantics the segment-parallel flag relies on)."""
+    rng = np.random.default_rng(0)
+    lens, qs = random_case(rng, 333, 17)
+    bi, bo, bh = resolve_positions_blocked(lens, qs)
+    ri, ro, rh = resolve_positions_reference(lens, qs)
+    np.testing.assert_array_equal(np.asarray(bi), np.asarray(ri))
+    np.testing.assert_array_equal(np.asarray(bo), np.asarray(ro))
+    np.testing.assert_array_equal(np.asarray(bh), np.asarray(rh))
