@@ -105,32 +105,85 @@ def _local_types(fn: ast.AST, view: _ModuleView) -> dict:
     return out
 
 
+_EXECUTOR_NAMES = (
+    "concurrent.futures.ThreadPoolExecutor", "futures.ThreadPoolExecutor",
+    "ThreadPoolExecutor",
+)
+
+
+def _note_entry(target, fn_key: FuncKey, types: dict, view: _ModuleView,
+                entries: list) -> None:
+    """Resolve a callable expression handed to a thread runtime (Thread
+    target, Timer function, executor submit/map fn) to a FuncKey."""
+    if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name):
+        base, meth = target.value.id, target.attr
+        if base == "self" and fn_key.class_name:
+            entries.append(FuncKey(fn_key.class_name, meth))
+        elif base in types:
+            entries.append(FuncKey(types[base], meth))
+    elif isinstance(target, ast.Name):
+        if FuncKey(None, target.id) in view.functions:
+            entries.append(FuncKey(None, target.id))
+
+
+def _executor_vars(fn: ast.AST, aliases) -> set:
+    """Local names bound to a ThreadPoolExecutor: ``x = ThreadPoolExecutor
+    (...)`` and ``with ThreadPoolExecutor(...) as x:`` — the pool's worker
+    threads run whatever ``x.submit``/``x.map`` is handed (the background
+    restore fan-out shape this pass must cover)."""
+    out: set = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            if resolve(node.value.func, aliases) in _EXECUTOR_NAMES:
+                out.add(node.targets[0].id)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                if (
+                    isinstance(item.context_expr, ast.Call)
+                    and resolve(item.context_expr.func, aliases)
+                    in _EXECUTOR_NAMES
+                    and isinstance(item.optional_vars, ast.Name)
+                ):
+                    out.add(item.optional_vars.id)
+    return out
+
+
 def _thread_entries(view: _ModuleView) -> list:
-    """FuncKeys the runtime invokes on their own thread."""
+    """FuncKeys the runtime invokes on their own thread: Thread targets,
+    Timer functions, ThreadPoolExecutor submit/map callables, and
+    socketserver/http handler methods."""
     entries: list = []
     for fn_key, fn in view.functions.items():
         types = _local_types(fn, view)
+        executors = _executor_vars(fn, view.aliases)
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
                 continue
             fname = resolve(node.func, view.aliases)
-            if fname not in ("threading.Thread", "Thread"):
-                continue
-            target = None
-            for kw in node.keywords:
-                if kw.arg == "target":
-                    target = kw.value
-            if target is None:
-                continue
-            if isinstance(target, ast.Attribute) and isinstance(target.value, ast.Name):
-                base, meth = target.value.id, target.attr
-                if base == "self" and fn_key.class_name:
-                    entries.append(FuncKey(fn_key.class_name, meth))
-                elif base in types:
-                    entries.append(FuncKey(types[base], meth))
-            elif isinstance(target, ast.Name):
-                if FuncKey(None, target.id) in view.functions:
-                    entries.append(FuncKey(None, target.id))
+            if fname in ("threading.Thread", "Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        _note_entry(kw.value, fn_key, types, view, entries)
+            elif fname in ("threading.Timer", "Timer"):
+                # Timer(interval, function): the function runs on the
+                # timer's own thread.
+                if len(node.args) >= 2:
+                    _note_entry(node.args[1], fn_key, types, view, entries)
+                for kw in node.keywords:
+                    if kw.arg == "function":
+                        _note_entry(kw.value, fn_key, types, view, entries)
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("submit", "map")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in executors
+                and node.args
+            ):
+                # pool.submit(fn, ...) / pool.map(fn, items): fn runs on
+                # the pool's worker threads.
+                _note_entry(node.args[0], fn_key, types, view, entries)
     for cls in view.handler_classes():
         for fn_key in view.functions:
             if fn_key.class_name == cls and (

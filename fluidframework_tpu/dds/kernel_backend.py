@@ -218,8 +218,21 @@ def summary_to_state(summary: dict, geometry: dict, slot_for) -> mk.DocState:
     a property id to a kernel prop slot — callers keep their own table so
     later ops encode against the same slots.  Raises ValueError when the
     summary does not fit the geometry (callers grow and retry)."""
+    import jax
     import jax.numpy as jnp
 
+    return jax.tree.map(
+        jnp.asarray, summary_to_state_host(summary, geometry, slot_for)
+    )
+
+
+def summary_to_state_host(summary: dict, geometry: dict, slot_for) -> mk.DocState:
+    """``summary_to_state`` with the leaves left as HOST numpy arrays: the
+    batched parallel restore packs many docs' rows host-side, stacks them,
+    and ships ONE transfer + ONE scatter dispatch instead of a per-doc
+    device round-trip (models/*.restore_from_checkpoints).  Byte-identical
+    content to ``summary_to_state`` by construction (that wrapper is just
+    ``jnp.asarray`` over this)."""
     S = geometry["max_segments"]
     T = geometry["text_capacity"]
     R = geometry["remove_slots"]
@@ -286,29 +299,29 @@ def summary_to_state(summary: dict, geometry: dict, slot_for) -> mk.DocState:
         ob_ref_seq[j] = o["refSeq"]
 
     return mk.DocState(
-        text=jnp.asarray(text_pool),
-        text_end=jnp.asarray(end, jnp.int32),
-        nseg=jnp.asarray(len(entries), jnp.int32),
-        seg_start=jnp.asarray(seg_start),
-        seg_len=jnp.asarray(seg_len),
-        ins_key=jnp.asarray(ins_key),
-        ins_client=jnp.asarray(ins_client),
-        seg_uid=jnp.asarray(seg_uid),
-        seg_obpre=jnp.full((S,), -1, jnp.int32),
-        rem_keys=tuple(jnp.asarray(rem_keys[r]) for r in range(R)),
-        rem_clients=tuple(jnp.asarray(rem_clients[r]) for r in range(R)),
-        prop_keys=tuple(jnp.asarray(prop_keys[p]) for p in range(P)),
-        prop_vals=tuple(jnp.asarray(prop_vals[p]) for p in range(P)),
-        uid_next=jnp.asarray(len(entries), jnp.int32),
-        ob_key=jnp.asarray(ob_key),
-        ob_client=jnp.asarray(ob_client),
-        ob_start_uid=jnp.asarray(ob_start_uid),
-        ob_end_uid=jnp.asarray(ob_end_uid),
-        ob_start_side=jnp.asarray(ob_start_side),
-        ob_end_side=jnp.asarray(ob_end_side),
-        ob_ref_seq=jnp.asarray(ob_ref_seq),
-        min_seq=jnp.asarray(summary["minSeq"], jnp.int32),
-        error=jnp.zeros((), jnp.int32),
+        text=text_pool,
+        text_end=np.asarray(end, np.int32),
+        nseg=np.asarray(len(entries), np.int32),
+        seg_start=seg_start,
+        seg_len=seg_len,
+        ins_key=ins_key,
+        ins_client=ins_client,
+        seg_uid=seg_uid,
+        seg_obpre=np.full((S,), -1, np.int32),
+        rem_keys=tuple(rem_keys[r] for r in range(R)),
+        rem_clients=tuple(rem_clients[r] for r in range(R)),
+        prop_keys=tuple(prop_keys[p] for p in range(P)),
+        prop_vals=tuple(prop_vals[p] for p in range(P)),
+        uid_next=np.asarray(len(entries), np.int32),
+        ob_key=ob_key,
+        ob_client=ob_client,
+        ob_start_uid=ob_start_uid,
+        ob_end_uid=ob_end_uid,
+        ob_start_side=ob_start_side,
+        ob_end_side=ob_end_side,
+        ob_ref_seq=ob_ref_seq,
+        min_seq=np.asarray(summary["minSeq"], np.int32),
+        error=np.zeros((), np.int32),
     )
 
 

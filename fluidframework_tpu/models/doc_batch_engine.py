@@ -66,6 +66,7 @@ from __future__ import annotations
 
 import functools
 import json
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -82,6 +83,12 @@ from ..parallel import mesh as pm
 from ..parallel.mesh import doc_mesh, shard_docs
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters, Histogram, SampledTelemetryHelper
+from .recovery import (
+    RecoveryTracker,
+    load_checkpoint_records,
+    stale_due_docs,
+    write_checkpoint_records,
+)
 from .staging import OverloadGate, RowQueue, StagingRing, upload_replicated
 
 
@@ -112,6 +119,10 @@ class _DocHost:
     base_summary: dict | None = None
     last_seq: int = 0  # highest OP seq ingested
     ops_since_ckpt: int = 0
+    # Monotonic time the doc FIRST went dirty after its last durable
+    # checkpoint (0.0 = clean): the bounded-staleness writer's seconds-
+    # behind signal (recovery.BackgroundCheckpointWriter).
+    dirty_since: float = 0.0
     # Set by restore_from_checkpoints: the doc consumes parsed messages
     # (seq dedupe needs per-message seqs the native encoder can't skip).
     restored: bool = False
@@ -320,6 +331,28 @@ class DocBatchEngine:
         # Checkpoint / watchdog knobs (see module docstring).
         self.checkpoint_store = checkpoint_store
         self.checkpoint_every = checkpoint_every
+        # Checkpoint-plane lock: the bounded-staleness background writer
+        # (models/recovery.BackgroundCheckpointWriter) enters through
+        # checkpoint_stale() on its own thread; step()/ingest*/
+        # maybe_checkpoint/restore all take this, so a sweep only ever
+        # sees the engine at an op boundary.  Re-entrant because step()
+        # calls maybe_checkpoint under it.  Uncontended acquisition is
+        # nanoseconds against ms-scale dispatches.
+        self.ckpt_lock = threading.RLock()
+        # Durable-write plane for checkpoint sweeps: saves happen outside
+        # ckpt_lock (fsyncs must not stall serving), serialized here with
+        # per-doc seq fencing so concurrent sweeps never write an older
+        # record over a newer one.
+        self._ckpt_io_lock = threading.Lock()
+        self._ckpt_saved_seq: dict[int, int] = {}
+        # Per-incident recovery clock (kill/restore -> first post-restore
+        # op applied); gauges ride health(), the histogram rides
+        # latency_histograms() into /metrics.
+        self.recovery_tracker = RecoveryTracker()
+        # Record-file mtimes last seen by a refresh trail: the standby's
+        # poll skips unchanged records instead of re-reading and
+        # re-parsing every checkpoint every poll_s.
+        self._trail_mtime: dict[int, float] = {}
         self.doc_keys = list(doc_keys) if doc_keys is not None else [
             str(d) for d in range(n_docs)
         ]
@@ -524,7 +557,13 @@ class DocBatchEngine:
         This is the engine's inbound seam: the equivalent of
         DeltaManager -> ContainerRuntime.process for one container, except
         application is deferred to the next batched device step.
+        Serialized on ``ckpt_lock`` against the background checkpoint
+        writer (a sweep never sees a half-staged message).
         """
+        with self.ckpt_lock:
+            return self._ingest_one(doc_idx, msg)
+
+    def _ingest_one(self, doc_idx: int, msg: SequencedMessage) -> None:
         h = self.hosts[doc_idx]
         assert h.mode != "native" or self._in_lane(doc_idx), (
             f"doc {doc_idx} already fed through the native byte path; "
@@ -548,6 +587,8 @@ class DocBatchEngine:
             return
         h.last_seq = max(h.last_seq, msg.seq)
         h.ops_since_ckpt += 1
+        if not h.dirty_since:
+            h.dirty_since = time.monotonic()
         self._lat_sample(doc_idx, msg.timestamp)
         if h.boot_counting:
             # Post-summary tail actually replayed on a boot-from-checkpoint/
@@ -607,8 +648,10 @@ class DocBatchEngine:
     # -------------------------------------------------------- batched ingest
     def ingest_batch(self, doc_idxs, msgs) -> int:
         """Flight-recorded entry over ``_ingest_batch`` (the ``ingest``
-        phase of a trace; a free no-op while no recorder is installed)."""
-        with span("ingest", msgs=len(doc_idxs)):
+        phase of a trace; a free no-op while no recorder is installed).
+        Holds ``ckpt_lock`` so the background checkpoint writer only ever
+        sweeps at a whole-batch boundary."""
+        with self.ckpt_lock, span("ingest", msgs=len(doc_idxs)):
             return self._ingest_batch(doc_idxs, msgs)
 
     def _ingest_batch(self, doc_idxs, msgs) -> int:
@@ -673,6 +716,8 @@ class DocBatchEngine:
                 continue
             h.last_seq = max(h.last_seq, msg.seq)
             h.ops_since_ckpt += 1
+            if not h.dirty_since:
+                h.dirty_since = time.monotonic()
             self._lat_sample(d, msg.timestamp)
             if h.boot_counting:
                 counters.bump("boot_replay_len")
@@ -864,6 +909,10 @@ class DocBatchEngine:
         healthy document stays on whichever path fed it first (the two
         paths intern property slots independently); recovery-lane routing
         normalizes a native doc onto the object path."""
+        with self.ckpt_lock:
+            return self._ingest_lines(doc_idx, data)
+
+    def _ingest_lines(self, doc_idx: int, data: bytes) -> int:
         from ..native.ingest_native import NativeIngestEncoder, available
 
         h = self.hosts[doc_idx]
@@ -912,6 +961,8 @@ class DocBatchEngine:
             self._busy.add(doc_idx)
         h.min_seq = max(h.min_seq, h.native.min_seq)
         h.ops_since_ckpt += len(ops)
+        if len(ops) and not h.dirty_since:
+            h.dirty_since = time.monotonic()
         if self.checkpoint_store is not None:
             # Checkpoints need the seq floor; one JSON parse of the chunk's
             # last line covers the whole chunk (lines are seq-ordered).
@@ -1076,8 +1127,13 @@ class DocBatchEngine:
 
     def latency_histograms(self) -> dict[str, Histogram]:
         """Mergeable op-latency histograms for the metrics plane: the
-        fleet aggregate plus one per mesh shard."""
-        out = {"op_latency": self.op_latency}
+        fleet aggregate, one per mesh shard, and the per-incident
+        recovery-time histogram (kill/restore -> first post-restore op
+        applied)."""
+        out = {
+            "op_latency": self.op_latency,
+            "recovery_time": self.recovery_tracker.histogram,
+        }
         if self.n_shards > 1:
             for s, h in enumerate(self._shard_latency):
                 out[f"op_latency_shard{s}"] = h
@@ -1269,7 +1325,25 @@ class DocBatchEngine:
         the pipeline synchronizes only at the recover()/watchdog/
         checkpoint boundaries below.  Afterwards, any latched overflow
         bits are recovered (grow-and-replay or oracle routing), so
-        ``errors()`` is all-zero on return unless recovery is off."""
+        ``errors()`` is all-zero on return unless recovery is off.
+
+        Holds ``ckpt_lock`` end to end (the background checkpoint writer
+        can only sweep between steps), and is the recovery clock's
+        completion point: the first step that applies staged work after a
+        restore closes the open incident (kill -> first post-restore op
+        applied)."""
+        with self.ckpt_lock:
+            had_work = bool(
+                self._busy
+                or any(ln.queue for ln in self.overflow.values())
+                or any(ln.queue for ln in self.seg_lanes.values())
+            )
+            steps = self._step_fleet()
+            if had_work and self.recovery_tracker.active:
+                self.recovery_tracker.complete()
+            return steps
+
+    def _step_fleet(self) -> int:
         t0 = time.perf_counter() if self.sampled is not None else 0.0
         steps = 0
         while self._busy:
@@ -1448,6 +1522,16 @@ class DocBatchEngine:
     def enable_segment_sharding(
         self, d: int, s_local: int = 0, text_capacity: int = 0
     ) -> bool:
+        # ckpt_lock: promotion moves the doc's row into a seg lane the
+        # background checkpoint sweep also reads — see migrate_doc.
+        with self.ckpt_lock:
+            return self._enable_segment_sharding_locked(
+                d, s_local, text_capacity
+            )
+
+    def _enable_segment_sharding_locked(
+        self, d: int, s_local: int = 0, text_capacity: int = 0
+    ) -> bool:
         """Promote a hot doc onto the segment-parallel path: its device row
         re-blocks into the seg-sharded layout (``mk.seg_shard_state`` — live
         segments split into contiguous runs over the segs axis, text/
@@ -1514,6 +1598,10 @@ class DocBatchEngine:
         batch geometry).  Staged lane ops apply first so nothing is lost.
         Returns False when the gathered state no longer fits the batch
         geometry (the doc stays segment-sharded and serviceable)."""
+        with self.ckpt_lock:  # mutates state/seg_lanes the sweep reads
+            return self._disable_segment_sharding_locked(d)
+
+    def _disable_segment_sharding_locked(self, d: int) -> bool:
         lane = self.seg_lanes.get(d)
         if lane is None:
             return False
@@ -1551,6 +1639,10 @@ class DocBatchEngine:
         points, so runs skew toward the hot shard over time).  Gather +
         re-shard, byte- and order-preserving (``mk.seg_rebalance_state``,
         the compaction gather's fill conventions)."""
+        with self.ckpt_lock:  # mutates lane state the sweep reads
+            return self._rebalance_segments_locked(d)
+
+    def _rebalance_segments_locked(self, d: int) -> bool:
         lane = self.seg_lanes.get(d)
         if lane is None:
             return False
@@ -1964,6 +2056,14 @@ class DocBatchEngine:
         return len(self._free_slots[shard])
 
     def migrate_doc(self, d: int, dst_shard: int) -> bool:
+        # ckpt_lock: migration mutates self.state/self._slot, which the
+        # background checkpoint sweep reads (bulk host transfer + per-doc
+        # slot slicing) — an unlocked scatter mid-sweep could checkpoint
+        # a torn or vacated row as the doc's durable record.
+        with self.ckpt_lock:
+            return self._migrate_doc_locked(d, dst_shard)
+
+    def _migrate_doc_locked(self, d: int, dst_shard: int) -> bool:
         """Live doc migration between mesh shards (hot-shard rebalancing).
 
         The handoff is checkpoint + summary adoption — the same primitives
@@ -2200,23 +2300,76 @@ class DocBatchEngine:
         return failed
 
     # ------------------------------------------------------------- checkpoint
-    def maybe_checkpoint(self, force: bool = False) -> list[int]:
+    def maybe_checkpoint(self, force: bool = False, docs=None) -> list[int]:
         """Write durable checkpoint records for docs whose op count since
         the last checkpoint reached ``checkpoint_every`` (all dirty docs
         when ``force``), then truncate their replay logs to the tail.
-        Returns the doc indices checkpointed."""
+        ``docs`` restricts the sweep to an explicit due list (the
+        bounded-staleness writer's candidates) — those checkpoint whenever
+        dirty, regardless of cadence.  Takes ``ckpt_lock`` (re-entrant
+        from step()).  Returns the doc indices checkpointed."""
         if self.checkpoint_store is None:
             return []
-        if not force and self.checkpoint_every <= 0:
+        if docs is None and not force and self.checkpoint_every <= 0:
             return []
+        with self.ckpt_lock:
+            out, pending = self._checkpoint_sweep(force, docs)
+        # Durable writes (one fsync per record) land OUTSIDE ckpt_lock:
+        # a background-writer sweep must not stall the serving thread's
+        # ingest/step behind N fsyncs.  (A cadence checkpoint from step()
+        # itself still holds the outer re-entrant lock — that thread is
+        # paying for its own write, the status quo.)
+        write_checkpoint_records(self, pending, "batch")
+        return out
+
+    def checkpoint_stale(
+        self, max_ops_behind: int = 0, max_seconds_behind: float = 0.0
+    ) -> list[int]:
+        """Bounded-staleness delta sweep: checkpoint every dirty doc whose
+        durable record is ``max_ops_behind`` applied ops or
+        ``max_seconds_behind`` seconds behind the live stream (0 disables
+        that bound).  Safe from a background thread — the record BUILD
+        runs under ``ckpt_lock`` so it only ever observes op boundaries;
+        the durable writes land after release so the sweep's fsyncs never
+        stall the serving thread.  Returns the doc indices checkpointed."""
+        if self.checkpoint_store is None or not (
+            max_ops_behind or max_seconds_behind
+        ):
+            return []
+        now = time.monotonic()
+        with self.ckpt_lock:
+            due = stale_due_docs(
+                self.hosts, self.n_docs, max_ops_behind,
+                max_seconds_behind, now,
+            )
+            if not due:
+                return []
+            with span("checkpoint_sweep", docs=len(due)):
+                out, pending = self._checkpoint_sweep(force=False, docs=due)
+            if out:
+                self.counters.bump("stale_checkpoints_written", len(out))
+        write_checkpoint_records(self, pending, "batch")
+        return out
+
+    def _checkpoint_sweep(
+        self, force: bool, docs
+    ) -> tuple[list[int], list[tuple[int, int, dict]]]:
+        """Build-and-account half of a checkpoint sweep (under
+        ``ckpt_lock``); the returned ``pending`` records go to
+        ``_write_checkpoint_records`` after release."""
+        candidates = range(self.n_docs) if docs is None else docs
         due = [
-            d for d in range(self.n_docs)
+            d for d in candidates
             if self.hosts[d].ops_since_ckpt > 0
-            and (force or self.hosts[d].ops_since_ckpt >= self.checkpoint_every)
+            and (
+                force or docs is not None
+                or self.hosts[d].ops_since_ckpt >= self.checkpoint_every
+            )
         ]
         if not due:
-            return []  # host-side check only: no device readback paid
+            return [], []  # host-side check only: no device readback paid
         out: list[int] = []
+        pending: list[tuple[int, int, dict]] = []
         # ONE bulk device->host transfer covers every due batch doc (the
         # per-doc summary walk below then slices host arrays; per-doc
         # device_get would serialize ~25 tiny transfers per doc against
@@ -2295,20 +2448,18 @@ class DocBatchEngine:
             if geometry is not None:
                 record["geometry"] = geometry
                 record["growths"] = growths
-            with span("checkpoint", doc=self.doc_keys[d], lane=lane):
-                self.checkpoint_store.save(
-                    self.doc_keys[d], h.last_seq, record
-                )
+            pending.append((d, h.last_seq, record))
             h.base_seq = h.last_seq
             h.base_summary = summary
             h.log = [m for m in h.log if m.seq > h.base_seq]
             if h.raw_log:
                 h.raw_log = self._truncate_raw_log(h.raw_log, h.base_seq)
             h.ops_since_ckpt = 0
+            h.dirty_since = 0.0
             h.boot_counting = False  # a new durable floor ends the boot phase
             self.counters.bump("checkpoints_written")
             out.append(d)
-        return out
+        return out, pending
 
     @staticmethod
     def _truncate_raw_log(raw_log: list[bytes], base_seq: int) -> list[bytes]:
@@ -2335,95 +2486,286 @@ class DocBatchEngine:
                 kept.append(b"\n".join(lines) + b"\n")
         return kept
 
-    def restore_from_checkpoints(self, store=None) -> list[int]:
+    def note_incident(self, started_at: float) -> None:
+        """Back-date the current recovery incident to the supervisor's
+        kill timestamp (``time.monotonic`` domain): the recovery histogram
+        then measures kill -> first post-restore op applied, not merely
+        restore -> applied."""
+        self.recovery_tracker.begin(started_at)
+
+    def restore_from_checkpoints(
+        self,
+        store=None,
+        parallel: bool = True,
+        max_workers: int | None = None,
+        refresh: bool = False,
+    ) -> list[int]:
         """Engine restart path: load each doc's durable checkpoint record,
         rebuild its state (batch row, overflow lane, or oracle/quarantine
         replica), and set the seq floor so the upstream replay of ops the
         checkpoint already covers is skipped.  Returns restored doc
-        indices."""
+        indices.
+
+        ``parallel`` (default) is the batched fast path: all records load
+        concurrently (thread pool over the store's ``load_many``) and
+        every batch-lane doc seeds through ONE stacked host build + ONE
+        scatter dispatch instead of a per-doc device round-trip.
+        ``parallel=False`` is the sequential oracle — per-doc load,
+        per-doc scatter, the original restore loop — kept byte-identical
+        by contract (fuzzed in tests/test_recovery_plane.py).
+
+        ``refresh`` is the warm-standby trailing mode: docs already
+        restored RE-adopt a record strictly newer than their current seq
+        floor (first-source-wins still holds for live serving — refresh
+        refuses any doc with staged work).  A trailing standby calls this
+        on a cadence so promotion starts from the freshest durable state.
+        """
         store = store if store is not None else self.checkpoint_store
         if store is None:
             return []
+        with self.ckpt_lock:
+            return self._restore(store, parallel, max_workers, refresh)
+
+    def _restore(self, store, parallel, max_workers, refresh) -> list[int]:
+        t_start = time.monotonic()
+        with span("restore_scan", docs=self.n_docs):
+            candidates: list[int] = []
+            cand_mtime: dict[int, float] = {}
+            for d in range(self.n_docs):
+                h = self.hosts[d]
+                if h.restored and not refresh:
+                    # Already seeded by an earlier restore (e.g. a local
+                    # checkpoint before a scribe boot-from-summary pass):
+                    # the first source wins — never regress a doc's
+                    # replay floor.
+                    continue
+                if refresh and self._queue_depth(d):
+                    # Trailing adoption never races staged work: a doc
+                    # with pending ops is being SERVED, not trailed.
+                    continue
+                if refresh:
+                    # Unchanged record file -> nothing new to adopt: the
+                    # atomic save replaces the file, so trailing polls pay
+                    # one stat per doc, not O(total checkpoint bytes).
+                    # The seen-mtime is stamped only after a SUCCESSFUL
+                    # load below — stamping here would let one transient
+                    # read failure permanently exclude the doc from
+                    # trailing.
+                    mt = getattr(store, "mtime", lambda _k: None)(
+                        self.doc_keys[d]
+                    )
+                    if mt is not None and self._trail_mtime.get(d) == mt:
+                        continue
+                    if mt is not None:
+                        cand_mtime[d] = mt
+                candidates.append(d)
+        if not candidates:
+            return []
+        records = load_checkpoint_records(
+            store, [self.doc_keys[d] for d in candidates],
+            parallel=parallel, max_workers=max_workers,
+        )
         restored: list[int] = []
-        for d in range(self.n_docs):
-            if self.hosts[d].restored:
-                # Already seeded by an earlier restore (e.g. a local
-                # checkpoint before a scribe boot-from-summary pass): the
-                # first source wins — never regress a doc's replay floor.
-                continue
-            rec = store.load(self.doc_keys[d])
-            if rec is None or rec.get("engine") != "doc_batch":
-                continue
-            h = self.hosts[d]
-            h.quorum = dict(rec.get("quorum", {}))
-            h.prop_slot = {int(k): v for k, v in rec.get("prop_slot", {}).items()}
-            h.min_seq = rec.get("min_seq", 0)
-            h.base_seq = h.last_seq = int(rec["seq"])
-            h.base_summary = rec["summary"]
-            # Restored docs consume parsed messages (the object path): the
-            # native encoder cannot skip already-checkpointed seqs.
-            h.mode = "obj"
-            h.restored = True
-            h.boot_counting = True
-            lane = rec.get("lane", "batch")
-            if lane in ("oracle", "quarantine"):
-                tree = RefMergeTree()
-                tree.import_summary(rec["summary"])
-                tree.update_min_seq(h.min_seq)
-                if lane == "oracle":
-                    self.oracles[d] = tree
-                else:
-                    self.quarantine[d] = tree
-                    self.quarantine_reason[d] = "restored"
-                    if self.readmit_after_steps:
-                        # A restart must not strand the doc in quarantine
-                        # when auto-readmission is the configured policy:
-                        # schedule it like a first flap.
-                        self._flaps.setdefault(d, 1)
-                        self._readmit_interval[d] = self.readmit_after_steps
-                        self._readmit_due[d] = (
-                            self._step_count + self.readmit_after_steps
-                        )
-            elif lane == "overflow":
-                geom = {k: int(v) for k, v in rec["geometry"].items()}
-                state = kb.summary_to_state(
-                    rec["summary"], geom,
-                    lambda p, _h=h, _g=geom: self._prop_slot_for_geom(_h, p, _g),
-                )
-                self.overflow[d] = self._make_lane(
-                    state, geom, int(rec.get("growths", 1))
-                )
-            else:
-                try:
-                    row = kb.summary_to_state(
-                        rec["summary"], self.geometry,
-                        lambda p, _h=h: self._prop_slot_for_geom(
-                            _h, p, self.geometry
-                        ),
-                    )
-                except (ValueError, IndexError):
-                    # The checkpoint outgrew the batch geometry (a restart
-                    # with smaller capacity — including fewer prop slots
-                    # than the restored prop table): restore into an
-                    # overflow lane at a fitted geometry.
-                    geom = self._fit_geometry(
-                        self.geometry, rec["summary"], len(h.prop_slot)
-                    )
+        # Batch-lane rows collected host-side for the single scatter
+        # (parallel path); the sequential oracle scatters per doc instead.
+        batch_rows: list[tuple[int, object]] = []
+        with span("restore_build", records=len(records)):
+            for i, d in enumerate(candidates):
+                rec = records.get(i)
+                if rec is not None and d in cand_mtime:
+                    # Load succeeded: this record content is now seen —
+                    # future trails skip it until the file changes.
+                    self._trail_mtime[d] = cand_mtime[d]
+                if rec is None or rec.get("engine") != "doc_batch":
+                    continue
+                h = self.hosts[d]
+                if refresh and h.restored:
+                    if int(rec["seq"]) <= h.last_seq:
+                        continue  # nothing newer to adopt
+                    self.counters.bump("checkpoint_refreshes")
+                if refresh:
+                    self._drop_restored_identity(d)
+                h.quorum = dict(rec.get("quorum", {}))
+                h.prop_slot = {
+                    int(k): v for k, v in rec.get("prop_slot", {}).items()
+                }
+                h.min_seq = rec.get("min_seq", 0)
+                h.base_seq = h.last_seq = int(rec["seq"])
+                h.base_summary = rec["summary"]
+                # Restored docs consume parsed messages (the object path):
+                # the native encoder cannot skip already-checkpointed seqs.
+                h.mode = "obj"
+                h.restored = True
+                h.boot_counting = True
+                lane = rec.get("lane", "batch")
+                if lane in ("oracle", "quarantine"):
+                    tree = RefMergeTree()
+                    tree.import_summary(rec["summary"])
+                    tree.update_min_seq(h.min_seq)
+                    if lane == "oracle":
+                        self.oracles[d] = tree
+                    else:
+                        self.quarantine[d] = tree
+                        self.quarantine_reason[d] = "restored"
+                        if self.readmit_after_steps:
+                            # A restart must not strand the doc in
+                            # quarantine when auto-readmission is the
+                            # configured policy: schedule it like a first
+                            # flap.
+                            self._flaps.setdefault(d, 1)
+                            self._readmit_interval[d] = (
+                                self.readmit_after_steps
+                            )
+                            self._readmit_due[d] = (
+                                self._step_count + self.readmit_after_steps
+                            )
+                elif lane == "overflow":
+                    geom = {k: int(v) for k, v in rec["geometry"].items()}
                     state = kb.summary_to_state(
                         rec["summary"], geom,
                         lambda p, _h=h, _g=geom: self._prop_slot_for_geom(
                             _h, p, _g
                         ),
                     )
-                    self.overflow[d] = self._make_lane(state, geom, 1)
-                else:
-                    slot = int(self._slot[d])
-                    self.state = jax.tree.map(
-                        lambda x, s: x.at[slot].set(s), self.state, row
+                    self.overflow[d] = self._make_lane(
+                        state, geom, int(rec.get("growths", 1))
                     )
-            restored.append(d)
-            self.counters.bump("docs_restored")
+                else:
+                    try:
+                        row = kb.summary_to_state_host(
+                            rec["summary"], self.geometry,
+                            lambda p, _h=h: self._prop_slot_for_geom(
+                                _h, p, self.geometry
+                            ),
+                        )
+                    except (ValueError, IndexError):
+                        # The checkpoint outgrew the batch geometry (a
+                        # restart with smaller capacity — including fewer
+                        # prop slots than the restored prop table):
+                        # restore into an overflow lane at a fitted
+                        # geometry.
+                        geom = self._fit_geometry(
+                            self.geometry, rec["summary"], len(h.prop_slot)
+                        )
+                        state = kb.summary_to_state(
+                            rec["summary"], geom,
+                            lambda p, _h=h, _g=geom: self._prop_slot_for_geom(
+                                _h, p, _g
+                            ),
+                        )
+                        self.overflow[d] = self._make_lane(state, geom, 1)
+                    else:
+                        slot = int(self._slot[d])
+                        if parallel:
+                            batch_rows.append((slot, row))
+                        else:
+                            self.state = jax.tree.map(
+                                lambda x, s, _s=slot: x.at[_s].set(
+                                    jnp.asarray(s)
+                                ),
+                                self.state, row,
+                            )
+                restored.append(d)
+                self.counters.bump("docs_restored")
+        if batch_rows:
+            # ONE stacked transfer + ONE donated scatter dispatch seeds
+            # every batch-lane doc (pow2-padded like the cohort scatter,
+            # so the executable ladder stays log2(fleet) deep; pad lanes
+            # route out of bounds via mode="drop").
+            with span("restore_scatter", rows=len(batch_rows)):
+                n = len(batch_rows)
+                nc = 1 << (n - 1).bit_length()
+                idx = np.full((nc,), batch_rows[-1][0], np.int32)
+                idx[:n] = [s for s, _ in batch_rows]
+                valid = np.zeros((nc,), bool)
+                valid[:n] = True
+                rows = [r for _, r in batch_rows]
+                rows += [batch_rows[-1][1]] * (nc - n)
+                stacked = jax.tree.map(
+                    lambda *xs: jnp.asarray(np.stack(xs)), *rows
+                )
+                self.state = self._scatter_cohort(
+                    self.state, stacked, jnp.asarray(idx), jnp.asarray(valid)
+                )
+        if restored and not refresh:
+            # A real restore (not standby trailing) opens a recovery
+            # incident: the clock runs until the first post-restore op
+            # applies on device.  note_incident() back-dates it to the
+            # supervisor's kill time when one is known.
+            self.recovery_tracker.begin(t_start)
         return restored
+
+    def _drop_restored_identity(self, d: int) -> None:
+        """Forget a doc's prior adoption before a refresh re-seed (warm-
+        standby trailing only: the doc has no staged work by contract)."""
+        self.overflow.pop(d, None)
+        self.oracles.pop(d, None)
+        self.quarantine.pop(d, None)
+        self.quarantine_reason.pop(d, None)
+        self.seg_lanes.pop(d, None)
+        self._readmit_due.pop(d, None)
+        self._readmit_interval.pop(d, None)
+        self._verified_digest.pop(d, None)
+        h = self.hosts[d]
+        h.log.clear()
+        h.raw_log.clear()
+        h.queue.clear()
+        self._busy.discard(d)
+
+    def warmup(self) -> int:
+        """Pre-compile the fleet's serving programs (warm-standby boot):
+        dispatch all-NOOP megasteps at every pow2 depth up to
+        ``megastep_k`` plus one compact through the exact serving entry
+        points, so a promoted standby pays ZERO XLA compiles on its first
+        real dispatch.  NOOP slices are identity by kernel contract, so
+        state bytes are untouched.  Cohort-bucketed executables (mesh-less
+        Zipf tails) still compile on first use — they are per-cohort-size
+        and cheap relative to the fleet programs.  Returns the number of
+        warmup dispatches run."""
+        warmed = 0
+        with self.ckpt_lock, span("warmup", k_max=self.megastep_k):
+            stage = self._staging()
+            if self.mesh is None:
+                # The K=1 mesh-less fast path dispatches _step directly.
+                ops, payloads = stage.acquire(1, self.capacity)
+                dev_ops, dev_payloads = stage.upload(ops[0], payloads[0])
+                self.state = self._step(self.state, dev_ops, dev_payloads)
+                warmed += 1
+            depths = []
+            k = 1
+            while k <= self.megastep_k:
+                depths.append(k)
+                k *= 2
+            if self.megastep_k > 1 and self.megastep_k not in depths:
+                # _select_k clamps to min(megastep_k, pow2(need)), so a
+                # non-pow2 configured K is itself a reachable dispatch
+                # shape — skip it here and the first deep-queue dispatch
+                # after promotion pays the compile warmup exists to kill.
+                depths.append(self.megastep_k)
+            for k in depths:
+                if self.mesh is not None or k > 1:
+                    ops, payloads = stage.acquire(k, self.capacity)
+                    dev_ops, dev_payloads = stage.upload(ops, payloads)
+                    self.state = self._megastep(
+                        self.state, dev_ops, dev_payloads
+                    )
+                    warmed += 1
+            mins = np.zeros((self.capacity,), np.int32)
+            for d, h in enumerate(self.hosts):
+                mins[self._slot[d]] = h.min_seq
+            if self.mesh is not None:
+                mins_dev = jax.device_put(mins, shard_docs(self.mesh))
+            else:
+                mins_dev = jnp.asarray(mins)
+            self.state = self._compact(self.state, mins_dev)
+            warmed += 1
+            jax.block_until_ready(self.state)
+            # Absorb the warmup compiles into the watchdog count NOW, so
+            # they show up as boot-time cache growth rather than landing
+            # on the first serving step's poll.
+            self.recompile_watchdog.poll()
+        self.counters.gauge("warmup_dispatches", warmed)
+        return warmed
 
     # ----------------------------------------------------------------- health
     def health(self) -> dict:
@@ -2521,6 +2863,26 @@ class DocBatchEngine:
                     for h in self._shard_latency
                 ],
             )
+        # Recovery surface: per-incident recovery percentiles plus how far
+        # the durable checkpoints trail the live stream right now (the
+        # bounded-staleness writer's target signal).
+        self.recovery_tracker.emit_gauges(self.counters)
+        now = time.monotonic()
+        self.counters.gauge(
+            "dirty_docs",
+            sum(1 for h in self.hosts if h.ops_since_ckpt > 0),
+        )
+        self.counters.gauge(
+            "checkpoint_age_s",
+            round(
+                max(
+                    (now - h.dirty_since for h in self.hosts
+                     if h.dirty_since),
+                    default=0.0,
+                ),
+                3,
+            ),
+        )
         snap = self.counters.snapshot()
         snap.update(
             quarantined_docs=len(self.quarantine),

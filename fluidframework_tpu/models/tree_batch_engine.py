@@ -28,6 +28,8 @@ policy as the string engine.
 from __future__ import annotations
 
 import functools
+import threading
+import time
 from dataclasses import dataclass, field
 
 import jax
@@ -53,6 +55,12 @@ from ..ops import tree_kernel as tk
 from ..parallel import mesh as pm
 from ..protocol.messages import MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters
+from .recovery import (
+    RecoveryTracker,
+    load_checkpoint_records,
+    stale_due_docs,
+    write_checkpoint_records,
+)
 from .staging import OverloadGate, RowQueue, StagingRing
 
 
@@ -74,6 +82,9 @@ class _TreeHost:
     base_seq: int = 0
     last_seq: int = 0
     ops_since_ckpt: int = 0
+    # Monotonic time the doc first went dirty after its last durable
+    # checkpoint (0.0 = clean): the bounded-staleness writer's signal.
+    dirty_since: float = 0.0
     # Set by restore_from_checkpoints: tail ops this doc applies are a
     # boot replay (counted as boot_replay_len in health until the first
     # post-boot checkpoint ends the boot phase).
@@ -253,6 +264,19 @@ class TreeBatchEngine:
         self.mesh = mesh
         self.checkpoint_store = checkpoint_store
         self.checkpoint_every = checkpoint_every
+        # Checkpoint-plane lock + per-incident recovery clock (same
+        # contract as doc_batch_engine: the bounded-staleness background
+        # writer enters via checkpoint_stale under this lock; step/ingest
+        # hold it so sweeps only see op boundaries).
+        self.ckpt_lock = threading.RLock()
+        # Durable-write plane: saves outside ckpt_lock, seq-fenced per doc
+        # (same contract as DocBatchEngine).
+        self._ckpt_io_lock = threading.Lock()
+        self._ckpt_saved_seq: dict[int, int] = {}
+        self.recovery_tracker = RecoveryTracker()
+        # Record-file mtimes last seen by a refresh trail (standby
+        # trailing: one stat per doc per poll, not a full record re-read).
+        self._trail_mtime: dict[int, float] = {}
         self.doc_keys = list(doc_keys) if doc_keys is not None else [
             str(d) for d in range(n_docs)
         ]
@@ -370,11 +394,14 @@ class TreeBatchEngine:
 
     def ingest(self, doc_idx: int, msg: SequencedMessage) -> None:
         """Integrate one sequenced message: EditManager translation on the
-        host, op-row staging for the device (or fallback apply)."""
+        host, op-row staging for the device (or fallback apply).
+        Serialized on ``ckpt_lock`` against the background checkpoint
+        writer."""
         if msg.type != MessageType.OP:
             return
-        for edit in self._unwrap(msg.contents):
-            self._ingest_edit(doc_idx, msg, edit)
+        with self.ckpt_lock:
+            for edit in self._unwrap(msg.contents):
+                self._ingest_edit(doc_idx, msg, edit)
 
     def ingest_batch(self, doc_idxs, msgs) -> None:
         """Batch-delivery seam (BroadcasterLambda.subscribe_batch / the
@@ -394,6 +421,8 @@ class TreeBatchEngine:
             return
         h.last_seq = max(h.last_seq, msg.seq)
         h.ops_since_ckpt += 1
+        if not h.dirty_since:
+            h.dirty_since = time.monotonic()
         if h.boot_counting:
             self.counters.bump("boot_replay_len")
         commit = commit_from_json(c["changes"])
@@ -762,6 +791,18 @@ class TreeBatchEngine:
         return written
 
     def step(self) -> int:
+        """Apply everything staged as batched device megasteps.  Holds
+        ``ckpt_lock`` end to end (the background checkpoint writer only
+        sweeps between steps) and closes any open recovery incident once
+        staged work actually applied (kill -> first post-restore op)."""
+        with self.ckpt_lock:
+            had_work = bool(self._busy)
+            steps = self._step_fleet()
+            if had_work and self.recovery_tracker.active:
+                self.recovery_tracker.complete()
+            return steps
+
+    def _step_fleet(self) -> int:
         steps = 0
         while self._busy:
             # Proactive compact: dead rows accumulate monotonically (stable
@@ -859,22 +900,65 @@ class TreeBatchEngine:
         return steps
 
     # ------------------------------------------------------------- checkpoint
-    def maybe_checkpoint(self, force: bool = False) -> list[int]:
+    def maybe_checkpoint(self, force: bool = False, docs=None) -> list[int]:
         """Write durable checkpoint records (forest + EditManager window)
         for docs whose commit count since the last record reached
         ``checkpoint_every``; all dirty docs when ``force``.  The host
         trunk fold (``checkpoint`` forest) IS the snapshot state, so this
-        needs no device readback."""
+        needs no device readback.  ``docs`` restricts the sweep to an
+        explicit due list (the bounded-staleness writer): those
+        checkpoint whenever dirty, regardless of cadence."""
         if self.checkpoint_store is None:
             return []
-        if not force and self.checkpoint_every <= 0:
+        if docs is None and not force and self.checkpoint_every <= 0:
             return []
+        with self.ckpt_lock:
+            out, pending = self._checkpoint_sweep(force, docs)
+        # Durable writes outside ckpt_lock (same contract as the string
+        # engine): a background sweep's fsyncs must not stall serving.
+        write_checkpoint_records(self, pending, "device")
+        return out
+
+    def checkpoint_stale(
+        self, max_ops_behind: int = 0, max_seconds_behind: float = 0.0
+    ) -> list[int]:
+        """Bounded-staleness delta sweep (same contract as
+        ``DocBatchEngine.checkpoint_stale``): checkpoint every dirty doc
+        whose durable record trails by ``max_ops_behind`` applied ops or
+        ``max_seconds_behind`` seconds.  Record build under ``ckpt_lock``;
+        durable writes after release."""
+        if self.checkpoint_store is None or not (
+            max_ops_behind or max_seconds_behind
+        ):
+            return []
+        now = time.monotonic()
+        with self.ckpt_lock:
+            due = stale_due_docs(
+                self.hosts, self.n_docs, max_ops_behind,
+                max_seconds_behind, now,
+            )
+            if not due:
+                return []
+            with span("checkpoint_sweep", docs=len(due)):
+                out, pending = self._checkpoint_sweep(force=False, docs=due)
+            if out:
+                self.counters.bump("stale_checkpoints_written", len(out))
+        write_checkpoint_records(self, pending, "device")
+        return out
+
+    def _checkpoint_sweep(
+        self, force: bool, docs
+    ) -> tuple[list[int], list[tuple[int, int, dict]]]:
         out: list[int] = []
-        for d in range(self.n_docs):
+        pending: list[tuple[int, int, dict]] = []
+        for d in (range(self.n_docs) if docs is None else docs):
             h = self.hosts[d]
             if h.ops_since_ckpt <= 0:
                 continue
-            if not force and h.ops_since_ckpt < self.checkpoint_every:
+            if (
+                docs is None and not force
+                and h.ops_since_ckpt < self.checkpoint_every
+            ):
                 continue
             if d in self.fallbacks:
                 lane = "fallback"
@@ -895,28 +979,90 @@ class TreeBatchEngine:
                 "em": h.em.summarize(),
                 "commits": h.total_commits,
             }
-            self.checkpoint_store.save(self.doc_keys[d], h.last_seq, record)
+            pending.append((d, h.last_seq, record))
             h.base_seq = h.last_seq
             h.ops_since_ckpt = 0
+            h.dirty_since = 0.0
             h.boot_counting = False  # a new durable floor ends the boot phase
             self.counters.bump("checkpoints_written")
             out.append(d)
-        return out
+        return out, pending
 
-    def restore_from_checkpoints(self, store=None) -> list[int]:
+    def note_incident(self, started_at: float) -> None:
+        """Back-date the current recovery incident to the supervisor's
+        kill timestamp (``time.monotonic`` domain)."""
+        self.recovery_tracker.begin(started_at)
+
+    def restore_from_checkpoints(
+        self, store=None, parallel: bool = True,
+        max_workers: int | None = None, refresh: bool = False,
+    ) -> list[int]:
         """Engine restart path: rebuild each doc's host forest and
         EditManager window from its durable record, re-materialize the
         device columns from the forest (a synthesized whole-content insert
         commit), and set the seq floor so replayed ops the checkpoint
-        covers are skipped."""
+        covers are skipped.
+
+        ``parallel`` (default) loads every record concurrently (thread
+        pool over the store's ``load_many`` — the JSON read+parse is the
+        restore's I/O phase); the host builds stay in doc order either
+        way, and the re-materialized device rows land through the normal
+        batched step, so the device half is already one megastep per K·B
+        rows.  ``parallel=False`` is the sequential oracle (per-doc
+        loads), byte-identical by contract.
+
+        ``refresh`` is the warm-standby trailing mode: adopt docs that
+        GAINED a record since the last pass, without opening a recovery
+        incident.  Parity gap vs the string engine (same precedent as
+        ``migrations_unsupported``): an already-adopted tree doc is NOT
+        re-seeded from a newer record — its device columns came from a
+        staged re-materialization that cannot be overwritten in place —
+        so a promoted tree standby replays from each doc's first-adopted
+        floor instead of its freshest one."""
         store = store if store is not None else self.checkpoint_store
         if store is None:
             return []
+        with self.ckpt_lock:
+            return self._restore(store, parallel, max_workers, refresh)
+
+    def _restore(self, store, parallel, max_workers, refresh) -> list[int]:
+        t_start = time.monotonic()
+        with span("restore_scan", docs=self.n_docs):
+            candidates = []
+            cand_mtime: dict[int, float] = {}
+            for d in range(self.n_docs):
+                h = self.hosts[d]
+                if h.restored:
+                    continue  # already-seeded docs: first source wins
+                if refresh and h.queue:
+                    # Trailing never races staged work (a doc with queued
+                    # rows is being served, not trailed).
+                    continue
+                if refresh:
+                    # Unchanged record file -> nothing new: trailing polls
+                    # pay one stat per doc, not a record re-read.  Stamped
+                    # as seen only after a successful load below — a
+                    # transient read failure must not permanently exclude
+                    # the doc from trailing.
+                    mt = getattr(store, "mtime", lambda _k: None)(
+                        self.doc_keys[d]
+                    )
+                    if mt is not None and self._trail_mtime.get(d) == mt:
+                        continue
+                    if mt is not None:
+                        cand_mtime[d] = mt
+                candidates.append(d)
+        if not candidates:
+            return []
+        records = load_checkpoint_records(
+            store, [self.doc_keys[d] for d in candidates],
+            parallel=parallel, max_workers=max_workers,
+        )
         restored: list[int] = []
-        for d in range(self.n_docs):
-            if self.hosts[d].restored:
-                continue  # already seeded (first restore source wins)
-            rec = store.load(self.doc_keys[d])
+        for i, d in enumerate(candidates):
+            rec = records.get(i)
+            if rec is not None and d in cand_mtime:
+                self._trail_mtime[d] = cand_mtime[d]
             if rec is None or rec.get("engine") != "tree_batch":
                 continue
             h = self.hosts[d]
@@ -958,6 +1104,13 @@ class TreeBatchEngine:
                     self._busy.add(d)
             restored.append(d)
             self.counters.bump("docs_restored")
+        if restored and not refresh:
+            # A real restore (not standby trailing) opens a recovery
+            # incident: the clock runs until the first post-restore step
+            # applies staged work (the re-materialization rows count —
+            # they ARE the restore's device half).  note_incident()
+            # back-dates to the kill time.
+            self.recovery_tracker.begin(t_start)
         return restored
 
     # ----------------------------------------------------------------- health
@@ -1004,6 +1157,25 @@ class TreeBatchEngine:
                 if q:
                     depth[self.shard_of(d)] += q
             self.counters.gauge("shard_queue_depth", depth)
+        # Recovery surface (same shape as the string engine): incident
+        # percentiles + current checkpoint staleness.
+        self.recovery_tracker.emit_gauges(self.counters)
+        now = time.monotonic()
+        self.counters.gauge(
+            "dirty_docs",
+            sum(1 for h in self.hosts if h.ops_since_ckpt > 0),
+        )
+        self.counters.gauge(
+            "checkpoint_age_s",
+            round(
+                max(
+                    (now - h.dirty_since for h in self.hosts
+                     if h.dirty_since),
+                    default=0.0,
+                ),
+                3,
+            ),
+        )
         snap = self.counters.snapshot()
         snap.update(
             fallback_docs=len(self.fallbacks),

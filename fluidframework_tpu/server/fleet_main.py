@@ -94,6 +94,38 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--watchdog-every", type=int, default=0,
                    help="engine steps between divergence-watchdog sweeps "
                         "(0 disables)")
+    p.add_argument("--standby", action="store_true",
+                   help="run as a WARM STANDBY: pre-compile the serving "
+                        "programs, trail --checkpoint-dir continuously, "
+                        "and promote to primary the moment the lease in "
+                        "--lease-file lapses (requires both flags).  On "
+                        "promotion the process attaches the firehose and "
+                        "serves; the seq-floor dedupe replays only the "
+                        "post-checkpoint tail")
+    p.add_argument("--lease-file", default=None,
+                   help="primary-lease file (server/failover.LeaseFile): "
+                        "a primary acquires + heartbeats it; a standby "
+                        "watches it for expiry.  Epoch-fenced, so a "
+                        "paused ex-primary can never reclaim a promoted "
+                        "lease")
+    p.add_argument("--lease-ttl", type=float, default=2.0,
+                   help="lease ttl seconds (renewed every ttl/3; failover "
+                        "detection latency is bounded by this)")
+    p.add_argument("--standby-poll", type=float, default=0.25,
+                   help="seconds between standby trailing passes "
+                        "(checkpoint re-adoption cadence)")
+    p.add_argument("--ckpt-stale-ops", type=int, default=0,
+                   help="bounded-staleness checkpoints: background-write "
+                        "any dirty doc this many applied ops behind its "
+                        "durable record (0 = off; composes with "
+                        "--checkpoint-every, which bounds hot docs)")
+    p.add_argument("--ckpt-stale-seconds", type=float, default=0.0,
+                   help="bounded-staleness checkpoints: background-write "
+                        "any doc dirty for this many seconds (0 = off) — "
+                        "bounds the recovery replay tail of COLD docs")
+    p.add_argument("--ckpt-sweep-interval", type=float, default=0.25,
+                   help="seconds between background checkpoint sweeps "
+                        "(with --ckpt-stale-ops/--ckpt-stale-seconds)")
     p.add_argument("--readmit-after-steps", type=int, default=0,
                    help="auto-readmit quarantined docs after this many "
                         "engine steps (backoff-doubled per flap; 0 = manual)")
@@ -198,10 +230,13 @@ def main(argv: list[str] | None = None) -> int:
         megastep_k=args.megastep_k,
         seg_rebalance_every=args.seg_rebalance_every,
     )
-    if store is not None:
+    if store is not None and not args.standby:
         # Restart path: restore durable checkpoints BEFORE consuming, so
         # the firehose catch-up replay of already-checkpointed ops is
-        # skipped and recovery replay stays bounded.
+        # skipped and recovery replay stays bounded.  A standby skips
+        # this eager pass — WarmStandby.prepare() performs the initial
+        # adoption (refresh trail, no recovery incident); doubling it
+        # here would re-read every record and open a stray boot clock.
         restored = eng.restore_from_checkpoints()
         if restored:
             print(json.dumps({
@@ -221,6 +256,45 @@ def main(argv: list[str] | None = None) -> int:
         from ..observability import FlightRecorder, install
 
         recorder = install(FlightRecorder(args.trace_capacity))
+    lease = heartbeat = None
+    if args.lease_file:
+        from .failover import LeaseFile
+
+        lease = LeaseFile(
+            args.lease_file, holder=f"fleet-{_os.getpid()}",
+            ttl_s=args.lease_ttl,
+        )
+    if args.standby:
+        # Warm standby: programs compiled, checkpoints trailed, promotion
+        # on primary lease loss — then fall through into the serving path
+        # below exactly like a primary (the consumer's seq-floor dedupe
+        # replays only the post-checkpoint tail).
+        if store is None or lease is None:
+            p.error("--standby requires --checkpoint-dir and --lease-file")
+        from .failover import WarmStandby
+
+        ws = WarmStandby(eng, store, lease=lease, poll_s=args.standby_poll)
+        ws.prepare()
+        print(json.dumps({
+            "standby": True, "leaseFile": args.lease_file,
+            "health": eng.health(),
+        }), flush=True)
+        ws.watch()
+        ws.promote()
+        print(json.dumps({
+            "promoted": True, "health": eng.health(),
+        }), flush=True)
+    elif lease is not None:
+        if not lease.acquire():
+            print(json.dumps({
+                "error": "lease held by another primary",
+                "lease": lease.read(),
+            }), flush=True)
+            return 1
+    if lease is not None and lease.epoch >= 0:
+        from .failover import LeaseHeartbeat
+
+        heartbeat = LeaseHeartbeat(lease).start()
     fc = FleetConsumer(args.host, args.port, eng, doc_ids,
                        boot_store=boot_store)
     if fc.booted_docs:
@@ -240,8 +314,26 @@ def main(argv: list[str] | None = None) -> int:
         plane.register("latency", eng.latency_histograms)
         metrics_srv = MetricsServer(plane, port=args.metrics_port).start()
         print(json.dumps({"metricsPort": metrics_srv.port}), flush=True)
+    ckpt_writer = None
+    if store is not None and (args.ckpt_stale_ops or args.ckpt_stale_seconds):
+        # Bounded-staleness delta checkpoints: a background sweep keeps
+        # every doc's durable record within the configured ops/seconds of
+        # the live stream, so a successor's (or standby's) replay tail
+        # stays small even for docs too cold to hit --checkpoint-every.
+        from ..models.recovery import BackgroundCheckpointWriter
+
+        ckpt_writer = BackgroundCheckpointWriter(
+            eng,
+            max_ops_behind=args.ckpt_stale_ops,
+            max_seconds_behind=args.ckpt_stale_seconds,
+            interval_s=args.ckpt_sweep_interval,
+        ).start()
 
     def status(**extra) -> None:
+        if ckpt_writer is not None:
+            extra.setdefault("ckptWriter", ckpt_writer.stats())
+        if heartbeat is not None:
+            extra.setdefault("lease", heartbeat.stats())
         print(json.dumps(status_snapshot(
             eng, doc_ids, rows=fc.rows_staged,
             bytes_consumed=fc.bytes_consumed,
@@ -276,6 +368,15 @@ def main(argv: list[str] | None = None) -> int:
                         ],
                         "placement": eng.placement(),
                     }), flush=True)
+            if heartbeat is not None and heartbeat.lost:
+                # Fenced out: another holder took the lease (we stalled
+                # past the ttl and a standby promoted).  Stand down WITHOUT
+                # checkpointing: the successor owns the shared store now,
+                # and a force-write here could overwrite its newer records
+                # with our stale state — regressing the durable floor the
+                # fencing exists to protect.
+                status(leaseLost=True)
+                return 1
             if fc.dead_socks:
                 # A shard closed our firehose (restart/shutdown): exit
                 # nonzero so the supervisor restarts this tier — sleeping
@@ -311,6 +412,14 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     finally:
         fc.close()
+        if ckpt_writer is not None:
+            ckpt_writer.stop()
+        if heartbeat is not None:
+            heartbeat.stop()
+        if lease is not None:
+            # Clean shutdown hands the lease over immediately (a standby
+            # promotes now, not after the ttl runs out).
+            lease.release()
         flush = getattr(eng, "flush_telemetry", None)
         if flush is not None:
             flush()  # shutdown drain: no tail samples silently dropped

@@ -69,8 +69,16 @@ class Deployment:
     crash_window_s: float = 60.0
     restart_backoff_s: float = 0.5
     max_restart_backoff_s: float = 8.0
+    # Liveness beacon: with a path configured the supervisor stamps an
+    # atomic heartbeat JSON (ts + manifest) every ``heartbeat_every_s`` —
+    # the file a standby controller (server/failover.read_heartbeat) or
+    # operator watches to decide the whole deployment died, complementing
+    # the per-fleet lease files.
+    heartbeat_path: str | None = None
+    heartbeat_every_s: float = 1.0
     _stopping: bool = field(default=False, repr=False)
     _thread: threading.Thread | None = field(default=None, repr=False)
+    _hb_thread: threading.Thread | None = field(default=None, repr=False)
     # Guards shard records (proc/port/http_port/restarts) against the
     # supervisor thread's respawn writes: without it a router could read a
     # torn port mid-restart (fftpu-check thread-unlocked-write).  The
@@ -85,28 +93,10 @@ class Deployment:
             return ("127.0.0.1", s.port, s.http_port)
 
     def manifest(self) -> dict:
+        # A live pid only (see manifest_locked): a crash-looped / dying
+        # shard's stale pid must not read as a running member.
         with self._lock:
-            return {
-                "shards": [
-                    {
-                        "name": s.name,
-                        "port": s.port,
-                        "httpPort": s.http_port,
-                        # A live pid only: a crash-looped / dying shard's
-                        # stale pid must not read as a running member.
-                        "pid": (
-                            s.proc.pid
-                            if s.proc is not None and s.proc.poll() is None
-                            else None
-                        ),
-                        "restarts": s.restarts,
-                        "crashLooped": s.crash_looped,
-                        **({"lastError": s.last_error}
-                           if s.last_error else {}),
-                    }
-                    for s in self.shards
-                ]
-            }
+            return self.manifest_locked()
 
     # ----------------------------------------------------------- lifecycle
     def stop(self) -> None:
@@ -118,6 +108,8 @@ class Deployment:
         # a plain monotonic bool store is the one cross-thread write here
         # that needs no lock (join() below is the ordering barrier).
         self._stopping = True
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=10)
         if self._thread is not None:
             # _spawn aborts within one attempt cycle once _stopping is set
             # (readiness polls 1s slices with abort checks; worst case one
@@ -204,6 +196,85 @@ class Deployment:
                             s.last_error = repr(e)[-200:]
                             self._record_crash(s, time.monotonic())
             time.sleep(0.2)
+
+    def _heartbeat_loop(self) -> None:
+        """Liveness beacon thread: stamps ``heartbeat_path`` every
+        ``heartbeat_every_s`` REGARDLESS of what the supervisor thread is
+        doing — a respawn's readiness wait can hold ``_lock`` for tens of
+        seconds, and a beacon stamped from that thread would go stale and
+        false-positive "deployment died" at a watcher mid-respawn.  The
+        beacon signals process liveness (the daemon thread dies with the
+        process); the manifest garnish is best-effort: when ``_lock`` is
+        busy (supervisor mid-respawn) the stamp carries ``busy`` instead
+        of blocking behind the respawn."""
+        from .failover import write_heartbeat
+
+        last_manifest: dict = {}
+        while not self._stopping:
+            # Bounded wait, never the full respawn: a fresh manifest when
+            # the lock frees quickly, else the last known one + ``busy``.
+            if self._lock.acquire(timeout=min(0.5, self.heartbeat_every_s)):
+                try:
+                    last_manifest = self.manifest_locked()
+                    payload = last_manifest
+                finally:
+                    self._lock.release()
+            else:
+                payload = {**last_manifest, "busy": True}
+            # Suppress, not handle: a transiently full disk must not kill
+            # the beacon; the next tick re-stamps.
+            with contextlib.suppress(OSError):
+                write_heartbeat(self.heartbeat_path, payload)
+            time.sleep(self.heartbeat_every_s)
+
+    def manifest_locked(self) -> dict:
+        """``manifest()`` body for callers already holding ``_lock``."""
+        return {
+            "shards": [
+                {
+                    "name": s.name,
+                    "port": s.port,
+                    "httpPort": s.http_port,
+                    "pid": (
+                        s.proc.pid
+                        if s.proc is not None and s.proc.poll() is None
+                        else None
+                    ),
+                    "restarts": s.restarts,
+                    "crashLooped": s.crash_looped,
+                    **({"lastError": s.last_error} if s.last_error else {}),
+                }
+                for s in self.shards
+            ]
+        }
+
+    # ------------------------------------------------------------- promotion
+    def promote(self, name: str) -> bool:
+        """Operator/standby-controller promote path: revive a shard the
+        restart budget gave up on (``crashLooped``) — or restart a dead
+        one explicitly — reusing the supervisor's spawn machinery with a
+        FRESH budget window.  Returns False for an unknown shard or one
+        that is still alive."""
+        with self._lock:
+            shard = next((s for s in self.shards if s.name == name), None)
+            if shard is None:
+                return False
+            if shard.proc is not None and shard.proc.poll() is None:
+                return False  # alive: nothing to promote onto its ports
+            shard.crash_times = []
+            shard.crash_looped = False
+            shard.crash_acked = False
+            shard.backoff_s = 0.0
+            shard.next_restart_at = 0.0
+            shard.restarts += 1
+            try:
+                _spawn(shard, abort=lambda: self._stopping)
+                shard.last_error = ""
+            except Exception as e:  # noqa: BLE001 — surfaced in the manifest
+                shard.last_error = repr(e)[-200:]
+                self._record_crash(shard, time.monotonic())
+                return False
+            return True
 
 
 def shard_index(doc_id: str, n_shards: int) -> int:
@@ -292,6 +363,8 @@ def launch(config: dict, supervise: bool = False) -> Deployment:
         crash_window_s=float(config.get("crashWindowS", 60.0)),
         restart_backoff_s=float(config.get("restartBackoffS", 0.5)),
         max_restart_backoff_s=float(config.get("maxRestartBackoffS", 8.0)),
+        heartbeat_path=config.get("heartbeatFile"),
+        heartbeat_every_s=float(config.get("heartbeatEveryS", 1.0)),
     )
     try:
         for s in shards:
@@ -302,6 +375,12 @@ def launch(config: dict, supervise: bool = False) -> Deployment:
     if supervise:
         dep._thread = threading.Thread(target=dep._supervise_loop, daemon=True)
         dep._thread.start()
+        if dep.heartbeat_path is not None:
+            dep._hb_thread = threading.Thread(
+                target=dep._heartbeat_loop, name="launcher-heartbeat",
+                daemon=True,
+            )
+            dep._hb_thread.start()
     return dep
 
 

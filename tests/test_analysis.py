@@ -612,6 +612,98 @@ def test_threads_module_function_target(tmp_path):
     assert ".offset" in found[0].message
 
 
+EXECUTOR_BAD = (
+    "import threading\n"
+    "from concurrent.futures import ThreadPoolExecutor\n"
+    "class Restorer:\n"
+    "    def __init__(self):\n"
+    "        self.loaded = 0\n"
+    "        self._lock = threading.Lock()\n"
+    "    def _load_one(self, doc):\n"
+    "        self.loaded += 1\n"
+    "    def restore(self, docs):\n"
+    "        with ThreadPoolExecutor(max_workers=4) as ex:\n"
+    "            for d in docs:\n"
+    "                ex.submit(self._load_one, d)\n"
+    "    def stats(self):\n"
+    "        return self.loaded\n"
+)
+
+EXECUTOR_GOOD = EXECUTOR_BAD.replace(
+    "    def _load_one(self, doc):\n"
+    "        self.loaded += 1\n",
+    "    def _load_one(self, doc):\n"
+    "        with self._lock:\n"
+    "            self.loaded += 1\n",
+)
+
+
+def test_threads_executor_submit_is_a_thread_entry(tmp_path):
+    """ISSUE 12 coverage extension: a ThreadPoolExecutor worker body is a
+    thread entry (the parallel-restore fan-out shape) — an unlocked write
+    it makes to state the host path reads must fire, and the locked twin
+    must stay silent."""
+    pkg_bad = make_pkg(tmp_path / "bad", {"low/r.py": EXECUTOR_BAD})
+    found = threads.run(load_package(pkg_bad))
+    assert [f.rule for f in found] == ["thread-unlocked-write"]
+    assert ".loaded" in found[0].message and "_load_one" in found[0].detail
+
+    pkg_good = make_pkg(tmp_path / "good", {"low/r.py": EXECUTOR_GOOD})
+    assert threads.run(load_package(pkg_good)) == []
+
+
+def test_threads_executor_map_and_with_binding(tmp_path):
+    """``ex.map(fn, ...)`` over a with-bound executor also enters fn on
+    worker threads (CheckpointStore.load_many's exact shape)."""
+    pkg = make_pkg(tmp_path, {
+        "low/r.py": (
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "def _read(store):\n"
+            "    store.hits = store.hits + 1\n"
+            "def load_all(stores):\n"
+            "    with ThreadPoolExecutor(max_workers=2) as pool:\n"
+            "        return list(pool.map(_read, stores))\n"
+            "def peek(store):\n"
+            "    return store.hits\n"
+        ),
+    })
+    found = threads.run(load_package(pkg))
+    assert [f.rule for f in found] == ["thread-unlocked-write"]
+    assert ".hits" in found[0].message
+
+
+def test_threads_timer_function_is_a_thread_entry(tmp_path):
+    """``threading.Timer(t, fn)`` runs fn on the timer thread — the
+    lease-heartbeat/background-writer shape; positional and keyword
+    forms both count, and the locked twin stays silent."""
+    bad = (
+        "import threading\n"
+        "class Beat:\n"
+        "    def __init__(self):\n"
+        "        self.renewals = 0\n"
+        "        self._lock = threading.Lock()\n"
+        "        threading.Timer(1.0, self._renew).start()\n"
+        "    def _renew(self):\n"
+        "        self.renewals += 1\n"
+        "    def stats(self):\n"
+        "        return self.renewals\n"
+    )
+    pkg_bad = make_pkg(tmp_path / "bad", {"low/b.py": bad})
+    found = threads.run(load_package(pkg_bad))
+    assert [f.rule for f in found] == ["thread-unlocked-write"]
+    assert ".renewals" in found[0].message
+
+    good = bad.replace(
+        "    def _renew(self):\n"
+        "        self.renewals += 1\n",
+        "    def _renew(self):\n"
+        "        with self._lock:\n"
+        "            self.renewals += 1\n",
+    )
+    pkg_good = make_pkg(tmp_path / "good", {"low/b.py": good})
+    assert threads.run(load_package(pkg_good)) == []
+
+
 # ---------------------------------------------------------------------------
 # Pass 6: swallowed-exception
 # ---------------------------------------------------------------------------

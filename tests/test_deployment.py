@@ -177,3 +177,61 @@ def test_launcher_restart_budget_detects_crash_loop():
             assert s.restarts == m["restarts"]  # no further respawns
     finally:
         dep.stop()
+
+
+def test_launcher_heartbeat_and_promote_revives_crash_looped_shard():
+    """The supervisor's fast-recovery surface (ISSUE 12): it stamps a
+    liveness heartbeat file (the beacon a standby controller watches),
+    and ``promote`` revives a shard the restart budget gave up on —
+    fresh budget window, same spawn machinery, same ports."""
+    import tempfile
+    import time
+
+    from fluidframework_tpu.server.failover import read_heartbeat
+
+    hb_path = os.path.join(tempfile.mkdtemp(), "launcher-heartbeat.json")
+    dep = launch({
+        "shards": [{"name": "s0"}],
+        "restartBudget": 1,
+        "crashWindowS": 120.0,
+        "restartBackoffS": 0.05,
+        "maxRestartBackoffS": 0.1,
+        "heartbeatFile": hb_path,
+        "heartbeatEveryS": 0.1,
+    }, supervise=True)
+    try:
+        deadline = time.time() + 30
+        while time.time() < deadline and not os.path.exists(hb_path):
+            time.sleep(0.1)
+        rec, fresh = read_heartbeat(hb_path, stale_after_s=10.0)
+        assert fresh and rec["shards"][0]["name"] == "s0"
+
+        # Crash past the budget -> crashLooped, supervisor stands down.
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            with dep._lock:
+                looped = dep.shards[0].crash_looped
+                proc = dep.shards[0].proc
+            if looped:
+                break
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+            time.sleep(0.1)
+        assert dep.manifest()["shards"][0]["crashLooped"] is True
+        assert dep.promote("nope") is False  # unknown shard
+
+        # Promote: the shard comes back on its ports with a fresh budget.
+        assert dep.promote("s0") is True
+        m = dep.manifest()["shards"][0]
+        assert m["pid"] is not None and m["crashLooped"] is False
+        assert dep.promote("s0") is False  # alive: nothing to promote
+        # The heartbeat keeps stamping the revived manifest.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            rec, fresh = read_heartbeat(hb_path, stale_after_s=1.0)
+            if fresh and rec["shards"][0]["pid"] == m["pid"]:
+                break
+            time.sleep(0.1)
+        assert fresh and rec["shards"][0]["pid"] == m["pid"]
+    finally:
+        dep.stop()

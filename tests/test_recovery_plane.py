@@ -1,0 +1,660 @@
+"""Fast-recovery plane (ISSUE 12): batched parallel restore, delta
+checkpoints, warm-standby failover, and recovery observability.
+
+Contracts pinned here:
+
+- ``restore_from_checkpoints(parallel=True)`` (concurrent record loads +
+  one stacked scatter) is BYTE-IDENTICAL to the sequential oracle path
+  AND to a full replay of the op streams — across batch, overflow,
+  oracle, quarantine, geometry-outgrown, and seg-lane-checkpointed
+  records, with torn/corrupt records mixed in;
+- ``CheckpointStore.docs()`` decodes ids from filenames (O(entries) scan)
+  with an exact round-trip, falling back to the record body only for
+  legacy names; ``load_many`` == per-doc ``load``;
+- bounded-staleness delta checkpoints: ``checkpoint_stale`` honors the
+  max-ops-behind / max-seconds-behind bounds and the background writer
+  thread drives it safely against a live serving loop;
+- the lease file is epoch-fenced (an expired ex-holder can never renew a
+  promoted lease) and the heartbeat detects loss exactly once;
+- a warm standby trails checkpoints, promotes byte-identically, and the
+  recovery clock (kill -> first post-restore applied op) lands in
+  health()/histograms;
+- tier-1 recovery smoke: fleet kill + restore + converge over the real
+  composed stack WITH a standby, recovery intervals measured (the full
+  fault-palette soak rides behind ``-m slow`` via bench --config soak).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from fluidframework_tpu.models.doc_batch_engine import DocBatchEngine
+from fluidframework_tpu.models.recovery import (
+    BackgroundCheckpointWriter,
+    RecoveryTracker,
+)
+from fluidframework_tpu.models.tree_batch_engine import TreeBatchEngine
+from fluidframework_tpu.parallel import mesh as pm
+from fluidframework_tpu.protocol.messages import MessageType, SequencedMessage
+from fluidframework_tpu.server.failover import (
+    LeaseFile,
+    LeaseHeartbeat,
+    WarmStandby,
+)
+from fluidframework_tpu.server.ordered_log import CheckpointStore
+
+from test_engine_checkpoint import _ins, _join, _mk_engine, _rm, _schedule
+
+
+def _wait_until(cond, timeout_s: float = 5.0, every_s: float = 0.02) -> bool:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(every_s)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# CheckpointStore: filename-decoded scan + concurrent loads
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_store_docs_decodes_ids_from_filenames():
+    """The restore scan is a directory listing: every id the encoder can
+    write round-trips through the filename, including path-hostile and
+    non-ascii ids."""
+    tmp = tempfile.mkdtemp()
+    store = CheckpointStore(tmp)
+    ids = ["plain-doc_1.x", "a b", "sl/ash", "pc%t", "dØc", "%25"]
+    for i, doc in enumerate(ids):
+        store.save(doc, i, {"engine": "doc_batch"})
+    assert sorted(store.docs()) == sorted(ids)
+    for i, doc in enumerate(ids):
+        assert store.load(doc)["seq"] == i
+
+
+def test_checkpoint_store_docs_falls_back_for_legacy_names():
+    """A file whose name the encoder could not have produced (operator-
+    copied, uppercase hex, literal space) still lists — via the one
+    fallback read of its ``doc`` field."""
+    tmp = tempfile.mkdtemp()
+    store = CheckpointStore(tmp)
+    store.save("normal", 1, {"engine": "doc_batch"})
+    legacy_dir = store._dir
+    with open(os.path.join(legacy_dir, "weird name.json"), "w") as f:
+        json.dump({"doc": "legacy-a", "seq": 2}, f)
+    with open(os.path.join(legacy_dir, "bad%zzescape.json"), "w") as f:
+        json.dump({"doc": "legacy-b", "seq": 3}, f)
+    # Undecodable name AND unreadable body: skipped, never raises.
+    with open(os.path.join(legacy_dir, "torn %.json"), "w") as f:
+        f.write('{"trunc')
+    assert sorted(store.docs()) == ["legacy-a", "legacy-b", "normal"]
+
+
+def test_checkpoint_store_reads_pre_utf8_escape_records():
+    """Records written by the old per-CODEPOINT escaper (ambiguous beyond
+    Latin-1: '€' -> '%20ac') must not be orphaned by the per-UTF-8-byte
+    encoder: load/mtime fall back to the legacy filename, and the next
+    save migrates the record to the new name and drops the old file."""
+    tmp = tempfile.mkdtemp()
+    store = CheckpointStore(tmp)
+    legacy = os.path.join(store._dir, "doc-%20ac.json")
+    with open(legacy, "w") as f:
+        json.dump({"doc": "doc-€", "seq": 7, "engine": "doc_batch"}, f)
+    assert store.load("doc-€")["seq"] == 7
+    assert store.mtime("doc-€") is not None
+    store.save("doc-€", 9, {"engine": "doc_batch"})
+    assert not os.path.exists(legacy)
+    assert store.load("doc-€")["seq"] == 9
+    assert store.docs() == ["doc-€"]
+
+
+def test_checkpoint_store_load_many_matches_sequential_loads():
+    tmp = tempfile.mkdtemp()
+    store = CheckpointStore(tmp)
+    ids = [f"doc{i}" for i in range(17)]
+    for i, doc in enumerate(ids):
+        store.save(doc, i, {"engine": "doc_batch", "payload": [i] * 10})
+    want = {d: store.load(d) for d in ids + ["missing"]}
+    got = store.load_many(ids + ["missing"], max_workers=4)
+    assert got == want
+    assert got["missing"] is None
+
+
+# ---------------------------------------------------------------------------
+# Parallel restore == sequential oracle == full replay
+# ---------------------------------------------------------------------------
+
+def _state_equal(a, b) -> bool:
+    leaves_a = jax.tree.leaves(a)
+    leaves_b = jax.tree.leaves(b)
+    return len(leaves_a) == len(leaves_b) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(leaves_a, leaves_b)
+    )
+
+
+def _build_mixed_record_store(tmp: str):
+    """One checkpoint dir covering every record lane the restore must
+    handle: d0/d1 batch, d2 overflow (grown geometry), d3 quarantine
+    (poisoned), d4 oracle (growth budget exhausted).  Returns the streams
+    per doc key for replay comparison."""
+    streams: dict[str, list] = {}
+
+    # d0/d1: plain batch docs.
+    eng = _mk_engine(2, CheckpointStore(tmp), doc_keys=["d0", "d1"])
+    sched = _schedule(2, 8, seed=3)
+    for d in range(2):
+        eng.ingest(d, _join("w0", 0))
+        streams[f"d{d}"] = [_join("w0", 0)]
+    for d, m, _p in sched:
+        eng.ingest(d, m)
+        streams[f"d{d}"].append(m)
+    eng.step()
+    eng.maybe_checkpoint(force=True)
+
+    # d2: overflow lane (front-inserts past max_segments=6, grows).
+    eng2 = DocBatchEngine(
+        1, max_segments=6, max_insert_len=8, ops_per_step=4, use_mesh=False,
+        checkpoint_store=CheckpointStore(tmp), doc_keys=["d2"],
+    )
+    eng2.ingest(0, _join("w0", 0))
+    streams["d2"] = [_join("w0", 0)]
+    for s in range(1, 9):
+        m = _ins(s, 0, "ab")
+        eng2.ingest(0, m)
+        streams["d2"].append(m)
+    eng2.step()
+    assert 0 in eng2.overflow
+    eng2.maybe_checkpoint(force=True)
+
+    # d3: quarantined (poison op dropped by the validated replay).
+    eng3 = _mk_engine(1, CheckpointStore(tmp), doc_keys=["d3"])
+    eng3.ingest(0, _join("w0", 0))
+    streams["d3"] = [_join("w0", 0)]
+    for s, m in enumerate(
+        [_ins(1, 0, "ok"), _ins(2, 10**6, "XX"), _ins(3, 2, "go")], 1
+    ):
+        eng3.ingest(0, m)
+        streams["d3"].append(m)
+    eng3.step()
+    assert 0 in eng3.quarantine
+    eng3.maybe_checkpoint(force=True)
+
+    # d4: oracle-routed (growth budget 0 -> straight to the host oracle).
+    eng4 = DocBatchEngine(
+        1, max_segments=6, max_insert_len=8, ops_per_step=4, use_mesh=False,
+        recovery="grow", max_growths=0,
+        checkpoint_store=CheckpointStore(tmp), doc_keys=["d4"],
+    )
+    eng4.ingest(0, _join("w0", 0))
+    streams["d4"] = [_join("w0", 0)]
+    for s in range(1, 9):
+        m = _ins(s, 0, "cd")
+        eng4.ingest(0, m)
+        streams["d4"].append(m)
+    eng4.step()
+    assert 0 in eng4.oracles
+    eng4.maybe_checkpoint(force=True)
+
+    expected_text = {
+        "d0": eng.text(0), "d1": eng.text(1), "d2": eng2.text(0),
+        "d3": eng3.text(0), "d4": eng4.text(0),
+    }
+    return streams, expected_text
+
+
+def _restore_engine(tmp: str, parallel: bool) -> DocBatchEngine:
+    eng = _mk_engine(
+        5, CheckpointStore(tmp), doc_keys=["d0", "d1", "d2", "d3", "d4"]
+    )
+    restored = eng.restore_from_checkpoints(parallel=parallel)
+    assert restored == list(range(5))
+    return eng
+
+
+def test_parallel_restore_identical_to_sequential_and_replay():
+    """The tentpole identity: parallel restore == sequential oracle ==
+    full replay, across batch/overflow/quarantine/oracle records — state
+    bytes, lane membership, and post-restore convergence all equal."""
+    tmp = tempfile.mkdtemp()
+    streams, expected_text = _build_mixed_record_store(tmp)
+
+    par = _restore_engine(tmp, parallel=True)
+    seq = _restore_engine(tmp, parallel=False)
+
+    keys = ["d0", "d1", "d2", "d3", "d4"]
+    for i, k in enumerate(keys):
+        assert par.text(i) == seq.text(i) == expected_text[k], k
+        assert par.annotations(i) == seq.annotations(i), k
+    assert set(par.overflow) == set(seq.overflow) == {2}
+    assert set(par.quarantine) == set(seq.quarantine) == {3}
+    assert set(par.oracles) == set(seq.oracles) == {4}
+    # Batch rows (and lane states): exact device-byte identity.
+    for i in range(5):
+        if i not in par.quarantine and i not in par.oracles:
+            assert _state_equal(par.doc_state(i), seq.doc_state(i)), i
+    # Both opened a recovery incident; it closes on the first applied op.
+    assert par.recovery_tracker.active and seq.recovery_tracker.active
+
+    # Full replay oracle: a storeless engine fed the raw streams once.
+    replay = _mk_engine(5, None, doc_keys=keys)
+    for i, k in enumerate(keys):
+        for m in streams[k]:
+            replay.ingest(i, m)
+    replay.step()
+    for i, k in enumerate(keys):
+        assert replay.text(i) == expected_text[k], k
+
+    # Post-restore convergence: replaying the full stream into the
+    # restored engines is idempotent (floor dedupe) and new ops apply
+    # identically; the replay oracle (no floor) gets each op exactly once.
+    new_ops = {
+        k: _ins(len([m for m in streams[k]
+                     if m.type == MessageType.OP]) + 1, 0, "zz")
+        for k in keys
+    }
+    replay = _mk_engine(5, None, doc_keys=keys)
+    for engn in (par, seq, replay):
+        for i, k in enumerate(keys):
+            for m in streams[k]:
+                engn.ingest(i, m)  # restored engines dedupe by floor
+            engn.ingest(i, new_ops[k])
+        engn.step()
+    for i, k in enumerate(keys):
+        assert par.text(i) == seq.text(i) == replay.text(i), k
+        assert par.text(i).startswith("zz"), k
+    assert not par.recovery_tracker.active
+    assert par.health()["recovery_incidents"] == 1
+    assert par.health()["recovery_p99_ms"] > 0
+
+
+def test_restore_skips_torn_and_corrupt_records_next_to_good_ones():
+    """A hostile checkpoint dir: good records restore (both paths), torn/
+    corrupt ones degrade to full replay for exactly their doc."""
+    tmp = tempfile.mkdtemp()
+    store = CheckpointStore(tmp)
+    eng = _mk_engine(3, store, doc_keys=["g0", "bad", "g1"])
+    sched = _schedule(3, 6, seed=9)
+    for d in range(3):
+        eng.ingest(d, _join("w0", 0))
+    msgs: dict[int, list] = {d: [_join("w0", 0)] for d in range(3)}
+    for d, m, _p in sched:
+        eng.ingest(d, m)
+        msgs[d].append(m)
+    eng.step()
+    eng.maybe_checkpoint(force=True)
+    texts = [eng.text(d) for d in range(3)]
+    # Tear the middle record; drop a garbage file next to it.
+    with open(store._path("bad"), "w") as f:
+        f.write('{"engine": "doc_ba')
+    with open(os.path.join(store._dir, "noise.json"), "w") as f:
+        f.write("not json at all")
+
+    for parallel in (True, False):
+        eng2 = _mk_engine(
+            3, CheckpointStore(tmp), doc_keys=["g0", "bad", "g1"]
+        )
+        assert eng2.restore_from_checkpoints(parallel=parallel) == [0, 2]
+        # The torn doc replays its full stream; the good ones dedupe.
+        for d in range(3):
+            for m in msgs[d]:
+                eng2.ingest(d, m)
+        eng2.step()
+        assert [eng2.text(d) for d in range(3)] == texts, parallel
+        assert not eng2.errors().any()
+
+
+def test_geometry_outgrown_record_restores_fitted_both_paths():
+    """A record whose state outgrew the restoring engine's batch geometry
+    lands in a fitted overflow lane (the ``_fit_geometry`` path) —
+    identically for the parallel and sequential restores."""
+    tmp = tempfile.mkdtemp()
+    big = DocBatchEngine(
+        1, max_segments=64, max_insert_len=8, ops_per_step=4,
+        use_mesh=False, checkpoint_store=CheckpointStore(tmp),
+        doc_keys=["grown"],
+    )
+    big.ingest(0, _join("w0", 0))
+    for s in range(1, 25):  # 24 front-inserts -> 24 segments
+        big.ingest(0, _ins(s, 0, "ab"))
+    big.step()
+    big.maybe_checkpoint(force=True)
+    want = big.text(0)
+
+    engines = []
+    for parallel in (True, False):
+        small = DocBatchEngine(
+            1, max_segments=8, max_insert_len=8, ops_per_step=4,
+            use_mesh=False, checkpoint_store=CheckpointStore(tmp),
+            doc_keys=["grown"],
+        )
+        assert small.restore_from_checkpoints(parallel=parallel) == [0]
+        assert 0 in small.overflow, "fitted-overflow restore expected"
+        assert small.overflow[0].geometry["max_segments"] >= 24
+        assert small.text(0) == want
+        engines.append(small)
+    assert _state_equal(
+        engines[0].overflow[0].state, engines[1].overflow[0].state
+    )
+
+
+def test_seg_lane_doc_checkpointed_mid_promotion_restores_identical():
+    """A doc checkpointed WHILE segment-promoted (2-D docs x segs lane)
+    writes a batch-restorable record through the seg gather codec; both
+    restore paths and the full replay agree byte-for-byte."""
+    mesh = pm.docs_segs_mesh(jax.devices(), seg_shards=2)
+    tmp = tempfile.mkdtemp()
+    eng = DocBatchEngine(
+        2, max_insert_len=8, ops_per_step=4, use_mesh=True, mesh=mesh,
+        checkpoint_store=CheckpointStore(tmp), doc_keys=["hot", "cold"],
+    )
+    sched = _schedule(2, 8, seed=11)
+    msgs: dict[int, list] = {d: [_join("w0", 0)] for d in range(2)}
+    for d in range(2):
+        eng.ingest(d, _join("w0", 0))
+    for d, m, _p in sched:
+        eng.ingest(d, m)
+        msgs[d].append(m)
+    eng.step()
+    assert eng.enable_segment_sharding(0), "promotion must succeed"
+    # Checkpoint fires mid-promotion: doc 0's record goes through the
+    # seg-gather summary codec while the lane is live.
+    eng.maybe_checkpoint(force=True)
+    texts = [eng.text(d) for d in range(2)]
+    assert 0 in eng.seg_lanes  # still promoted after the sweep
+
+    restored = []
+    for parallel in (True, False):
+        eng2 = _mk_engine(
+            2, CheckpointStore(tmp), doc_keys=["hot", "cold"]
+        )
+        assert eng2.restore_from_checkpoints(parallel=parallel) == [0, 1]
+        assert [eng2.text(d) for d in range(2)] == texts
+        restored.append(eng2)
+    for d in range(2):
+        assert _state_equal(
+            restored[0].doc_state(d), restored[1].doc_state(d)
+        )
+    # Full replay agrees.
+    replay = _mk_engine(2, None, doc_keys=["hot", "cold"])
+    for d in range(2):
+        for m in msgs[d]:
+            replay.ingest(d, m)
+    replay.step()
+    assert [replay.text(d) for d in range(2)] == texts
+
+
+def test_tree_engine_parallel_restore_matches_sequential():
+    from test_tree_batch_engine import drive_tree_docs
+
+    svc, expected = drive_tree_docs(3, seed=4, steps=16)
+    tmp = tempfile.mkdtemp()
+    eng = TreeBatchEngine(
+        3, checkpoint_store=CheckpointStore(tmp), checkpoint_every=8,
+    )
+    for d in range(3):
+        for msg in svc.document(f"doc{d}").sequencer.log:
+            eng.ingest(d, msg)
+    eng.step()
+    eng.maybe_checkpoint(force=True)
+
+    outs = []
+    for parallel in (True, False):
+        eng2 = TreeBatchEngine(3, checkpoint_store=CheckpointStore(tmp))
+        assert eng2.restore_from_checkpoints(parallel=parallel) == [0, 1, 2]
+        assert eng2.recovery_tracker.active
+        eng2.step()  # apply the re-materialization rows -> incident closes
+        assert not eng2.recovery_tracker.active
+        assert eng2.health()["recovery_incidents"] == 1
+        outs.append([eng2.values(d) for d in range(3)])
+    assert outs[0] == outs[1] == [expected[d] for d in range(3)]
+
+
+# ---------------------------------------------------------------------------
+# Delta checkpoints: staleness bounds + background writer
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_stale_honors_ops_and_seconds_bounds():
+    tmp = tempfile.mkdtemp()
+    store = CheckpointStore(tmp)
+    eng = _mk_engine(2, store, checkpoint_every=10**6)  # cadence never fires
+    for d in range(2):
+        eng.ingest(d, _join("w0", 0))
+    eng.ingest(0, _ins(1, 0, "aa"))
+    eng.ingest(0, _ins(2, 0, "bb"))
+    eng.ingest(1, _ins(1, 0, "cc"))
+    eng.step()
+    assert eng.maybe_checkpoint() == []  # cadence: nothing due
+    # Ops bound: only doc 0 (2 ops behind) is due at threshold 2.
+    assert eng.checkpoint_stale(max_ops_behind=2) == [0]
+    assert store.load("0")["seq"] == 2
+    assert store.load("1") is None
+    # Seconds bound: doc 1 goes due once its dirty age crosses the bound.
+    assert eng.checkpoint_stale(max_seconds_behind=60.0) == []
+    time.sleep(0.03)
+    assert eng.checkpoint_stale(max_seconds_behind=0.02) == [1]
+    assert store.load("1")["seq"] == 1
+    # Clean engine: nothing left to sweep; gauges reflect it.
+    assert eng.checkpoint_stale(max_ops_behind=1, max_seconds_behind=0.01) == []
+    h = eng.health()
+    assert h["stale_checkpoints_written"] == 2
+    assert h["dirty_docs"] == 0 and h["checkpoint_age_s"] == 0.0
+
+
+def test_background_checkpoint_writer_sweeps_live_engine():
+    """The writer thread checkpoints a dirty doc within its staleness
+    bound while the 'serving thread' keeps ingesting/stepping — no torn
+    sweeps (the engine lock serializes), records land durably."""
+    tmp = tempfile.mkdtemp()
+    store = CheckpointStore(tmp)
+    eng = _mk_engine(1, store, checkpoint_every=10**6)
+    eng.ingest(0, _join("w0", 0))
+    writer = BackgroundCheckpointWriter(
+        eng, max_seconds_behind=0.03, interval_s=0.01
+    ).start()
+    try:
+        for s in range(1, 13):
+            eng.ingest(0, _ins(s, 0, "ab"))
+            eng.step()
+            time.sleep(0.005)
+        assert _wait_until(lambda: store.load("0") is not None)
+        assert _wait_until(
+            lambda: eng.health()["checkpoint_age_s"] < 0.5
+        )
+    finally:
+        writer.stop()
+    stats = writer.stats()
+    assert stats["ckpt_writer_sweeps"] > 0
+    assert stats["ckpt_writer_records"] >= 1
+    # The record is a real restore base.
+    eng2 = _mk_engine(1, CheckpointStore(tmp))
+    assert eng2.restore_from_checkpoints() == [0]
+    assert eng2.text(0) == eng.text(0)[: len(eng2.text(0))]
+
+
+# ---------------------------------------------------------------------------
+# Lease + heartbeat
+# ---------------------------------------------------------------------------
+
+def test_lease_file_expiry_and_epoch_fencing():
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, "lease.json")
+    a = LeaseFile(path, "a", ttl_s=0.15)
+    b = LeaseFile(path, "b", ttl_s=0.15)
+    assert a.acquire()
+    assert not b.acquire(), "live lease must not hand over"
+    assert a.renew()
+    assert b.held_by_other()
+    time.sleep(0.2)  # a's lease expires un-renewed
+    assert b.acquire(), "expired lease must hand over"
+    # Fencing: the ex-holder's renew fails (epoch moved on) and a plain
+    # re-acquire is refused while b is alive.
+    assert not a.renew()
+    assert not a.acquire()
+    assert b.read()["epoch"] > 0
+    # Clean release hands over immediately, no ttl wait.
+    b.release()
+    assert a.acquire()
+
+
+def test_lease_heartbeat_renews_then_detects_loss_once():
+    tmp = tempfile.mkdtemp()
+    path = os.path.join(tmp, "lease.json")
+    holder = LeaseFile(path, "primary", ttl_s=0.3)
+    assert holder.acquire()
+    losses = []
+    hb = LeaseHeartbeat(holder, on_lost=lambda: losses.append(1)).start()
+    try:
+        assert _wait_until(lambda: hb.stats()["lease_renewals"] >= 2)
+        assert not hb.lost
+        assert holder.holder_alive()
+        # A forced takeover (what promote() does) fences the heartbeat out.
+        thief = LeaseFile(path, "standby", ttl_s=0.3)
+        assert thief.acquire(force=True)
+        assert _wait_until(lambda: hb.lost)
+        assert losses == [1]
+    finally:
+        hb.stop()
+
+
+# ---------------------------------------------------------------------------
+# Warm standby
+# ---------------------------------------------------------------------------
+
+def test_warm_standby_trails_and_promotes_byte_identical():
+    tmp = tempfile.mkdtemp()
+    store = CheckpointStore(tmp)
+    primary = _mk_engine(2, store, checkpoint_every=4)
+    stream: dict[int, list] = {d: [_join("w0", 0)] for d in range(2)}
+    for d in range(2):
+        primary.ingest(d, _join("w0", 0))
+    sched = _schedule(2, 6, seed=21)
+    first_half = sched[: len(sched) // 2]
+    second_half = sched[len(sched) // 2:]
+    for d, m, _p in first_half:
+        primary.ingest(d, m)
+        stream[d].append(m)
+    primary.step()
+    primary.maybe_checkpoint(force=True)
+
+    lease_path = os.path.join(tmp, "lease.json")
+    primary_lease = LeaseFile(lease_path, "primary", ttl_s=0.2)
+    assert primary_lease.acquire()
+    standby = WarmStandby(
+        _mk_engine(2, CheckpointStore(tmp)),
+        CheckpointStore(tmp),
+        lease=LeaseFile(lease_path, "standby", ttl_s=0.2),
+    ).prepare()
+    assert standby.engine.health()["warmup_dispatches"] > 0
+    assert [standby.engine.text(d) for d in range(2)] == [
+        primary.text(d) for d in range(2)
+    ]
+    # prepare() outlives one ttl (warmup compiles); a live primary would
+    # have been heartbeating the whole time — renew before probing.
+    assert primary_lease.renew()
+    assert not standby.should_promote()  # primary lease is live
+
+    # Primary advances + checkpoints again; the trailing pass adopts the
+    # NEWER records (refresh), not first-source-wins staleness.
+    for d, m, _p in second_half:
+        primary.ingest(d, m)
+        stream[d].append(m)
+    primary.step()
+    primary.maybe_checkpoint(force=True)
+    assert standby.trail() == 2
+    assert standby.adoptions >= 2
+    assert [standby.engine.text(d) for d in range(2)] == [
+        primary.text(d) for d in range(2)
+    ]
+
+    # Primary dies (lease expires); standby promotes with the kill time.
+    assert primary_lease.renew()
+    t_kill = time.monotonic()
+    time.sleep(0.25)
+    assert standby.should_promote()
+    eng = standby.promote(incident_started_at=t_kill)
+    assert standby.lease.epoch >= 0  # lease taken over
+    assert eng.recovery_tracker.active
+    # Full-stream replay dedupes; one new op closes the incident.
+    for d in range(2):
+        for m in stream[d]:
+            eng.ingest(d, m)
+        eng.ingest(d, _ins(99, 0, "!!"))
+    eng.step()
+    h = eng.health()
+    assert h["recovery_incidents"] == 1
+    assert h["recovery_p99_ms"] >= 250  # >= the lease-expiry wait
+    assert h["standby_promotions"] == 1
+    for d in range(2):
+        assert eng.text(d).startswith("!!")
+    # The recovery histogram rides the metrics surface.
+    assert eng.latency_histograms()["recovery_time"].count == 1
+
+
+def test_recovery_tracker_earliest_begin_wins():
+    tr = RecoveryTracker()
+    t0 = time.monotonic() - 1.0
+    tr.begin()          # restore-start
+    tr.begin(t0)        # supervisor back-dates to the kill
+    tr.begin()          # a later begin must not shrink the window
+    dt = tr.complete()
+    assert dt is not None and dt >= 1.0
+    assert tr.incidents == 1 and not tr.active
+    assert tr.complete() is None  # idempotent close
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 recovery smoke: kill + restore + converge on the real stack
+# ---------------------------------------------------------------------------
+
+def test_chaos_smoke_standby_fleet_kill_recovers_fast():
+    """The tier-1 recovery smoke (no slow marker): a fleet kill over the
+    real composed stack with a warm standby + bounded-staleness writer —
+    byte identity holds, the kill promotes the standby, and the measured
+    recovery interval lands in the report."""
+    from fluidframework_tpu.testing.chaos import (
+        ChaosEvent,
+        ChaosSchedule,
+        run_chaos,
+    )
+
+    schedule = ChaosSchedule(seed=5, events=[
+        ChaosEvent(6, "fleet_kill"),
+        ChaosEvent(12, "torn_socket"),
+    ])
+    report = run_chaos(
+        seed=5, ticks=20, n_docs=2, schedule=schedule,
+        standby=True, ckpt_stale_seconds=0.05,
+    )
+    assert report["invariants"]["double_acks"] == 0
+    assert report["counters"]["fleet_restarts"] == 1
+    assert report["counters"]["standby_promotions"] == 1
+    rec = report["recovery"]
+    assert rec["standby"] is True
+    assert rec["incidents"] >= 1 and rec["open"] == 0
+    assert 0 < rec["recovery_p99_ms"] <= report["invariants"][
+        "recovery_bound_ms"
+    ]
+
+
+@pytest.mark.slow
+def test_chaos_full_palette_standby_soak():
+    """Full fault palette with the standby enabled (the SOAK_r12 shape,
+    shortened): all invariants incl. bounded recovery hold."""
+    from fluidframework_tpu.testing.chaos import run_soak
+
+    out = run_soak(
+        seed=12, ticks=120, n_docs=4,
+        standby=True, ckpt_stale_seconds=0.1,
+    )
+    assert out["recovery_p99_ms"] is not None
+    assert out["invariants"]["double_acks"] == 0
+    assert out["counters"]["standby_promotions"] >= 1
