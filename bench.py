@@ -1838,6 +1838,231 @@ def bench_soak(args) -> dict:
     return out
 
 
+def _fanout_mint(n_ops: int, payload_len: int = 24):
+    """Sequenced messages for one hot doc via a real sequencer (join +
+    n_ops client ops, the firehose wire shape)."""
+    from fluidframework_tpu.protocol.messages import UnsequencedMessage
+    from fluidframework_tpu.server.sequencer import Sequencer
+
+    seqr = Sequencer()
+    msgs = [seqr.join("w0")]
+    body = "x" * payload_len
+    for i in range(n_ops):
+        msgs.append(seqr.ticket(UnsequencedMessage(
+            client_id="w0", client_seq=i + 1, ref_seq=msgs[-1].seq,
+            contents={"type": 0, "pos1": i, "seg": body},
+        )))
+    return msgs
+
+
+def _fanout_sweep_point(n_subs: int, n_ops: int, pump: int) -> dict:
+    """One subscriber-count point: fresh messages (so the encode counter
+    counts THIS run), N virtual subscribers on one hot doc, timed publish
+    (the under-the-service-lock half) and timed drain (the per-subscriber
+    half), byte-identity sampled against the firehose oracle."""
+    from fluidframework_tpu.fanout import FanoutPlane
+    from fluidframework_tpu.protocol.messages import wire_encode_count
+
+    msgs = _fanout_mint(n_ops)
+    plane = FanoutPlane(ring_frames=1 << 16, ring_bytes=1 << 30)
+    plane.ensure_doc("hot", last_seq=0)
+    sampled = []
+    peers = []
+    for i in range(n_subs):
+        if i in (0, n_subs // 2, n_subs - 1):
+            chunks: list[bytes] = []
+            peer = plane.new_peer(sink=chunks.append)
+            sampled.append((peer, chunks))
+        else:
+            peer = plane.new_peer(sink=None)
+        plane.attach("hot", peer, flavor="wire", last_seq=0)
+        peers.append(peer)
+    enc0 = wire_encode_count()
+    publish_calls = 0
+    t0 = time.perf_counter_ns()
+    for lo in range(0, len(msgs), pump):
+        plane.publish("hot", msgs[lo:lo + pump])
+        publish_calls += 1
+    t_publish = time.perf_counter_ns() - t0
+    encodes = wire_encode_count() - enc0
+    t0 = time.perf_counter_ns()
+    for peer in peers:
+        plane.drain_virtual(peer)
+    t_drain = time.perf_counter_ns() - t0
+    oracle = b"".join(m.wire_line() for m in msgs)
+    identity_ok = all(b"".join(c) == oracle for _p, c in sampled)
+    n_total = len(msgs)
+    pumps = plane.stats()["frames_published"]
+    return {
+        "n_subscribers": n_subs,
+        "n_ops": n_total,
+        "pumps": pumps,
+        "wire_encodes": encodes,
+        "encodes_per_op": round(encodes / n_total, 4),
+        "frame_encodes_per_doc_pump": round(pumps / publish_calls, 4),
+        "per_op_publish_ns": round(t_publish / n_total, 1),
+        "per_delivery_ns": round(t_drain / (n_total * n_subs), 2),
+        "publish_ops_per_sec": round(n_total / (t_publish / 1e9), 1),
+        "deliveries_per_sec": round(
+            n_total * n_subs / (t_drain / 1e9), 1
+        ),
+        "byte_identity": identity_ok,
+    }
+
+
+def _fanout_resync_point(n_ops: int = 512, pump: int = 8) -> dict:
+    """Drop-and-resync byte-identity vs the firehose oracle: a tiny ring,
+    one stalled subscriber draining late, one live subscriber."""
+    from fluidframework_tpu.fanout import FanoutPlane
+
+    msgs = _fanout_mint(n_ops)
+    log = list(msgs)
+
+    def source(_doc, from_seq):
+        return [m for m in log if m.seq > from_seq]
+
+    plane = FanoutPlane(resync_source=source, ring_frames=4)
+    plane.ensure_doc("hot", last_seq=0)
+    live_chunks: list[bytes] = []
+    slow_chunks: list[bytes] = []
+    live = plane.new_peer(sink=live_chunks.append)
+    slow = plane.new_peer(sink=slow_chunks.append)
+    plane.attach("hot", live, flavor="wire", last_seq=0)
+    plane.attach("hot", slow, flavor="wire", last_seq=0)
+    half = len(msgs) // 2
+    for lo in range(0, half, pump):
+        plane.publish("hot", msgs[lo:lo + pump])
+        plane.drain_virtual(live)
+    plane.drain_virtual(slow)  # forced off the 4-frame ring: resync
+    for lo in range(half, len(msgs), pump):
+        plane.publish("hot", msgs[lo:lo + pump])
+        plane.drain_virtual(live)
+    plane.drain_virtual(slow)
+    oracle = b"".join(m.wire_line() for m in msgs)
+    stats = plane.stats()
+    return {
+        "resyncs": stats["resyncs"],
+        "frames_evicted": stats["frames_evicted"],
+        "slow_byte_identity": b"".join(slow_chunks) == oracle,
+        "live_byte_identity": b"".join(live_chunks) == oracle,
+        "live_resyncs": live.resyncs,
+    }
+
+
+def _fanout_boot_point(n_requests: int = 64) -> dict:
+    """Snapshot-boot tier: cold GET vs conditional-GET/304 latency over
+    real HTTP against a content-addressed summary with shared subtrees."""
+    import http.client
+
+    from fluidframework_tpu.fanout import HistorianTier
+    from fluidframework_tpu.server.gitstore import GitSnapshotStore
+
+    store = GitSnapshotStore()
+    summary = {
+        f"channel_{i:03d}": {
+            "header": {"seq": i, "kind": "sharedString"},
+            "body": {"text": "t" * 256, "props": {"k": i}},
+        }
+        for i in range(64)
+    }
+    store.save(100, summary)
+    summary["channel_000"]["body"]["text"] = "changed"
+    store.save(200, summary)
+    sha = store.versions[-1][1]
+    tier = HistorianTier(lambda d: store if d == "hot" else None).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", tier.port, timeout=30)
+
+        def req(path, headers=None):
+            t0 = time.perf_counter_ns()
+            conn.request("GET", path, headers=headers or {})
+            r = conn.getresponse()
+            r.read()
+            return r.status, (time.perf_counter_ns() - t0) / 1e6
+
+        cold, not_modified = [], []
+        for _ in range(n_requests):
+            status, ms = req(f"/doc/hot/snapshot/{sha}")
+            assert status == 200
+            cold.append(ms)
+            status, ms = req(
+                f"/doc/hot/snapshot/{sha}",
+                headers={"If-None-Match": f'"{sha}"'},
+            )
+            assert status == 304
+            not_modified.append(ms)
+        status, _ms = req(f"/doc/hot/path/{sha}?path=channel_001/body")
+        conn.close()
+        cold_p50 = float(np.median(cold))
+        nm_p50 = float(np.median(not_modified))
+        return {
+            "n_requests": n_requests,
+            "cold_ms_p50": round(cold_p50, 3),
+            "etag304_ms_p50": round(nm_p50, 3),
+            "etag304_speedup": round(cold_p50 / nm_p50, 2) if nm_p50 else None,
+            "path_read_ok": status == 200,
+            "git_sharing_ratio": round(store.sharing_ratio(), 3),
+            "tier_stats": tier.stats(),
+        }
+    finally:
+        tier.stop()
+
+
+def bench_fanout(args) -> dict:
+    """``--config fanout``: the read fan-out plane on ONE hot doc — a
+    subscriber-count sweep (1k -> 100k virtual subscribers) proving the
+    encode-once contract (wire encodes independent of N, one frame per
+    (doc, pump)) and flat per-op publish cost, a drop-and-resync
+    byte-identity check vs the firehose oracle, and the snapshot-boot
+    tier's cold-vs-304 latency (the FANOUT round artifact via
+    ``--artifact``)."""
+    platform, probe_err, probe_attempts, degraded, reduced = (
+        _resolve_backend()
+    )
+    n_ops = args.steps * 16 if args.steps_explicit else 2048
+    pump = 32
+    sweep_counts = [1_000, 10_000, 100_000]
+    if args.docs_explicit:  # degraded/CPU shrink knob reuses --docs
+        sweep_counts = [c for c in sweep_counts if c <= args.docs * 100]
+        sweep_counts = sweep_counts or [1_000]
+    sweep = [_fanout_sweep_point(n, n_ops, pump) for n in sweep_counts]
+    lo, hi = sweep[0], sweep[-1]
+    out = {
+        "metric": "fanout_per_delivery_ns",
+        "value": hi["per_delivery_ns"],
+        "unit": "ns",
+        "vs_baseline": None,
+        "n_ops": n_ops,
+        "pump_batch": pump,
+        "subscriber_sweep": sweep,
+        # The two acceptance invariants, computed across the sweep edges:
+        # encodes never scale with N, publish cost per op stays flat.
+        "encode_growth_vs_subscribers": round(
+            hi["wire_encodes"] / lo["wire_encodes"], 4
+        ),
+        "per_op_publish_cost_ratio": round(
+            hi["per_op_publish_ns"] / lo["per_op_publish_ns"], 3
+        ),
+        "byte_identity_all": all(p["byte_identity"] for p in sweep),
+        "resync": _fanout_resync_point(),
+        "snapshot_boot": _fanout_boot_point(),
+    }
+    out["platform"] = platform or "cpu"
+    if probe_attempts:
+        out["backend_attempts"] = probe_attempts
+    if degraded:
+        out["degraded"] = True
+        if probe_err:
+            out["backend_error"] = probe_err
+    elif reduced:
+        out["reduced_scale"] = True
+    if getattr(args, "artifact", None):
+        with open(args.artifact, "w") as f:
+            json.dump(out, f, indent=2)
+            f.write("\n")
+    return out
+
+
 _CHILD_TIMEOUTS = {
     "1": 900.0, "2": 600.0, "3": 1500.0, "4": 600.0, "5": 900.0,
     "latency": 600.0, "headline": 1500.0,
@@ -2029,7 +2254,8 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--config", default=None,
                    choices=["1", "2", "3", "4", "5", "latency", "headline",
-                            "multichip", "multichip-child", "soak", "all"])
+                            "multichip", "multichip-child", "soak", "fanout",
+                            "all"])
     p.add_argument("--devices", type=int, default=1,
                    help="mesh device count for the multichip-child config")
     p.add_argument("--artifact", default=None,
@@ -2098,14 +2324,17 @@ def main() -> None:
         "multichip": bench_multichip,
         "multichip-child": bench_multichip_child,
         "soak": bench_soak,
+        "fanout": bench_fanout,
     }
     def _emit(res: dict) -> None:
         # Every config row carries the observability attachment
         # (latency_p50_ms / latency_p99_ms / phase_shares — ISSUE 7).
         # The soak row is exempt: its p50/p99 are measured UNDER FAULT on
         # the real stack — attaching the synthetic probe's numbers next to
-        # them would invite reading the wrong column.
-        if res.get("metric", "").startswith("soak_"):
+        # them would invite reading the wrong column.  The fanout row is
+        # host-plane only (no engine in the loop): the device probe's
+        # latency columns would be noise next to its ns-scale numbers.
+        if res.get("metric", "").startswith(("soak_", "fanout_")):
             print(json.dumps(res), flush=True)
             return
         print(json.dumps(_attach_observability(res, args.megastep_k)),

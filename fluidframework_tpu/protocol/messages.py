@@ -18,6 +18,19 @@ from enum import IntEnum
 from typing import Any
 
 
+# Count of actual wire encodes (``json.dumps`` in ``wire_line``): bumped
+# once per message EVER, however many subscribers fan the bytes out.  The
+# read-fanout plane's tests and bench assert the encode-once contract on
+# deltas of this counter (a plain int under the GIL: a stats counter, not
+# a synchronization primitive).
+_wire_encodes = 0
+
+
+def wire_encode_count() -> int:
+    """Total ``SequencedMessage`` wire encodes performed by this process."""
+    return _wire_encodes
+
+
 class MessageType:
     """Protocol-level message types (subset the framework uses)."""
 
@@ -134,6 +147,8 @@ class SequencedMessage:
         lambda.ts:851, which stringifies once into the Kafka produce)."""
         b = self.__dict__.get("_wire_line")
         if b is None:
+            global _wire_encodes
+            _wire_encodes += 1
             b = (self.to_json() + "\n").encode()
             self.__dict__["_wire_line"] = b
         return b

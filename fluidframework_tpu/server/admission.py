@@ -17,8 +17,9 @@ Load signals (both cheap, both observable under the service lock):
   synchronously-broadcasting network front where that stays ~0, the
   uncompacted collab-window depth (seq - MSN): it grows while any
   connected client lags applying and recovers as refSeqs catch up.
-- ``consumer_backlog``: the deepest outbound queue over the document's
-  firehose consumers (``_QueuedWriter`` depth).  When a device fleet pauses
+- ``consumer_backlog``: the deepest outbound backlog over the document's
+  firehose consumers (fan-out frames behind + queued directs,
+  ``FanoutPlane.backlog``).  When a device fleet pauses
   a partition at its ingest watermark (credit-based flow control,
   ``FleetConsumer.pump``), the un-drained broadcast backs up HERE — the
   fleet's backpressure propagates to the front without a side channel, and

@@ -188,11 +188,15 @@ class ScriptoriumLambda:
 class BroadcasterLambda:
     """Fans sequenced ops out to per-document subscribers.
 
-    Two delivery shapes: per-message ``subscribe`` (the classic client
-    seam) and ``subscribe_batch``, which hands each pump's decoded
-    messages for a document as ONE list — the columnar-ingest seam
-    (engines feed the whole batch to ``ingest_batch`` instead of paying
-    per-message Python through the fan-out)."""
+    Three delivery shapes: per-message ``subscribe`` (the classic client
+    seam), ``subscribe_batch``, which hands each pump's decoded messages
+    for a document as ONE list — the columnar-ingest seam (engines feed
+    the whole batch to ``ingest_batch`` instead of paying per-message
+    Python through the fan-out) — and ``subscribe_frames``, which hands
+    each pump's batch as ONE encoded ``fanout.DeltaFrame``: every frame
+    subscriber (and every firehose consumer downstream) shares the SAME
+    bytes, so the wire encode happens once per (doc, pump) however many
+    subscribers fan it out."""
 
     def __init__(self, deltas: Topic, partition: int):
         self._in = deltas.partition(partition)
@@ -201,6 +205,8 @@ class BroadcasterLambda:
         self._batch_subs: dict[
             str, list[Callable[[list[SequencedMessage]], None]]
         ] = {}
+        self._frame_subs: dict[str, list[Callable]] = {}
+        self.frames_built = 0
 
     def subscribe(self, doc_id: str, fn: Callable[[SequencedMessage], None]) -> None:
         self._subs.setdefault(doc_id, []).append(fn)
@@ -210,18 +216,23 @@ class BroadcasterLambda:
     ) -> None:
         self._batch_subs.setdefault(doc_id, []).append(fn)
 
+    def subscribe_frames(self, doc_id: str, fn: Callable) -> None:
+        """fn(frame: fanout.DeltaFrame): one call per (doc, pump), the
+        frame object shared by every subscriber — encode-once fan-out."""
+        self._frame_subs.setdefault(doc_id, []).append(fn)
+
     def pump(self) -> int:
         n = 0
         batches: dict[str, list[SequencedMessage]] = {}
         for rec in self._in.read(self.offset):
             for fn in self._subs.get(rec.doc_id, []):
                 fn(rec.payload)
-            if rec.doc_id in self._batch_subs:
+            if rec.doc_id in self._batch_subs or rec.doc_id in self._frame_subs:
                 batches.setdefault(rec.doc_id, []).append(rec.payload)
             self.offset = rec.offset + 1
             n += 1
         for doc_id, msgs in batches.items():
-            for fn in self._batch_subs[doc_id]:
+            for fn in self._batch_subs.get(doc_id, []):
                 # Failure contract: a raising batch subscriber (e.g.
                 # ingest_batch's loud NotImplementedError on an unsupported
                 # wire form) forfeits this pump's remaining messages for
@@ -233,6 +244,16 @@ class BroadcasterLambda:
                 # dedupe above the checkpoint floor, so rewinding here
                 # would double-apply that prefix on the retry.
                 fn(msgs)
+            frame_fns = self._frame_subs.get(doc_id)
+            if frame_fns:
+                from ..fanout.frames import build_frame
+
+                frame = build_frame(doc_id, msgs)
+                self.frames_built += 1
+                for fn in frame_fns:
+                    # Same failure contract as batch subscribers; the frame
+                    # OBJECT is shared, so N subscribers cost one encode.
+                    fn(frame)
         return n
 
 

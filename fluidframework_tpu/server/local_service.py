@@ -83,6 +83,12 @@ class LocalDocument:
         # signals and hand new subscribers the current list, the
         # "initialClients" of the connect handshake).
         self._read_members: dict[str, dict] = {}
+        # Pump-boundary hooks: invoked at the end of every process_all that
+        # delivered anything.  The fan-out plane flushes its per-pump frame
+        # here, so EVERY delivery driver (network handlers, in-process
+        # tests, harnesses calling process_all directly) publishes to
+        # subscribers without knowing about the plane.
+        self._pump_listeners: list[Callable[[], None]] = []
 
     def connect(
         self,
@@ -154,7 +160,7 @@ class LocalDocument:
     def connect_stream(
         self,
         client_id: str,
-        subscriber: Subscriber,
+        subscriber: Subscriber | None,
         on_nack: Callable[[Nack], None] | None = None,
         mode: str = "write",
         token: str | None = None,
@@ -169,6 +175,11 @@ class LocalDocument:
         ref connectionManager.ts read/write modes), ``delivered_seq`` the
         highest seq already broadcast — everything above it will arrive
         through this subscription.
+
+        ``subscriber=None`` joins/nack-wires the client WITHOUT a
+        per-client delivery callback: the fan-out plane's document tap
+        (one subscriber per doc, however many sockets) carries delivery —
+        the per-socket Python walk in ``process_some`` disappears.
         """
         if not client_id:
             raise ValueError("empty client id (reserved for the service)")
@@ -181,7 +192,8 @@ class LocalDocument:
         if mode == "write":
             join = self.sequencer.join(client_id)
             self._pending.append(join)
-        self._subscribers[client_id] = subscriber
+        if subscriber is not None:
+            self._subscribers[client_id] = subscriber
         if on_nack is not None:
             self._nack_handlers[client_id] = on_nack
         if mode != "write":
@@ -219,6 +231,19 @@ class LocalDocument:
         sig = SignalMessage(client_id=client_id, contents=contents)
         for sub in list(self._signal_subscribers.values()):
             sub(sig)
+
+    def read_members(self) -> dict[str, dict]:
+        """Current read-mode audience membership (copy): the connect
+        handshake's "initialClients" surface, consumed by fronts that hand
+        a new signal subscriber its catch-up without reaching into
+        private state."""
+        return dict(self._read_members)
+
+    def snapshot_store(self):
+        """The document's git version chain (``GitSnapshotStore``): the
+        snapshot-boot tier serves commits straight from here — reads walk
+        immutable content-addressed objects, no sequencer interaction."""
+        return self._snapshots.git
 
     def ops_range(self, from_seq: int, to_seq: int) -> list[SequencedMessage]:
         """Sequenced ops with from_seq <= seq <= to_seq (delta storage read;
@@ -340,12 +365,19 @@ class LocalDocument:
             )
         )
 
+    def on_pump(self, fn: Callable[[], None]) -> None:
+        """Register a pump-boundary hook (see ``_pump_listeners``)."""
+        self._pump_listeners.append(fn)
+
     def process_all(self) -> int:
         """Drain the delivery queue, including messages enqueued by
         subscribers reacting to deliveries (reconnect replay, resubmit)."""
         n = 0
         while self._pending:
             n += self.process_some(len(self._pending))
+        if n:
+            for fn in list(self._pump_listeners):
+                fn()
         return n
 
 

@@ -24,14 +24,17 @@ Run standalone for cross-process use:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
+import selectors
+import socket
 import socketserver
 import threading
 import time
-from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+from ..fanout import FLAVOR_ENVELOPE, FLAVOR_WIRE, FanoutPlane, FanoutWriter
 from ..protocol.messages import MessageType, SequencedMessage, UnsequencedMessage
 from .local_service import LocalService
 
@@ -45,85 +48,46 @@ def seq_msg_from_dict(d: dict) -> SequencedMessage:
 
 
 class _ClientSession:
-    """Server-side state for one TCP connection."""
+    """Server-side state for one TCP connection.
 
-    def __init__(self, handler: "_NexusHandler") -> None:
+    Every connection owns a fan-out peer from the moment it is accepted:
+    ALL outbound bytes (handshake acks, errors, nacks, sync echoes, op
+    frames, signals) ride the peer's queues and are written by the fan-out
+    writer tier — handler threads and the broadcast path never block on a
+    socket buffer, and never write the socket concurrently."""
+
+    def __init__(self, handler: "_NexusHandler", peer, plane) -> None:
         self.handler = handler
+        self.peer = peer
+        self._plane = plane
         self.doc_id: str | None = None
         self.client_id: str | None = None
-        self.consumer_writer: "_QueuedWriter | None" = None
-        self._wlock = threading.Lock()
 
     def send(self, obj: dict) -> None:
         self.send_raw((json.dumps(obj) + "\n").encode())
 
     def send_raw(self, data: bytes) -> None:
-        try:
-            with self._wlock:
-                self.handler.wfile.write(data)
-                self.handler.wfile.flush()
-        except (OSError, ValueError):
-            # Peer went away (or socketserver already closed wfile — the
-            # queued writer thread can flush after finish()); the read
-            # loop / drop_session clean up.
-            pass
-
-
-class _QueuedWriter:
-    """Unbounded outbound queue + writer thread for firehose consumers.
-
-    Broadcast fan-out runs under the service lock; a consumer draining
-    slower than the stream produces would otherwise block the whole plane
-    on a full socket buffer (the reference's socket.io fronts buffer
-    outbound the same way).  ``backlog`` is the admission controller's
-    consumer-pressure signal: a fleet that paused this partition at its
-    ingest watermark stops draining the socket, the kernel buffer fills,
-    the writer thread blocks, and the depth here starts counting — the
-    downstream credit deficit made visible to the front."""
-
-    def __init__(self, session: "_ClientSession") -> None:
-        self._session = session
-        self._q: "deque[bytes]" = deque()
-        self._cv = threading.Condition()
-        self._closed = False
-        self._thread = threading.Thread(target=self._drain, daemon=True)
-        self._thread.start()
-
-    @property
-    def backlog(self) -> int:
-        """Queued-but-unsent chunk count (len() on a deque is atomic)."""
-        return len(self._q)
-
-    def send_raw(self, data: bytes) -> None:
-        with self._cv:
-            self._q.append(data)
-            self._cv.notify()
-
-    def _drain(self) -> None:
-        while True:
-            with self._cv:
-                while not self._q and not self._closed:
-                    self._cv.wait()
-                if self._closed and not self._q:
-                    return
-                batch = b"".join(self._q)
-                self._q.clear()
-            self._session.send_raw(batch)
-
-    def close(self) -> None:
-        with self._cv:
-            self._closed = True
-            self._cv.notify()
+        self._plane.enqueue_direct(self.peer, data)
 
 
 class _NexusHandler(socketserver.StreamRequestHandler):
-    """One thread per TCP client (ref: one socket.io connection)."""
+    """One thread per TCP client (ref: one socket.io connection).
 
-    def handle(self) -> None:  # noqa: C901 - protocol dispatch
+    The READ half lives here (blocking in a selector, line-split in
+    Python); the WRITE half lives on the shared fan-out writer thread —
+    the socket is nonblocking so a full outbound buffer parks the peer in
+    the writer's selector instead of stalling anything."""
+
+    def handle(self) -> None:
         server: NetworkServer = self.server.owner  # type: ignore[attr-defined]
-        session = _ClientSession(self)
+        sock = self.connection
+        with contextlib.suppress(OSError):  # best-effort latency knob
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.setblocking(False)
+        peer = server.fanout.new_peer(sock=sock)
+        session = _ClientSession(self, peer, server.fanout)
         try:
-            self._read_loop(server, session)
+            self._read_loop(server, session, sock)
         except OSError:
             # Torn peer mid-read (abrupt client death, chaos torn-socket):
             # normal teardown, counted for the overload/chaos surface —
@@ -133,35 +97,64 @@ class _NexusHandler(socketserver.StreamRequestHandler):
         finally:
             server.drop_session(session)
 
-    def _read_loop(self, server: "NetworkServer", session) -> None:
-        for raw in self.rfile:
-            line = raw.strip()
-            if not line:
-                continue
-            try:
-                req = json.loads(line)
-            except json.JSONDecodeError:
-                session.send({"t": "error", "reason": "bad json", "canRetry": False})
-                continue
-            kind = req.get("t")
-            if kind == "connect":
-                server.handle_connect(session, req)
-            elif kind == "consume":
-                server.handle_consume(session, req)
-            elif kind == "submit":
-                server.handle_submit(session, req)
-            elif kind == "signal":
-                server.handle_signal(session, req)
-            elif kind == "sync":
-                # Echo AFTER everything already broadcast on this socket:
-                # the client's deterministic quiescence marker.
-                session.send({"t": "sync", "n": req.get("n", 0)})
-            elif kind == "disconnect":
-                break
-            else:
-                session.send(
-                    {"t": "error", "reason": f"unknown op {kind!r}", "canRetry": False}
-                )
+    def _read_loop(self, server: "NetworkServer", session, sock) -> None:
+        sel = selectors.DefaultSelector()
+        sel.register(sock, selectors.EVENT_READ)
+        buf = b""
+        try:
+            while True:
+                sel.select()
+                try:
+                    data = sock.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                if not data:
+                    return  # orderly EOF
+                buf += data
+                while True:
+                    cut = buf.find(b"\n")
+                    if cut < 0:
+                        break
+                    line, buf = buf[:cut].strip(), buf[cut + 1:]
+                    if line and not self._dispatch(server, session, line):
+                        return
+        finally:
+            sel.close()
+
+    def _dispatch(self, server: "NetworkServer", session, line: bytes) -> bool:
+        """One protocol request; False ends the session (disconnect)."""
+        try:
+            req = json.loads(line)
+        except json.JSONDecodeError:
+            session.send({"t": "error", "reason": "bad json", "canRetry": False})
+            return True
+        kind = req.get("t")
+        if kind == "connect":
+            server.handle_connect(session, req)
+        elif kind == "consume":
+            server.handle_consume(session, req)
+        elif kind == "submit":
+            server.handle_submit(session, req)
+        elif kind == "signal":
+            server.handle_signal(session, req)
+        elif kind == "sync":
+            # Echo AFTER everything already broadcast on this socket: the
+            # echo rides the peer queue behind every frame already
+            # published for the session's document (direct-watermark
+            # ordering) — the client's deterministic quiescence marker.
+            session.send({"t": "sync", "n": req.get("n", 0)})
+        elif kind == "disconnect":
+            # Graceful goodbye: everything already queued for this socket
+            # (a pipelined sync echo, the tail of the broadcast) must reach
+            # the wire before drop_session clears the peer's queues — the
+            # old synchronous write loop guaranteed exactly this.
+            server.flush_peer(session.peer)
+            return False
+        else:
+            session.send(
+                {"t": "error", "reason": f"unknown op {kind!r}", "canRetry": False}
+            )
+        return True
 
 
 class NetworkServer:
@@ -185,8 +178,15 @@ class NetworkServer:
         # set, overloaded documents nack submits with a load-derived
         # retryAfter instead of ticketing them (deli's throttling nack).
         self.admission = admission
-        # doc_id -> live firehose writers (the consumer-backlog signal).
-        self._doc_consumers: dict[str, list[_QueuedWriter]] = {}
+        # The read fan-out plane: encode-once delta frames on a bounded
+        # per-doc ring, per-session peers drained by ONE selector-driven
+        # writer thread with vectored sends.  Documents are tapped with a
+        # single stream subscriber each (however many sockets), so the
+        # broadcast path under the service lock is O(1) per message.
+        self.fanout = FanoutPlane(resync_source=self._resync_source)
+        self.fanout_writer = FanoutWriter(self.fanout)
+        self.fanout.set_writer(self.fanout_writer)
+        self._tapped: set[str] = set()
         # Peers that vanished mid-read without a disconnect handshake
         # (abrupt client death / chaos torn sockets) — a fault-visibility
         # counter, surfaced through service_stats.
@@ -208,6 +208,60 @@ class NetworkServer:
     def stop(self) -> None:
         self._tcp.shutdown()
         self._tcp.server_close()
+        self.fanout_writer.stop()
+
+    # --------------------------------------------------------- fanout wiring
+    def _ensure_tap(self, doc) -> None:
+        """Install the ONE fan-out tap for a document (caller holds the
+        lock): a single stream subscriber accumulates each pump's batch,
+        and a single signal subscriber scatters presence through the
+        writer tier — per-socket callbacks are gone from the ordering
+        path."""
+        doc_id = doc.doc_id
+        if doc_id in self._tapped:
+            return
+        self._tapped.add(doc_id)
+        log = doc.sequencer.log
+        delivered = len(log) - doc.pending_count
+        self.fanout.ensure_doc(
+            doc_id, last_seq=log[delivered - 1].seq if delivered else 0
+        )
+        plane = self.fanout
+        # Tap id is per-FRONT: several stateless fronts may share one core
+        # (each with its own fan-out plane), and stream subscriptions are
+        # keyed by id — a shared name would let the last front clobber the
+        # others' taps.
+        tap_id = f"__fanout__{id(self)}"
+        doc.subscribe_stream(
+            tap_id, lambda msg, d=doc_id: plane.tap(d, msg)
+        )
+        doc.subscribe_signals(
+            tap_id,
+            lambda sig, d=doc_id: plane.publish_signal(
+                d, sig.client_id, sig.contents
+            ),
+        )
+        # Pump-boundary flush: ANY driver of process_all (handlers here,
+        # harnesses poking the doc under the service lock) publishes the
+        # pump's frame — delivery never depends on who pumped.
+        doc.on_pump(lambda d=doc_id: plane.flush(d))
+
+    def _pump_doc(self, doc) -> None:
+        """Deliver queued sequenced messages (caller holds the lock):
+        process_all walks ONE tap per message, and the tap's ``on_pump``
+        hook — the single owner of the delivery contract, shared with
+        harnesses that drive process_all directly — flushes the frame and
+        wakes the writer tier."""
+        doc.process_all()
+
+    def _resync_source(self, doc_id: str, from_seq: int):
+        """Rebuild a behind subscriber's missed range from the ordered log
+        (called by the fan-out plane with no plane lock held)."""
+        with self.lock:
+            doc = self.service.peek_document(doc_id)
+            if doc is None:
+                return None
+            return doc.ops_range(from_seq + 1, 1 << 60)
 
     # ----------------------------------------------------------- op handlers
     def handle_connect(self, session: _ClientSession, req: dict) -> None:
@@ -225,11 +279,7 @@ class NetworkServer:
                 })
                 return
             doc = self.service.document(doc_id)
-
-            def on_op(msg: SequencedMessage, s=session) -> None:
-                # Pre-encoded envelope: one json.dumps per message total,
-                # shared by every connected socket (not one per socket).
-                s.send_raw(msg.op_envelope())
+            self._ensure_tap(doc)
 
             def on_nack(nack, s=session) -> None:
                 s.send(
@@ -243,23 +293,42 @@ class NetworkServer:
                 )
 
             try:
+                # subscriber=None: delivery rides the doc's fan-out tap —
+                # the broadcast frame (encoded once per pump) reaches this
+                # socket through its peer cursor, not a per-socket callback.
                 join, delivered_seq = doc.connect_stream(
-                    client_id, on_op, on_nack, mode=mode, token=req.get("token")
+                    client_id, None, on_nack, mode=mode, token=req.get("token")
                 )
             except (AuthError, ValueError) as e:
                 session.send(
                     {"t": "error", "reason": f"connection rejected: {e}", "canRetry": False}
                 )
                 return
-            if req.get("signals"):
-                doc.subscribe_signals(
-                    client_id,
-                    lambda sig, s=session: s.send(
-                        {"t": "signal", "clientId": sig.client_id, "contents": sig.contents}
-                    ),
-                )
             session.doc_id = doc_id
             session.client_id = client_id
+            self.fanout.attach(
+                doc_id, session.peer, flavor=FLAVOR_ENVELOPE,
+                last_seq=delivered_seq,
+            )
+            if req.get("signals"):
+                self.fanout.add_signal_peer(doc_id, session.peer)
+                # Audience catch-up: current read membership, self included
+                # (the connect handshake's "initialClients") — enqueued
+                # without per-member wakes, ONE writer wake for the batch.
+                for member_id, details in doc.read_members().items():
+                    payload = (json.dumps({
+                        "t": "signal",
+                        "clientId": "",
+                        "contents": {
+                            "type": "clientJoin",
+                            "clientId": member_id,
+                            "details": details,
+                        },
+                    }) + "\n").encode()
+                    self.fanout.enqueue_direct(
+                        session.peer, payload, wake=False
+                    )
+                self.fanout_writer.wake([session.peer])
             session.send(
                 {
                     "t": "joined",
@@ -267,7 +336,7 @@ class NetworkServer:
                     "deliveredSeq": delivered_seq,
                 }
             )
-            doc.process_all()  # broadcast the join immediately
+            self._pump_doc(doc)  # broadcast the join immediately
 
     def handle_consume(self, session: _ClientSession, req: dict) -> None:
         """Firehose subscription: the sequenced stream as BARE message JSON
@@ -275,7 +344,10 @@ class NetworkServer:
         consumer seam (ref deli produce -> lambdas consume,
         deli/lambda.ts:851).  No quorum join, no audience membership; the
         bytes are exactly what native/ingest.cpp parses, so a device fleet
-        consumer forwards them without any per-op Python."""
+        consumer forwards them without any per-op Python.  Consumers share
+        the SAME once-encoded frames as every other subscriber of the doc
+        (one encode per (doc, pump)); a consumer that falls off the
+        bounded frame ring is resynced from the log, byte-identically."""
         from .auth import AuthError
 
         doc_id = req["doc"]
@@ -303,35 +375,50 @@ class NetworkServer:
                         "canRetry": False,
                     })
                     return
+            self._ensure_tap(doc)
             consumer_id = f"__consumer__{id(session)}"
             session.doc_id = doc_id
             session.client_id = consumer_id
-            # All consumer output rides an outbound queue: the broadcast
-            # path must never block on this socket's buffer.
-            writer = _QueuedWriter(session)
-            session.consumer_writer = writer
-            self._doc_consumers.setdefault(doc_id, []).append(writer)
-            # Envelope ack first; everything after it on this socket is raw.
-            writer.send_raw((json.dumps({"t": "consuming", "doc": doc_id}) + "\n").encode())
-            # Catch-up: the already-delivered prefix (pending-delivery msgs
-            # arrive through the subscription, mirroring connect()).
             log = doc.sequencer.log
             delivered = len(log) - doc.pending_count
-            for msg in log[:delivered]:
-                if msg.seq > from_seq:
-                    writer.send_raw(msg.wire_line())
-            doc.subscribe_stream(
-                consumer_id,
-                lambda msg, w=writer: w.send_raw(msg.wire_line()),
+            delivered_seq = log[delivered - 1].seq if delivered else 0
+            self.fanout.attach(
+                doc_id, session.peer, flavor=FLAVOR_WIRE,
+                last_seq=delivered_seq,
             )
+            # Envelope ack + catch-up (the already-delivered prefix, cached
+            # per-message encodes) as ONE direct buffer: a consumer that
+            # just read the ack already has the catch-up behind it in its
+            # receive buffer — its first pump stages the history instead of
+            # racing the writer tier's next send.  Pending-delivery msgs
+            # arrive through the ring, mirroring connect().
+            ack = (json.dumps({"t": "consuming", "doc": doc_id}) + "\n").encode()
+            catch = b"".join(
+                m.wire_line() for m in log[:delivered] if m.seq > from_seq
+            )
+            session.send_raw(ack + catch)
 
     def consumer_backlog(self, doc_id: str) -> int:
-        """Deepest outbound firehose queue for the document (caller holds
-        the lock): the downstream-credit signal the admission check reads."""
-        writers = self._doc_consumers.get(doc_id)
-        if not writers:
-            return 0
-        return max(w.backlog for w in writers)
+        """Deepest outbound firehose backlog for the document (frames
+        behind + queued directs + claimed-unsent buffers): the
+        downstream-credit signal the admission check reads."""
+        return self.fanout.backlog(doc_id)
+
+    def flush_peer(self, peer, timeout_s: float = 5.0) -> None:
+        """Best-effort drain of a peer's queued outbound bytes (graceful
+        disconnect).  Doubly bounded: only work queued at goodbye time
+        counts (a hot doc publishing past the goodbye must not extend the
+        wait), and a peer that stopped reading forfeits its tail after
+        ``timeout_s`` — never a handler-thread stall beyond that."""
+        goodbye_head = self.fanout.head_of(peer)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if peer.dead or self.fanout.backlog_of(
+                peer, head_cap=goodbye_head
+            ) == 0:
+                return
+            self.fanout_writer.wake([peer])
+            time.sleep(0.002)
 
     @staticmethod
     def doc_pressure(doc) -> int:
@@ -385,28 +472,27 @@ class NetworkServer:
                     return
             msg = UnsequencedMessage.from_json(json.dumps(req["msg"]))
             doc.submit(msg)
-            doc.process_all()  # network mode: broadcast as ticketed
+            self._pump_doc(doc)  # network mode: broadcast as ticketed
 
     def handle_signal(self, session: _ClientSession, req: dict) -> None:
         with self.lock:
             if session.doc_id is None:
                 return
+            # Delivery is queue-only under the lock: submit_signal reaches
+            # the doc's fan-out tap, which encodes the signal ONCE and
+            # appends bounded droppable directs — a slow signal subscriber
+            # can no longer stall op ticketing (at-most-once by contract).
             self.service.document(session.doc_id).submit_signal(
                 session.client_id, req.get("content")
             )
 
     def drop_session(self, session: _ClientSession) -> None:
         with self.lock:
-            if session.consumer_writer is not None:
-                session.consumer_writer.close()
-                if session.doc_id is not None:
-                    writers = self._doc_consumers.get(session.doc_id, [])
-                    if session.consumer_writer in writers:
-                        writers.remove(session.consumer_writer)
+            self.fanout.remove_peer(session.peer)
             if session.doc_id is not None and session.client_id is not None:
                 doc = self.service.document(session.doc_id)
                 doc.disconnect(session.client_id)
-                doc.process_all()  # broadcast the leave
+                self._pump_doc(doc)  # broadcast the leave
 
 
 class _AlfredHandler(BaseHTTPRequestHandler):
@@ -634,6 +720,11 @@ class HttpFront:
         }
         if nexus is not None:
             out["torn_sockets"] = nexus.torn_sockets
+            # Read fan-out surface: frames published/evicted, resyncs,
+            # signal deliveries/drops, writer-tier send totals.
+            fanout = nexus.fanout.stats()
+            fanout["writer"] = nexus.fanout_writer.stats()
+            out["fanout"] = fanout
         if admission is not None:
             # Graceful-degradation surface: the front's overload state and
             # shed-op totals, scrapeable (/metrics) and curl-able (/status).
@@ -651,14 +742,30 @@ class HttpFront:
 
 class ServicePlane:
     """Both fronts over one shared core: the deployable unit (tinylicious
-    analog).  ``ports`` are assigned when 0 (tests use ephemeral ports)."""
+    analog).  ``ports`` are assigned when 0 (tests use ephemeral ports).
 
-    def __init__(self, port: int = 0, http_port: int = 0, admission=None) -> None:
+    ``historian_port`` additionally serves the snapshot-boot tier
+    (fanout.historian): summary commits straight out of the git snapshot
+    store behind ETag/304 caching, on its own server so boot storms never
+    contend with the ordering lock.  None (default) keeps it off."""
+
+    def __init__(
+        self, port: int = 0, http_port: int = 0, admission=None,
+        historian_port: int | None = None,
+    ) -> None:
         self.nexus = NetworkServer(port=port, admission=admission)
         self.http = HttpFront(
             self.nexus.service, self.nexus.lock, port=http_port,
             nexus=self.nexus,
         )
+        self.historian = None
+        if historian_port is not None:
+            from ..fanout.historian import HistorianTier, service_snapshot_source
+
+            self.historian = HistorianTier(
+                service_snapshot_source(self.nexus.service),
+                port=historian_port,
+            )
 
     @property
     def service(self) -> LocalService:
@@ -667,11 +774,15 @@ class ServicePlane:
     def start(self) -> "ServicePlane":
         self.nexus.start()
         self.http.start()
+        if self.historian is not None:
+            self.historian.start()
         return self
 
     def stop(self) -> None:
         self.nexus.stop()
         self.http.stop()
+        if self.historian is not None:
+            self.historian.stop()
 
 
 def main() -> None:
@@ -688,6 +799,11 @@ def main() -> None:
                    help="admission control: nack submits when a doc's "
                         "deepest firehose consumer backlog exceeds this "
                         "(0 = signal disabled)")
+    p.add_argument("--historian-port", type=int, default=0,
+                   help="snapshot-boot tier port (0 = ephemeral; pass -1 "
+                        "to disable): summary commits served from the git "
+                        "store behind ETag/304 caching, off the ordering "
+                        "lock")
     args = p.parse_args()
     http_port = args.http_port
     if not http_port:
@@ -700,11 +816,16 @@ def main() -> None:
             max_pending=args.max_pending,
             max_consumer_backlog=args.max_consumer_backlog,
         ))
-    plane = ServicePlane(port=args.port, http_port=http_port,
-                         admission=admission)
+    plane = ServicePlane(
+        port=args.port, http_port=http_port, admission=admission,
+        historian_port=None if args.historian_port < 0 else args.historian_port,
+    )
     plane.start()
     # Readiness line for process supervisors / tests.
-    print(json.dumps({"port": plane.nexus.port, "httpPort": plane.http.port}), flush=True)
+    ready = {"port": plane.nexus.port, "httpPort": plane.http.port}
+    if plane.historian is not None:
+        ready["historianPort"] = plane.historian.port
+    print(json.dumps(ready), flush=True)
     threading.Event().wait()  # serve until killed
 
 

@@ -304,15 +304,32 @@ def test_slow_consumer_backlog_drives_admission_shedding():
     threshold, NEW submits for the document are shed with retryAfter —
     downstream backpressure reaches the producers with no side channel.
 
-    The consumer's stall is made deterministic by blocking the queued
-    writer's ``send_raw`` exactly the way a full kernel socket buffer
-    would block the drain thread — relying on real TCP buffers here is
+    The consumer's stall is made deterministic by wedging the peer's
+    socket sends exactly the way a full kernel socket buffer would park
+    the fan-out writer — relying on real TCP buffers here is
     box-dependent (loopback auto-tuning can absorb megabytes)."""
     import socket as sk
     import threading as th
 
     from fluidframework_tpu.dds.shared_string import SharedString
     from fluidframework_tpu.server.netserver import ServicePlane
+
+    class _StalledSock:
+        """Socket proxy whose sends wait for the unblock event, then report
+        a full buffer: the consumer has stopped granting credit."""
+
+        def __init__(self, sock, unblock):
+            self._sock = sock
+            self._unblock = unblock
+
+        def fileno(self):
+            return self._sock.fileno()
+
+        def sendmsg(self, bufs):
+            self._unblock.wait()
+            raise BlockingIOError
+
+        send = sendmsg
 
     admission = AdmissionController(AdmissionConfig(
         max_pending=100000, max_consumer_backlog=64,
@@ -329,10 +346,12 @@ def test_slow_consumer_backlog_drives_admission_shedding():
             ack += consumer.recv(1)
         assert b"consuming" in ack
         with plane.nexus.lock:
-            (writer,) = plane.nexus._doc_consumers["sc"]
-            # From here the drain thread blocks on its next send — the
-            # consumer has stopped granting credit.
-            writer._session.send_raw = lambda data: unblock.wait()
+            (peer,) = [
+                p for p in plane.nexus.fanout._docs["sc"].subs if p.is_socket
+            ]
+            # From here the writer tier's next send for this peer wedges —
+            # frames back up behind its cursor (and in its claimed outbuf).
+            peer.sock = _StalledSock(peer.sock, unblock)
 
             doc = plane.service.document("sc")
             ss = SharedString(client_id="w0")

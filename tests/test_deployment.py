@@ -69,7 +69,11 @@ def test_compose_topology_smoke():
             fleets.append(subprocess.Popen(
                 [sys.executable, "-m", "fluidframework_tpu.server.fleet_main",
                  "--port", str(shard.port), "--docs", docs,
-                 "--exit-after-rows", "1", "--platform", "cpu"],
+                 # One op row per doc: exit only after EVERY doc's firehose
+                 # catch-up landed (exiting at 1 races the other doc's
+                 # in-flight catch-up bytes).
+                 "--exit-after-rows", str(len(by_shard[si])),
+                 "--platform", "cpu"],
                 stdout=subprocess.PIPE, text=True, cwd=REPO, env=ENV,
             ))
         for si, proc in enumerate(fleets):

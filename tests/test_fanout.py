@@ -1,0 +1,557 @@
+"""Read fan-out plane (ISSUE 13): encode-once delta frames, bounded
+drop-and-resync byte-identity, the snapshot-boot historian tier's HTTP
+caching contract, and sequencer-free at-most-once presence."""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.fanout import (
+    FLAVOR_ENVELOPE,
+    FLAVOR_WIRE,
+    RESYNC_BOOT_MARKER,
+    FanoutPlane,
+    HistorianTier,
+)
+from fluidframework_tpu.protocol.messages import (
+    UnsequencedMessage,
+    wire_encode_count,
+)
+from fluidframework_tpu.server.sequencer import Sequencer
+
+
+def _mint(n_ops: int, client: str = "w0", text: str = "x") -> list:
+    """Sequenced messages via a real sequencer: join + n_ops ops."""
+    seqr = Sequencer()
+    out = [seqr.join(client)]
+    for i in range(n_ops):
+        out.append(seqr.ticket(UnsequencedMessage(
+            client_id=client, client_seq=i + 1, ref_seq=out[-1].seq,
+            contents={"i": i, "text": text * (i % 5 + 1)},
+        )))
+    return out
+
+
+def _oracle(msgs) -> bytes:
+    return b"".join(m.wire_line() for m in msgs)
+
+
+# --------------------------------------------------------------------------
+# Delta frames: encode-once, shared bytes
+# --------------------------------------------------------------------------
+
+def test_broadcaster_frames_encode_once_shared():
+    """N frame subscribers + the firehose oracle share ONE encode per
+    message — one frame per (doc, pump), the same object for everyone."""
+    from fluidframework_tpu.server.ordered_log import Topic
+    from fluidframework_tpu.server.lambdas import BroadcasterLambda
+
+    deltas = Topic("deltas", 1)
+    bc = BroadcasterLambda(deltas, 0)
+    got: list[list] = [[] for _ in range(8)]
+    for i in range(8):
+        bc.subscribe_frames("d", lambda fr, i=i: got[i].append(fr))
+    msgs = _mint(24)
+    before = wire_encode_count()
+    for chunk in (msgs[:10], msgs[10:]):  # two pumps
+        for m in chunk:
+            deltas.produce("d", m)
+        bc.pump()
+    encodes = wire_encode_count() - before
+    # <=1 encode per message however many subscribers (the oracle below
+    # re-reads the SAME cached bytes: no further encodes).
+    assert encodes == len(msgs)
+    assert bc.frames_built == 2
+    for sub in got:
+        assert len(sub) == 2
+        # every subscriber got the SAME frame objects
+        assert sub[0] is got[0][0] and sub[1] is got[0][1]
+    assert b"".join(fr.wire for fr in got[0]) == _oracle(msgs)
+    assert wire_encode_count() - before == len(msgs)
+
+
+def test_plane_publish_and_drain_byte_identity():
+    """Wire + envelope subscribers over several pumps: every observed
+    stream byte-identical to its flavor's oracle."""
+    plane = FanoutPlane()
+    msgs = _mint(40)
+    plane.ensure_doc("d", last_seq=0)
+    sinks = []
+    for flavor in (FLAVOR_WIRE, FLAVOR_WIRE, FLAVOR_ENVELOPE):
+        chunks: list[bytes] = []
+        peer = plane.new_peer(sink=chunks.append)
+        plane.attach("d", peer, flavor=flavor, last_seq=0)
+        sinks.append((flavor, peer, chunks))
+    for lo in range(0, len(msgs), 7):
+        plane.publish("d", msgs[lo:lo + 7])
+    for _flavor, peer, _chunks in sinks:
+        plane.drain_virtual(peer)
+    wire_oracle = _oracle(msgs)
+    env_oracle = b"".join(m.op_envelope() for m in msgs)
+    for flavor, _peer, chunks in sinks:
+        want = wire_oracle if flavor == FLAVOR_WIRE else env_oracle
+        assert b"".join(chunks) == want
+    assert plane.stats()["frames_published"] == len(range(0, len(msgs), 7))
+    assert plane.stats()["resyncs"] == 0
+
+
+def test_slow_subscriber_drop_and_resync_byte_identity():
+    """A subscriber that stops draining falls off the bounded ring; its
+    resync rebuilds the missed range from the log — the full observed
+    stream stays byte-identical to the firehose oracle, and the fast
+    subscriber never noticed."""
+    msgs = _mint(60)
+    log = {m.seq: m for m in msgs}
+
+    def resync_source(doc_id, from_seq):
+        return [m for s, m in sorted(log.items()) if s > from_seq]
+
+    plane = FanoutPlane(resync_source=resync_source, ring_frames=4)
+    plane.ensure_doc("d", last_seq=0)
+    fast_chunks: list[bytes] = []
+    slow_chunks: list[bytes] = []
+    fast = plane.new_peer(sink=fast_chunks.append)
+    slow = plane.new_peer(sink=slow_chunks.append)
+    plane.attach("d", fast, flavor=FLAVOR_WIRE, last_seq=0)
+    plane.attach("d", slow, flavor=FLAVOR_WIRE, last_seq=0)
+    for lo in range(0, 30, 3):
+        plane.publish("d", msgs[lo:lo + 3])
+        plane.drain_virtual(fast)  # fast keeps up pump by pump
+    # slow drains only now: >4 frames published, the ring evicted some.
+    plane.drain_virtual(slow)
+    # tail pumps: both keep up again
+    for lo in range(30, len(msgs), 3):
+        plane.publish("d", msgs[lo:lo + 3])
+        plane.drain_virtual(fast)
+    plane.drain_virtual(slow)
+    oracle = _oracle(msgs)
+    assert b"".join(fast_chunks) == oracle
+    assert b"".join(slow_chunks) == oracle
+    stats = plane.stats()
+    assert stats["frames_evicted"] > 0
+    assert slow.resyncs >= 1 and fast.resyncs == 0
+    assert stats["boot_resyncs"] == 0
+
+
+def test_resync_without_retained_log_sends_boot_marker():
+    """When the missed range is no longer retained, the subscriber gets
+    the snapshot-boot marker instead of silently missing bytes, and the
+    live stream resumes after it."""
+    msgs = _mint(24)
+    plane = FanoutPlane(resync_source=lambda d, s: None, ring_frames=2)
+    plane.ensure_doc("d", last_seq=0)
+    chunks: list[bytes] = []
+    peer = plane.new_peer(sink=chunks.append)
+    plane.attach("d", peer, flavor=FLAVOR_WIRE, last_seq=0)
+    for lo in range(0, 20, 2):
+        plane.publish("d", msgs[lo:lo + 2])
+    plane.drain_virtual(peer)
+    # Everything missed collapses into the marker: the subscriber must
+    # snapshot-boot (historian tier) instead of receiving a gapped stream.
+    assert chunks == [RESYNC_BOOT_MARKER]
+    assert plane.stats()["boot_resyncs"] == 1
+    # post-marker pumps stream normally again
+    plane.publish("d", msgs[20:22])
+    plane.publish("d", msgs[22:24])
+    plane.drain_virtual(peer)
+    assert b"".join(chunks[1:]) == _oracle(msgs[20:24])
+
+
+# --------------------------------------------------------------------------
+# Historian snapshot-boot tier
+# --------------------------------------------------------------------------
+
+@pytest.fixture
+def historian_store():
+    from fluidframework_tpu.server.gitstore import GitSnapshotStore
+
+    store = GitSnapshotStore()
+    store.save(10, {"root": {"a": "v1", "big": {"x": 1, "y": 2}}})
+    store.save(20, {"root": {"a": "v2", "big": {"x": 1, "y": 2}}})
+    tier = HistorianTier(lambda doc: store if doc == "doc" else None).start()
+    yield tier, store
+    tier.stop()
+
+
+def _get(port: int, path: str, headers: dict | None = None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path, headers=headers or {})
+    r = conn.getresponse()
+    body = r.read()
+    out = (r.status, dict(r.getheaders()), body)
+    conn.close()
+    return out
+
+
+def test_historian_latest_etag_and_304(historian_store):
+    tier, store = historian_store
+    status, headers, body = _get(tier.port, "/doc/doc/snapshot")
+    assert status == 200
+    latest_sha = store.versions[-1][1]
+    assert headers["ETag"] == f'"{latest_sha}"'
+    assert headers["Cache-Control"] == "no-cache"
+    payload = json.loads(body)
+    assert payload["commit"] == latest_sha and payload["seq"] == 20
+    assert payload["summary"]["root"]["a"] == "v2"
+    # Conditional revalidation: one header round-trip, no body.
+    status, headers, body = _get(
+        tier.port, "/doc/doc/snapshot",
+        headers={"If-None-Match": f'"{latest_sha}"'},
+    )
+    assert status == 304 and body == b""
+    assert headers["ETag"] == f'"{latest_sha}"'
+    # A stale ETag (older version) still gets the full new snapshot.
+    old_sha = store.versions[0][1]
+    status, _h, body = _get(
+        tier.port, "/doc/doc/snapshot",
+        headers={"If-None-Match": f'"{old_sha}"'},
+    )
+    assert status == 200 and json.loads(body)["commit"] == latest_sha
+    stats = tier.stats()
+    assert stats["not_modified_304"] == 1 and stats["cold_serves"] == 2
+
+
+def test_historian_sha_addressed_immutable_and_versions(historian_store):
+    tier, store = historian_store
+    old_sha = store.versions[0][1]
+    status, headers, body = _get(tier.port, f"/doc/doc/snapshot/{old_sha}")
+    assert status == 200
+    assert "immutable" in headers["Cache-Control"]
+    assert json.loads(body)["summary"]["root"]["a"] == "v1"
+    # sha-addressed conditional GET: 304 without touching the store
+    status, _h, body = _get(
+        tier.port, f"/doc/doc/snapshot/{old_sha}",
+        headers={"If-None-Match": f'"{old_sha}"'},
+    )
+    assert status == 304 and body == b""
+    status, _h, body = _get(tier.port, "/doc/doc/versions?max=5")
+    ids = [v["id"] for v in json.loads(body)["versions"]]
+    assert ids == [store.versions[1][1], store.versions[0][1]]
+    status, _h, _b = _get(tier.port, "/doc/doc/snapshot/deadbeef")
+    assert status == 404
+
+
+def test_historian_partial_subtree_read_over_http(historian_store):
+    tier, store = historian_store
+    sha = store.versions[-1][1]
+    status, headers, body = _get(
+        tier.port, f"/doc/doc/path/{sha}?path=root/big"
+    )
+    assert status == 200
+    assert json.loads(body)["value"] == {"x": 1, "y": 2}
+    assert "immutable" in headers["Cache-Control"]
+    status, _h, body = _get(tier.port, f"/doc/doc/path/{sha}?path=root/a")
+    assert json.loads(body)["value"] == "v1" or json.loads(body)["value"] == "v2"
+    status, _h, _b = _get(tier.port, f"/doc/doc/path/{sha}?path=root/nope")
+    assert status == 404
+    assert tier.stats()["path_reads"] == 2
+
+
+def test_historian_serves_service_docs_without_touching_sequencer():
+    """ServicePlane integration: boots come straight from the gitstore —
+    unknown docs 404 (never instantiated), and reads leave the sequencer
+    exactly where it was."""
+    from fluidframework_tpu.server.netserver import ServicePlane
+
+    plane = ServicePlane(historian_port=0).start()
+    try:
+        with plane.nexus.lock:
+            doc = plane.service.document("hot")
+            doc.save_snapshot(5, {"ch": {"v": 1}})
+            seq_before = doc.sequencer.seq
+        port = plane.historian.port
+        status, headers, body = _get(port, "/doc/hot/snapshot")
+        assert status == 200
+        sha = json.loads(body)["commit"]
+        status, _h, _b = _get(
+            port, "/doc/hot/snapshot", headers={"If-None-Match": f'"{sha}"'}
+        )
+        assert status == 304
+        status, _h, _b = _get(port, "/doc/never-created/snapshot")
+        assert status == 404
+        with plane.nexus.lock:
+            assert plane.service.peek_document("never-created") is None
+            assert doc.sequencer.seq == seq_before
+    finally:
+        plane.stop()
+
+
+# --------------------------------------------------------------------------
+# Presence plane
+# --------------------------------------------------------------------------
+
+def test_presence_at_most_once_bounded_drop_no_sequencer():
+    """Signals encode once, deliver at most once per subscriber, drop past
+    the per-peer bound, and never touch any ordering state."""
+    plane = FanoutPlane(max_directs=4)
+    plane.ensure_doc("d", last_seq=0)
+    live_chunks: list[bytes] = []
+    live = plane.new_peer(sink=live_chunks.append)
+    stalled = plane.new_peer(sink=lambda b: None)
+    plane.add_signal_peer("d", live)
+    plane.add_signal_peer("d", stalled)
+    before = wire_encode_count()
+    for i in range(10):
+        plane.publish_signal("d", "w0", {"cursor": i})
+        plane.drain_virtual(live)  # live keeps up; stalled never drains
+    assert wire_encode_count() == before  # signals never touch op encodes
+    got = [json.loads(c) for c in live_chunks]
+    assert [g["contents"]["cursor"] for g in got] == list(range(10))
+    assert all(g["t"] == "signal" and g["clientId"] == "w0" for g in got)
+    stats = plane.stats()
+    # stalled peer: bound 4, ten published -> six shed, at most once each
+    assert stats["signal_drops"] == 6 and stalled.signal_drops == 6
+    assert stats["signals_published"] == 10
+    assert stats["frames_published"] == 0  # nowhere near the ordering path
+
+
+def test_stalled_signal_subscriber_does_not_stall_ticketing():
+    """ISSUE 13 satellite regression: a signal subscriber that never reads
+    must not stall op ticketing.  Pre-fanout, submit_signal wrote every
+    subscriber's socket synchronously under the service lock — one full
+    kernel buffer wedged the whole ordering plane."""
+    from fluidframework_tpu.server.netserver import ServicePlane
+
+    plane = ServicePlane().start()
+    stalled = writer = None
+    try:
+        # Stalled subscriber: connects with signals, then never reads.
+        stalled = socket.create_connection(("127.0.0.1", plane.nexus.port))
+        stalled.sendall(json.dumps({
+            "t": "connect", "doc": "d", "client": "lurker",
+            "mode": "read", "signals": True,
+        }).encode() + b"\n")
+        sf = stalled.makefile("rb")
+        while b'"joined"' not in sf.readline():
+            pass  # connect fully processed; from here the lurker stalls
+        # Tight per-peer signal bound so the storm sheds visibly (kernel
+        # buffers on loopback can otherwise swallow megabytes).
+        with plane.nexus.lock:
+            plane.nexus.fanout.max_directs = 64
+        # Writer client on its own socket.
+        writer = socket.create_connection(("127.0.0.1", plane.nexus.port))
+        writer.sendall(json.dumps({
+            "t": "connect", "doc": "d", "client": "w0", "mode": "write",
+        }).encode() + b"\n")
+        wf = writer.makefile("rb")
+        while b'"joined"' not in wf.readline():
+            pass
+        # Saturate far past any kernel buffer: ~32MB of signal payload the
+        # stalled peer never drains.  Old code would block mid-loop.
+        blob = "s" * 65536
+        t0 = time.monotonic()
+        for i in range(500):
+            writer.sendall(json.dumps(
+                {"t": "signal", "content": {"i": i, "blob": blob}}
+            ).encode() + b"\n")
+        # Ticketing stays live: an op submitted and sync-echoed promptly.
+        writer.sendall(json.dumps({
+            "t": "submit",
+            "msg": {"clientId": "w0", "clientSequenceNumber": 1,
+                    "referenceSequenceNumber": 1, "type": "op",
+                    "contents": {"probe": True}},
+        }).encode() + b"\n")
+        writer.sendall(b'{"t": "sync", "n": 7}\n')
+        writer.settimeout(30)
+        deadline = time.monotonic() + 30
+        synced = False
+        while time.monotonic() < deadline:
+            line = wf.readline()
+            if not line:
+                break
+            if b'"sync"' in line and b'"n": 7' in line:
+                synced = True
+                break
+        elapsed = time.monotonic() - t0
+        assert synced, "ticketing wedged behind the stalled signal subscriber"
+        assert elapsed < 30
+        stats = plane.http.service_stats()["fanout"]
+        # the stalled peer's bounded queue shed most of the storm
+        assert stats["signal_drops"] > 0
+        with plane.nexus.lock:
+            doc = plane.service.peek_document("d")
+            # signals never sequenced: log = lurker-less quorum traffic only
+            types = [m.type for m in doc.sequencer.log]
+            assert "signal" not in types
+    finally:
+        for s in (stalled, writer):
+            if s is not None:
+                s.close()
+        plane.stop()
+
+
+# --------------------------------------------------------------------------
+# Wire integration: consumers + clients share frames over real TCP
+# --------------------------------------------------------------------------
+
+def _read_lines_until(sock_file, n_payload_lines: int, deadline_s: float = 30):
+    out = []
+    end = time.monotonic() + deadline_s
+    while len(out) < n_payload_lines and time.monotonic() < end:
+        line = sock_file.readline()
+        if not line:
+            break
+        out.append(line)
+    return out
+
+
+def test_firehose_and_clients_share_one_encode_over_tcp():
+    """One connect client + two firehose consumers on one doc: per pump,
+    every sequenced message is wire-encoded exactly once, and each
+    consumer's byte stream equals the log's cached encoding."""
+    from fluidframework_tpu.server.netserver import ServicePlane
+
+    plane = ServicePlane().start()
+    socks = []
+    try:
+        consumers = []
+        for _ in range(2):
+            c = socket.create_connection(("127.0.0.1", plane.nexus.port))
+            socks.append(c)
+            c.sendall(b'{"t": "consume", "doc": "d"}\n')
+            f = c.makefile("rb")
+            assert b"consuming" in f.readline()
+            consumers.append(f)
+        w = socket.create_connection(("127.0.0.1", plane.nexus.port))
+        socks.append(w)
+        w.sendall(json.dumps({
+            "t": "connect", "doc": "d", "client": "w0", "mode": "write",
+        }).encode() + b"\n")
+        wf = w.makefile("rb")
+        while b'"joined"' not in wf.readline():
+            pass
+        # Quiesce the join broadcast (its one encode included) before
+        # snapshotting the counter: the sync echo orders after the frame.
+        w.sendall(b'{"t": "sync", "n": 0}\n')
+        while True:
+            line = wf.readline()
+            if not line or b'"sync"' in line:
+                break
+        before = wire_encode_count()
+        n_ops = 16
+        for i in range(n_ops):
+            w.sendall(json.dumps({
+                "t": "submit",
+                "msg": {"clientId": "w0", "clientSequenceNumber": i + 1,
+                        "referenceSequenceNumber": 1, "type": "op",
+                        "contents": {"i": i}},
+            }).encode() + b"\n")
+        w.sendall(b'{"t": "sync", "n": 1}\n')
+        while True:
+            line = wf.readline()
+            if not line or b'"sync"' in line:
+                break
+        # join already encoded pre-`before`; the 16 ops encode once each
+        # though three subscribers (2 wire + 1 envelope) observed them.
+        assert wire_encode_count() - before == n_ops
+        with plane.nexus.lock:
+            doc = plane.service.peek_document("d")
+            oracle = b"".join(m.wire_line() for m in doc.sequencer.log)
+        for f in consumers:
+            lines = _read_lines_until(f, len(oracle.splitlines()))
+            assert b"".join(lines) == oracle
+    finally:
+        for s in socks:
+            s.close()
+        plane.stop()
+
+
+def test_pipelined_sync_disconnect_still_echoes():
+    """A client may pipeline sync + disconnect in one write: the sync echo
+    (its deterministic quiescence marker) must reach the wire before the
+    server tears the session down — queued-writer delivery included."""
+    from fluidframework_tpu.server.netserver import ServicePlane
+
+    plane = ServicePlane().start()
+    s = None
+    try:
+        s = socket.create_connection(("127.0.0.1", plane.nexus.port))
+        s.sendall(json.dumps({
+            "t": "connect", "doc": "d", "client": "w0", "mode": "write",
+        }).encode() + b"\n")
+        f = s.makefile("rb")
+        while b'"joined"' not in f.readline():
+            pass
+        s.sendall(b'{"t": "sync", "n": 9}\n{"t": "disconnect"}\n')
+        s.settimeout(15)
+        saw_sync = False
+        while True:
+            line = f.readline()
+            if not line:
+                break  # server closed after the goodbye
+            if b'"sync"' in line and b'"n": 9' in line:
+                saw_sync = True
+        assert saw_sync, "sync echo lost on pipelined disconnect"
+    finally:
+        if s is not None:
+            s.close()
+        plane.stop()
+
+
+def test_backlogged_consumer_resyncs_over_tcp_byte_identical():
+    """A consumer that stops reading while the ring is tiny gets dropped
+    to catch-up and resynced from the log — the bytes it finally reads are
+    still exactly the firehose oracle."""
+    from fluidframework_tpu.server.netserver import ServicePlane
+
+    plane = ServicePlane().start()
+    socks = []
+    try:
+        with plane.nexus.lock:
+            plane.nexus.fanout.ring_frames = 4  # force eviction quickly
+        c = socket.create_connection(("127.0.0.1", plane.nexus.port))
+        socks.append(c)
+        c.sendall(b'{"t": "consume", "doc": "d"}\n')
+        cf = c.makefile("rb")
+        assert b"consuming" in cf.readline()
+        w = socket.create_connection(("127.0.0.1", plane.nexus.port))
+        socks.append(w)
+        w.sendall(json.dumps({
+            "t": "connect", "doc": "d", "client": "w0", "mode": "write",
+        }).encode() + b"\n")
+        wf = w.makefile("rb")
+        while b'"joined"' not in wf.readline():
+            pass
+        # Big payloads + no reads on the consumer: kernel buffers fill,
+        # frames fall off the 4-deep ring.
+        blob = "y" * 32768
+        n_ops = 96
+        for i in range(n_ops):
+            w.sendall(json.dumps({
+                "t": "submit",
+                "msg": {"clientId": "w0", "clientSequenceNumber": i + 1,
+                        "referenceSequenceNumber": 1, "type": "op",
+                        "contents": {"i": i, "blob": blob}},
+            }).encode() + b"\n")
+        w.sendall(b'{"t": "sync", "n": 2}\n')
+        while True:
+            line = wf.readline()
+            if not line or b'"sync"' in line:
+                break
+        with plane.nexus.lock:
+            oracle = b"".join(
+                m.wire_line()
+                for m in plane.service.peek_document("d").sequencer.log
+            )
+        got = b""
+        c.settimeout(10)
+        end = time.monotonic() + 60
+        while len(got) < len(oracle) and time.monotonic() < end:
+            try:
+                data = c.recv(1 << 20)
+            except socket.timeout:
+                break
+            if not data:
+                break
+            got += data
+        assert got == oracle
+    finally:
+        for s in socks:
+            s.close()
+        plane.stop()
