@@ -1339,9 +1339,26 @@ def bench_config5(args) -> dict:
     assert not eng.errors().any() and not eng.fallbacks
     assert eng.device_fraction() == 1.0
 
+    # Object-mark oracle on the SAME streams (host fold only): the pooled
+    # path's speedup + byte-identity, recorded side by side (PR 14 — the
+    # mark_pool=False fold is the fuzz oracle, same pattern as plan_cache).
+    oracle = TreeBatchEngine(D, capacity=cap, ops_per_step=32,
+                             pool_capacity=8 * cap, mark_pool=False)
+    t0 = time.perf_counter()
+    for d, msgs in enumerate(streams):
+        for m in msgs:
+            oracle.ingest(d, m)
+    t_oracle = time.perf_counter() - t0
+    identity = all(
+        json.dumps(eng.hosts[d].em.summarize(), sort_keys=True)
+        == json.dumps(oracle.hosts[d].em.summarize(), sort_keys=True)
+        for d in range(D)
+    )
+
+    health = eng.health()
     dev_rate = n_edits / t_dev
     pipeline = n_edits / (t_host + t_dev)
-    return {
+    out = {
         "metric": "config5_tree_device_edits_per_sec",
         "value": round(dev_rate, 1),
         "unit": "edits/s",
@@ -1351,11 +1368,20 @@ def bench_config5(args) -> dict:
         "edits": n_edits,
         "pipeline_edits_per_sec": round(pipeline, 1),
         "host_translation_edits_per_sec": round(n_edits / t_host, 1),
-        "translation_plan_hit_rate": eng.health().get(
+        "oracle_host_edits_per_sec": round(n_edits / t_oracle, 1),
+        "mark_pool_speedup": round(t_oracle / t_host, 2),
+        "mark_pool_identity": identity,
+        "mark_pool_hit_rate": health.get("mark_pool_hit_rate", 0.0),
+        "pool_occupancy": health.get("pool_occupancy", 0.0),
+        "translation_plan_hit_rate": health.get(
             "translation_plan_hit_rate", 0.0
         ),
-        "engine_health": eng.health(),
+        "engine_health": health,
     }
+    if getattr(args, "artifact", None):
+        with open(args.artifact, "w") as f:
+            json.dump(out, f, indent=2)
+    return out
 
 
 def bench_latency(args) -> dict:

@@ -21,12 +21,15 @@ import json
 import sys
 from pathlib import Path
 
-from . import determinism, donation, jit_safety, layer_check, swallowed, threads
+from . import (
+    determinism, donation, jit_safety, layer_check, markchurn, swallowed,
+    threads,
+)
 from .core import Baseline, Finding, load_package
 
 PASSES = (
     "layer-check", "jit-safety", "donation", "determinism", "threads",
-    "swallowed-exception",
+    "swallowed-exception", "fold-mark-churn",
 )
 
 
@@ -72,6 +75,8 @@ def run_all(
         findings += swallowed.run(
             index, layer_map, layers_cfg.get("swallowed_scope")
         )
+    if "fold-mark-churn" in selected:
+        findings += markchurn.run(index, layers_cfg.get("fold_churn_scope"))
     findings.sort(key=lambda f: (f.file, f.line, f.rule))
 
     baseline = Baseline.load(baseline_path) if baseline_path else Baseline()

@@ -534,13 +534,27 @@ def rebase_node_change(a: NodeChange, b: NodeChange, a_after: bool = True) -> No
             out.fields[key] = kind_of(a_fc).clone(a_fc)
             continue
         kind = kind_of(a_fc)
-        if kind is not kind_of(b_fc):
-            # Two producers spoke different kinds for one field (a typed
-            # view racing an untyped/schema-less writer).  Degrade
-            # DETERMINISTICALLY instead of crashing the delta pump: the
-            # later-sequenced side drops its field change, the earlier
-            # side carries through untouched — every replica computes the
-            # same outcome from the same sequence order.
+        b_kind = kind_of(b_fc)
+        if kind is not b_kind:
+            if getattr(kind, "is_sequence", False) and getattr(
+                b_kind, "is_sequence", False
+            ):
+                # Sequence FAMILY (one pooled span, one object list):
+                # same algebra, different storage — rebase through the
+                # shared mark-list view.  The object-list result is what
+                # a pure-object replica computes, so replicas converge
+                # regardless of which representation each one holds.
+                out.fields[key] = rebase_marks(
+                    kind.as_mark_list(a_fc), b_kind.as_mark_list(b_fc),
+                    a_after,
+                )
+                continue
+            # Two producers spoke genuinely different kinds for one field
+            # (a typed view racing an untyped/schema-less writer).
+            # Degrade DETERMINISTICALLY instead of crashing the delta
+            # pump: the later-sequenced side drops its field change, the
+            # earlier side carries through untouched — every replica
+            # computes the same outcome from the same sequence order.
             if a_after:
                 continue
             out.fields[key] = kind_of(a_fc).clone(a_fc)
@@ -595,6 +609,21 @@ def _compose_mixed_kinds(a_fc, b_fc):
     """
     from .field_kinds import OptionalChange, compose_marks, kind_of
 
+    # Normalize sequence-family operands to bare mark lists (a pooled
+    # columnar span composes through the same object algebra — compose is
+    # an offline path, never the pooled trunk fold).
+    if not isinstance(a_fc, (list, OptionalChange)):
+        k = kind_of(a_fc)
+        if getattr(k, "is_sequence", False):
+            a_fc = k.as_mark_list(a_fc)
+    if not isinstance(b_fc, (list, OptionalChange)):
+        k = kind_of(b_fc)
+        if getattr(k, "is_sequence", False):
+            b_fc = k.as_mark_list(b_fc)
+    if isinstance(a_fc, list) and isinstance(b_fc, list):
+        # Both were sequence-family (one pooled, one object): after
+        # normalization this is a plain sequence compose.
+        return compose_marks(a_fc, b_fc)
     if isinstance(b_fc, OptionalChange):
         if b_fc.set is not None:
             # Whole-content shadow — but b's recorded prior (set[1]) lives
@@ -709,7 +738,26 @@ def apply_marks(nodes: list[Node], marks: list[Mark]) -> None:
     """Single-pass rebuild: consume the input node list per mark, emitting
     the output; MoveIn emits a register placeholder patched once every
     MoveOut of the list has detached its nodes (a move may land left OR
-    right of its source)."""
+    right of its source).
+
+    Skip/Modify-only lists (the trunk checkpoint fold's dominant shape —
+    value sets and nested edits) apply IN PLACE: no output list rebuild,
+    no O(field) extend per edit."""
+    structural = False
+    for m in marks:
+        if not isinstance(m, (Skip, Modify)):
+            structural = True
+            break
+    if not structural:
+        pos = 0
+        for m in marks:
+            if isinstance(m, Skip):
+                pos += m.count
+            else:
+                apply_node_change(nodes[pos], m.change)
+                pos += 1
+        assert pos <= len(nodes), "marks walk past end of field"
+        return
     out: list = []
     registers: dict[int, dict[int, Node]] = {}  # id -> {original offset: node}
     pos = 0
@@ -809,7 +857,7 @@ def rebase_constraint_path(
     """Carry a constraint path through one NodeChange.  Returns
     (rebased path | None when a node on the path was detached/replaced,
     whether the subtree at the path was edited)."""
-    from .field_kinds import SEQUENCE, kind_of
+    from .field_kinds import kind_of
 
     cur: NodeChange | None = change
     out: list = []
@@ -820,8 +868,10 @@ def rebase_constraint_path(
             cur = None
             continue
         kind = kind_of(fc)
-        if kind is SEQUENCE:
-            fates = _Fates(fc)
+        if getattr(kind, "is_sequence", False):
+            # Sequence-family kinds (object mark lists AND pooled columnar
+            # spans) expose the mark-list view the fate map walks.
+            fates = _Fates(kind.as_mark_list(fc))
             k, pos, nested = fates.node(idx)
             if k != "keep":
                 return None, True

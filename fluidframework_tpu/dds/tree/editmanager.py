@@ -53,6 +53,13 @@ class TrunkCommit:
     client_id: str
     revision: Any
     change: Commit  # trunk coordinates (context = previous trunk commit)
+    # Pooled-mode cache: the same trunk commit extends EVERY peer's
+    # translation stream; pooling it once (at integration, when the fold
+    # already holds the pooled form) instead of per-peer is sound because
+    # rebase outputs depend only on the b-side's STRUCTURE (mark kinds /
+    # counts / positions), never on later apply-enrichment of the object
+    # form (value-tuple arity, Remove.detached payloads).
+    pooled: Any = None
 
 
 @dataclass
@@ -121,18 +128,56 @@ def bridge_bare(commits: list[Commit], incoming: Commit) -> tuple[
 
 
 class EditManager:
-    """Trunk + peer branches for one SharedTree instance."""
+    """Trunk + peer branches for one SharedTree instance.
+
+    ``mark_pool`` switches the WHOLE peer-stream state (xs / stages /
+    inflight / scratch) to the pooled columnar mark store
+    (dds/tree/mark_pool.py): incoming commits pool once at integration,
+    the window fold runs as column passes with span reuse for disjoint
+    commits, and only the returned trunk commit materializes object marks
+    (the caller apply-enriches that clone; pooled spans stay immutable).
+    ``None``/falsy keeps the object fold — the byte-identity fuzz oracle.
+    Pass a shared ``MarkPool`` so a fleet's gauges aggregate, or ``True``
+    for a private pool."""
 
     def __init__(
         self,
         encode_rev: Callable[[Any], Any] | None = None,
         decode_rev: Callable[[Any], Any] | None = None,
+        mark_pool=None,
     ) -> None:
         self.trunk: list[TrunkCommit] = []
         self.trunk_base = 0  # all commits with seq <= trunk_base are evicted
         self.peers: dict[str, PeerBranch] = {}
         self._encode_rev = encode_rev or (lambda r: r)
         self._decode_rev = decode_rev or (lambda r: r)
+        self.pool = None
+        if mark_pool:
+            # One import at construction (module handle cached on the
+            # instance): the fold calls these per commit per window entry,
+            # and a function-local import there pays importlib machinery
+            # on the hot path.
+            from . import mark_pool as mp
+
+            self._mp = mp
+            self.pool = mark_pool if isinstance(mark_pool, mp.MarkPool) \
+                else mp.MarkPool()
+
+    def _pool_commit(self, commit: Commit) -> Commit:
+        """Pooled-mode conversion (idempotent); object mode passes through."""
+        if self.pool is None:
+            return commit
+        return self._mp.pool_commit(self.pool, commit)
+
+    def _pooled_trunk(self, t: TrunkCommit) -> Commit:
+        """Pooled view of a trunk commit, cached on the commit (one
+        conversion shared by every peer stream); object mode passes the
+        change through untouched."""
+        if self.pool is None:
+            return t.change
+        if t.pooled is None:
+            t.pooled = self._mp.pool_commit(self.pool, t.change)
+        return t.pooled
 
     # ------------------------------------------------------------------ query
     def _trunk_range(self, lo: int, hi: int) -> list[TrunkCommit]:
@@ -185,7 +230,7 @@ class EditManager:
                 if br.scratch:
                     br.scratch.pop(0)
                 continue
-            x = t.change
+            x = self._pooled_trunk(t)
             if br.scratch:
                 br.scratch, x = bridge_bare(br.scratch, x)
             br.xs.append((t.seq, x))
@@ -199,25 +244,47 @@ class EditManager:
             drop += 1
         if drop:
             del xs[:drop]
-        c = clone_commit(change)
         stage_list: list[tuple[int, Commit]] = []
-        for i in range(len(xs)):
-            tseq, x = xs[i]
-            nxt = rebase_commit(c, x, a_after=True)
-            xs[i] = (tseq, rebase_commit(x, c, a_after=False))
-            c = nxt
-            stage_list.append((tseq, c))
-        # The recorded stages share Mark objects with each other AND with
-        # the final fold value (rebase's per-field clones are shallow), and
-        # the caller apply-ENRICHES the returned trunk commit in place — so
-        # the trunk log and caller get a private deep clone, keeping every
-        # recorded stage at its unapplied form (what _advance materializes
-        # and summarize serializes, exactly as the legacy bridge walk
-        # produced).  One clone per commit, not per stage.
-        ret = clone_commit(c) if stage_list else c
-        br.inflight.append((revision, clone_commit(change)))
+        if self.pool is not None:
+            # Pooled fold: both bridge legs come out of mark_pool's fused
+            # pair (columnar rebase + identity span reuse for disjoint
+            # commits); the peer stream keeps sharing unchanged spans
+            # instead of re-materializing every mark per window entry.
+            rebase_pair = self._mp.rebase_pair
+            c = self._pool_commit(change)
+            for i in range(len(xs)):
+                tseq, x = xs[i]
+                nxt, xw = rebase_pair(c, x)
+                xs[i] = (tseq, xw)
+                c = nxt
+                stage_list.append((tseq, c))
+            ret = self._mp.unpool_commit(c)
+            pooled_ret = c
+            br.inflight.append((revision, self._pool_commit(change)))
+        else:
+            c = clone_commit(change)
+            for i in range(len(xs)):
+                tseq, x = xs[i]
+                nxt = rebase_commit(c, x, a_after=True)
+                xs[i] = (tseq, rebase_commit(x, c, a_after=False))
+                c = nxt
+                stage_list.append((tseq, c))
+            # The recorded stages share Mark objects with each other AND
+            # with the final fold value (rebase's per-field clones are
+            # shallow), and the caller apply-ENRICHES the returned trunk
+            # commit in place — so the trunk log and caller get a private
+            # deep clone, keeping every recorded stage at its unapplied
+            # form (what _advance materializes and summarize serializes,
+            # exactly as the legacy bridge walk produced).  One clone per
+            # commit, not per stage.
+            pooled_ret = None
+            ret = clone_commit(c) if stage_list else c
+            br.inflight.append((revision, clone_commit(change)))
         br.stages.append(stage_list)
-        self.trunk.append(TrunkCommit(seq=seq, client_id=client_id, revision=revision, change=ret))
+        self.trunk.append(TrunkCommit(
+            seq=seq, client_id=client_id, revision=revision, change=ret,
+            pooled=pooled_ret if self.pool is not None else None,
+        ))
         return ret
 
     def _advance(self, client_id: str, br: PeerBranch, upto: int) -> None:
@@ -238,7 +305,9 @@ class EditManager:
                     br.inflight.pop(0)
                     br.stages.pop(0)
                 else:
-                    br.inflight, _ = bridge(br.inflight, t.change)
+                    br.inflight, _ = bridge(
+                        br.inflight, self._pooled_trunk(t)
+                    )
         else:
             moved = False
             for t in rng:
@@ -296,7 +365,9 @@ class EditManager:
                         if t.client_id == client_id:
                             br.scratch.pop(0)
                         else:
-                            br.scratch, _ = bridge_bare(br.scratch, t.change)
+                            br.scratch, _ = bridge_bare(
+                                br.scratch, self._pooled_trunk(t)
+                            )
                 br.pos = min_seq
         self.trunk = [t for t in self.trunk if t.seq > min_seq]
         self.trunk_base = min_seq
@@ -343,17 +414,23 @@ class EditManager:
         self.peers = {}
         for cid, p in data["peers"].items():
             inflight = [
-                (self._decode_rev(rev), commit_from_json(ch))
+                (self._decode_rev(rev), self._pool_commit(
+                    commit_from_json(ch)
+                ))
                 for rev, ch in p["inflight"]
             ]
             # The previous incarnation's fold write-back state is not part
             # of the summary; re-seed the stream from the in-flight clones
             # (extension bridges through them until their trunk entries
-            # are crossed — the original walk, applied lazily).
+            # are crossed — the original walk, applied lazily).  Pooled
+            # mode shares the immutable spans instead of cloning.
             self.peers[cid] = PeerBranch(
                 base=p["base"],
                 inflight=inflight,
                 pos=p["base"],
-                scratch=[clone_commit(ch) for _rev, ch in inflight],
+                scratch=(
+                    [ch for _rev, ch in inflight] if self.pool is not None
+                    else [clone_commit(ch) for _rev, ch in inflight]
+                ),
                 stages=[None] * len(inflight),
             )

@@ -108,6 +108,13 @@ class SequenceFieldKind(FieldKind):
     """The mark-list algebra (changeset.py) behind the registry facade."""
 
     name = "sequence"
+    # Sequence-FAMILY marker: this kind (and the pooled columnar kind in
+    # mark_pool.py) can expose a bare mark-list view for the fate-map
+    # consumers (constraint paths, mixed-kind compose).
+    is_sequence = True
+
+    def as_mark_list(self, change):
+        return change
 
     def clone(self, change):
         return list(change)  # shallow, matching the historical copy

@@ -51,12 +51,15 @@ class NetworkDeltaConnection(DeltaConnection):
         nack_listener: Callable[[Nack], None] | None,
         signal_listener: Callable[[SignalMessage], None] | None,
         token: str | None = None,
+        boot_listener: Callable[[], None] | None = None,
     ) -> None:
         self.client_id = client_id
         self.mode = mode
         self._listener = listener
         self._nack_listener = nack_listener
         self._signal_listener = signal_listener
+        self._boot_listener = boot_listener
+        self.boot_resyncs = 0
         self._inbound: queue.Queue = queue.Queue()
         self._connected = False
         self._sync_counter = 0
@@ -165,6 +168,18 @@ class NetworkDeltaConnection(DeltaConnection):
                     SignalMessage(client_id=item["clientId"], contents=item["contents"])
                 )
             return True
+        if kind == "resync":
+            # Fan-out plane drop-to-catch-up: ``boot: true`` means this
+            # connection's missed range left the retained log — the host
+            # must re-seed from the historian snapshot tier and reconnect
+            # (the FleetConsumer implements the full fetch-adopt-resume
+            # loop; container hosts register ``boot_listener`` to reload
+            # through their storage service).
+            if item.get("boot"):
+                self.boot_resyncs += 1
+                if self._boot_listener is not None:
+                    self._boot_listener()
+            return False
         if kind == "sync":
             self._sync_seen = item.get("n")
             return False
@@ -361,6 +376,7 @@ class NetworkDocumentService(DocumentService):
         nack_listener: Callable[[Nack], None] | None = None,
         signal_listener: Callable[[SignalMessage], None] | None = None,
         mode: str = "write",
+        boot_listener: Callable[[], None] | None = None,
     ) -> DeltaConnection:
         token = None
         if self._f.token_provider is not None:
@@ -368,6 +384,7 @@ class NetworkDocumentService(DocumentService):
         conn = NetworkDeltaConnection(
             self._f.host, self._f.port, self._doc, client_id, mode,
             listener, nack_listener, signal_listener, token=token,
+            boot_listener=boot_listener,
         )
         self._f.live_connections.append(conn)
         return conn

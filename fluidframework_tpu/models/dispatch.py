@@ -1,0 +1,64 @@
+"""Engine-owned dispatch seam: the models -> parallel.mesh inversion.
+
+PR 6 made the mesh the production serving path, which left the batched
+engines (state layer) importing ``parallel.mesh`` (orchestration layer) —
+an upward edge the fftpu-check baseline carried with a rationale ever
+since.  This module inverts it: the engines depend on an abstract
+**dispatch plane** — the object that owns mesh construction, state
+sharding, and the jitted ``shard_map`` program factories — and the
+concrete plane registers itself here when its module loads.
+
+Resolution order:
+
+1. whatever called :func:`register_dispatch_plane` first (in-process
+   composition: importing ``fluidframework_tpu.parallel.mesh`` anywhere —
+   to build a mesh, which every mesh-passing caller already does —
+   registers it);
+2. otherwise the provider named by ``FFTPU_DISPATCH_PLANE`` (a dotted
+   module path) is loaded and must self-register — the multi-backend
+   seam: an alternative serving plane (single-host, virtual, a future
+   non-JAX backend) binds here without the engines changing;
+3. the default provider is ``fluidframework_tpu.parallel.mesh``.
+
+The plane's surface is duck-typed (the default provider is the
+``parallel.mesh`` module itself); engines use:
+
+- ``doc_mesh()`` / ``docs_segs_mesh(seg_shards=)`` — mesh construction
+- ``shard_fleet_state`` / ``fleet_doc_axes`` / ``fleet_state_specs`` /
+  ``shard_docs`` — fleet placement
+- ``mesh_fleet_program`` / ``mesh_seg_program`` — jitted dispatch
+- ``seg_state_specs`` / ``shard_seg_state`` / ``SEG_AXIS`` — segment lanes
+- ``error_count`` — the per-shard error-latch reduce
+- ``P`` — PartitionSpec re-export
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+
+_PLANE = None
+
+DEFAULT_PROVIDER = "fluidframework_tpu.parallel.mesh"
+
+
+def register_dispatch_plane(plane):
+    """Install the concrete dispatch plane (called by the provider module
+    at import time).  Last registration wins — tests swap in fakes."""
+    global _PLANE
+    _PLANE = plane
+    return plane
+
+
+def dispatch_plane():
+    """The active dispatch plane, loading the configured provider on
+    first use (the composition-root binding; see module docstring)."""
+    if _PLANE is None:
+        provider = os.environ.get("FFTPU_DISPATCH_PLANE", DEFAULT_PROVIDER)
+        importlib.import_module(provider)
+        if _PLANE is None:
+            raise RuntimeError(
+                f"dispatch provider {provider!r} did not register a plane "
+                "(it must call models.dispatch.register_dispatch_plane)"
+            )
+    return _PLANE

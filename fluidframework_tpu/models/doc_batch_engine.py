@@ -79,8 +79,7 @@ from ..dds.mergetree_ref import RefMergeTree
 from ..dds.shared_string import decode_obliterate_places
 from ..observability.flight_recorder import RecompileWatchdog, instant, span
 from ..ops import mergetree_kernel as mk
-from ..parallel import mesh as pm
-from ..parallel.mesh import doc_mesh, shard_docs
+from .dispatch import dispatch_plane
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters, Histogram, SampledTelemetryHelper
 from .recovery import (
@@ -405,6 +404,11 @@ class DocBatchEngine:
         self._lat_pending: list[tuple[float, int]] = []
 
         if use_mesh:
+            # Engine-owned dispatch seam (models/dispatch.py): the plane
+            # owns mesh construction + shard_map program factories; the
+            # concrete provider (parallel.mesh by default) registers
+            # itself, inverting the old models -> parallel import.
+            pm = self._pm = dispatch_plane()
             if mesh is not None:
                 self.mesh = mesh
             elif seg_shards > 1:
@@ -413,10 +417,11 @@ class DocBatchEngine:
                 # segs axis via segment lanes.
                 self.mesh = pm.docs_segs_mesh(seg_shards=seg_shards)
             else:
-                self.mesh = doc_mesh()
+                self.mesh = pm.doc_mesh()
             n_shards = self.mesh.devices.size
             self.seg_shards = int(dict(self.mesh.shape).get(pm.SEG_AXIS, 1))
         else:
+            self._pm = None
             self.mesh = None
             n_shards = 1
             self.seg_shards = 1
@@ -1235,7 +1240,7 @@ class DocBatchEngine:
                 self.megastep_k, self.capacity, self.ops_per_step,
                 mk.OP_FIELDS, self.max_insert_len, mesh=self.mesh,
                 doc_axis=(
-                    pm.fleet_doc_axes(self.mesh)
+                    self._pm.fleet_doc_axes(self.mesh)
                     if self.mesh is not None else "docs"
                 ),
             )
@@ -1569,7 +1574,7 @@ class DocBatchEngine:
         except ValueError:
             return False
         lane = _SegmentLane(
-            state=pm.shard_seg_state(blocked, self.mesh),
+            state=self._pm.shard_seg_state(blocked, self.mesh),
             n_shards=self.seg_shards, s_local=s_local,
             queue=RowQueue(mk.OP_FIELDS, self.max_insert_len),
         )
@@ -1656,7 +1661,7 @@ class DocBatchEngine:
         ):
             host = jax.tree.map(np.asarray, lane.state)
             blocked = mk.seg_rebalance_state(host, s_local=lane.s_local)
-            lane.state = pm.shard_seg_state(blocked, self.mesh)
+            lane.state = self._pm.shard_seg_state(blocked, self.mesh)
         lane.version += 1
         lane.rebalances += 1
         lane.ops_since_rebalance = 0
@@ -1670,7 +1675,7 @@ class DocBatchEngine:
         for d, h in enumerate(self.hosts):
             mins[self._slot[d]] = h.min_seq
         if self.mesh is not None:
-            mins_dev = jax.device_put(mins, shard_docs(self.mesh))
+            mins_dev = jax.device_put(mins, self._pm.shard_docs(self.mesh))
         else:
             mins_dev = jnp.asarray(mins)
         self.state = self._compact(self.state, mins_dev)
@@ -1703,7 +1708,7 @@ class DocBatchEngine:
             # errors are per-lane scalars checked below, so an active seg
             # or overflow lane must not force the batch-state gather.
             with span("readback", kind="error_count"):
-                batch_clean = int(pm.error_count(self.state.error)) == 0
+                batch_clean = int(self._pm.error_count(self.state.error)) == 0
         if not batch_clean:
             with span("readback", kind="error_vector"):
                 err = np.asarray(self.state.error)
@@ -2695,6 +2700,53 @@ class DocBatchEngine:
             self.recovery_tracker.begin(t_start)
         return restored
 
+    def adopt_boot_snapshot(self, doc_idx: int, record: dict) -> int:
+        """Client half of the fan-out plane's ``{"t":"resync","boot":true}``
+        contract: a consumer that fell off the retained log re-seeds the
+        document from a historian snapshot record (the scribe summary
+        schema, ``engine: doc_batch``) and re-consumes from the returned
+        seq floor.  Staged pre-gap work is dropped — the snapshot covers
+        it — and the adoption rides the refresh re-seed path, so lanes,
+        quorum, prop tables and the replay floor all reset consistently.
+        A record at or below the doc's applied floor adopts nothing (the
+        caller re-consumes from the doc's own floor)."""
+        with self.ckpt_lock:
+            h = self.hosts[doc_idx]
+            seq = int(record["seq"])
+            if seq <= h.last_seq:
+                self.counters.bump("boot_snapshots_stale")
+                return h.last_seq
+            # Clear staged work up front: the refresh guard refuses docs
+            # with pending ops (trailing must not race serving), but a
+            # boot resync REPLACES the doc — pre-gap rows are covered.
+            h.queue.clear()
+            for lane in (self.overflow.get(doc_idx),
+                         self.seg_lanes.get(doc_idx)):
+                if lane is not None:
+                    lane.queue.clear()
+            self._busy.discard(doc_idx)
+
+            key = self.doc_keys[doc_idx]
+
+            class _OneRecord:
+                def load(self, doc_id, _key=key, _rec=record):
+                    return _rec if doc_id == _key else None
+
+            adopted = self._restore(
+                _OneRecord(), parallel=False, max_workers=None, refresh=True
+            )
+            if doc_idx not in adopted:
+                # The record was unusable (engine mismatch / schema drift):
+                # fail LOUDLY — returning the stale floor would send the
+                # consumer back to a range the server already declared
+                # gone, an infinite resync loop that looks healthy.
+                raise ValueError(
+                    f"boot snapshot for doc {key!r} not adoptable "
+                    f"(engine={record.get('engine')!r})"
+                )
+            self.counters.bump("boot_snapshots_adopted")
+            return h.last_seq
+
     def _drop_restored_identity(self, d: int) -> None:
         """Forget a doc's prior adoption before a refresh re-seed (warm-
         standby trailing only: the doc has no staged work by contract)."""
@@ -2754,7 +2806,7 @@ class DocBatchEngine:
             for d, h in enumerate(self.hosts):
                 mins[self._slot[d]] = h.min_seq
             if self.mesh is not None:
-                mins_dev = jax.device_put(mins, shard_docs(self.mesh))
+                mins_dev = jax.device_put(mins, self._pm.shard_docs(self.mesh))
             else:
                 mins_dev = jnp.asarray(mins)
             self.state = self._compact(self.state, mins_dev)

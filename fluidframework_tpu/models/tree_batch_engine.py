@@ -48,11 +48,13 @@ from ..dds.tree.changeset import (
     commit_from_json,
 )
 from ..dds.tree.editmanager import EditManager
+from ..dds.tree.mark_pool import MarkPool
+from ..dds.tree.mark_pool import pool_commit_from_json as _pool_commit_from_json
 from ..dds.tree.field_kinds import OptionalChange
 from ..dds.tree.forest import ROOT_FIELD, Forest, Node
 from ..observability.flight_recorder import RecompileWatchdog, span
 from ..ops import tree_kernel as tk
-from ..parallel import mesh as pm
+from .dispatch import dispatch_plane
 from ..protocol.messages import MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters
 from .recovery import (
@@ -199,6 +201,12 @@ class _TranslationPlan:
         return ops, pay
 
 
+# Watermark-accounting kind sets (the scalar _block_upper fast path; the
+# vectorized branch derives the same sets from tk directly).
+_GROW_KINDS = (int(tk.NestedOpKind.INSERT), int(tk.NestedOpKind.REPLACE_FIELD))
+_POOLED_KINDS = _GROW_KINDS + (int(tk.NestedOpKind.SET),)
+_POOLED_VKINDS = tuple(int(p) for p in tk._POOLED)
+
 # Module-level jitted programs: shared compile cache across engine
 # instances (keyed by input shapes), instead of per-instance jit closures.
 
@@ -235,6 +243,8 @@ class TreeBatchEngine:
         doc_keys: list[str] | None = None,
         megastep_k: int = 1,
         plan_cache: bool = True,
+        mark_pool: bool = True,
+        native_wire: bool = True,
         telemetry=None,
         overload_high_watermark: int = 0,
         overload_low_watermark: int = 0,
@@ -256,8 +266,19 @@ class TreeBatchEngine:
             high=overload_high_watermark or 8 * budget,
             low=overload_low_watermark or budget,
         )
+        # Pooled columnar mark store (dds/tree/mark_pool.py): one pool is
+        # shared by every doc's EditManager so occupancy/reuse gauges are
+        # fleet-wide.  ``mark_pool=False`` keeps the object-mark fold —
+        # the byte-identity fuzz oracle, same pattern as plan_cache.
+        self.markpool = MarkPool() if mark_pool else None
+        # ingest_lines rides the native tree decoder when its symbol is
+        # present (stale prebuilt .so -> Python decode, never a crash).
+        self.native_wire = native_wire
         self.hosts = [
-            _TreeHost(queue=RowQueue(tk.NESTED_OP_FIELDS, max_insert_len))
+            _TreeHost(
+                em=EditManager(mark_pool=self.markpool),
+                queue=RowQueue(tk.NESTED_OP_FIELDS, max_insert_len),
+            )
             for _ in range(n_docs)
         ]
         self.fallbacks: dict[int, Forest] = {}
@@ -310,11 +331,14 @@ class TreeBatchEngine:
         self._step = _tree_step_jit
         self._megastep = _tree_megastep_jit
         self._compact = _tree_compact_jit
+        self._pm = None
         if mesh is not None:
             # Partition-rule-matched placement + shard_map-wrapped fleet
-            # programs: one donated dispatch steps every shard, zero
-            # hot-path collectives (parallel.mesh; same machinery as the
+            # programs resolved through the engine-owned dispatch seam
+            # (models/dispatch.py): one donated dispatch steps every
+            # shard, zero hot-path collectives (same machinery as the
             # string engine).
+            pm = self._pm = dispatch_plane()
             self.state = pm.shard_fleet_state(self.state, mesh)
             # On a docs x segs mesh the doc dim shards over BOTH axes
             # flattened — the program specs must match the placement
@@ -413,7 +437,84 @@ class TreeBatchEngine:
         for d, m in zip(doc_idxs, msgs):
             self.ingest(d, m)
 
-    def _ingest_edit(self, doc_idx: int, msg: SequencedMessage, c: dict) -> None:
+    def ingest_lines(self, doc_idx: int, data: bytes) -> int:
+        """Stage newline-separated wire JSON for one tree document — the
+        firehose consumer seam (API parity with ``DocBatchEngine``).
+        With the native tree decoder present (native/ingest.cpp
+        ``ing_tree_decode``, symbol-gated like ``_sync_native_props``) and
+        the mark pool enabled, the envelope + mark numeric plane decodes
+        in C++ straight into pool columns; otherwise every line takes the
+        Python parse.  A malformed line lands all EARLIER lines, then
+        raises through the Python decode (which owns error semantics) —
+        per-document isolation, other docs' feeds are untouched.  Returns
+        op rows staged (applied edits for fallback-routed docs)."""
+        with self.ckpt_lock:
+            return self._ingest_lines(doc_idx, data)
+
+    def _ingest_lines(self, doc_idx: int, data: bytes) -> int:
+        h = self.hosts[doc_idx]
+        commits_before = h.total_commits
+        rows_before = len(h.queue)
+        tables = None
+        if self.markpool is not None and self.native_wire:
+            from ..native import ingest_native as inat
+
+            try:
+                tables = inat.tree_decode(data)  # None: lib/symbol absent
+            except ValueError:
+                # Malformed line: re-decode in Python so the error carries
+                # the Python path's exact semantics (earlier lines land).
+                self.counters.bump("tree_native_decode_errors")
+                tables = None
+        if tables is not None:
+            self.counters.bump("tree_native_batches")
+            self._ingest_native_tables(doc_idx, data, tables)
+        else:
+            for raw in data.split(b"\n"):
+                line = raw.strip()
+                if line:
+                    self.ingest(
+                        doc_idx, SequencedMessage.from_json(line.decode())
+                    )
+        if doc_idx in self.fallbacks:
+            return h.total_commits - commits_before
+        return len(h.queue) - rows_before
+
+    def _ingest_native_tables(self, doc_idx: int, data: bytes, tables) -> None:
+        import json as _json
+
+        from ..dds.tree.mark_pool import pool_commit_from_native
+        from ..native.ingest_native import TREE_ST_EDITS, TREE_ST_OPAQUE
+
+        msgs, chgs, flds, marks, spans = (t.tolist() for t in tables)
+        for m in msgs:
+            status = m[10]
+            if status != TREE_ST_EDITS and status != TREE_ST_OPAQUE:
+                continue  # non-op line: the op path ignores it too
+            msg = SequencedMessage(
+                client_id=data[m[4] : m[4] + m[5]].decode(),
+                client_seq=m[13], ref_seq=m[1], seq=m[0], min_seq=m[2],
+                type=MessageType.OP, contents=None,
+            )
+            if status == TREE_ST_OPAQUE:
+                # Grouped batches, address envelopes, dict-form commits,
+                # escaped ids: the Python walk, exactly as without native.
+                contents = _json.loads(data[m[11] : m[11] + m[12]])
+                for edit in self._unwrap(contents):
+                    self._ingest_edit(doc_idx, msg, edit)
+                continue
+            with span("host_fold_mark_alloc", doc=doc_idx):
+                commit = pool_commit_from_native(
+                    self.markpool, data, m, chgs, flds, marks, spans
+                )
+            self._ingest_edit(
+                doc_idx, msg,
+                {"sid": data[m[6] : m[6] + m[7]].decode(), "rev": m[3]},
+                commit=commit,
+            )
+
+    def _ingest_edit(self, doc_idx: int, msg: SequencedMessage, c: dict,
+                     commit=None) -> None:
         h = self.hosts[doc_idx]
         if h.base_seq and msg.seq <= h.base_seq:
             # Covered by the durable checkpoint (restart replay): skip.
@@ -425,15 +526,28 @@ class TreeBatchEngine:
             h.dirty_since = time.monotonic()
         if h.boot_counting:
             self.counters.bump("boot_replay_len")
-        commit = commit_from_json(c["changes"])
-        trunk = h.em.add_sequenced(
-            client_id=msg.client_id,
-            revision=(c["sid"], c["rev"]),
-            change=commit,
-            ref_seq=msg.ref_seq,
-            seq=msg.seq,
-        )
-        h.em.advance_min_seq(msg.min_seq)
+        # Host-fold sub-phases (flight recorder): mark_alloc (wire ->
+        # commit/mark construction), rebase (EditManager window fold),
+        # compose (trunk-suffix fold into the checkpoint forest) and
+        # translate (_flatten) — the phase_shares row that makes the
+        # "Mark.__init__ is ~30% of host time" claim reproducible.
+        if commit is None:
+            with span("host_fold_mark_alloc", doc=doc_idx):
+                if self.markpool is not None:
+                    commit = _pool_commit_from_json(
+                        self.markpool, c["changes"]
+                    )
+                else:
+                    commit = commit_from_json(c["changes"])
+        with span("host_fold_rebase", doc=doc_idx):
+            trunk = h.em.add_sequenced(
+                client_id=msg.client_id,
+                revision=(c["sid"], c["rev"]),
+                change=commit,
+                ref_seq=msg.ref_seq,
+                seq=msg.seq,
+            )
+            h.em.advance_min_seq(msg.min_seq)
         h.total_commits += 1
         if doc_idx in self.fallbacks:
             # Fallback docs apply directly; their trunk log is dead weight
@@ -444,11 +558,13 @@ class TreeBatchEngine:
         if len(h.trunk_log) >= self.CHECKPOINT_EVERY:
             # Fold the suffix into the checkpoint forest: bounded host
             # memory, and fallback routing replays only the tail.
-            for t in h.trunk_log:
-                apply_commit(h.checkpoint.root, t)
-            h.trunk_log.clear()
+            with span("host_fold_compose", doc=doc_idx):
+                for t in h.trunk_log:
+                    apply_commit(h.checkpoint.root, t)
+                h.trunk_log.clear()
         try:
-            ops_blk, pay_blk = self._flatten(trunk, msg.seq)
+            with span("host_fold_translate", doc=doc_idx):
+                ops_blk, pay_blk = self._flatten(trunk, msg.seq)
         except UnsupportedShape:
             self._route_to_fallback(doc_idx)
             return
@@ -463,9 +579,20 @@ class TreeBatchEngine:
     @staticmethod
     def _block_upper(ops_blk: np.ndarray) -> tuple[int, int]:
         """(row, pool-word) upper bounds of an op-row block — vectorized
-        watermark accounting (ingest and resync share it)."""
+        watermark accounting (ingest and resync share it).  Tiny blocks
+        (the per-edit ingest case) take a scalar walk: numpy reductions on
+        2-row arrays cost more than the loop they replace."""
         if not len(ops_blk):
             return 0, 0
+        if len(ops_blk) <= 8:
+            t = tk._TGT
+            rows = words = 0
+            for r in ops_blk.tolist():
+                if r[0] in _GROW_KINDS:
+                    rows += r[t + 2]
+                if r[0] in _POOLED_KINDS and r[t + 5] in _POOLED_VKINDS:
+                    words += r[t + 4]
+            return rows, words
         kinds = ops_blk[:, 0]
         ins = (kinds == tk.NestedOpKind.INSERT) | (
             kinds == tk.NestedOpKind.REPLACE_FIELD
@@ -754,7 +881,7 @@ class TreeBatchEngine:
                 self.megastep_k, self.fleet_capacity, self.ops_per_step,
                 tk.NESTED_OP_FIELDS, self.max_insert_len, mesh=self.mesh,
                 doc_axis=(
-                    pm.fleet_doc_axes(self.mesh)
+                    self._pm.fleet_doc_axes(self.mesh)
                     if self.mesh is not None else "docs"
                 ),
             )
@@ -882,7 +1009,7 @@ class TreeBatchEngine:
             # Per-shard latch reduce: one scalar readback instead of a
             # cross-mesh [D] error gather on every step.
             with span("readback", kind="error_count"):
-                clean = int(pm.error_count(self.state.error)) == 0
+                clean = int(self._pm.error_count(self.state.error)) == 0
             if clean:
                 self.maybe_checkpoint()
                 return steps
@@ -1066,7 +1193,7 @@ class TreeBatchEngine:
             if rec is None or rec.get("engine") != "tree_batch":
                 continue
             h = self.hosts[d]
-            h.em = EditManager()
+            h.em = EditManager(mark_pool=self.markpool)
             h.em.load(rec["em"])
             h.base_seq = h.last_seq = int(rec["seq"])
             h.restored = True
@@ -1134,6 +1261,19 @@ class TreeBatchEngine:
             round(hits / (hits + misses), 4) if hits + misses else 0.0,
         )
         self.counters.gauge("translation_plans", len(self._plans))
+        # Mark-pool surface: hit rate = span demands answered by reusing
+        # an existing immutable span (the incremental-rebase identity
+        # reuse) over all demands; occupancy = live slots / pool storage.
+        if self.markpool is not None:
+            ps = self.markpool.stats()
+            hits = ps["mark_pool_reuse_hits"]
+            total = hits + ps["mark_pool_spans"]
+            self.counters.gauge(
+                "mark_pool_hit_rate",
+                round(hits / total, 4) if total else 0.0,
+            )
+            for k, v in ps.items():
+                self.counters.gauge(k, v)
         self.counters.gauge("recompiles", self.recompile_watchdog.recompiles)
         self.counters.gauge(
             "despecializations", self.recompile_watchdog.despecializations
@@ -1255,6 +1395,18 @@ class TreeBatchEngine:
                     f"hot shards {hot} (tree fleet cannot migrate docs)",
                 )
         return []
+
+    def adopt_boot_snapshot(self, doc_idx: int, record: dict) -> int:
+        """Parity surface with ``DocBatchEngine.adopt_boot_snapshot`` —
+        the tree fleet cannot re-seed an already-materialized doc's device
+        columns in place (same documented gap as ``refresh`` adoption and
+        ``migrations_unsupported``), so this is a COUNTED no-op returning
+        the doc's own floor: the consumer re-consumes from where the
+        engine actually is, which is correct (if slower) because the
+        ordered log replay from that floor is never gapped for a doc the
+        engine itself kept up with."""
+        self.counters.bump("boot_snapshot_unsupported")
+        return self.hosts[doc_idx].last_seq
 
     def errors(self) -> np.ndarray:
         return np.asarray(self.state.error)[: self.n_docs]

@@ -63,6 +63,18 @@ def _ensure_built() -> ctypes.CDLL | None:
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
         ]
+    # Tree wire decode: same symbol-presence gate (a stale prebuilt .so
+    # simply keeps the Python tree decode).
+    if hasattr(lib, "ing_tree_decode"):
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.ing_tree_decode.restype = ctypes.c_int32
+        lib.ing_tree_decode.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64,
+            i64p, ctypes.c_int32, i32p, ctypes.c_int32,
+            i32p, ctypes.c_int32, i32p, ctypes.c_int32,
+            i64p, ctypes.c_int32, i32p, i32p,
+        ]
     _lib_cache.append(lib)
     return lib
 
@@ -140,3 +152,76 @@ class NativeIngestEncoder:
                 max_rows *= 2
                 continue
             return ops[:n], payloads[:n]
+
+
+# ---------------------------------------------------------------------------
+# Tree wire decode
+# ---------------------------------------------------------------------------
+
+# Row widths (mirror native/ingest.cpp ing_tree_decode).
+_TREE_MSG_FIELDS = 14
+_TREE_CHG_FIELDS = 3
+_TREE_FLD_FIELDS = 4
+_TREE_MARK_FIELDS = 5
+
+TREE_ST_EDITS, TREE_ST_SKIP, TREE_ST_OPAQUE = 0, 1, 2
+
+
+def tree_decode_available() -> bool:
+    lib = _ensure_built()
+    return lib is not None and hasattr(lib, "ing_tree_decode")
+
+
+def tree_decode(data: bytes):
+    """Decode newline-separated sequenced tree messages into mark-pool
+    columns (stateless; the whole-feed grow-and-retry contract of
+    ``NativeIngestEncoder.encode``).
+
+    Returns ``(msgs, chgs, flds, marks, spans)`` numpy tables — see the
+    C header comment for layouts — or ``None`` when the library (or the
+    ``ing_tree_decode`` symbol on a stale prebuilt .so) is unavailable.
+    Raises ``ValueError`` on a malformed line (message index included),
+    matching the Python path's ownership of error semantics."""
+    lib = _ensure_built()
+    if lib is None or not hasattr(lib, "ing_tree_decode"):
+        return None
+    n_lines = data.count(b"\n") + 1
+    m_msgs = max(16, n_lines)
+    m_chgs = m_flds = max(32, 2 * n_lines)
+    m_marks = m_spans = max(64, 8 * n_lines)
+    while True:
+        msgs = np.empty((m_msgs, _TREE_MSG_FIELDS), np.int64)
+        chgs = np.empty((m_chgs, _TREE_CHG_FIELDS), np.int32)
+        flds = np.empty((m_flds, _TREE_FLD_FIELDS), np.int32)
+        marks = np.empty((m_marks, _TREE_MARK_FIELDS), np.int32)
+        spans = np.empty((m_spans, 2), np.int64)
+        counts = np.zeros((5,), np.int32)
+        err_line = np.zeros((1,), np.int32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        n = lib.ing_tree_decode(
+            data, len(data),
+            msgs.ctypes.data_as(i64p), m_msgs,
+            chgs.ctypes.data_as(i32p), m_chgs,
+            flds.ctypes.data_as(i32p), m_flds,
+            marks.ctypes.data_as(i32p), m_marks,
+            spans.ctypes.data_as(i64p), m_spans,
+            counts.ctypes.data_as(i32p),
+            err_line.ctypes.data_as(i32p),
+        )
+        if n == -1:
+            raise ValueError(
+                f"native tree decode: malformed message at line "
+                f"{int(err_line[0])}"
+            )
+        if n == -2:  # some table filled: double everything, re-run
+            m_msgs *= 2
+            m_chgs *= 2
+            m_flds *= 2
+            m_marks *= 2
+            m_spans *= 2
+            continue
+        return (
+            msgs[: counts[0]], chgs[: counts[1]], flds[: counts[2]],
+            marks[: counts[3]], spans[: counts[4]],
+        )

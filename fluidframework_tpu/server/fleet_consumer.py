@@ -28,11 +28,15 @@ that drives live doc migration (``engine.rebalance_hot_shards``).
 from __future__ import annotations
 
 import contextlib
+import http.client
 import json
 import selectors
 import socket
 
+from ..fanout.plane import RESYNC_BOOT_MARKER
 from ..models.doc_batch_engine import DocBatchEngine
+
+_BOOT_MARKER = RESYNC_BOOT_MARKER.rstrip(b"\n")
 
 
 class FleetConsumer:
@@ -46,6 +50,7 @@ class FleetConsumer:
         doc_ids: list[str],
         recv_bytes: int = 1 << 16,
         boot_store=None,
+        historian: tuple[str, int] | None = None,
     ) -> None:
         if len(doc_ids) > engine.n_docs:
             raise ValueError(
@@ -53,6 +58,18 @@ class FleetConsumer:
             )
         self.engine = engine
         self.doc_ids = list(doc_ids)
+        self._host = host
+        self._port = port
+        # Snapshot-boot tier address ((host, port) of the historian HTTP
+        # front): the client half of the fan-out plane's
+        # ``{"t":"resync","boot":true}`` contract — when a firehose falls
+        # off the retained log, the consumer fetches the latest historian
+        # snapshot, adopts it into the engine, and re-consumes from its
+        # seq.  Without it a boot marker kills the doc's socket (the
+        # supervisor restart path, the pre-PR-14 behavior).
+        self._historian = historian
+        self.boot_resyncs = 0
+        self.boot_resync_failures = 0
         self.booted_docs: list[int] = []
         if boot_store is not None:
             # Boot-from-summary: seed the engine from the latest acked
@@ -85,28 +102,42 @@ class FleetConsumer:
         self._sel = selectors.DefaultSelector()  # epoll: no FD_SETSIZE cap
         try:
             for doc_id in doc_ids:
-                s = self._connect(host, port)
+                s = self._subscribe(doc_id)
                 self._socks.append(s)  # tracked immediately: any later
-                s.sendall(              # failure closes the whole set
-                    (json.dumps({"t": "consume", "doc": doc_id}) + "\n").encode()
+                self._sel.register(   # failure closes the whole set
+                    s, selectors.EVENT_READ, len(self._socks) - 1
                 )
-                # Unbuffered ack read: a buffered reader would swallow
-                # catch-up bytes already in flight behind the ack line.
-                ack_buf = bytearray()
-                while not ack_buf.endswith(b"\n"):
-                    ch = s.recv(1)
-                    if not ch:
-                        raise RuntimeError(
-                            "connection closed during consume handshake"
-                        )
-                    ack_buf += ch
-                ack = json.loads(ack_buf)
-                if ack.get("t") != "consuming":
-                    raise RuntimeError(f"consume handshake failed: {ack}")
-                s.setblocking(False)
-                self._sel.register(s, selectors.EVENT_READ, len(self._socks) - 1)
         except BaseException:
             self.close()
+            raise
+
+    def _subscribe(self, doc_id: str, from_seq: int = 0) -> socket.socket:
+        """Open one firehose subscription (handshake done, socket
+        nonblocking); ``from_seq`` skips the already-covered prefix of the
+        catch-up (the boot-resync re-consume floor)."""
+        s = self._connect(self._host, self._port)
+        try:
+            req = {"t": "consume", "doc": doc_id}
+            if from_seq:
+                req["from"] = from_seq
+            s.sendall((json.dumps(req) + "\n").encode())
+            # Unbuffered ack read: a buffered reader would swallow
+            # catch-up bytes already in flight behind the ack line.
+            ack_buf = bytearray()
+            while not ack_buf.endswith(b"\n"):
+                ch = s.recv(1)
+                if not ch:
+                    raise RuntimeError(
+                        "connection closed during consume handshake"
+                    )
+                ack_buf += ch
+            ack = json.loads(ack_buf)
+            if ack.get("t") != "consuming":
+                raise RuntimeError(f"consume handshake failed: {ack}")
+            s.setblocking(False)
+            return s
+        except BaseException:
+            s.close()
             raise
 
     @staticmethod
@@ -182,6 +213,13 @@ class FleetConsumer:
             # the durable ack-derived floor, carried on the wire for
             # consumers that need durability-bounded windows.
             acked = acked or b'"type":"summaryAck"' in feed
+            if _BOOT_MARKER in feed:
+                # Fan-out plane drop-to-catch-up, boot flavor: the missed
+                # range left the retained log — snapshot-boot instead of
+                # consuming a gapped stream (one substring probe per
+                # chunk, same idiom as the summaryAck trigger).
+                staged += self._handle_boot_marker(idx, feed)
+                continue
             staged += self.engine.ingest_lines(idx, feed)
         self.rows_staged += staged
         if staged:
@@ -196,6 +234,71 @@ class FleetConsumer:
             self.engine.compact()
             self.engine.counters.bump("msn_compactions")
         return staged
+
+    def _handle_boot_marker(self, idx: int, feed: bytes) -> int:
+        """Consume the pre-marker prefix, then snapshot-boot: fetch the
+        latest historian snapshot, adopt it into the engine, and
+        re-subscribe the firehose from its seq.  Post-marker bytes are
+        DISCARDED — the re-subscription's catch-up re-delivers everything
+        past the adopted floor, so dropping them is what keeps the stream
+        gapless."""
+        head, _, _rest = feed.partition(_BOOT_MARKER)
+        cut = head.rfind(b"\n")
+        staged = 0
+        if cut >= 0:
+            staged += self.engine.ingest_lines(idx, head[: cut + 1])
+        self._tails[idx] = b""
+        self._boot_resync(idx)
+        return staged
+
+    def _boot_resync(self, idx: int) -> None:
+        doc_id = self.doc_ids[idx]
+        old = self._socks[idx]
+        with contextlib.suppress(KeyError, ValueError):
+            self._sel.unregister(old)
+        with contextlib.suppress(OSError):
+            old.close()
+        try:
+            if self._historian is None:
+                raise RuntimeError(
+                    "boot resync marker without a historian address"
+                )
+            # Short timeout: this fetch runs on the pump thread (boot
+            # resyncs are rare, but a wedged historian must not stall the
+            # whole fleet's drain for long — failure falls to the
+            # supervisor restart path below).
+            conn = http.client.HTTPConnection(*self._historian, timeout=5)
+            try:
+                conn.request("GET", f"/doc/{doc_id}/snapshot")
+                resp = conn.getresponse()
+                body = json.loads(resp.read() or b"{}")
+            finally:
+                conn.close()
+            if resp.status != 200:
+                raise RuntimeError(f"historian snapshot read: {body}")
+            # The historian's seq stamp is authoritative (the snapshot's
+            # commit seq), so it lands after the record's own keys.
+            record = {**body["summary"], "doc": doc_id,
+                      "seq": int(body["seq"])}
+            floor = self.engine.adopt_boot_snapshot(idx, record)
+            sock = self._subscribe(doc_id, from_seq=floor)
+        except (OSError, RuntimeError, ValueError, KeyError) as e:
+            # No snapshot to boot from (or the re-subscribe died): the doc
+            # is dead for this consumer, exactly like a server close — the
+            # supervisor restart path owns it from here.
+            self.boot_resync_failures += 1
+            self.engine.counters.bump("boot_resync_failures")
+            self.dead_socks.add(idx)
+            if self.engine.counters.logger is not None:
+                self.engine.counters.logger.error(
+                    "boot_resync_failed", f"doc {doc_id}: {e}"
+                )
+            return
+        self._socks[idx] = sock
+        self._sel.register(sock, selectors.EVENT_READ, idx)
+        self.paused_socks.discard(idx)
+        self.boot_resyncs += 1
+        self.engine.counters.bump("boot_resyncs_handled")
 
     def _apply_flow_control(self) -> None:
         """Advance the engine's watermark hysteresis and park/re-arm the
@@ -237,6 +340,8 @@ class FleetConsumer:
             paused_docs=len(self.paused_socks),
             pump_pauses=self.pump_pauses,
             pump_resumes=self.pump_resumes,
+            boot_resyncs=self.boot_resyncs,
+            boot_resync_failures=self.boot_resync_failures,
         )
         return out
 

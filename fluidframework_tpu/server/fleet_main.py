@@ -77,6 +77,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--ops-per-step", type=int, default=32)
     p.add_argument("--max-insert-len", type=int, default=8)
     p.add_argument("--idle-sleep", type=float, default=0.02)
+    p.add_argument("--historian", default=None,
+                   help="host:port of the snapshot-boot historian tier; "
+                        "enables {\"t\":\"resync\",\"boot\":true} "
+                        "handling (fetch snapshot, adopt, re-consume)")
     p.add_argument("--status-every", type=float, default=10.0)
     p.add_argument("--exit-after-rows", type=int, default=0)
     p.add_argument("--recovery", choices=("grow", "oracle", "off"),
@@ -295,8 +299,15 @@ def main(argv: list[str] | None = None) -> int:
         from .failover import LeaseHeartbeat
 
         heartbeat = LeaseHeartbeat(lease).start()
+    historian = None
+    if args.historian:
+        hh, _, hp = args.historian.rpartition(":")
+        try:
+            historian = (hh or "127.0.0.1", int(hp))
+        except ValueError:
+            p.error(f"--historian wants host:port, got {args.historian!r}")
     fc = FleetConsumer(args.host, args.port, eng, doc_ids,
-                       boot_store=boot_store)
+                       boot_store=boot_store, historian=historian)
     if fc.booted_docs:
         print(json.dumps({
             "bootedFromSummary": [doc_ids[d] for d in fc.booted_docs],

@@ -449,6 +449,304 @@ bool emit_line(Encoder& e, Scanner& s, Out& out) {
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// Tree wire decode: sequenced tree-edit batches -> mark-pool columns.
+//
+// The tree family's host fold pools sequence-field mark lists as columnar
+// spans (fluidframework_tpu/dds/tree/mark_pool.py).  This decoder parses
+// the NUMERIC PLANE of a tree op batch — message envelopes, edit framing,
+// and every mark's kind/count/id/offset — straight into flat columns, and
+// hands payloads that are genuinely object-shaped (insert content, removed
+// subtrees, nested changes, non-sequence field kinds) back to Python as
+// RAW JSON byte spans, so only those spans pay a json.loads.
+//
+// Stateless by design (no quorum/prop tables: tree identity is the
+// client-id string plus the edit's (sid, rev) revision, all returned as
+// spans), so one call decodes a whole feed chunk idempotently.  Anything
+// the columnar grammar cannot express — grouped batches, address
+// envelopes, dict-form commits with constraints, escaped key strings —
+// degrades per MESSAGE to an opaque contents span the Python side parses
+// exactly like the no-native path, and a malformed line fails the whole
+// call so the Python oracle owns error semantics.
+// ---------------------------------------------------------------------------
+
+namespace tree {
+
+// Mark kind codes — MUST match dds/tree/mark_pool.py (K_SKIP..K_MOVEIN).
+enum MarkKind { MK_SKIP = 0, MK_INSERT = 1, MK_REMOVE = 2, MK_MODIFY = 3,
+                MK_MOVEOUT = 4, MK_MOVEIN = 5 };
+
+constexpr int MSG_FIELDS = 14;   // see ing_tree_decode docstring
+constexpr int CHG_FIELDS = 3;
+constexpr int FLD_FIELDS = 4;
+constexpr int MARK_FIELDS = 5;
+
+// Message status codes.
+enum MsgStatus { ST_EDITS = 0, ST_SKIP = 1, ST_OPAQUE = 2 };
+
+struct TreeOut {
+  const char* base;
+  int64_t* msgs; int32_t max_msgs;
+  int32_t* chgs; int32_t max_chgs;
+  int32_t* flds; int32_t max_flds;
+  int32_t* marks; int32_t max_marks;
+  int64_t* spans; int32_t max_spans;
+  int32_t n_msgs = 0, n_chgs = 0, n_flds = 0, n_marks = 0, n_spans = 0;
+  bool overflow = false;
+
+  int32_t span(const char* s, const char* e) {
+    if (n_spans >= max_spans) { overflow = true; return -1; }
+    spans[2 * (int64_t)n_spans] = s - base;
+    spans[2 * (int64_t)n_spans + 1] = e - s;
+    return n_spans++;
+  }
+  int32_t* mark_row() {
+    if (n_marks >= max_marks) { overflow = true; return nullptr; }
+    int32_t* r = marks + (int64_t)n_marks++ * MARK_FIELDS;
+    r[0] = r[1] = r[2] = r[3] = 0; r[4] = -1;
+    return r;
+  }
+  int32_t* fld_row() {
+    if (n_flds >= max_flds) { overflow = true; return nullptr; }
+    int32_t* r = flds + (int64_t)n_flds++ * FLD_FIELDS;
+    r[0] = -1; r[1] = r[2] = 0; r[3] = -1;
+    return r;
+  }
+  int32_t* chg_row() {
+    if (n_chgs >= max_chgs) { overflow = true; return nullptr; }
+    int32_t* r = chgs + (int64_t)n_chgs++ * CHG_FIELDS;
+    r[0] = r[1] = 0; r[2] = -1;
+    return r;
+  }
+};
+
+// Raw escape-free string span (keys / ids / tags).  Any backslash fails —
+// the caller degrades to the opaque route, never mis-slices.
+bool span_string(Scanner& s, const char** b, const char** e) {
+  s.skip_ws();
+  if (s.p >= s.end || *s.p != '"') return false;
+  const char* q = (const char*)memchr(s.p + 1, '"', s.end - s.p - 1);
+  if (!q) return false;
+  if (memchr(s.p + 1, '\\', q - s.p - 1)) return false;
+  *b = s.p + 1; *e = q; s.p = q + 1;
+  return true;
+}
+
+// Record the extent of one JSON value as a span (payload handoff).
+int32_t value_span(Scanner& s, TreeOut& out) {
+  s.skip_ws();
+  const char* start = s.p;
+  if (!skip_value(s)) return -2;  // malformed
+  return out.span(start, s.p);
+}
+
+bool parse_i64(Scanner& s, int64_t* v) {
+  double d;
+  if (!parse_number(s, &d)) return false;
+  *v = (int64_t)d;
+  return true;
+}
+
+// One mark array element; emits one mark row.  Returns false on malformed
+// input (whole-line error: Python owns the failure semantics).
+bool parse_mark(Scanner& s, TreeOut& out) {
+  if (!s.consume('[')) return false;
+  const char* tb; const char* te;
+  if (!span_string(s, &tb, &te)) return false;
+  size_t tl = te - tb;
+  int32_t* row = out.mark_row();
+  if (row == nullptr) return false;  // overflow: caller retries the call
+  int64_t v = 0;
+  if (tl == 1 && *tb == 's') {
+    row[0] = MK_SKIP;
+    if (!s.consume(',') || !parse_i64(s, &v)) return false;
+    row[1] = (int32_t)v;
+  } else if (tl == 1 && *tb == 'i') {
+    row[0] = MK_INSERT;
+    if (!s.consume(',')) return false;
+    row[4] = value_span(s, out);
+    if (row[4] == -2) return false;
+  } else if (tl == 1 && *tb == 'r') {
+    row[0] = MK_REMOVE;
+    if (!s.consume(',') || !parse_i64(s, &v)) return false;
+    row[1] = (int32_t)v;
+    if (s.peek() == ',') {
+      s.consume(',');
+      row[4] = value_span(s, out);
+      if (row[4] == -2) return false;
+    }
+  } else if (tl == 1 && *tb == 'm') {
+    row[0] = MK_MODIFY;
+    row[1] = 1;
+    if (!s.consume(',')) return false;
+    row[4] = value_span(s, out);
+    if (row[4] == -2) return false;
+  } else if (tl == 2 && tb[0] == 'm' && tb[1] == 'o') {
+    row[0] = MK_MOVEOUT;
+    if (!s.consume(',') || !parse_i64(s, &v)) return false;
+    row[1] = (int32_t)v;
+    if (!s.consume(',') || !parse_i64(s, &v)) return false;
+    row[2] = (int32_t)v;
+    if (s.peek() == ',') {
+      s.consume(',');
+      if (!parse_i64(s, &v)) return false;
+      row[3] = (int32_t)v;
+    }
+  } else if (tl == 2 && tb[0] == 'm' && tb[1] == 'i') {
+    row[0] = MK_MOVEIN;
+    if (!s.consume(',') || !parse_i64(s, &v)) return false;
+    row[2] = (int32_t)v;  // id
+    if (!s.consume(',') || !parse_i64(s, &v)) return false;
+    row[1] = (int32_t)v;  // count
+    row[3] = -1;          // offset None sentinel (mark_pool._NONE_OFF)
+    if (s.peek() == ',') {
+      s.consume(',');
+      if (s.peek() == 'n') { s.p += 4; }
+      else if (parse_i64(s, &v)) row[3] = (int32_t)v;
+      else return false;
+    }
+  } else {
+    return false;  // unknown tag: Python raises on it, so do we
+  }
+  return s.consume(']');
+}
+
+// One NodeChange object {"v": [...], "f": {key: fieldchange}}.
+bool parse_change(Scanner& s, TreeOut& out) {
+  int32_t* chg = out.chg_row();
+  int32_t fld_start = out.n_flds;
+  int32_t v_span = -1;
+  if (!s.consume('{')) return false;
+  if (!s.consume('}')) {
+    while (true) {
+      const char* kb; const char* ke;
+      if (!span_string(s, &kb, &ke)) return false;
+      if (!s.consume(':')) return false;
+      size_t kl = ke - kb;
+      if (kl == 1 && *kb == 'v') {
+        v_span = value_span(s, out);
+        if (v_span == -2) return false;
+      } else if (kl == 1 && *kb == 'f') {
+        if (!s.consume('{')) return false;
+        if (!s.consume('}')) {
+          while (true) {
+            const char* fb; const char* fe;
+            if (!span_string(s, &fb, &fe)) return false;
+            if (!s.consume(':')) return false;
+            int32_t* fld = out.fld_row();
+            int32_t key_span = out.span(fb, fe);
+            int32_t mark_start = out.n_marks;
+            if (s.peek() == '[') {
+              s.consume('[');
+              if (!s.consume(']')) {
+                while (true) {
+                  if (!parse_mark(s, out)) return false;
+                  if (s.consume(',')) continue;
+                  if (!s.consume(']')) return false;
+                  break;
+                }
+              }
+              if (fld != nullptr) {
+                fld[0] = key_span;
+                fld[1] = mark_start;
+                fld[2] = out.n_marks - mark_start;
+              }
+            } else {
+              // Non-sequence field kind: raw span, Python's registry
+              // decodes it (same as the no-native path).
+              int32_t os = value_span(s, out);
+              if (os == -2) return false;
+              if (fld != nullptr) {
+                fld[0] = key_span;
+                fld[3] = os;
+              }
+            }
+            if (s.consume(',')) continue;
+            if (!s.consume('}')) return false;
+            break;
+          }
+        }
+      } else if (!skip_value(s)) {
+        return false;
+      }
+      if (s.consume(',')) continue;
+      if (!s.consume('}')) return false;
+      break;
+    }
+  }
+  if (chg != nullptr) {
+    chg[0] = fld_start;
+    chg[1] = out.n_flds - fld_start;
+    chg[2] = v_span;
+  }
+  return true;
+}
+
+enum ContentsResult { CT_EDIT, CT_OPAQUE, CT_ERROR };
+
+// Parse contents as a direct {"type":"edit", "sid", "rev", "changes":[..]}
+// object.  Emits chg/fld/mark/span rows as it goes; a shape the grammar
+// cannot express rolls those rows back and reports CT_OPAQUE (the caller
+// records the raw span instead).
+ContentsResult parse_edit_contents(
+    Scanner& s, TreeOut& out, int64_t* sid_off, int64_t* sid_len,
+    int64_t* rev, int32_t* chg_start, int32_t* chg_count) {
+  int32_t m0 = out.n_msgs, c0 = out.n_chgs, f0 = out.n_flds;
+  int32_t k0 = out.n_marks, s0 = out.n_spans;
+  (void)m0;
+  bool is_edit = false, saw_changes = false;
+  *chg_start = out.n_chgs;
+  if (!s.consume('{')) return CT_OPAQUE;
+  if (!s.consume('}')) {
+    while (true) {
+      const char* kb; const char* ke;
+      if (!span_string(s, &kb, &ke)) goto opaque;
+      if (!s.consume(':')) return CT_ERROR;
+      {
+        size_t kl = ke - kb;
+        if (kl == 4 && memcmp(kb, "type", 4) == 0) {
+          const char* vb; const char* ve;
+          if (!span_string(s, &vb, &ve)) goto opaque;
+          if (ve - vb != 4 || memcmp(vb, "edit", 4) != 0) goto opaque;
+          is_edit = true;
+        } else if (kl == 3 && memcmp(kb, "sid", 3) == 0) {
+          const char* vb; const char* ve;
+          if (!span_string(s, &vb, &ve)) goto opaque;
+          *sid_off = vb - out.base;
+          *sid_len = ve - vb;
+        } else if (kl == 3 && memcmp(kb, "rev", 3) == 0) {
+          if (!parse_i64(s, rev)) goto opaque;
+        } else if (kl == 7 && memcmp(kb, "changes", 7) == 0) {
+          if (s.peek() != '[') goto opaque;  // dict form (constraints)
+          s.consume('[');
+          saw_changes = true;
+          if (!s.consume(']')) {
+            while (true) {
+              if (!parse_change(s, out)) return CT_ERROR;
+              if (s.consume(',')) continue;
+              if (!s.consume(']')) return CT_ERROR;
+              break;
+            }
+          }
+        } else if (!skip_value(s)) {
+          return CT_ERROR;
+        }
+      }
+      if (s.consume(',')) continue;
+      if (!s.consume('}')) return CT_ERROR;
+      break;
+    }
+  }
+  if (!is_edit || !saw_changes) goto opaque;
+  *chg_count = out.n_chgs - *chg_start;
+  return CT_EDIT;
+opaque:
+  out.n_chgs = c0; out.n_flds = f0; out.n_marks = k0; out.n_spans = s0;
+  return CT_OPAQUE;
+}
+
+}  // namespace tree
+
 }  // namespace
 
 extern "C" {
@@ -488,6 +786,126 @@ int32_t ing_encode(void* h, const char* data, int64_t len,
     p = nl ? nl + 1 : end;
   }
   return out.n;
+}
+
+// Tree wire decode (see the tree:: namespace header comment).
+//
+// Layouts (row-major):
+//   out_msgs  int64[max_msgs, 14]: seq, ref, min_seq, rev, client_off,
+//             client_len, sid_off, sid_len, chg_start, chg_count, status
+//             (0 edits, 1 skip, 2 opaque), opq_off, opq_len, client_seq
+//   out_chgs  int32[max_chgs, 3]: fld_start, fld_count, v_span
+//   out_flds  int32[max_flds, 4]: key_span, mark_start, mark_count,
+//             opaque_span (>=0: non-sequence field change JSON)
+//   out_marks int32[max_marks, 5]: kind, a, b, c, payload_span
+//   out_spans int64[max_spans, 2]: byte offset, byte length (into data)
+//
+// Returns the message count (counts for all five tables in out_counts),
+// -1 on a malformed line (*err_line = its index; the caller falls back to
+// the Python decode, which owns error semantics), or -2 when any output
+// table filled (caller doubles capacities and re-runs; the decode is
+// stateless so a re-run is safe).
+int32_t ing_tree_decode(const char* data, int64_t len,
+                        int64_t* out_msgs, int32_t max_msgs,
+                        int32_t* out_chgs, int32_t max_chgs,
+                        int32_t* out_flds, int32_t max_flds,
+                        int32_t* out_marks, int32_t max_marks,
+                        int64_t* out_spans, int32_t max_spans,
+                        int32_t* out_counts, int32_t* err_line) {
+  using namespace tree;
+  TreeOut out{data, out_msgs, max_msgs, out_chgs, max_chgs,
+              out_flds, max_flds, out_marks, max_marks,
+              out_spans, max_spans};
+  *err_line = -1;
+  const char* p = data;
+  const char* end = data + len;
+  int32_t line_idx = -1;
+  while (p < end) {
+    const char* nl = (const char*)memchr(p, '\n', end - p);
+    const char* line_end = nl ? nl : end;
+    if (line_end > p) {
+      line_idx++;
+      if (out.n_msgs >= max_msgs) return -2;
+      int64_t* m = out_msgs + (int64_t)out.n_msgs * MSG_FIELDS;
+      for (int i = 0; i < MSG_FIELDS; i++) m[i] = 0;
+      m[10] = ST_SKIP;
+      Scanner s{p, line_end};
+      bool is_op = false;
+      const char* cstart = nullptr;
+      const char* cend = nullptr;
+      if (!s.consume('{')) { *err_line = line_idx; return -1; }
+      if (!s.consume('}')) {
+        while (true) {
+          const char* kb; const char* ke;
+          if (!span_string(s, &kb, &ke)) { *err_line = line_idx; return -1; }
+          if (!s.consume(':')) { *err_line = line_idx; return -1; }
+          size_t kl = ke - kb;
+          bool ok = true;
+          if (kl == 14 && memcmp(kb, "sequenceNumber", 14) == 0) {
+            ok = parse_i64(s, &m[0]);
+          } else if (kl == 23 &&
+                     memcmp(kb, "referenceSequenceNumber", 23) == 0) {
+            ok = parse_i64(s, &m[1]);
+          } else if (kl == 21 &&
+                     memcmp(kb, "minimumSequenceNumber", 21) == 0) {
+            ok = parse_i64(s, &m[2]);
+          } else if (kl == 4 && memcmp(kb, "type", 4) == 0) {
+            const char* vb; const char* ve;
+            ok = span_string(s, &vb, &ve);
+            is_op = ok && (ve - vb == 2) && memcmp(vb, "op", 2) == 0;
+          } else if (kl == 20 &&
+                     memcmp(kb, "clientSequenceNumber", 20) == 0) {
+            ok = parse_i64(s, &m[13]);
+          } else if (kl == 8 && memcmp(kb, "clientId", 8) == 0) {
+            const char* vb; const char* ve;
+            ok = span_string(s, &vb, &ve);
+            if (ok) { m[4] = vb - data; m[5] = ve - vb; }
+          } else if (kl == 8 && memcmp(kb, "contents", 8) == 0) {
+            s.skip_ws();
+            cstart = s.p;
+            ok = skip_value(s);
+            cend = s.p;
+          } else {
+            ok = skip_value(s);
+          }
+          if (!ok) { *err_line = line_idx; return -1; }
+          if (s.consume(',')) continue;
+          if (s.consume('}')) break;
+          *err_line = line_idx;
+          return -1;
+        }
+      }
+      if (is_op && cstart != nullptr) {
+        Scanner cs{cstart, cend};
+        int32_t chg_start = 0, chg_count = 0;
+        ContentsResult r = parse_edit_contents(
+            cs, out, &m[6], &m[7], &m[3], &chg_start, &chg_count);
+        if (r == CT_ERROR) {
+          if (out.overflow) return -2;  // table filled mid-parse: retry
+          *err_line = line_idx;
+          return -1;
+        }
+        if (r == CT_EDIT) {
+          m[8] = chg_start;
+          m[9] = chg_count;
+          m[10] = ST_EDITS;
+        } else {
+          m[10] = ST_OPAQUE;
+          m[11] = cstart - data;
+          m[12] = cend - cstart;
+        }
+      }
+      if (out.overflow) return -2;
+      out.n_msgs++;
+    }
+    p = nl ? nl + 1 : end;
+  }
+  out_counts[0] = out.n_msgs;
+  out_counts[1] = out.n_chgs;
+  out_counts[2] = out.n_flds;
+  out_counts[3] = out.n_marks;
+  out_counts[4] = out.n_spans;
+  return out.n_msgs;
 }
 
 // Export the property interning table: writes up to max_entries

@@ -27,7 +27,8 @@ import pytest
 from fluidframework_tpu.analysis import cli as check_cli
 from fluidframework_tpu.analysis.core import Baseline, load_package
 from fluidframework_tpu.analysis import (
-    determinism, donation, jit_safety, layer_check, swallowed, threads,
+    determinism, donation, jit_safety, layer_check, markchurn, swallowed,
+    threads,
 )
 
 REPO = Path(__file__).resolve().parent.parent
@@ -924,6 +925,86 @@ def test_package_is_clean():
     )
 
 
+# ---------------------------------------------------------------------------
+# Pass 7: fold-mark-churn
+# ---------------------------------------------------------------------------
+
+CHURN_SCOPE = {
+    "files": ["fixturepkg/fold/pool.py"],
+    "classes": ["Skip", "Remove"],
+    "exempt_functions": ["to_marks"],
+}
+
+
+def test_fold_mark_churn_fires_on_loop_and_comprehension(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "fold/pool.py": (
+            "class Skip:\n"
+            "    def __init__(self, n):\n"
+            "        self.n = n\n"
+            "def fold(counts):\n"
+            "    out = []\n"
+            "    for c in counts:\n"
+            "        out.append(Skip(c))\n"
+            "    return out\n"
+            "def fold2(counts):\n"
+            "    return [Skip(c) for c in counts]\n"
+        ),
+    })
+    found = markchurn.run(load_package(pkg), CHURN_SCOPE)
+    assert [f.rule for f in found] == ["fold-mark-churn"] * 2
+    details = sorted(f.detail for f in found)
+    assert details == ["Skip in fold (loop)", "Skip in fold2 (comprehension)"]
+
+
+def test_fold_mark_churn_good_twins_silent(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "fold/pool.py": (
+            "class Skip:\n"
+            "    def __init__(self, n):\n"
+            "        self.n = n\n"
+            "class Remove:\n"
+            "    def __init__(self, n):\n"
+            "        self.n = n\n"
+            # one-off construction outside any loop: fine
+            "def head(c):\n"
+            "    return Skip(c)\n"
+            # the sanctioned materialization boundary, by name
+            "def to_marks(counts):\n"
+            "    return [Skip(c) for c in counts]\n"
+            # column rows in a loop: the pooled idiom, no mark objects
+            "def fold(counts):\n"
+            "    rows = []\n"
+            "    for c in counts:\n"
+            "        rows.append((0, c, 0, 0, None))\n"
+            "    return rows\n"
+        ),
+        # churn OUTSIDE the scoped files (the object oracle): fine
+        "oracle/changeset.py": (
+            "class Skip:\n"
+            "    def __init__(self, n):\n"
+            "        self.n = n\n"
+            "def rebase(counts):\n"
+            "    return [Skip(c) for c in counts]\n"
+        ),
+    })
+    assert markchurn.run(load_package(pkg), CHURN_SCOPE) == []
+
+
+def test_fold_mark_churn_disabled_without_scope(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "fold/pool.py": (
+            "class Skip:\n"
+            "    def __init__(self, n):\n"
+            "        self.n = n\n"
+            "def fold(counts):\n"
+            "    return [Skip(c) for c in counts]\n"
+        ),
+    })
+    assert markchurn.run(load_package(pkg), None) == []
+    assert markchurn.run(load_package(pkg), {}) == []
+
+
 def _copy_pkg(tmp_path: Path) -> Path:
     dst = tmp_path / "fluidframework_tpu"
     shutil.copytree(
@@ -971,6 +1052,15 @@ SEEDINGS = [
          "        pass\n"
      ),
      "swallowed-exception", "swallowed-exception"),
+    ("dds/tree/mark_pool.py",
+     lambda s: s + (
+         "\n\ndef _seeded_churn(pool, counts):\n"
+         "    out = []\n"
+         "    for c in counts:\n"
+         "        out.append(Skip(c))\n"
+         "    return pool_marks(pool, out)\n"
+     ),
+     "fold-mark-churn", "fold-mark-churn"),
 ]
 
 
