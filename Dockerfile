@@ -32,6 +32,13 @@ COPY deploy ./deploy
 # the ctypes loaders (fluidframework_tpu/native/*_native.py).
 RUN pip install --no-cache-dir -e .
 
+# Static-analysis gate: the image FAILS TO BUILD on any unbaselined
+# fftpu-check finding (all 11 passes — layering, jit/donation safety,
+# determinism, thread/lock discipline, blocking-under-lock, mesh safety).
+# Pure AST, no JAX import, ~10s; a hazardous tree never becomes a
+# deployable service image.
+RUN python -m fluidframework_tpu.analysis.cli fluidframework_tpu --json
+
 # Pre-build the native libraries so containers start warm; failure is
 # non-fatal (the ctypes loaders rebuild on demand at first use).
 RUN (g++ -O2 -shared -fPIC -std=c++17 -o native/libtpusequencer.so native/sequencer.cpp \

@@ -736,6 +736,11 @@ def _static_analysis_probe() -> dict:
         "n_baselined": len(result["suppressed"]),
         "n_stale_baseline": len(result["stale_baseline"]),
         "n_modules": result["n_modules"],
+        # Per-pass wall time: the gate's own budget, tracked next to the
+        # numbers it guards (the suite is 11 passes now — a pass that
+        # quietly goes quadratic should show up in the artifact, not in
+        # someone's pre-commit patience).
+        "pass_times_ms": result["pass_times_ms"],
     }
 
 

@@ -4,16 +4,29 @@ Reference parity: the Fluid repo machine-enforces its architecture
 (``layerInfo.json`` + the ``layer-check`` build command, SURVEY §1).  This
 package is that idea widened to the hazard classes this repro's own history
 documents: the PR 4 staging-aliasing bug was a use-after-donate, the PR 7
-recompile watchdog only catches trace despecialization at *runtime*, and
+recompile watchdog only catches trace despecialization at *runtime*,
 byte-identity convergence (BASELINE.json's core invariant) dies silently to
-any nondeterministic host-path construct.  Five passes, pure AST (no JAX
-import), findings suppressible via a committed ``baseline.json``:
+any nondeterministic host-path construct, and the PR 11-13 concurrency
+plane's lock/donation laws lived only in CHANGES.md prose.  Eleven passes,
+pure AST (no JAX import), findings suppressible via a committed
+``baseline.json``:
 
-- ``layer_check``    — downward-only imports per ``layers.json``
-- ``jit_safety``     — trace hazards reachable from jit/shard_map entries
-- ``donation``       — use-after-donate of ``donate_argnums`` arguments
-- ``determinism``    — nondeterministic constructs in byte-identity paths
-- ``threads``        — unlocked cross-thread attribute mutation
+- ``layer_check``      — downward-only imports per ``layers.json``
+- ``jit_safety``       — trace hazards reachable from jit/shard_map entries
+- ``donation``         — use-after-donate of ``donate_argnums`` arguments
+- ``determinism``      — nondeterministic constructs in byte-identity paths
+- ``threads``          — unlocked cross-thread attribute mutation
+- ``swallowed``        — silently dropped exceptions in serving layers
+- ``markchurn``        — mark-object churn back in the pooled tree fold
+- ``lock_order``       — static deadlock detection (lock-acquisition graph)
+- ``lock_consistency`` — lockset guard checking (lock A here, B there)
+- ``blocking``         — blocking syscalls under declared critical locks
+- ``mesh_safety``      — collective axis/spec/donation hazards in
+  shard_map programs
+
+The lock passes share one call-graph/lock-inheritance engine
+(``core.PackageView``/``LockFlowScan``/``walk_lock_flow``) — per-pass
+visitors over one worklist, not four private walkers.
 
 Run ``fftpu-check fluidframework_tpu/`` (registered in pyproject), or see
 ``tests/test_analysis.py::test_package_is_clean`` — the tier-1 gate that

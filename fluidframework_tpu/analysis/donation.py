@@ -26,8 +26,8 @@ from __future__ import annotations
 
 import ast
 
-from .core import Finding, Module, PackageIndex, dotted_name
-from .jit_safety import build_func_index, scan_registrations
+from .core import Finding, Module, PackageIndex, build_func_index, dotted_name
+from .jit_safety import scan_registrations
 
 
 def _donators(index: PackageIndex) -> dict:

@@ -39,7 +39,18 @@ from __future__ import annotations
 import ast
 from dataclasses import dataclass, field
 
-from .core import Finding, Module, PackageIndex, dotted_name, resolve
+from .core import (  # noqa: F401  (FuncInfo/build_func_index/resolve_in
+    # re-exported: the donation + mesh-safety passes import them from here
+    # and from core interchangeably)
+    Finding,
+    FuncInfo,
+    Module,
+    PackageIndex,
+    build_func_index,
+    dotted_name,
+    resolve,
+    resolve_in,
+)
 
 JIT_NAMES = {"jax.jit"}
 SHARD_MAP_NAMES = {"jax.experimental.shard_map.shard_map", "shard_map"}
@@ -61,52 +72,9 @@ STATIC_ATTRS = {
 
 
 # --------------------------------------------------------------------------
-# Function index + jit registration scanning (shared with the donation pass)
+# Jit registration scanning (shared with the donation + mesh-safety passes;
+# the function index itself lives in core.build_func_index)
 # --------------------------------------------------------------------------
-
-@dataclass
-class FuncInfo:
-    mod: Module
-    node: ast.AST                 # FunctionDef | Lambda
-    qualname: str                 # "pkg.mod.f" / "pkg.mod.Class.m"
-    class_name: str | None = None
-
-    def params(self) -> list[str]:
-        a = self.node.args
-        names = [p.arg for p in a.posonlyargs + a.args]
-        return names
-
-    def kwonly(self) -> list[str]:
-        return [p.arg for p in self.node.args.kwonlyargs]
-
-
-def build_func_index(index: PackageIndex) -> dict:
-    out: dict = {}
-    for mod in index.modules:
-        for node in mod.tree.body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                out[f"{mod.modname}.{node.name}"] = FuncInfo(mod, node, f"{mod.modname}.{node.name}")
-            elif isinstance(node, ast.ClassDef):
-                for sub in node.body:
-                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                        q = f"{mod.modname}.{node.name}.{sub.name}"
-                        out[q] = FuncInfo(mod, sub, q, class_name=node.name)
-    return out
-
-
-def resolve_in(mod: Module, aliases: dict, expr: ast.AST) -> str | None:
-    """``resolve`` + fallback: unqualified references (no import alias on
-    the head) are module-local definitions -> ``<modname>.<name>``."""
-    dn = dotted_name(expr)
-    if dn is None:
-        return None
-    if dn.split(".")[0] in aliases:
-        return resolve(expr, aliases)
-    pkg_root = mod.modname.split(".")[0]
-    if dn.startswith(pkg_root + ".") or dn == pkg_root:
-        return dn
-    return f"{mod.modname}.{dn}"
-
 
 def _const_index_set(node: ast.AST | None) -> set:
     """static_argnums/donate_argnums literal -> set of ints."""

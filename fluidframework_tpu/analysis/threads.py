@@ -1,21 +1,23 @@
 """Pass 5 — thread-shared-state: unlocked cross-thread attribute mutation.
 
 The serving tier runs real threads — socketserver per-connection handlers,
-``_QueuedWriter`` drain threads, the launcher's crash-restart supervisor,
+the fanout writer drain thread, the launcher's crash-restart supervisor,
 metrics scrape handlers.  An attribute written from a thread body and read
 from the host path without a common lock is a data race that presents as
 a once-a-week flaky test (or a torn port number mid-rebalance).
 
-Mechanics (per module, pure AST):
+Mechanics (per module, on the shared ``core`` walkers):
 
 1. **Thread entries** — ``threading.Thread(target=X)`` where ``X`` is
    ``self.method``, a module function, or ``var.method`` with ``var``'s
-   class known (constructor assignment or annotation); plus ``handle`` /
-   ``do_*`` methods of ``socketserver``/``http.server`` handler subclasses
-   (the library spawns those per request).
-2. **Reachability** — entry bodies plus transitively called same-class
-   ``self.`` methods, module functions, and methods on locally-typed vars.
-   A callee reached ONLY from under a lock inherits the lock.
+   class known (constructor assignment or annotation); ``threading.Timer``
+   functions; ``ThreadPoolExecutor`` submit/map callables; plus ``handle``
+   / ``do_*`` methods of ``socketserver``/``http.server`` handler
+   subclasses (the library spawns those per request).
+2. **Reachability** — ``core.walk_lock_flow``: entry bodies plus
+   transitively called same-class ``self.`` methods, module functions, and
+   methods on locally-typed vars.  A callee reached ONLY from under a lock
+   inherits the lock (the held set rides the call edge).
 3. **Lock model** — ``with <name-or-attr>:`` counts as lock-held (covers
    ``Lock``/``RLock``/``Condition`` attributes; non-call context
    expressions are overwhelmingly locks in this codebase).
@@ -26,84 +28,27 @@ Mechanics (per module, pure AST):
 
 Thread-safe containers (``queue.Queue``, ``collections.deque`` method
 calls) never trip this pass: method *calls* are not attribute writes.
+
+The *which-lock* refinement — a write guarded by lock A here and lock B
+(or nothing) there — is the ``lock-consistency`` pass, which shares this
+pass's entry discovery and walker.
 """
 
 from __future__ import annotations
 
 import ast
-from dataclasses import dataclass
 
-from .core import Finding, Module, PackageIndex, dotted_name, resolve
-
-HANDLER_BASES = {
-    "StreamRequestHandler", "BaseRequestHandler", "DatagramRequestHandler",
-    "BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
-}
-
-
-@dataclass(frozen=True)
-class FuncKey:
-    class_name: str | None
-    name: str
-
-
-class _ModuleView:
-    """Per-module symbol tables the pass needs."""
-
-    def __init__(self, mod: Module) -> None:
-        self.mod = mod
-        self.aliases = mod.aliases()
-        self.functions: dict = {}    # FuncKey -> FunctionDef
-        self.classes: dict = {}      # name -> ClassDef
-        for node in mod.tree.body:
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                self.functions[FuncKey(None, node.name)] = node
-            elif isinstance(node, ast.ClassDef):
-                self.classes[node.name] = node
-                for sub in node.body:
-                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                        self.functions[FuncKey(node.name, sub.name)] = sub
-
-    def handler_classes(self) -> set:
-        out = set()
-        for name, node in self.classes.items():
-            for base in node.bases:
-                dn = dotted_name(base) or ""
-                if dn.split(".")[-1] in HANDLER_BASES:
-                    out.add(name)
-        return out
-
-
-def _local_types(fn: ast.AST, view: _ModuleView) -> dict:
-    """var name -> class name, from ``x = ClassName(...)`` and ``x: T``
-    annotations (string annotations included)."""
-    out: dict = {}
-    for node in ast.walk(fn):
-        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
-                and isinstance(node.targets[0], ast.Name) \
-                and isinstance(node.value, ast.Call):
-            dn = dotted_name(node.value.func)
-            if dn in view.classes:
-                out[node.targets[0].id] = dn
-        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
-            ann = node.annotation
-            txt = (ann.value if isinstance(ann, ast.Constant)
-                   else ast.unparse(ann))
-            head = str(txt).strip().strip('"\'').split("[")[0].split(".")[-1]
-            if head in view.classes:
-                out[node.target.id] = head
-    # Parameter annotations.
-    args = getattr(fn, "args", None)
-    if args is not None:
-        for p in args.posonlyargs + args.args + args.kwonlyargs:
-            if p.annotation is not None:
-                txt = (p.annotation.value if isinstance(p.annotation, ast.Constant)
-                       else ast.unparse(p.annotation))
-                head = str(txt).strip().strip('"\'').split("[")[0].split(".")[-1]
-                if head in view.classes:
-                    out[p.arg] = head
-    return out
-
+from .core import (
+    Finding,
+    FuncKey,
+    LockFlowScan,
+    LockNamer,
+    ModuleView,
+    PackageIndex,
+    local_types,
+    resolve,
+    walk_lock_flow,
+)
 
 _EXECUTOR_NAMES = (
     "concurrent.futures.ThreadPoolExecutor", "futures.ThreadPoolExecutor",
@@ -111,7 +56,7 @@ _EXECUTOR_NAMES = (
 )
 
 
-def _note_entry(target, fn_key: FuncKey, types: dict, view: _ModuleView,
+def _note_entry(target, fn_key: FuncKey, types: dict, view: ModuleView,
                 entries: list) -> None:
     """Resolve a callable expression handed to a thread runtime (Thread
     target, Timer function, executor submit/map fn) to a FuncKey."""
@@ -150,13 +95,13 @@ def _executor_vars(fn: ast.AST, aliases) -> set:
     return out
 
 
-def _thread_entries(view: _ModuleView) -> list:
+def thread_entries(view: ModuleView) -> list:
     """FuncKeys the runtime invokes on their own thread: Thread targets,
     Timer functions, ThreadPoolExecutor submit/map callables, and
-    socketserver/http handler methods."""
+    socketserver/http handler methods.  Shared with lock-consistency."""
     entries: list = []
     for fn_key, fn in view.functions.items():
-        types = _local_types(fn, view)
+        types = local_types(fn, view)
         executors = _executor_vars(fn, view.aliases)
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
@@ -192,134 +137,63 @@ def _thread_entries(view: _ModuleView) -> list:
     return entries
 
 
-def _is_lock_with(item: ast.withitem) -> bool:
-    return isinstance(item.context_expr, (ast.Name, ast.Attribute))
-
-
-class _ReachScan:
-    """Collect call edges + attribute writes, tracking lock depth."""
-
-    def __init__(self, view: _ModuleView, fn_key: FuncKey, locked: bool) -> None:
-        self.view = view
-        self.fn_key = fn_key
-        self.types = _local_types(view.functions[fn_key], view)
-        self.base_locked = locked
-        self.writes: list = []     # (attr, line, locked)
-        self.edges: list = []      # (FuncKey, locked_at_callsite)
-
-    def run(self) -> None:
-        fn = self.view.functions[self.fn_key]
-        self._scan(fn.body, self.base_locked)
-
-    def _scan(self, stmts: list, locked: bool) -> None:  # noqa: C901
-        for st in stmts:
-            if isinstance(st, ast.With):
-                inner = locked or any(_is_lock_with(i) for i in st.items)
-                for i in st.items:
-                    self._expr(i.context_expr, locked)
-                self._scan(st.body, inner)
-                continue
-            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-                continue
-            if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-                targets = (st.targets if isinstance(st, ast.Assign)
-                           else [st.target])
-                for t in targets:
-                    self._note_write(t, locked)
-                if getattr(st, "value", None) is not None:
-                    self._expr(st.value, locked)
-                continue
-            if isinstance(st, (ast.If, ast.While)):
-                self._expr(st.test, locked)
-                self._scan(st.body, locked)
-                self._scan(st.orelse, locked)
-                continue
-            if isinstance(st, ast.For):
-                self._expr(st.iter, locked)
-                self._scan(st.body, locked)
-                self._scan(st.orelse, locked)
-                continue
-            if isinstance(st, ast.Try):
-                self._scan(st.body, locked)
-                for h in st.handlers:
-                    self._scan(h.body, locked)
-                self._scan(st.orelse, locked)
-                self._scan(st.finalbody, locked)
-                continue
-            for node in ast.walk(st):
-                if isinstance(node, ast.expr):
-                    self._expr(node, locked, walk=False)
-
-    def _note_write(self, target: ast.AST, locked: bool) -> None:
-        if isinstance(target, (ast.Tuple, ast.List)):
-            for e in target.elts:
-                self._note_write(e, locked)
-            return
-        if isinstance(target, ast.Starred):
-            self._note_write(target.value, locked)
-            return
-        if isinstance(target, ast.Subscript) and isinstance(
-                target.value, ast.Attribute):
-            # self.x[k] = v mutates the container held by attr x.
-            target = target.value
-        if isinstance(target, ast.Attribute):
-            is_self = (isinstance(target.value, ast.Name)
-                       and target.value.id == "self")
-            self.writes.append((target.attr, target.lineno, locked, is_self))
-
-    def _expr(self, node: ast.AST, locked: bool, walk: bool = True) -> None:
-        nodes = ast.walk(node) if walk else [node]
-        for n in nodes:
-            if isinstance(n, ast.Call):
-                self._call(n, locked)
-
-    def _call(self, call: ast.Call, locked: bool) -> None:
+def local_resolver(view: ModuleView, key: FuncKey, types: dict):
+    """Module-scoped call resolution (the per-module passes' flavor of
+    ``PackageView.resolve_call``): module functions, ``self.`` methods,
+    locally-typed var methods."""
+    def _resolve(call: ast.Call, _types=types) -> FuncKey | None:
         func = call.func
         if isinstance(func, ast.Name):
-            key = FuncKey(None, func.id)
-            if key in self.view.functions:
-                self.edges.append((key, locked))
-        elif isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            k = FuncKey(None, func.id)
+            if k in view.functions:
+                return k
+        elif isinstance(func, ast.Attribute) and isinstance(
+                func.value, ast.Name):
             base, meth = func.value.id, func.attr
-            cls = None
-            if base == "self":
-                cls = self.fn_key.class_name
-            elif base in self.types:
-                cls = self.types[base]
+            cls = key.class_name if base == "self" else _types.get(base)
             if cls is not None:
-                key = FuncKey(cls, meth)
-                if key in self.view.functions:
-                    self.edges.append((key, locked))
+                k = FuncKey(cls, meth)
+                if k in view.functions:
+                    return k
+        return None
+    return _resolve
+
+
+def module_lock_scans(view: ModuleView, entries: list,
+                      shared_locks: frozenset = frozenset()) -> dict:
+    """Walk a module's thread-reachable code with lock inheritance; returns
+    ``{FuncKey: {held_frozenset: LockFlowScan | None}}``.  Shared by the
+    threads and lock-consistency passes."""
+    namer = LockNamer(shared_locks)
+    mod = view.mod
+
+    def make_scan(key, held):
+        fn = view.functions.get(key)
+        if fn is None:
+            return None
+        types = local_types(fn, view)
+        return LockFlowScan(
+            fn, held, namer, modname=mod.modname,
+            class_name=key.class_name, types=types,
+            resolver=local_resolver(view, key, types),
+        ).run()
+
+    return walk_lock_flow([(k, frozenset()) for k in entries], make_scan)
 
 
 def run(index: PackageIndex) -> list[Finding]:
     findings: list[Finding] = []
     for mod in index.modules:
-        view = _ModuleView(mod)
-        entries = _thread_entries(view)
+        view = ModuleView(mod)
+        entries = thread_entries(view)
         if not entries:
             continue
 
-        # Reachability with lock inheritance: state[key] = unlocked-reached?
-        # (reached unlocked anywhere wins over locked).
-        state: dict = {}
-        work: list = [(k, False) for k in entries]
-        scans: dict = {}
-        while work:
-            key, locked = work.pop()
-            prev = state.get(key)
-            if prev is not None and (prev is False or locked):
-                continue  # already at least this exposed
-            state[key] = locked if prev is None else (prev and locked)
-            if key not in view.functions:
-                continue
-            scan = _ReachScan(view, key, state[key])
-            scan.run()
-            scans[key] = scan
-            for callee, callsite_locked in scan.edges:
-                work.append((callee, callsite_locked or state[key]))
-
-        thread_keys = set(scans)
+        scans = module_lock_scans(view, entries)
+        thread_keys = {
+            k for k, ctxs in scans.items()
+            if any(s is not None for s in ctxs.values())
+        }
 
         # Attribute touches from NON-thread code (reads or writes), minus
         # __init__ everywhere (init-before-start is the safe idiom).  Each
@@ -337,41 +211,43 @@ def run(index: PackageIndex) -> list[Finding]:
                     outside.setdefault(node.attr, []).append(
                         (fn_key, node.lineno, is_self))
 
-        for key, scan in scans.items():
+        for key, ctxs in scans.items():
             if key.name == "__init__":
                 continue
-            fn_label = (f"{key.class_name}.{key.name}" if key.class_name
-                        else key.name)
-            for attr, line, locked, write_is_self in scan.writes:
-                if locked or attr not in outside:
+            fn_label = key.label()
+            for scan in ctxs.values():
+                if scan is None:
                     continue
-                candidates = outside[attr]
-                if write_is_self:
-                    candidates = [
-                        c for c in candidates
-                        if not c[2] or c[0].class_name == key.class_name
-                    ]
-                if not candidates:
-                    continue
-                other_key, other_line, _self = candidates[0]
-                other_label = (f"{other_key.class_name}.{other_key.name}"
-                               if other_key.class_name else other_key.name)
-                findings.append(Finding(
-                    rule="thread-unlocked-write",
-                    file=mod.rel, line=line,
-                    message=(
-                        f"{fn_label} (thread body) writes `.{attr}` without "
-                        f"a lock; `{other_label}` (line {other_line}) touches "
-                        "it from outside the thread"
-                    ),
-                    hint=(
-                        "guard both sides with the owning object's lock, or "
-                        "baseline with a rationale if the race is benign"
-                    ),
-                    detail=f"{fn_label}: unlocked write to .{attr}",
-                ))
-    # Dedup per (rule, file, detail): a loop writing the same attr twice is
-    # one finding per write site though — keep line in the key.
+                for attr, line, held, write_is_self, _owner in scan.writes:
+                    if held or attr not in outside:
+                        continue
+                    candidates = outside[attr]
+                    if write_is_self:
+                        candidates = [
+                            c for c in candidates
+                            if not c[2] or c[0].class_name == key.class_name
+                        ]
+                    if not candidates:
+                        continue
+                    other_key, other_line, _self = candidates[0]
+                    findings.append(Finding(
+                        rule="thread-unlocked-write",
+                        file=mod.rel, line=line,
+                        message=(
+                            f"{fn_label} (thread body) writes `.{attr}` "
+                            f"without a lock; `{other_key.label()}` (line "
+                            f"{other_line}) touches it from outside the "
+                            "thread"
+                        ),
+                        hint=(
+                            "guard both sides with the owning object's "
+                            "lock, or baseline with a rationale if the "
+                            "race is benign"
+                        ),
+                        detail=f"{fn_label}: unlocked write to .{attr}",
+                    ))
+    # Dedup per (rule, file, line, detail): multiple reach contexts can
+    # re-observe the same write site.
     seen: set = set()
     out: list = []
     for f in findings:

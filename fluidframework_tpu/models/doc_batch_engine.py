@@ -356,6 +356,13 @@ class DocBatchEngine:
             str(d) for d in range(n_docs)
         ]
         assert len(self.doc_keys) == n_docs
+        # Warm the native ingest plane HERE, with no lock held: the byte
+        # path's g++ rebuild (missing/stale .so) must never run lazily
+        # under ckpt_lock — ingest_lines only probes the non-building
+        # loaded() accessor (fftpu-check blocking-under-lock).
+        from ..native import ingest_native as _ingest_native
+
+        _ingest_native.warm()
         self.watchdog_every = watchdog_every
         self.watchdog_sample = watchdog_sample
         self._watchdog_cursor = 0
@@ -918,10 +925,13 @@ class DocBatchEngine:
             return self._ingest_lines(doc_idx, data)
 
     def _ingest_lines(self, doc_idx: int, data: bytes) -> int:
-        from ..native.ingest_native import NativeIngestEncoder, available
+        # loaded(), not available(): this runs under ckpt_lock, and the
+        # building probe spawns g++ for a stale .so — warm() at __init__
+        # already did any building with the lock free.
+        from ..native.ingest_native import NativeIngestEncoder, loaded
 
         h = self.hosts[doc_idx]
-        if self._in_lane(doc_idx) or not available():
+        if self._in_lane(doc_idx) or not loaded():
             # Lanes, checkpoint-restored docs, and the no-native fallback
             # consume parsed messages — decoded as one batch and fed
             # through the columnar fast path (ingest_batch routes lane
@@ -1346,7 +1356,16 @@ class DocBatchEngine:
             steps = self._step_fleet()
             if had_work and self.recovery_tracker.active:
                 self.recovery_tracker.complete()
-            return steps
+        # Cadence checkpoints run AFTER the serving lock releases: the
+        # record build retakes ckpt_lock briefly, but the durable fsyncs
+        # land with it free — the serving thread no longer pays platter
+        # time under the lock every ingest/step contender waits on
+        # (fftpu-check blocking-under-lock: fsync under ckpt_lock).
+        # Work staged by a racing ingest meanwhile is skipped by the
+        # sweep's staged-but-unapplied guard, exactly as a background
+        # sweep would skip it.
+        self.maybe_checkpoint()
+        return steps
 
     def _step_fleet(self) -> int:
         t0 = time.perf_counter() if self.sampled is not None else 0.0
@@ -1371,7 +1390,6 @@ class DocBatchEngine:
                 self.watchdog()
             if self.readmit_after_steps:
                 self._maybe_readmit()
-        self.maybe_checkpoint()
         # Sync boundary housekeeping (host-side, O(programs + samples)):
         # resolve e2e latency samples, poll for mid-serve recompiles, and
         # feed the sampled step timing when a telemetry sink is attached.
@@ -2311,19 +2329,21 @@ class DocBatchEngine:
         when ``force``), then truncate their replay logs to the tail.
         ``docs`` restricts the sweep to an explicit due list (the
         bounded-staleness writer's candidates) — those checkpoint whenever
-        dirty, regardless of cadence.  Takes ``ckpt_lock`` (re-entrant
-        from step()).  Returns the doc indices checkpointed."""
+        dirty, regardless of cadence.  Takes ``ckpt_lock`` for the record
+        build only; callers must NOT hold it across this call (step()
+        invokes it after its serving hold releases).  Returns the doc
+        indices checkpointed."""
         if self.checkpoint_store is None:
             return []
         if docs is None and not force and self.checkpoint_every <= 0:
             return []
         with self.ckpt_lock:
             out, pending = self._checkpoint_sweep(force, docs)
-        # Durable writes (one fsync per record) land OUTSIDE ckpt_lock:
-        # a background-writer sweep must not stall the serving thread's
-        # ingest/step behind N fsyncs.  (A cadence checkpoint from step()
-        # itself still holds the outer re-entrant lock — that thread is
-        # paying for its own write, the status quo.)
+        # Durable writes (one fsync per record) land OUTSIDE ckpt_lock —
+        # for every caller: the background writer's sweeps and, since the
+        # step() call site moved below its lock hold, the serving
+        # thread's own cadence checkpoints too (fftpu-check
+        # blocking-under-lock enforces this: ckpt_lock denies fsync).
         write_checkpoint_records(self, pending, "batch")
         return out
 

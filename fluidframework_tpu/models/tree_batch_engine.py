@@ -302,6 +302,13 @@ class TreeBatchEngine:
             str(d) for d in range(n_docs)
         ]
         assert len(self.doc_keys) == n_docs
+        # Warm the native decode plane with no lock held: ingest_lines
+        # probes only the non-building tree_decode accessor under
+        # ckpt_lock (fftpu-check blocking-under-lock — a lazy g++ run
+        # under the serving lock convoys every ingest).
+        from ..native import ingest_native as _ingest_native
+
+        _ingest_native.warm()
         self.counters = HealthCounters(telemetry)
         # Interning tables shared by the fleet; ROOT_FIELD must be id 0
         # (the virtual root's field in the kernel's materializer).
@@ -927,7 +934,11 @@ class TreeBatchEngine:
             steps = self._step_fleet()
             if had_work and self.recovery_tracker.active:
                 self.recovery_tracker.complete()
-            return steps
+        # Cadence checkpoints after the serving lock releases (same
+        # contract as DocBatchEngine.step): the durable fsyncs must not
+        # run while every ingest contender queues on ckpt_lock.
+        self.maybe_checkpoint()
+        return steps
 
     def _step_fleet(self) -> int:
         steps = 0
@@ -1011,7 +1022,6 @@ class TreeBatchEngine:
             with span("readback", kind="error_count"):
                 clean = int(self._pm.error_count(self.state.error)) == 0
             if clean:
-                self.maybe_checkpoint()
                 return steps
         with span("readback", kind="error_vector"):
             err = np.asarray(self.state.error)
@@ -1023,7 +1033,6 @@ class TreeBatchEngine:
                 self.state = self.state._replace(
                     error=self.state.error.at[d].set(0)
                 )
-        self.maybe_checkpoint()
         return steps
 
     # ------------------------------------------------------------- checkpoint

@@ -6,8 +6,13 @@ quorum lookup, insert chunking, property interning) runs in C++, replacing
 the per-op Python that bounds the fleet's ingest rate.  Differentially
 tested against the Python path in tests/test_native_ingest.py.
 
-Build: ``native/libtpuingest.so`` compiles on demand with g++ if missing or
-stale (same scheme as the native sequencer; no pip/pybind11 dependencies).
+Build: ``native/libtpuingest.so`` compiles with g++ if missing or stale
+(same scheme as the native sequencer; no pip/pybind11 dependencies) — but
+ONLY through ``warm()``/``available()``, which the engines call at
+construction time.  The serving-path accessors (``loaded``,
+``tree_decode``, ``NativeIngestEncoder``) never spawn the compiler: they
+run under the engines' ``ckpt_lock``, where a g++ run would stall every
+ingest contender for seconds (fftpu-check ``blocking-under-lock``).
 """
 
 from __future__ import annotations
@@ -25,11 +30,27 @@ _LIB = _REPO_ROOT / "native" / "libtpuingest.so"
 OP_FIELDS = 8
 
 _lib_cache: list = []
+_warmed: list = []
 
 
-def _ensure_built() -> ctypes.CDLL | None:
-    if _lib_cache:
-        return _lib_cache[0]
+def warm() -> bool:
+    """Build (when missing or stale vs the source) and load the library,
+    eagerly and idempotently.  This is the ONLY entry that runs g++: call
+    it at process/engine startup, never from a serving path — the lazy
+    rebuild used to be reachable under the engines' ``ckpt_lock``, and a
+    multi-second compiler run under the serving lock convoys every ingest
+    (fftpu-check blocking-under-lock: subprocess under ckpt_lock).  The
+    engines warm in ``__init__``; the hot-path accessors below only ever
+    LOAD a prebuilt library.
+
+    The idempotence latch is the WARM flag, not the lib cache: a
+    non-building accessor touched first may have cached a loadable but
+    STALE .so, and the first warm() must still run the staleness rebuild
+    (already-constructed encoders keep their old handle; everything after
+    the warm sees the fresh library)."""
+    if _warmed:
+        return bool(_lib_cache) and _lib_cache[0] is not None
+    _warmed.append(True)
     try:
         if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
             subprocess.run(
@@ -37,9 +58,27 @@ def _ensure_built() -> ctypes.CDLL | None:
                  "-o", str(_LIB), str(_SRC)],
                 check=True, capture_output=True,
             )
-        lib = ctypes.CDLL(str(_LIB))
     except (OSError, subprocess.CalledProcessError):
-        _lib_cache.append(None)
+        pass  # a previously-built library may still load below
+    _lib_cache[:] = [_try_load()]
+    return _lib_cache[0] is not None
+
+
+def _ensure_built() -> ctypes.CDLL | None:
+    """Serving-path accessor: the cached library, loading a PREBUILT .so
+    on first touch — never compiling.  Returns None when no usable
+    prebuilt library exists (the callers fall back to the Python decode
+    paths); ``warm()`` upgrades a None verdict after building."""
+    if _lib_cache:
+        return _lib_cache[0]
+    _lib_cache[:] = [_try_load() if _LIB.exists() else None]
+    return _lib_cache[0]
+
+
+def _try_load() -> ctypes.CDLL | None:
+    try:
+        lib = ctypes.CDLL(str(_LIB))
+    except OSError:
         return None
     lib.ing_create.restype = ctypes.c_void_p
     lib.ing_create.argtypes = [ctypes.c_int32, ctypes.c_int32]
@@ -75,11 +114,18 @@ def _ensure_built() -> ctypes.CDLL | None:
             i32p, ctypes.c_int32, i32p, ctypes.c_int32,
             i64p, ctypes.c_int32, i32p, i32p,
         ]
-    _lib_cache.append(lib)
     return lib
 
 
 def available() -> bool:
+    """Build-on-demand probe for host tools/tests (outside any serving
+    lock).  Serving paths use the non-building accessors instead."""
+    return warm()
+
+
+def loaded() -> bool:
+    """Non-building availability probe for serving paths (safe under the
+    engines' locks): True iff a prebuilt library is loaded/loadable."""
     return _ensure_built() is not None
 
 

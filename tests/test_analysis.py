@@ -3,7 +3,7 @@
 Three tiers:
 
 1. Per-pass fixture tests — a known-bad snippet fires the rule, its
-   known-good twin stays silent (all five passes).
+   known-good twin stays silent (all eleven passes).
 2. Baseline round-trip — add / suppress / expire, rationale enforcement.
 3. Self-hosting gates — ``test_package_is_clean`` runs the whole suite on
    the real package (tier-1: every future PR is checked), and seeded
@@ -27,8 +27,8 @@ import pytest
 from fluidframework_tpu.analysis import cli as check_cli
 from fluidframework_tpu.analysis.core import Baseline, load_package
 from fluidframework_tpu.analysis import (
-    determinism, donation, jit_safety, layer_check, markchurn, swallowed,
-    threads,
+    blocking, determinism, donation, jit_safety, layer_check,
+    lock_consistency, lock_order, markchurn, mesh_safety, swallowed, threads,
 )
 
 REPO = Path(__file__).resolve().parent.parent
@@ -1005,6 +1005,622 @@ def test_fold_mark_churn_disabled_without_scope(tmp_path):
     assert markchurn.run(load_package(pkg), {}) == []
 
 
+# ---------------------------------------------------------------------------
+# Pass 8: lock-order
+# ---------------------------------------------------------------------------
+
+LOCK_HEADER = (
+    "import threading\n"
+    "la = threading.Lock()\n"
+    "lb = threading.Lock()\n"
+)
+
+
+def test_lock_order_cycle_via_nesting(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/locks.py": LOCK_HEADER + (
+            "def f():\n"
+            "    with la:\n"
+            "        with lb:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with lb:\n"
+            "        with la:\n"
+            "            pass\n"
+        ),
+    })
+    found = lock_order.run(load_package(pkg), {})
+    assert [f.rule for f in found] == ["lock-order-cycle"]
+    assert "la" in found[0].detail and "lb" in found[0].detail
+
+
+def test_lock_order_consistent_nesting_silent(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/locks.py": LOCK_HEADER + (
+            "def f():\n"
+            "    with la:\n"
+            "        with lb:\n"
+            "            pass\n"
+            "def g():\n"
+            "    with la:\n"
+            "        with lb:\n"
+            "            pass\n"
+            "def h():\n"          # release-then-take is NOT an inversion
+            "    with lb:\n"
+            "        pass\n"
+            "    with la:\n"
+            "        pass\n"
+        ),
+    })
+    assert lock_order.run(load_package(pkg), {}) == []
+
+
+def test_lock_order_multi_item_with_counts_as_nesting(tmp_path):
+    """``with la, lb:`` acquires lb WHILE la is held — the single-statement
+    form must produce the same la -> lb edge as the nested form (review
+    regression: the edge was recorded against the pre-statement held
+    set, silently dropping the AB half of a textbook AB/BA deadlock)."""
+    pkg = make_pkg(tmp_path, {
+        "low/locks.py": LOCK_HEADER + (
+            "def f():\n"
+            "    with la, lb:\n"
+            "        pass\n"
+            "def g():\n"
+            "    with lb:\n"
+            "        with la:\n"
+            "            pass\n"
+        ),
+    })
+    found = lock_order.run(load_package(pkg), {})
+    assert [f.rule for f in found] == ["lock-order-cycle"]
+
+
+def test_lock_order_cycle_through_call_edge(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/locks.py": LOCK_HEADER + (
+            "def helper():\n"
+            "    with lb:\n"
+            "        pass\n"
+            "def f():\n"
+            "    with la:\n"
+            "        helper()\n"      # la -> lb, one call deep
+            "def other():\n"
+            "    with la:\n"
+            "        pass\n"
+            "def g():\n"
+            "    with lb:\n"
+            "        other()\n"       # lb -> la: cycle
+        ),
+    })
+    found = lock_order.run(load_package(pkg), {})
+    assert [f.rule for f in found] == ["lock-order-cycle"]
+
+
+def test_lock_order_shared_lock_unifies_across_modules(tmp_path):
+    """The engines acquire ``self.ckpt_lock``; models/recovery acquires
+    ``engine.ckpt_lock`` on an untyped parameter.  The shared_locks
+    registry is what makes those ONE lock — without it the reversed
+    nesting in another module is invisible."""
+    files = {
+        "low/eng.py": (
+            "import threading\n"
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self.ckpt_lock = threading.RLock()\n"
+            "        self.io_lock = threading.Lock()\n"
+            "    def sweep(self):\n"
+            "        with self.ckpt_lock:\n"
+            "            with self.io_lock:\n"
+            "                pass\n"
+        ),
+        "low/recovery.py": (
+            "def write_records(engine):\n"
+            "    with engine.io_lock:\n"
+            "        with engine.ckpt_lock:\n"
+            "            pass\n"
+        ),
+    }
+    pkg = make_pkg(tmp_path / "shared", files)
+    found = lock_order.run(
+        load_package(pkg), {"shared_locks": ["ckpt_lock", "io_lock"]}
+    )
+    assert [f.rule for f in found] == ["lock-order-cycle"]
+    assert "ckpt_lock" in found[0].detail
+
+    pkg2 = make_pkg(tmp_path / "unshared", files)
+    assert lock_order.run(load_package(pkg2), {}) == []
+
+
+def test_walk_budget_exhaustion_raises_not_false_clean(tmp_path):
+    """A truncated walk must FAIL the run, never report clean on an
+    unfinished analysis (review regression: the budget exhausted
+    silently)."""
+    from fluidframework_tpu.analysis.core import walk_lock_flow
+
+    pkg = make_pkg(tmp_path, {
+        "low/locks.py": LOCK_HEADER + (
+            "def f():\n"
+            "    with la:\n"
+            "        g()\n"
+            "def g():\n"
+            "    f()\n"
+        ),
+    })
+    # Mutual recursion under a lock converges (contexts are finite)...
+    assert lock_order.run(load_package(pkg), {}) == []
+    # ...but an engine starved of budget must raise, not return partial.
+    with pytest.raises(RuntimeError, match="work budget"):
+        walk_lock_flow(
+            [(("k", i), frozenset()) for i in range(10)],
+            lambda key, held: None,
+            max_items=3,
+        )
+
+
+def test_lock_order_reentrant_self_acquire_silent(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/eng.py": (
+            "import threading\n"
+            "class Engine:\n"
+            "    def __init__(self):\n"
+            "        self.ckpt_lock = threading.RLock()\n"
+            "    def step(self):\n"
+            "        with self.ckpt_lock:\n"
+            "            self.maybe_checkpoint()\n"
+            "    def maybe_checkpoint(self):\n"
+            "        with self.ckpt_lock:\n"   # re-entrant: fine
+            "            pass\n"
+        ),
+    })
+    assert lock_order.run(load_package(pkg), {}) == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 9: lock-consistency
+# ---------------------------------------------------------------------------
+
+CONS_BAD = (
+    "import threading\n"
+    "class Counter:\n"
+    "    def __init__(self):\n"
+    "        self.n = 0\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._t = threading.Thread(target=self._run)\n"
+    "    def _run(self):\n"
+    "        with self._lock:\n"
+    "            self.n += 1\n"
+    "def reset(c: Counter):\n"
+    "    c.n = 0\n"                      # no lock: excludes nobody
+)
+
+CONS_GOOD = CONS_BAD.replace(
+    "def reset(c: Counter):\n"
+    "    c.n = 0\n",
+    "def reset(c: Counter):\n"
+    "    with c._lock:\n"
+    "        c.n = 0\n",
+)
+
+
+def test_lock_consistency_unlocked_nonthread_write_fires(tmp_path):
+    pkg = make_pkg(tmp_path / "bad", {"low/c.py": CONS_BAD})
+    found = lock_consistency.run(load_package(pkg), {})
+    assert [f.rule for f in found] == ["lock-inconsistent-guard"]
+    assert "Counter.n" in found[0].detail and "no lock" in found[0].detail
+    # The threads pass does NOT own this shape (its thread-side write IS
+    # locked) — the two passes split the space, no double report.
+    assert threads.run(load_package(pkg)) == []
+
+    pkg_good = make_pkg(tmp_path / "good", {"low/c.py": CONS_GOOD})
+    assert lock_consistency.run(load_package(pkg_good), {}) == []
+
+
+def test_lock_consistency_two_different_locks_fire(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/c.py": (
+            "import threading\n"
+            "class Counter:\n"
+            "    def __init__(self):\n"
+            "        self.n = 0\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._other = threading.Lock()\n"
+            "        self._t = threading.Thread(target=self._run)\n"
+            "    def _run(self):\n"
+            "        with self._lock:\n"
+            "            self.n += 1\n"
+            "    def reset(self):\n"
+            "        with self._other:\n"     # disjoint lock: no exclusion
+            "            self.n = 0\n"
+        ),
+    })
+    found = lock_consistency.run(load_package(pkg), {})
+    assert [f.rule for f in found] == ["lock-inconsistent-guard"]
+    assert "Counter._lock" in found[0].message
+    assert "Counter._other" in found[0].message
+
+
+def test_lock_consistency_two_thread_race_not_dropped(tmp_path):
+    """Locked-vs-unlocked between two THREADS has no non-thread toucher,
+    so the threads pass never fires — this pass must own it (review
+    regression: the unlocked thread site was excluded as 'the threads
+    pass's beat' even when that pass could not fire)."""
+    pkg = make_pkg(tmp_path, {
+        "low/c.py": (
+            "import threading\n"
+            "class Pump:\n"
+            "    def __init__(self):\n"
+            "        self.count = 0\n"
+            "        self._lock = threading.Lock()\n"
+            "        threading.Thread(target=self._drain).start()\n"
+            "        threading.Thread(target=self._reset).start()\n"
+            "    def _drain(self):\n"
+            "        with self._lock:\n"
+            "            self.count += 1\n"
+            "    def _reset(self):\n"
+            "        self.count = 0\n"       # forgot the lock
+        ),
+    })
+    assert threads.run(load_package(pkg)) == []
+    found = lock_consistency.run(load_package(pkg), {})
+    assert [f.rule for f in found] == ["lock-inconsistent-guard"]
+    assert "Pump.count" in found[0].detail
+
+
+def test_lock_consistency_thread_unlocked_left_to_threads_pass(tmp_path):
+    """A fully-unlocked attr (thread side included) is the threads pass's
+    finding; lock-consistency stays quiet rather than double-reporting."""
+    pkg = make_pkg(tmp_path, {"low/w.py": THREAD_BAD})
+    assert lock_consistency.run(load_package(pkg), {}) == []
+    assert [f.rule for f in threads.run(load_package(pkg))] == \
+        ["thread-unlocked-write"]
+
+
+def test_lock_consistency_init_exempt(tmp_path):
+    pkg = make_pkg(tmp_path, {"low/c.py": CONS_GOOD})
+    # __init__'s unlocked self.n = 0 never counts as a site.
+    assert lock_consistency.run(load_package(pkg), {}) == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 10: blocking-under-lock
+# ---------------------------------------------------------------------------
+
+BLOCK_CFG = {
+    "shared_locks": ["ckpt_lock"],
+    "critical_locks": [
+        {"lock": "ckpt_lock", "deny": ["fsync", "sleep"]},
+    ],
+}
+
+BLOCK_BAD = (
+    "import os\n"
+    "import threading\n"
+    "class Eng:\n"
+    "    def __init__(self):\n"
+    "        self.ckpt_lock = threading.RLock()\n"
+    "    def save(self, fd):\n"
+    "        with self.ckpt_lock:\n"
+    "            os.fsync(fd)\n"
+)
+
+BLOCK_GOOD = BLOCK_BAD.replace(
+    "        with self.ckpt_lock:\n"
+    "            os.fsync(fd)\n",
+    "        with self.ckpt_lock:\n"
+    "            pass\n"
+    "        os.fsync(fd)\n",       # after release: the sanctioned shape
+)
+
+
+def test_blocking_under_lock_fires_and_release_twin_silent(tmp_path):
+    pkg = make_pkg(tmp_path / "bad", {"low/e.py": BLOCK_BAD})
+    found = blocking.run(load_package(pkg), BLOCK_CFG)
+    assert [f.rule for f in found] == ["blocking-under-lock"]
+    assert "fsync" in found[0].detail and "ckpt_lock" in found[0].detail
+
+    pkg_good = make_pkg(tmp_path / "good", {"low/e.py": BLOCK_GOOD})
+    assert blocking.run(load_package(pkg_good), BLOCK_CFG) == []
+
+
+def test_blocking_under_lock_transitive_call_edge(tmp_path):
+    """The lock rides call edges — exactly how the real finding this pass
+    shipped with was reachable (step -> maybe_checkpoint -> the recovery
+    plane's fsync), two modules away from the ``with``."""
+    pkg = make_pkg(tmp_path, {
+        "low/e.py": (
+            "import threading\n"
+            "from .io import write_all\n"
+            "class Eng:\n"
+            "    def __init__(self):\n"
+            "        self.ckpt_lock = threading.RLock()\n"
+            "    def step(self):\n"
+            "        with self.ckpt_lock:\n"
+            "            write_all(self)\n"
+        ),
+        "low/io.py": (
+            "import time\n"
+            "def write_all(engine):\n"
+            "    time.sleep(0.1)\n"
+        ),
+    })
+    found = blocking.run(load_package(pkg), BLOCK_CFG)
+    assert [f.rule for f in found] == ["blocking-under-lock"]
+    assert found[0].file == "fixturepkg/low/io.py"
+    assert "sleep" in found[0].detail
+
+
+def test_blocking_under_lock_exempt_function(tmp_path):
+    cfg = {
+        "shared_locks": ["ckpt_lock"],
+        "critical_locks": [
+            {"lock": "ckpt_lock", "deny": ["fsync", "sleep"],
+             "exempt": ["Eng.save"]},
+        ],
+    }
+    pkg = make_pkg(tmp_path, {"low/e.py": BLOCK_BAD})
+    assert blocking.run(load_package(pkg), cfg) == []
+
+
+def test_blocking_under_lock_configured_package_call(tmp_path):
+    """``blocking_calls`` carries the hand-knowledge static typing cannot:
+    ``store.save`` fsyncs, whoever ``store`` is."""
+    cfg = {
+        "shared_locks": ["ckpt_lock"],
+        "critical_locks": [{"lock": "ckpt_lock", "deny": ["fsync"]}],
+        "blocking_calls": {"store.save": "fsync"},
+    }
+    pkg = make_pkg(tmp_path, {
+        "low/e.py": (
+            "import threading\n"
+            "class Eng:\n"
+            "    def __init__(self, store):\n"
+            "        self.ckpt_lock = threading.RLock()\n"
+            "        self.store = store\n"
+            "    def sweep(self, k, rec):\n"
+            "        with self.ckpt_lock:\n"
+            "            self.store.save(k, rec)\n"
+        ),
+    })
+    found = blocking.run(load_package(pkg), cfg)
+    assert [f.rule for f in found] == ["blocking-under-lock"]
+    assert "store.save" in found[0].message
+
+
+def test_blocking_under_lock_config_validation(tmp_path):
+    pkg = make_pkg(tmp_path, {"low/e.py": "X = 1\n"})
+    with pytest.raises(ValueError, match="unknown deny"):
+        blocking.run(load_package(pkg), {
+            "critical_locks": [{"lock": "l", "deny": ["disk"]}],
+        })
+    with pytest.raises(ValueError, match="unknown categories"):
+        blocking.run(load_package(pkg), {
+            "critical_locks": [{"lock": "l", "deny": ["fsync"]}],
+            "blocking_calls": {"x.y": "disk"},
+        })
+
+
+def test_blocking_under_lock_noncritical_lock_silent(tmp_path):
+    pkg = make_pkg(tmp_path, {"low/e.py": BLOCK_BAD})
+    assert blocking.run(load_package(pkg), {"critical_locks": []}) == []
+
+
+# ---------------------------------------------------------------------------
+# Pass 11: mesh-safety
+# ---------------------------------------------------------------------------
+
+MESH_HEADER = (
+    "import jax\n"
+    "import numpy as np\n"
+    "from jax.sharding import Mesh, PartitionSpec as P\n"
+    "from jax.experimental.shard_map import shard_map\n"
+    "mesh = Mesh(np.array([]), ('docs',))\n"
+)
+
+
+def test_mesh_axis_unknown_fires_and_declared_axis_silent(tmp_path):
+    pkg = make_pkg(tmp_path / "bad", {
+        "low/k.py": MESH_HEADER + (
+            "def k(x, axis='doc'):\n"            # typo'd axis
+            "    return jax.lax.psum(x, axis)\n"
+        ),
+    })
+    found = mesh_safety.run(load_package(pkg), None)
+    assert [f.rule for f in found] == ["mesh-axis-unknown"]
+    assert "'doc'" in found[0].detail
+
+    pkg_good = make_pkg(tmp_path / "good", {
+        "low/k.py": MESH_HEADER + (
+            "SEG_AXIS = 'segs'\n"
+            "mesh2 = Mesh(np.array([]), ('docs', SEG_AXIS))\n"
+            "def k(x, axis='docs'):\n"
+            "    return jax.lax.psum(x, axis)\n"
+            "def k2(x):\n"
+            "    return jax.lax.all_gather(x, SEG_AXIS)\n"   # constant resolves
+        ),
+    })
+    assert mesh_safety.run(load_package(pkg_good), None) == []
+
+
+def test_mesh_axis_resolves_against_innermost_function(tmp_path):
+    """A kernel closure nested in a factory resolves ITS OWN param
+    defaults (review regression: calls were attributed to the outermost
+    def, so the factory's unrelated `axis` default shadowed the
+    kernel's — a spurious finding on the mesh_seg_program-style
+    closure idiom, and a hidden one in the mirror case)."""
+    pkg = make_pkg(tmp_path / "good", {
+        "low/k.py": MESH_HEADER + (
+            "def make(axis='legacy'):\n"              # unrelated default
+            "    def kern(x, axis='docs'):\n"
+            "        return jax.lax.psum(x, axis)\n"
+            "    return kern\n"
+        ),
+    })
+    assert mesh_safety.run(load_package(pkg), None) == []
+
+    pkg2 = make_pkg(tmp_path / "bad", {
+        "low/k.py": MESH_HEADER + (
+            "def make(axis='docs'):\n"                # outer is fine...
+            "    def kern(x, axis='doc'):\n"          # ...inner typo'd
+            "        return jax.lax.psum(x, axis)\n"
+            "    return kern\n"
+        ),
+    })
+    found = mesh_safety.run(load_package(pkg2), None)
+    assert [f.rule for f in found] == ["mesh-axis-unknown"]
+
+
+def test_mesh_in_specs_arity(tmp_path):
+    pkg = make_pkg(tmp_path, {
+        "low/m.py": MESH_HEADER + (
+            "def step(a, b):\n"
+            "    return a\n"
+            "bad = shard_map(step, mesh=mesh, in_specs=(P('docs'),),\n"
+            "                out_specs=P('docs'))\n"
+            "good = shard_map(step, mesh=mesh,\n"
+            "                 in_specs=(P('docs'), P('docs')),\n"
+            "                 out_specs=P('docs'))\n"
+        ),
+    })
+    found = mesh_safety.run(load_package(pkg), None)
+    assert [f.rule for f in found] == ["mesh-in-specs-arity"]
+    assert "1" in found[0].message and "2" in found[0].message
+
+
+def test_mesh_donate_replicated_out_literal(tmp_path):
+    pkg = make_pkg(tmp_path / "bad", {
+        "low/m.py": MESH_HEADER + (
+            "def step(a, b):\n"
+            "    return a\n"
+            "prog = jax.jit(\n"
+            "    shard_map(step, mesh=mesh, in_specs=(P('docs'), P('docs')),\n"
+            "              out_specs=P()),\n"      # replicated output
+            "    donate_argnums=(0,),\n"           # + donation = the bug
+            ")\n"
+        ),
+    })
+    found = mesh_safety.run(load_package(pkg), None)
+    assert [f.rule for f in found] == ["mesh-donate-replicated-out"]
+
+    # Twins: donation off, or sharded out_specs — both silent.
+    pkg2 = make_pkg(tmp_path / "nodonate", {
+        "low/m.py": MESH_HEADER + (
+            "def step(a, b):\n"
+            "    return a\n"
+            "prog = jax.jit(\n"
+            "    shard_map(step, mesh=mesh, in_specs=(P('docs'), P('docs')),\n"
+            "              out_specs=P()),\n"
+            "    donate_argnums=(),\n"
+            ")\n"
+        ),
+    })
+    assert mesh_safety.run(load_package(pkg2), None) == []
+    pkg3 = make_pkg(tmp_path / "sharded", {
+        "low/m.py": MESH_HEADER + (
+            "def step(a, b):\n"
+            "    return a\n"
+            "prog = jax.jit(\n"
+            "    shard_map(step, mesh=mesh, in_specs=(P('docs'), P('docs')),\n"
+            "              out_specs=P('docs')),\n"
+            "    donate_argnums=(0,),\n"
+            ")\n"
+        ),
+    })
+    assert mesh_safety.run(load_package(pkg3), None) == []
+
+
+DECLARED_PROG = (
+    "import jax\n"
+    "from jax.experimental.shard_map import shard_map\n"
+    "def seg_prog(fn, mesh, specs, donate=False):\n"
+    "    m = shard_map(fn, mesh=mesh, in_specs=(specs,), out_specs=specs)\n"
+    "    return jax.jit(m, donate_argnums=(0,) if donate else ())\n"
+)
+
+
+def test_mesh_declared_replicated_program_guards_donation(tmp_path):
+    scope = {"replicated_out_programs": ["fixturepkg/low/m.py::seg_prog"]}
+    pkg = make_pkg(tmp_path / "off", {"low/m.py": DECLARED_PROG})
+    assert mesh_safety.run(load_package(pkg), scope) == []
+
+    # The "re-enable donation" edit: flip the default -> the rule fires
+    # (the conditional donate_argnums resolves through the param default).
+    pkg2 = make_pkg(tmp_path / "on", {
+        "low/m.py": DECLARED_PROG.replace("donate=False", "donate=True"),
+    })
+    found = mesh_safety.run(load_package(pkg2), scope)
+    assert [f.rule for f in found] == ["mesh-donate-replicated-out"]
+    assert "seg_prog" in found[0].detail
+
+
+def test_mesh_scope_stale_entry_fails_loudly(tmp_path):
+    pkg = make_pkg(tmp_path, {"low/m.py": "X = 1\n"})
+    with pytest.raises(ValueError, match="matches no function"):
+        mesh_safety.run(load_package(pkg), {
+            "replicated_out_programs": ["fixturepkg/low/m.py::gone"],
+        })
+    # And the real package's layers.json does pin mesh_seg_program.
+    real_cfg = json.loads((PKG / "analysis" / "layers.json").read_text())
+    assert real_cfg["mesh_scope"]["replicated_out_programs"] == [
+        "fluidframework_tpu/parallel/mesh.py::mesh_seg_program"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# CLI: --changed-only + per-pass timing
+# ---------------------------------------------------------------------------
+
+def _git(cwd, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=cwd, check=True, capture_output=True,
+    )
+
+
+def test_cli_changed_only_scopes_to_git_diff(tmp_path, capsys):
+    pkg = _one_finding_pkg(tmp_path)
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    # Clean working tree: the (committed) legacy finding is out of scope.
+    assert check_cli.main([str(pkg), "--changed-only"]) == 0
+    capsys.readouterr()
+    # Touch the offending module: the finding is back in the pre-commit
+    # loop, exit 1.
+    src = pkg / "low" / "util.py"
+    src.write_text(src.read_text() + "# touched\n")
+    assert check_cli.main([str(pkg), "--changed-only", "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["changed_only"] is True and out["n_changed"] >= 1
+    assert [f["file"] for f in out["findings"]] == ["fixturepkg/low/util.py"]
+    # An UNTRACKED new module is "changed" too (pre-commit covers adds).
+    src.write_text("X = 1\n")
+    (pkg / "low" / "fresh.py").write_text("from ..high import svc\n")
+    assert check_cli.main([str(pkg), "--changed-only"]) == 1
+    capsys.readouterr()
+
+
+def test_cli_changed_only_outside_git_is_usage_error(tmp_path, capsys,
+                                                     monkeypatch):
+    pkg = _one_finding_pkg(tmp_path)
+    monkeypatch.setenv("GIT_DIR", str(tmp_path / "nope" / ".git"))
+    monkeypatch.setenv("GIT_CEILING_DIRECTORIES", str(tmp_path))
+    assert check_cli.main([str(pkg), "--changed-only"]) == 2
+    assert "git" in capsys.readouterr().err
+
+
+def test_run_all_reports_per_pass_wall_time(tmp_path, capsys):
+    pkg = _one_finding_pkg(tmp_path)
+    result = check_cli.run_all(pkg)
+    assert set(result["pass_times_ms"]) == set(check_cli.PASSES)
+    assert all(t >= 0 for t in result["pass_times_ms"].values())
+    # Subset runs time only their passes; --json carries the block.
+    result = check_cli.run_all(pkg, rules=["layer-check"])
+    assert set(result["pass_times_ms"]) == {"layer-check"}
+    check_cli.main([str(pkg), "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert set(out["pass_times_ms"]) == set(check_cli.PASSES)
+
+
 def _copy_pkg(tmp_path: Path) -> Path:
     dst = tmp_path / "fluidframework_tpu"
     shutil.copytree(
@@ -1061,6 +1677,44 @@ SEEDINGS = [
          "    return pool_marks(pool, out)\n"
      ),
      "fold-mark-churn", "fold-mark-churn"),
+    # An AB/BA inversion of the engines' real lock pair, planted in the
+    # module that really manipulates both (shared_locks unification).
+    ("models/recovery.py",
+     lambda s: s + (
+         "\n\ndef _seeded_order_a(engine):\n"
+         "    with engine.ckpt_lock:\n"
+         "        with engine._ckpt_io_lock:\n"
+         "            pass\n"
+         "\n\ndef _seeded_order_b(engine):\n"
+         "    with engine._ckpt_io_lock:\n"
+         "        with engine.ckpt_lock:\n"
+         "            pass\n"
+     ),
+     "lock-order-cycle", "lock-order"),
+    # A supervisor-side counter reset that forgot the heartbeat's lock —
+    # the heartbeat thread writes _renewals under LeaseHeartbeat._lock.
+    ("server/failover.py",
+     lambda s: s + (
+         "\n\ndef _seeded_reset(hb: LeaseHeartbeat) -> None:\n"
+         "    hb._renewals = 0\n"
+     ),
+     "lock-inconsistent-guard", "lock-consistency"),
+    # A durable fsync planted under the serving lock: the exact PR 12 law
+    # the blocking pass now enforces (ckpt_lock denies fsync).
+    ("models/doc_batch_engine.py",
+     lambda s: s + (
+         "\n\ndef _seeded_fsync(engine, fd):\n"
+         "    import os as _os\n"
+         "    with engine.ckpt_lock:\n"
+         "        _os.fsync(fd)\n"
+     ),
+     "blocking-under-lock", "blocking-under-lock"),
+    # The "re-enable donation" edit on the declared replicated-out
+    # program: flipping mesh_seg_program's default trips mesh-safety (and
+    # the named regression test in test_segment_parallel.py).
+    ("parallel/mesh.py",
+     lambda s: s.replace("donate: bool = False", "donate: bool = True"),
+     "mesh-donate-replicated-out", "mesh-safety"),
 ]
 
 

@@ -348,3 +348,26 @@ def test_tree_engine_rebalance_is_counted_noop():
         moves = eng.rebalance_hot_shards()
         assert moves == []
         assert eng.health()["migrations_unsupported"] >= 1
+
+
+def test_mesh_seg_program_defaults_donation_off():
+    """Regression pin for the jax 0.4.37 persistent-cache aliasing bug: a
+    DONATED ``mesh_seg_program`` executable reloaded from the persistent
+    XLA compile cache returns permuted/garbage outputs whenever the
+    obliterate branch runs (two-process repro — the byte-identity fuzz
+    caught it live; see the repro note in ``parallel/mesh.py``).
+
+    Donation must stay OFF by default until the upstream bug is fixed.
+    A well-meaning "re-enable donation" PR now trips THIS named test and
+    the ``mesh-safety`` pass's ``mesh-donate-replicated-out`` rule
+    (layers.json declares mesh_seg_program replicated-out), instead of a
+    flaky byte-identity fuzz three suites away."""
+    import inspect
+
+    sig = inspect.signature(pm.mesh_seg_program.__wrapped__)
+    assert sig.parameters["donate"].default is False, (
+        "mesh_seg_program must default donate=False: donated "
+        "replicated-output executables corrupt on persistent-cache "
+        "reload (jax 0.4.37). Re-enable only with the cache off or "
+        "after the upstream aliasing fix — see parallel/mesh.py."
+    )
