@@ -1833,17 +1833,29 @@ def bench_soak(args) -> dict:
     # run's post-kill compiles and erases the very recovery tail under
     # measurement (r10's 16.8 s p99 IS that first process-cold restore).
     standby = os.environ.get("FFTPU_SOAK_STANDBY", "1") != "0"
+    # r16 placement plane: the soak fleet is MIXED by default — tree docs
+    # ride the same Zipf ranking, fault schedule, and byte-identity
+    # invariants as the string docs (FFTPU_SOAK_TREE_DOCS=0 opts out),
+    # so the artifact carries per-family recovery percentiles.
+    n_tree_docs = int(os.environ.get("FFTPU_SOAK_TREE_DOCS", "3"))
     out = _run_soak_child(
-        platform, seed=seed, ticks=ticks, n_docs=n_docs, standby=standby,
+        platform, seed=seed, ticks=ticks, n_docs=n_docs,
+        n_tree_docs=n_tree_docs, standby=standby,
         ckpt_stale_seconds=0.25 if standby else 0.0,
     )
     if standby and os.environ.get("FFTPU_SOAK_COMPARE", "1") != "0":
+        # The 30 s recovery bound is the headline run's SLO; the cold
+        # comparison exists to measure how slow process-cold restore is
+        # (mixed-fleet tree re-materialization pays its own compiles and
+        # lands well past 30 s), so it runs under a relaxed ceiling.
         cold = _run_soak_child(platform, seed=seed, ticks=ticks,
-                               n_docs=n_docs)
+                               n_docs=n_docs, n_tree_docs=n_tree_docs,
+                               recovery_bound_s=180.0)
         out["no_standby"] = {
             k: cold.get(k) for k in (
-                "recovery_p50_ms", "recovery_p99_ms", "p50_ms", "p99_ms",
-                "duration_s",
+                "recovery_p50_ms", "recovery_p99_ms",
+                "tree_recovery_p50_ms", "tree_recovery_p99_ms",
+                "p50_ms", "p99_ms", "duration_s",
             )
         }
         out["no_standby"]["fleet_restarts"] = (
@@ -1852,6 +1864,12 @@ def bench_soak(args) -> dict:
         if out.get("recovery_p99_ms") and cold.get("recovery_p99_ms"):
             out["recovery_speedup"] = round(
                 cold["recovery_p99_ms"] / out["recovery_p99_ms"], 2
+            )
+        if (out.get("tree_recovery_p99_ms")
+                and cold.get("tree_recovery_p99_ms")):
+            out["tree_recovery_speedup"] = round(
+                cold["tree_recovery_p99_ms"] / out["tree_recovery_p99_ms"],
+                2,
             )
     out["platform"] = platform or "cpu"
     if probe_attempts:

@@ -48,6 +48,13 @@ from .frames import (
 
 # A resync request larger than the retained log window gets this marker
 # instead of ops: the client must boot from a snapshot (historian tier).
+# Client contract (FleetConsumer._boot_resync, both engine families via
+# models/placement.adopt_boot_snapshot): a snapshot AHEAD of the doc's
+# applied floor is adopted and consumption resumes from its seq; one
+# at/below the floor is REFUSED (AdoptResult.adopted=False) and the doc
+# falls to the supervisor restart path — re-subscribing from the
+# engine's own floor would just draw this marker again, an infinite
+# resync loop that looks healthy.
 RESYNC_BOOT_MARKER = b'{"t":"resync","boot":true}\n'
 
 

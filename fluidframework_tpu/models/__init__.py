@@ -7,5 +7,11 @@ the TPU-native expression of the reference's inbound-op hot path
 """
 
 from .doc_batch_engine import DocBatchEngine
+from .placement import AdoptResult, PlacementError, PlacementPlane
 
-__all__ = ["DocBatchEngine"]
+__all__ = [
+    "AdoptResult",
+    "DocBatchEngine",
+    "PlacementError",
+    "PlacementPlane",
+]

@@ -80,6 +80,7 @@ from ..dds.shared_string import decode_obliterate_places
 from ..observability.flight_recorder import RecompileWatchdog, instant, span
 from ..ops import mergetree_kernel as mk
 from .dispatch import dispatch_plane
+from . import placement
 from ..protocol.messages import DeltaType, MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters, Histogram, SampledTelemetryHelper
 from .recovery import (
@@ -438,36 +439,18 @@ class DocBatchEngine:
         self.seg_lane_text_capacity = seg_lane_text_capacity
         self.seg_rebalance_every = seg_rebalance_every
         self.max_seg_lanes = max_seg_lanes
-        # Device capacity rounds up to a mesh multiple (padding docs are
-        # inert: their queues stay empty so they only ever apply noops).
-        # ``spare_slots`` reserves extra free rows beyond the fleet so live
-        # migration always has landing slots on every shard.
         self.n_shards = n_shards
         self._shard_latency = [Histogram() for _ in range(n_shards)]
-        self.capacity = -(-(n_docs + spare_slots) // n_shards) * n_shards
-        self.docs_per_shard = self.capacity // n_shards
-        # Device-row placement: doc -> slot (row index into the sharded
-        # state; shard = slot // docs_per_shard).  Docs distribute in
-        # contiguous blocks over ALL shards (identity when there are no
-        # spare slots), so the staging buffer is packed by doc placement
-        # and a shard-layout device_put splits it per chip; spare slots
-        # spread across shards as the per-shard free pool ``migrate_doc``
-        # lands in.
-        per = -(-n_docs // n_shards)  # docs per shard at construction
-        self._slot = np.array(
-            [
-                (d // per) * self.docs_per_shard + (d % per)
-                for d in range(n_docs)
-            ],
-            dtype=np.int64,
+        # Device-row placement rides the shared plane (models/placement.py):
+        # doc -> slot indirection with per-shard spare-slot free pools, the
+        # same plane the tree fleet rides.  ``_slot`` aliases the plane's
+        # live array for hot-path staging packs.
+        self.placement_plane = placement.PlacementPlane(
+            n_docs, n_shards, spare_slots
         )
-        used = set(map(int, self._slot))
-        self._free_slots: dict[int, list[int]] = {
-            s: [] for s in range(n_shards)
-        }
-        for slot in range(self.capacity):
-            if slot not in used:
-                self._free_slots[slot // self.docs_per_shard].append(slot)
+        self.capacity = self.placement_plane.capacity
+        self.docs_per_shard = self.placement_plane.docs_per_shard
+        self._slot = self.placement_plane.slots
         # Per-shard applied-op counters (host-side, no device readback):
         # accumulated at drain time, the hot-shard detection signal.
         self._shard_ops = np.zeros((n_shards,), np.int64)
@@ -2039,44 +2022,27 @@ class DocBatchEngine:
     # ---------------------------------------------------- placement/migration
     def shard_of(self, doc_idx: int) -> int:
         """The mesh shard currently hosting this doc's device row."""
-        return int(self._slot[doc_idx]) // self.docs_per_shard
+        return self.placement_plane.shard_of(doc_idx)
 
     def placement(self) -> dict[str, int]:
         """doc key -> mesh shard: the summary-ownership alignment surface
         (server.partition_manager.ScribePool.align_to_placement)."""
-        return {self.doc_keys[d]: self.shard_of(d) for d in range(self.n_docs)}
+        return self.placement_plane.placement(self.doc_keys)
 
     def shard_load(self) -> tuple[np.ndarray, np.ndarray]:
         """Per-shard (applied ops since the last ``hot_shards`` reset,
-        currently queued ops) — host-side accounting only, no device
-        readback."""
-        depth = np.zeros((self.n_shards,), np.int64)
-        for d in range(self.n_docs):
-            q = len(self.hosts[d].queue)
-            if q:
-                depth[self.shard_of(d)] += q
-        return self._shard_ops.copy(), depth
+        currently queued ops) — see placement.shard_load."""
+        return placement.shard_load(self)
 
     def hot_shards(
         self, factor: float = 2.0, reset: bool = False, load=None
     ) -> list[int]:
         """Shards whose load (applied + queued ops) exceeds ``factor`` x
-        the fleet mean — the live-migration trigger.  ``reset`` zeroes the
-        applied-op counters so the next window measures fresh traffic;
-        callers that already hold a ``shard_load()`` result pass its sum
-        as ``load`` to skip the O(n_docs) rewalk."""
-        if load is None:
-            ops, depth = self.shard_load()
-            load = ops + depth
-        if reset:
-            self._shard_ops[:] = 0
-        if self.n_shards <= 1 or not load.any():
-            return []
-        mean = float(load.mean())
-        return [int(s) for s in np.flatnonzero(load > factor * mean)]
+        the fleet mean — see placement.hot_shards."""
+        return placement.hot_shards(self, factor, reset, load)
 
     def free_slots(self, shard: int) -> int:
-        return len(self._free_slots[shard])
+        return self.placement_plane.free_slots(shard)
 
     def migrate_doc(self, d: int, dst_shard: int) -> bool:
         # ckpt_lock: migration mutates self.state/self._slot, which the
@@ -2099,26 +2065,31 @@ class DocBatchEngine:
         after.  Host-side queues, retained logs, and checkpoint floors
         travel with the doc untouched — a doc may migrate MID-STREAM with
         staged ops pending; they simply apply at the new slot on the next
-        step.  Returns False (doc stays put) when the doc is off the batch
-        path (lane/oracle/quarantine), already on ``dst_shard``, poisoned,
-        or the destination has no free slot.
+        step.  Raises ``placement.PlacementError`` for a doc pinned to a
+        parallel lane (segment-sharded or overflow: its serving state
+        lives outside the fleet slot, so a silent slot handoff would
+        strand it — drain or demote first).  Returns False (doc stays
+        put) when the doc is oracle/quarantine-routed, already on
+        ``dst_shard``, poisoned, or the destination has no free slot.
         """
-        if not (0 <= dst_shard < self.n_shards):
-            raise ValueError(f"no shard {dst_shard} in a {self.n_shards}-shard mesh")
-        if not (0 <= d < self.n_docs):
-            raise ValueError(f"no doc {d}")
-        if (
-            d in self.overflow or d in self.oracles
-            or d in self.quarantine or d in self.seg_lanes
-        ):
+        plane = self.placement_plane
+        plane.validate(d, dst_shard)
+        plane.require_migratable(
+            d,
+            "segment" if d in self.seg_lanes
+            else "overflow" if d in self.overflow else None,
+        )
+        if d in self.oracles or d in self.quarantine:
             return False
-        src_slot = int(self._slot[d])
+        reservation = plane.reserve(d, dst_shard)
+        if reservation is None:
+            return False
+        src_slot, dst_slot = reservation
         src_shard = src_slot // self.docs_per_shard
-        if src_shard == dst_shard or not self._free_slots[dst_shard]:
-            return False
         h = self.hosts[d]
         row = jax.tree.map(lambda x: np.asarray(x[src_slot]), self.state)
         if int(row.error):
+            plane.release(dst_slot)
             return False  # recover first; never migrate a latched row
         self._sync_native_props(h)
         summary = kb.state_to_summary(
@@ -2130,16 +2101,15 @@ class DocBatchEngine:
                 lambda p: self._prop_slot_for_geom(h, p, self.geometry),
             )
         except (ValueError, IndexError):
+            plane.release(dst_slot)
             return False  # does not re-pack at batch geometry: stay put
-        dst_slot = self._free_slots[dst_shard].pop()
         self.state = jax.tree.map(
             lambda x, s: x.at[dst_slot].set(s), self.state, new_row
         )
         self.state = jax.tree.map(
             lambda x, s: x.at[src_slot].set(s), self.state, self._proto
         )
-        self._slot[d] = dst_slot
-        self._free_slots[src_shard].append(src_slot)
+        plane.commit(d, src_slot, dst_slot)
         # Fresh row content (text pool re-packed): the watchdog must
         # re-verify before the pre-filter may skip this doc again.
         self._verified_digest.pop(d, None)
@@ -2161,61 +2131,19 @@ class DocBatchEngine:
         whose own queue exceeds the fleet mean cannot be rebalanced by
         placement; with a segs axis available that doc is promoted to the
         segment-parallel path instead and appears in the result with
-        ``dst_shard == -1`` (its placement slot stays reserved)."""
-        ops, depth = self.shard_load()
-        load = ops + depth
-        hot = self.hot_shards(factor, reset=True, load=load)
-        if not hot:
-            return []
-        # Hysteresis: a doc whose OWN queue exceeds factor x the fleet
-        # mean IS the hotspot — migrating it just moves the hot shard
-        # (and would ping-pong it every interval, paying a full
-        # export/repack handoff each time).  Such docs are the
-        # hot-document-parallelism problem (ROADMAP), not a placement
-        # problem; skip them and move the deepest doc that actually
-        # rebalances.
-        mean = float(load.mean())
-        moves: list[tuple[int, int, int]] = []
-        for s in hot:
-            if len(moves) >= max_moves:
-                break
-            candidates = [
-                d for d in range(self.n_docs)
-                if self.shard_of(d) == s and not self._in_lane(d)
-                and len(self.hosts[d].queue) <= factor * mean
-            ]
-            if not candidates:
-                self.counters.bump("hot_shard_moves_skipped")
-                # The skipped case IS the hot-document problem: a doc whose
-                # own queue exceeds the fleet mean cannot be placed away.
-                # With a segs axis available, promote it to the
-                # segment-parallel path instead of leaving it serialized.
-                if self.seg_shards > 1:
-                    hot_docs = sorted(
-                        (
-                            d for d in range(self.n_docs)
-                            if self.shard_of(d) == s and not self._in_lane(d)
-                            and len(self.hosts[d].queue) > factor * mean
-                        ),
-                        key=lambda dd: -len(self.hosts[dd].queue),
-                    )
-                    for d in hot_docs:
-                        if self.enable_segment_sharding(d):
-                            moves.append((d, s, -1))
-                            break
-                continue
-            d = max(candidates, key=lambda dd: len(self.hosts[dd].queue))
-            for dst in map(int, np.argsort(depth)):
-                if dst == s or not self._free_slots[dst]:
-                    continue
-                if self.migrate_doc(d, dst):
-                    depth[dst] += len(self.hosts[d].queue)
-                    moves.append((d, s, dst))
-                    break
-        if moves:
-            self.counters.bump("hot_shard_rebalances", len(moves))
-            instant("rebalance", moves=len(moves), hot_shards=len(hot))
-        return moves
+        ``dst_shard == -1`` (its placement slot stays reserved).  The
+        detection + move-selection skeleton is the shared plane's
+        (placement.rebalance_hot_shards — the tree fleet rides the same
+        one); the segment-parallel promotion of hot DOCUMENTS is this
+        engine's hook into it."""
+        return placement.rebalance_hot_shards(
+            self, self.placement_plane, factor, max_moves,
+            in_lane=self._in_lane,
+            promote_hot_doc=(
+                self.enable_segment_sharding if self.seg_shards > 1
+                else None
+            ),
+        )
 
     def _sync_native_props(self, h: _DocHost) -> None:
         """Fold the native encoder's C++ prop-interning table into the host
@@ -2554,36 +2482,13 @@ class DocBatchEngine:
     def _restore(self, store, parallel, max_workers, refresh) -> list[int]:
         t_start = time.monotonic()
         with span("restore_scan", docs=self.n_docs):
-            candidates: list[int] = []
-            cand_mtime: dict[int, float] = {}
-            for d in range(self.n_docs):
-                h = self.hosts[d]
-                if h.restored and not refresh:
-                    # Already seeded by an earlier restore (e.g. a local
-                    # checkpoint before a scribe boot-from-summary pass):
-                    # the first source wins — never regress a doc's
-                    # replay floor.
-                    continue
-                if refresh and self._queue_depth(d):
-                    # Trailing adoption never races staged work: a doc
-                    # with pending ops is being SERVED, not trailed.
-                    continue
-                if refresh:
-                    # Unchanged record file -> nothing new to adopt: the
-                    # atomic save replaces the file, so trailing polls pay
-                    # one stat per doc, not O(total checkpoint bytes).
-                    # The seen-mtime is stamped only after a SUCCESSFUL
-                    # load below — stamping here would let one transient
-                    # read failure permanently exclude the doc from
-                    # trailing.
-                    mt = getattr(store, "mtime", lambda _k: None)(
-                        self.doc_keys[d]
-                    )
-                    if mt is not None and self._trail_mtime.get(d) == mt:
-                        continue
-                    if mt is not None:
-                        cand_mtime[d] = mt
-                candidates.append(d)
+            # First-boot vs trailing/re-seed candidate selection is the
+            # shared plane's (placement.restore_candidates): first source
+            # wins for live serving, trailing never races staged work,
+            # unchanged record files skip on one mtime stat per doc.
+            candidates, cand_mtime = placement.restore_candidates(
+                self, store, refresh, self._queue_depth
+            )
         if not candidates:
             return []
         records = load_checkpoint_records(
@@ -2720,52 +2625,31 @@ class DocBatchEngine:
             self.recovery_tracker.begin(t_start)
         return restored
 
-    def adopt_boot_snapshot(self, doc_idx: int, record: dict) -> int:
+    def adopt_boot_snapshot(
+        self, doc_idx: int, record: dict
+    ) -> placement.AdoptResult:
         """Client half of the fan-out plane's ``{"t":"resync","boot":true}``
-        contract: a consumer that fell off the retained log re-seeds the
-        document from a historian snapshot record (the scribe summary
-        schema, ``engine: doc_batch``) and re-consumes from the returned
-        seq floor.  Staged pre-gap work is dropped — the snapshot covers
-        it — and the adoption rides the refresh re-seed path, so lanes,
-        quorum, prop tables and the replay floor all reset consistently.
-        A record at or below the doc's applied floor adopts nothing (the
-        caller re-consumes from the doc's own floor)."""
-        with self.ckpt_lock:
-            h = self.hosts[doc_idx]
-            seq = int(record["seq"])
-            if seq <= h.last_seq:
-                self.counters.bump("boot_snapshots_stale")
-                return h.last_seq
-            # Clear staged work up front: the refresh guard refuses docs
-            # with pending ops (trailing must not race serving), but a
-            # boot resync REPLACES the doc — pre-gap rows are covered.
-            h.queue.clear()
-            for lane in (self.overflow.get(doc_idx),
-                         self.seg_lanes.get(doc_idx)):
-                if lane is not None:
-                    lane.queue.clear()
-            self._busy.discard(doc_idx)
+        contract (the shared orchestration — placement.adopt_boot_snapshot —
+        riding this engine's refresh re-seed path): a consumer that fell
+        off the retained log re-seeds the document from a historian
+        snapshot record (the scribe summary schema, ``engine: doc_batch``)
+        and re-consumes from the returned floor; lanes, quorum, prop
+        tables and the replay floor all reset consistently."""
+        return placement.adopt_boot_snapshot(
+            self, doc_idx, record, self._clear_staged
+        )
 
-            key = self.doc_keys[doc_idx]
-
-            class _OneRecord:
-                def load(self, doc_id, _key=key, _rec=record):
-                    return _rec if doc_id == _key else None
-
-            adopted = self._restore(
-                _OneRecord(), parallel=False, max_workers=None, refresh=True
-            )
-            if doc_idx not in adopted:
-                # The record was unusable (engine mismatch / schema drift):
-                # fail LOUDLY — returning the stale floor would send the
-                # consumer back to a range the server already declared
-                # gone, an infinite resync loop that looks healthy.
-                raise ValueError(
-                    f"boot snapshot for doc {key!r} not adoptable "
-                    f"(engine={record.get('engine')!r})"
-                )
-            self.counters.bump("boot_snapshots_adopted")
-            return h.last_seq
+    def _clear_staged(self, doc_idx: int) -> None:
+        """Drop a doc's staged pre-gap work ahead of a boot-snapshot
+        adoption: the refresh guard refuses docs with pending ops
+        (trailing must not race serving), but a boot resync REPLACES the
+        doc — pre-gap rows are covered by the snapshot."""
+        self.hosts[doc_idx].queue.clear()
+        for lane in (self.overflow.get(doc_idx),
+                     self.seg_lanes.get(doc_idx)):
+            if lane is not None:
+                lane.queue.clear()
+        self._busy.discard(doc_idx)
 
     def _drop_restored_identity(self, d: int) -> None:
         """Forget a doc's prior adoption before a refresh re-seed (warm-

@@ -52,9 +52,10 @@ from ..dds.tree.mark_pool import MarkPool
 from ..dds.tree.mark_pool import pool_commit_from_json as _pool_commit_from_json
 from ..dds.tree.field_kinds import OptionalChange
 from ..dds.tree.forest import ROOT_FIELD, Forest, Node
-from ..observability.flight_recorder import RecompileWatchdog, span
+from ..observability.flight_recorder import RecompileWatchdog, instant, span
 from ..ops import tree_kernel as tk
 from .dispatch import dispatch_plane
+from . import placement
 from ..protocol.messages import MessageType, SequencedMessage
 from ..utils.telemetry import HealthCounters
 from .recovery import (
@@ -242,6 +243,7 @@ class TreeBatchEngine:
         checkpoint_every: int = 0,
         doc_keys: list[str] | None = None,
         megastep_k: int = 1,
+        spare_slots: int = 0,
         plan_cache: bool = True,
         mark_pool: bool = True,
         native_wire: bool = True,
@@ -322,13 +324,23 @@ class TreeBatchEngine:
         self._plans: dict[tuple, _TranslationPlan] = {}
         self._collector = _FlattenCollector()
         self._PLAN_CACHE_MAX = 4096
-        # Fleet capacity rounds up to a mesh multiple (padding rows are
-        # inert: empty queues -> all-NOOP slices), mirroring the string
-        # engine; shard = doc // docs_per_shard (contiguous placement).
+        # Placement rides the shared plane (models/placement.py): doc ->
+        # slot indirection with per-shard spare-slot free pools, the same
+        # contract as the string engine (fleet capacity rounds up to a
+        # mesh multiple; padding/free rows are inert pristine protos).
+        # ``_slot`` aliases the plane's live array for hot-path packing.
         self.n_shards = mesh.devices.size if mesh is not None else 1
-        self.fleet_capacity = -(-n_docs // self.n_shards) * self.n_shards
-        self.docs_per_shard = self.fleet_capacity // self.n_shards
+        self.placement_plane = placement.PlacementPlane(
+            n_docs, self.n_shards, spare_slots
+        )
+        self.fleet_capacity = self.placement_plane.capacity
+        self.docs_per_shard = self.placement_plane.docs_per_shard
+        self._slot = self.placement_plane.slots
+        # Per-shard applied-op counters (host-side): accumulated at drain
+        # time, the hot-shard detection signal.
+        self._shard_ops = np.zeros((self.n_shards,), np.int64)
         proto = tk.init_nested_forest(capacity, pool_capacity)
+        self._proto = proto  # pristine row: retires vacated/re-seeded slots
         self.state = jax.tree.map(
             lambda x: jnp.broadcast_to(
                 x, (self.fleet_capacity,) + x.shape
@@ -906,9 +918,10 @@ class TreeBatchEngine:
     def _drain_into(
         self, busy: list[int], ops: np.ndarray, payloads: np.ndarray
     ) -> list[int]:
-        """Dequeue up to ops_per_step op rows per busy doc into its row of
-        the zeroed staging arrays — slice copies, never a per-op Python
-        loop.  Returns the rows written (buffer-reuse dirty tracking)."""
+        """Dequeue up to ops_per_step op rows per busy doc into its
+        PLACEMENT slot's row of the zeroed staging arrays — slice copies,
+        never a per-op Python loop.  Returns the rows written
+        (buffer-reuse dirty tracking)."""
         B = self.ops_per_step
         written: list[int] = []
         for d in busy:
@@ -916,12 +929,15 @@ class TreeBatchEngine:
             take = min(B, len(h.queue))
             if not take:
                 continue
+            r = int(self._slot[d])
             src_ops, src_payloads = h.queue.take(take)
-            ops[d, :take] = src_ops
-            payloads[d, :take] = src_payloads
+            ops[r, :take] = src_ops
+            payloads[r, :take] = src_payloads
+            # Charge the op count to the hosting shard (hot-shard signal).
+            self._shard_ops[r // self.docs_per_shard] += take
             if not h.queue:
                 self._busy.discard(d)
-            written.append(d)
+            written.append(r)
         return written
 
     def step(self) -> int:
@@ -974,7 +990,7 @@ class TreeBatchEngine:
                 )
                 self._rows_upper = np.where(
                     active,
-                    np.asarray(self.state.nrow)[: self.n_docs].astype(
+                    np.asarray(self.state.nrow)[self._slot].astype(
                         np.int64
                     )
                     + queued,
@@ -982,7 +998,7 @@ class TreeBatchEngine:
                 )
                 self._pool_upper = np.where(
                     active,
-                    np.asarray(self.state.pool_end)[: self.n_docs].astype(
+                    np.asarray(self.state.pool_end)[self._slot].astype(
                         np.int64
                     )
                     + queued_words,
@@ -1026,12 +1042,13 @@ class TreeBatchEngine:
         with span("readback", kind="error_vector"):
             err = np.asarray(self.state.error)
         for d in range(self.n_docs):
-            if err[d] and d not in self.fallbacks:
+            s = int(self._slot[d])
+            if err[s] and d not in self.fallbacks:
                 # Capacity/range overflow on device: replay on the host.
                 self._route_to_fallback(d)
                 self.counters.bump("fallback_routes")
                 self.state = self.state._replace(
-                    error=self.state.error.at[d].set(0)
+                    error=self.state.error.at[s].set(0)
                 )
         return steps
 
@@ -1149,12 +1166,11 @@ class TreeBatchEngine:
 
         ``refresh`` is the warm-standby trailing mode: adopt docs that
         GAINED a record since the last pass, without opening a recovery
-        incident.  Parity gap vs the string engine (same precedent as
-        ``migrations_unsupported``): an already-adopted tree doc is NOT
-        re-seeded from a newer record — its device columns came from a
-        staged re-materialization that cannot be overwritten in place —
-        so a promoted tree standby replays from each doc's first-adopted
-        floor instead of its freshest one."""
+        incident — including the IN-PLACE RE-SEED of an already-adopted
+        doc from a strictly newer record (string-engine parity): the
+        doc's materialized pooled columns reset to the pristine proto row
+        and the fresh forest re-materializes on top, so a promoted tree
+        standby replays from each doc's freshest durable floor."""
         store = store if store is not None else self.checkpoint_store
         if store is None:
             return []
@@ -1164,30 +1180,13 @@ class TreeBatchEngine:
     def _restore(self, store, parallel, max_workers, refresh) -> list[int]:
         t_start = time.monotonic()
         with span("restore_scan", docs=self.n_docs):
-            candidates = []
-            cand_mtime: dict[int, float] = {}
-            for d in range(self.n_docs):
-                h = self.hosts[d]
-                if h.restored:
-                    continue  # already-seeded docs: first source wins
-                if refresh and h.queue:
-                    # Trailing never races staged work (a doc with queued
-                    # rows is being served, not trailed).
-                    continue
-                if refresh:
-                    # Unchanged record file -> nothing new: trailing polls
-                    # pay one stat per doc, not a record re-read.  Stamped
-                    # as seen only after a successful load below — a
-                    # transient read failure must not permanently exclude
-                    # the doc from trailing.
-                    mt = getattr(store, "mtime", lambda _k: None)(
-                        self.doc_keys[d]
-                    )
-                    if mt is not None and self._trail_mtime.get(d) == mt:
-                        continue
-                    if mt is not None:
-                        cand_mtime[d] = mt
-                candidates.append(d)
+            # First-boot vs trailing/re-seed candidate selection is the
+            # shared plane's (placement.restore_candidates): first source
+            # wins for live serving, trailing never races staged work,
+            # unchanged record files skip on one mtime stat per doc.
+            candidates, cand_mtime = placement.restore_candidates(
+                self, store, refresh, lambda d: len(self.hosts[d].queue)
+            )
         if not candidates:
             return []
         records = load_checkpoint_records(
@@ -1202,6 +1201,17 @@ class TreeBatchEngine:
             if rec is None or rec.get("engine") != "tree_batch":
                 continue
             h = self.hosts[d]
+            if refresh and h.restored:
+                if int(rec["seq"]) <= h.last_seq:
+                    continue  # nothing newer to adopt
+                self.counters.bump("checkpoint_refreshes")
+            if refresh:
+                # In-place re-seed: forget the prior adoption (host
+                # windows, staged rows, fallback entry) and reset the
+                # doc's materialized pooled columns to the pristine proto
+                # row, so the fresh record's re-materialization lands on
+                # clean state.
+                self._drop_restored_identity(d)
             h.em = EditManager(mark_pool=self.markpool)
             h.em.load(rec["em"])
             h.base_seq = h.last_seq = int(rec["seq"])
@@ -1247,7 +1257,62 @@ class TreeBatchEngine:
             # they ARE the restore's device half).  note_incident()
             # back-dates to the kill time.
             self.recovery_tracker.begin(t_start)
+        if restored and refresh:
+            # Trailing/re-seed hands back LIVE state: apply the staged
+            # re-materializations now (unlike the string engine's direct
+            # row scatter, the tree handoff rides the batched step), so a
+            # promoted standby serves byte-identical reads immediately
+            # and the next trailing pass's staged-work guard doesn't see
+            # this pass's own rows.
+            self._step_fleet()
         return restored
+
+    # ----------------------------------------------------------------- warmup
+    def warmup(self) -> int:
+        """Pre-compile the fleet's serving programs (warm-standby boot):
+        dispatch all-NOOP megasteps at every pow2 depth up to
+        ``megastep_k`` plus one compact through the exact serving entry
+        points, so a promoted standby pays ZERO XLA compiles on its first
+        real dispatch.  Zeroed staging rows are NOOP by kernel contract
+        (NestedOpKind.NOOP == 0), so state bytes are untouched.  Returns
+        the number of warmup dispatches run."""
+        warmed = 0
+        with self.ckpt_lock, span("warmup", k_max=self.megastep_k):
+            stage = self._staging()
+            if self.mesh is None:
+                # The K=1 mesh-less fast path dispatches _step directly.
+                ops, payloads = stage.acquire(1, self.fleet_capacity)
+                dev_ops, dev_payloads = stage.upload(ops[0], payloads[0])
+                self.state = self._step(self.state, dev_ops, dev_payloads)
+                warmed += 1
+            depths = []
+            k = 1
+            while k <= self.megastep_k:
+                depths.append(k)
+                k *= 2
+            if self.megastep_k > 1 and self.megastep_k not in depths:
+                # _select_k clamps to min(megastep_k, pow2(need)), so a
+                # non-pow2 configured K is itself a reachable dispatch
+                # shape — skip it here and the first deep-queue dispatch
+                # after promotion pays the compile warmup exists to kill.
+                depths.append(self.megastep_k)
+            for k in depths:
+                if self.mesh is not None or k > 1:
+                    ops, payloads = stage.acquire(k, self.fleet_capacity)
+                    dev_ops, dev_payloads = stage.upload(ops, payloads)
+                    self.state = self._megastep(
+                        self.state, dev_ops, dev_payloads
+                    )
+                    warmed += 1
+            self.state = self._compact(self.state)
+            warmed += 1
+            jax.block_until_ready(self.state)
+            # Absorb the warmup compiles into the watchdog count NOW, so
+            # they show up as boot-time cache growth rather than landing
+            # on the first serving step's poll.
+            self.recompile_watchdog.poll()
+        self.counters.gauge("warmup_dispatches", warmed)
+        return warmed
 
     # ----------------------------------------------------------------- health
     def health(self) -> dict:
@@ -1294,11 +1359,6 @@ class TreeBatchEngine:
             max((len(self.hosts[d].queue) for d in self._busy), default=0),
         )
         self.counters.gauge("n_shards", self.n_shards)
-        # Rebalance parity gap, surfaced: the tree fleet detects hot shards
-        # but cannot migrate docs (rebalance_hot_shards is a counted
-        # no-op), so the count is always present for supervisors to alarm
-        # on — zero means "no imbalance seen", not "unmonitored".
-        self.counters.bump("migrations_unsupported", 0)
         if self.n_shards > 1:
             depth = [0] * self.n_shards
             for d in range(self.n_docs):
@@ -1347,7 +1407,8 @@ class TreeBatchEngine:
         """The document's root field as forest JSON (Node.to_json shape)."""
         if doc_idx in self.fallbacks:
             return [n.to_json() for n in self.fallbacks[doc_idx].root_field]
-        st = jax.tree.map(lambda x: x[doc_idx], self.state)
+        slot = int(self._slot[doc_idx])
+        st = jax.tree.map(lambda x: x[slot], self.state)
         field_names, type_names = self._name_tables()
         return tk.nested_to_json(st, field_names, type_names)
 
@@ -1357,65 +1418,171 @@ class TreeBatchEngine:
         return [n.get("v") for n in self.tree_json(doc_idx)]
 
     def shard_of(self, doc_idx: int) -> int:
-        """The mesh shard hosting this doc's device row (contiguous
-        placement; the tree fleet has no migration yet)."""
-        return doc_idx // self.docs_per_shard
+        """The mesh shard currently hosting this doc's device row."""
+        return self.placement_plane.shard_of(doc_idx)
 
     def placement(self) -> dict[str, int]:
         """doc key -> mesh shard (ScribePool.align_to_placement surface)."""
-        return {self.doc_keys[d]: self.shard_of(d) for d in range(self.n_docs)}
+        return self.placement_plane.placement(self.doc_keys)
+
+    def shard_load(self) -> tuple[np.ndarray, np.ndarray]:
+        """Per-shard (applied ops since the last ``hot_shards`` reset,
+        currently queued ops) — see placement.shard_load."""
+        return placement.shard_load(self)
 
     def hot_shards(
         self, factor: float = 2.0, reset: bool = False, load=None
     ) -> list[int]:
-        """Shards whose queued-op load exceeds ``factor`` x the fleet mean —
-        the same detection surface as the string engine (which also folds in
-        applied-op counters; the tree fleet only tracks queue depth).
-        ``reset``/``load`` are accepted for signature parity with
-        ``DocBatchEngine.hot_shards`` (engine-agnostic supervisors) and
-        ignored: there are no applied-op counters to reset, and queue
-        depth is recomputed each call."""
-        if self.n_shards <= 1:
-            return []
-        depth = np.zeros((self.n_shards,), np.int64)
-        for d in range(self.n_docs):
-            q = len(self.hosts[d].queue)
-            if q:
-                depth[self.shard_of(d)] += q
-        if not depth.any():
-            return []
-        return [int(s) for s in np.flatnonzero(depth > factor * depth.mean())]
+        """Shards whose load (applied + queued ops) exceeds ``factor`` x
+        the fleet mean — see placement.hot_shards (the same detection the
+        string engine rides)."""
+        return placement.hot_shards(self, factor, reset, load)
+
+    def free_slots(self, shard: int) -> int:
+        return self.placement_plane.free_slots(shard)
+
+    def migrate_doc(self, d: int, dst_shard: int) -> bool:
+        # ckpt_lock: migration mutates self.state and the slot map, which
+        # the background checkpoint sweep and ingest both read.
+        with self.ckpt_lock:
+            return self._migrate_doc_locked(d, dst_shard)
+
+    def _migrate_doc_locked(self, d: int, dst_shard: int) -> bool:
+        """Live tree-doc migration between mesh shards (hot-shard
+        rebalancing; string-engine parity).
+
+        The handoff is the same trunk-fold + re-materialization the
+        restore path trusts: the trunk suffix folds into the checkpoint
+        forest (which then carries the doc's FULL ingested trunk state —
+        including any rows still queued for the device, so the queue
+        drops), the vacated slot retires to the pristine proto row, and
+        the forest re-materializes at the destination slot as one
+        whole-content insert staged through the normal batched step.
+        Observable state (``tree_json``) is byte-identical once staged
+        work applies; host EditManager windows and checkpoint floors
+        travel with the doc untouched, so a doc may migrate MID-STREAM.
+        Raises ``placement.PlacementError`` for a fallback-routed doc
+        (its serving state lives in a host Forest, not the fleet slot).
+        Returns False (doc stays put) when the doc is already on
+        ``dst_shard``, its row latched an error, the forest cannot
+        re-flatten, or the destination has no free slot."""
+        plane = self.placement_plane
+        plane.validate(d, dst_shard)
+        plane.require_migratable(
+            d, "fallback" if d in self.fallbacks else None
+        )
+        reservation = plane.reserve(d, dst_shard)
+        if reservation is None:
+            return False
+        src_slot, dst_slot = reservation
+        src_shard = src_slot // self.docs_per_shard
+        h = self.hosts[d]
+        if int(np.asarray(self.state.error)[src_slot]):
+            plane.release(dst_slot)
+            return False  # recover first; never migrate a latched row
+        # Fold the trunk suffix: the checkpoint forest becomes the full
+        # ingested trunk state (the same fold the checkpoint sweep and
+        # fallback routing perform).
+        for t in h.trunk_log:
+            apply_commit(h.checkpoint.root, t)
+        h.trunk_log.clear()
+        ops_blk = pay_blk = None
+        if h.checkpoint.root_field:
+            ch = NodeChange()
+            ch.fields[ROOT_FIELD] = [
+                Insert([n.clone() for n in h.checkpoint.root_field])
+            ]
+            try:
+                ops_blk, pay_blk = self._flatten([ch], seq=h.last_seq)
+            except UnsupportedShape:
+                plane.release(dst_slot)
+                return False  # cannot re-pack: doc keeps serving in place
+        # Queued rows are covered by the folded forest; re-staging them on
+        # top of the re-materialization would double-apply.
+        h.queue.clear()
+        self._busy.discard(d)
+        self.state = jax.tree.map(
+            lambda x, s: x.at[src_slot].set(s), self.state, self._proto
+        )
+        plane.commit(d, src_slot, dst_slot)
+        # The destination slot is pristine by pool invariant (spare slots
+        # start as broadcast protos; retired slots reset above), so the
+        # watermarks restart at the re-materialization bound.
+        self._rows_upper[d] = 0
+        self._pool_upper[d] = 0
+        if ops_blk is not None and len(ops_blk):
+            rows_up, words_up = self._block_upper(ops_blk)
+            self._rows_upper[d] += rows_up
+            self._pool_upper[d] += words_up
+            h.queue.extend_block(ops_blk, pay_blk)
+            self._busy.add(d)
+        self.counters.bump("doc_migrations")
+        instant(
+            "migrate_doc", doc=self.doc_keys[d], src=src_shard,
+            dst=dst_shard,
+        )
+        return True
 
     def rebalance_hot_shards(
         self, factor: float = 2.0, max_moves: int = 1
     ) -> list[tuple[int, int, int]]:
-        """Parity surface with ``DocBatchEngine.rebalance_hot_shards`` —
-        but the tree fleet has slot-fixed placement (no slot indirection,
-        no ``migrate_doc``), so this is a COUNTED no-op: hot shards are
-        detected and ``migrations_unsupported`` is bumped per detection so
-        fleet supervisors can alarm on sustained imbalance instead of the
-        previous silent nothing.  Returns [] always."""
-        hot = self.hot_shards(factor)
-        if hot:
-            self.counters.bump("migrations_unsupported", len(hot))
-            if self.counters.logger is not None:
-                self.counters.logger.error(
-                    "tree_rebalance_unsupported",
-                    f"hot shards {hot} (tree fleet cannot migrate docs)",
-                )
-        return []
+        """Detect hot shards and live-migrate their deepest-queued docs
+        to the coldest shards with free slots — the shared plane's
+        skeleton (placement.rebalance_hot_shards), one trunk-fold +
+        re-materialization handoff per move.  Returns the ``(doc,
+        src_shard, dst_shard)`` moves made; callers re-align the scribe
+        pool afterwards so summary ownership follows the docs."""
+        return placement.rebalance_hot_shards(
+            self, self.placement_plane, factor, max_moves,
+            in_lane=lambda d: d in self.fallbacks,
+        )
 
-    def adopt_boot_snapshot(self, doc_idx: int, record: dict) -> int:
-        """Parity surface with ``DocBatchEngine.adopt_boot_snapshot`` —
-        the tree fleet cannot re-seed an already-materialized doc's device
-        columns in place (same documented gap as ``refresh`` adoption and
-        ``migrations_unsupported``), so this is a COUNTED no-op returning
-        the doc's own floor: the consumer re-consumes from where the
-        engine actually is, which is correct (if slower) because the
-        ordered log replay from that floor is never gapped for a doc the
-        engine itself kept up with."""
-        self.counters.bump("boot_snapshot_unsupported")
-        return self.hosts[doc_idx].last_seq
+    def adopt_boot_snapshot(
+        self, doc_idx: int, record: dict
+    ) -> placement.AdoptResult:
+        """Client half of the fan-out plane's ``{"t":"resync","boot":true}``
+        contract (the shared orchestration — placement.adopt_boot_snapshot —
+        riding this engine's refresh re-seed path): a consumer that fell
+        off the retained log re-seeds the document from a historian
+        snapshot record (the scribe summary schema, ``engine:
+        tree_batch``) and re-consumes from the returned floor; the host
+        EditManager window, checkpoint forest, and materialized device
+        columns all reset consistently."""
+        return placement.adopt_boot_snapshot(
+            self, doc_idx, record, self._clear_staged
+        )
+
+    def _clear_staged(self, doc_idx: int) -> None:
+        """Drop a doc's staged pre-gap work ahead of a boot-snapshot
+        adoption (the refresh guard refuses docs with pending ops; a boot
+        resync REPLACES the doc, so pre-gap rows are covered)."""
+        self.hosts[doc_idx].queue.clear()
+        self._busy.discard(doc_idx)
+
+    def _drop_restored_identity(self, d: int) -> None:
+        """Forget a doc's prior adoption before a refresh re-seed (warm-
+        standby trailing / boot-snapshot adoption: no staged work by
+        contract).  The device half resets the doc's materialized pooled
+        columns to the pristine proto row — re-materialization is
+        incremental on top of whatever the row holds, so a re-seed must
+        land on clean state (this reset is what closes the old
+        'cannot be overwritten in place' parity gap)."""
+        had_fallback = self.fallbacks.pop(d, None) is not None
+        h = self.hosts[d]
+        h.queue.clear()
+        h.trunk_log.clear()
+        h.checkpoint = Forest()
+        self._busy.discard(d)
+        self._rows_upper[d] = 0
+        self._pool_upper[d] = 0
+        if h.total_commits or h.restored or had_fallback:
+            # Only docs that ever materialized (or whose slot may hold
+            # stale pre-fallback content) pay the row reset; a fresh
+            # standby's first adoption lands on already-pristine rows.
+            slot = int(self._slot[d])
+            self.state = jax.tree.map(
+                lambda x, s: x.at[slot].set(s), self.state, self._proto
+            )
 
     def errors(self) -> np.ndarray:
-        return np.asarray(self.state.error)[: self.n_docs]
+        return np.asarray(self.state.error)[self._slot]

@@ -27,6 +27,13 @@ Layers:
 Multi-host pods extend the same mesh across hosts: the doc axis rides
 ICI within a slice and DCN across slices — no code change, just a larger
 ``jax.devices()`` list.
+
+The doc-axis shard index (device position along ``docs``) is also the
+shard domain of the shared placement plane (``models/placement.py``):
+``shard_of``/``free_slots``/``migrate_doc`` address THESE shards, so a
+live migration is a slot handoff between two positions of the same
+sharded state arrays — the mesh program never recompiles for a move,
+and 2-D seg-lane docs keep their reserved doc-axis slot while promoted.
 """
 
 from __future__ import annotations

@@ -301,8 +301,11 @@ class WarmStandby:
     --standby).
 
     Requires an engine whose ``restore_from_checkpoints`` supports
-    ``refresh=`` trailing re-adoption (``DocBatchEngine`` today; the tree
-    fleet's standby is future work alongside its migration gap)."""
+    ``refresh=`` trailing re-adoption — both fleet families do
+    (``DocBatchEngine`` scatters the fresh summary over the doc's row;
+    ``TreeBatchEngine`` resets the doc's pooled columns to the proto row
+    and re-materializes the newer checkpoint forest on top), so a mixed
+    string+tree deployment runs one standby per family."""
 
     def __init__(
         self,

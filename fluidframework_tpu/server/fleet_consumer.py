@@ -280,8 +280,18 @@ class FleetConsumer:
             # commit seq), so it lands after the record's own keys.
             record = {**body["summary"], "doc": doc_id,
                       "seq": int(body["seq"])}
-            floor = self.engine.adopt_boot_snapshot(idx, record)
-            sock = self._subscribe(doc_id, from_seq=floor)
+            result = self.engine.adopt_boot_snapshot(idx, record)
+            if not result.adopted:
+                # Refused below the doc's floor: the snapshot cannot help,
+                # and the server already declared this consumer's range
+                # gone — re-subscribing from the engine's own floor would
+                # just draw another boot marker (an infinite resync loop
+                # that looks healthy).  Fall to the supervisor path.
+                raise RuntimeError(
+                    f"boot snapshot seq {record['seq']} at or below doc "
+                    f"floor {result.floor}: nothing to adopt"
+                )
+            sock = self._subscribe(doc_id, from_seq=result.floor)
         except (OSError, RuntimeError, ValueError, KeyError) as e:
             # No snapshot to boot from (or the re-subscribe died): the doc
             # is dead for this consumer, exactly like a server close — the

@@ -1709,6 +1709,20 @@ SEEDINGS = [
          "        _os.fsync(fd)\n"
      ),
      "blocking-under-lock", "blocking-under-lock"),
+    # A durable fsync planted inside the shared placement plane's
+    # reservation window: PlacementPlane._lock is a leaf every serving
+    # read convoys on, so it denies ALL blocking categories (PR 16).
+    ("models/placement.py",
+     lambda s: s.replace(
+         "    def require_migratable(",
+         "    def _seeded_fsync(self, fd):\n"
+         "        import os as _os\n"
+         "        with self._lock:\n"
+         "            _os.fsync(fd)\n"
+         "\n"
+         "    def require_migratable(",
+     ),
+     "blocking-under-lock", "blocking-under-lock"),
     # The "re-enable donation" edit on the declared replicated-out
     # program: flipping mesh_seg_program's default trips mesh-safety (and
     # the named regression test in test_segment_parallel.py).

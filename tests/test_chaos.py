@@ -499,6 +499,42 @@ def test_chaos_smoke_converges_byte_identical():
     assert c["ops_sequenced"] > 100
 
 
+def test_chaos_mixed_fleet_converges_both_families():
+    """ISSUE 16 acceptance smoke: a MIXED string+tree fleet under chaos —
+    fleet_kill takes out BOTH engine tiers at once, a warm standby
+    promotes per family, and a live ``migrate`` fault moves a tree doc
+    between mesh shards mid-stream — and both families converge
+    byte-identical to their fault-free oracles (RefMergeTree for the
+    string docs, EditManager+Forest replay for the tree docs)."""
+    schedule = ChaosSchedule(seed=16, events=[
+        ChaosEvent(5, "nack_storm", "cd0", 4),
+        ChaosEvent(8, "migrate", "td1"),
+        ChaosEvent(12, "fleet_kill"),
+        ChaosEvent(18, "torn_socket", "td0"),
+        ChaosEvent(22, "migrate", "td0"),
+    ])
+    report = run_chaos(seed=16, ticks=30, n_docs=2, n_tree_docs=2,
+                       schedule=schedule, standby=True,
+                       ckpt_stale_seconds=0.25)
+    inv = report["invariants"]
+    assert inv["converged_docs"] == 2
+    assert inv["tree_converged_docs"] == 2
+    assert inv["double_acks"] == 0
+    assert inv["max_queue_depth"] <= inv["queue_depth_bound"]
+    assert inv["max_tree_queue_depth"] <= inv["tree_queue_depth_bound"]
+    c = report["counters"]
+    assert c["fleet_restarts"] == 1
+    assert c["standby_promotions"] == 2  # one per family
+    assert c["doc_migrations"] >= 1  # the migrate fault made a real move
+    rec = report["recovery"]
+    assert rec["standby"] is True
+    assert rec["open"] == 0 and rec["tree_open"] == 0
+    assert rec["incidents"] >= 1 and rec["tree_incidents"] >= 1
+    assert 0 < rec["tree_recovery_p99_ms"] <= inv["recovery_bound_ms"]
+    tree = report["tree"]
+    assert tree["n_docs"] == 2 and tree["n_shards"] == 8
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", [10, 21, 33])
 def test_soak_full_schedule_multi_seed(seed):

@@ -699,6 +699,100 @@ def test_fleet_consumer_boot_marker_snapshot_resync_over_tcp(tmp_path):
         plane.stop()
 
 
+def test_fleet_consumer_boot_resync_refused_below_floor(tmp_path):
+    """Refusal half of the boot-resync contract: when the only historian
+    snapshot sits at/below the doc's applied floor, adoption is REFUSED —
+    re-subscribing from the engine's own floor would just draw another
+    boot marker (an infinite resync loop that looks healthy) — and the
+    doc falls to the supervisor restart path: ``boot_resync_failures``
+    counts, ``dead_socks`` carries the doc, and the engine's served state
+    is untouched."""
+    from fluidframework_tpu.dds.shared_string import SharedString
+    from fluidframework_tpu.models.doc_batch_engine import DocBatchEngine
+    from fluidframework_tpu.native.ingest_native import available
+    from fluidframework_tpu.server.fleet_consumer import FleetConsumer
+    from fluidframework_tpu.server.netserver import ServicePlane
+    from fluidframework_tpu.server.ordered_log import CheckpointStore
+
+    if not available():
+        pytest.skip("native ingest encoder unavailable")
+
+    plane = ServicePlane(historian_port=0).start()
+    fc = None
+    try:
+        with plane.nexus.lock:
+            doc = plane.service.document("d0")
+            a = SharedString(client_id="d0-w0")
+            doc.connect(a.client_id, a.process)
+            doc.process_all()
+
+        def flush():
+            n = 0
+            with plane.nexus.lock:
+                d = plane.service.document("d0")
+                for m in a.take_outbox():
+                    d.submit(m)
+                    n += 1
+                d.process_all()
+            return n
+
+        a.insert_text(0, "hello world")
+        rows = flush()
+
+        def mk_engine():
+            return DocBatchEngine(
+                1, max_segments=4096, text_capacity=1 << 16,
+                max_insert_len=8, ops_per_step=8, use_mesh=False,
+                recovery="off", doc_keys=["d0"],
+            )
+
+        eng = mk_engine()
+        fc = FleetConsumer(
+            "127.0.0.1", plane.nexus.port, eng, ["d0"],
+            historian=("127.0.0.1", plane.historian.port),
+        )
+        fc.run_for(rows)
+        assert eng.text(0) == a.text
+        text_before = eng.text(0)
+
+        # A perfectly well-formed snapshot record, but stamped at/below
+        # the doc's applied floor (the historian's seq stamp is the
+        # authoritative one): stale — nothing for the consumer to adopt.
+        oracle = mk_engine()
+        with plane.nexus.lock:
+            log_msgs = list(plane.service.document("d0").sequencer.log)
+        for m in log_msgs:
+            oracle.ingest(0, m)
+        oracle.step()
+        oracle.checkpoint_store = CheckpointStore(str(tmp_path / "ck"))
+        oracle.maybe_checkpoint(force=True)
+        rec = oracle.checkpoint_store.load("d0")
+        assert rec is not None
+        snap_seq = eng.hosts[0].last_seq  # == the floor: refused
+        with plane.nexus.lock:
+            plane.service.document("d0").save_snapshot(snap_seq, rec)
+
+        _force_boot_marker(plane, "d0")
+
+        deadline = time.monotonic() + 30
+        while not fc.dead_socks and time.monotonic() < deadline:
+            fc.pump(wait_s=0.05)
+            fc.step()
+        assert 0 in fc.dead_socks, "doc should fall to the supervisor path"
+        assert fc.boot_resyncs == 0
+        assert fc.boot_resync_failures == 1
+        assert fc.health()["boot_resync_failures"] == 1
+        assert eng.counters.get("boot_snapshots_stale") == 1
+        assert not eng.counters.get("boot_snapshots_adopted")
+        # The refusal never touched the served doc.
+        assert eng.text(0) == text_before
+        assert not eng.errors().any()
+    finally:
+        if fc is not None:
+            fc.close()
+        plane.stop()
+
+
 def test_delta_connection_surfaces_boot_marker():
     """Driver side of the contract: NetworkDeltaConnection hands the boot
     marker to the host's boot listener (the container reload hook) instead

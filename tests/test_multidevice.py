@@ -381,6 +381,132 @@ def test_migration_summary_chain_continues(tmp_path):
     pool.close()
 
 
+def test_tree_midstream_migration_byte_identity():
+    """Tree-family mirror of test_midstream_migration_byte_identity: a
+    tree doc live-migrated between mesh shards mid-stream (trunk-fold +
+    re-materialization handoff) converges byte-identically — observable
+    state equals the never-migrated mesh run's AND the host-stack
+    oracle's, and the tail of the stream ingests and applies at the NEW
+    placement.  Fallback-routed docs refuse the move loudly."""
+    from fluidframework_tpu.models.placement import PlacementError
+
+    D = 6
+    svc, expected = drive_tree_docs(D, seed=5, steps=24)
+    logs = {d: list(svc.document(f"doc{d}").sequencer.log) for d in range(D)}
+    a = TreeBatchEngine(D, mesh=doc_mesh(), megastep_k=4, spare_slots=8)
+    b = TreeBatchEngine(D, mesh=doc_mesh(), megastep_k=4, spare_slots=8)
+    for eng in (a, b):
+        for d in range(D):
+            for msg in logs[d][: len(logs[d]) // 2]:
+                eng.ingest(d, msg)
+        eng.step()
+    moved = next(d for d in range(D) if d not in a.fallbacks)
+    src = a.shard_of(moved)
+    dst = next(s for s in range(a.n_shards) if s != src and a.free_slots(s))
+    assert a.migrate_doc(moved, dst), "migration refused"
+    assert a.shard_of(moved) == dst and a.shard_of(moved) != b.shard_of(moved)
+    assert a.counters.get("doc_migrations") == 1
+    # A fallback-routed doc refuses loudly BEFORE any slot handoff: its
+    # serving state lives in a host Forest, not the fleet slot.
+    for d in sorted(a.fallbacks):
+        with pytest.raises(PlacementError):
+            a.migrate_doc(d, (a.shard_of(d) + 1) % a.n_shards)
+        break
+    # Mid-stream: the tail ingests and applies at the NEW placement.
+    for eng in (a, b):
+        for d in range(D):
+            for msg in logs[d][len(logs[d]) // 2:]:
+                eng.ingest(d, msg)
+        eng.step()
+    assert not a.errors().any() and not b.errors().any()
+    for d in range(D):
+        assert a.values(d) == expected[d], f"doc {d} diverged from oracle"
+        assert a.tree_json(d) == b.tree_json(d), f"doc {d} diverged"
+        if d == moved or d in a.fallbacks:
+            continue
+        slot = int(a._slot[d])
+        assert _rows_equal(
+            jax.tree.map(lambda x: x[slot], a.state),
+            jax.tree.map(lambda x: x[slot], b.state),
+        ), f"tree doc {d} state rows diverged"
+
+
+def test_tree_migration_summary_chain_continues(tmp_path):
+    """Tree-family mirror of test_migration_summary_chain_continues:
+    scribe alignment follows a live tree-doc migration — after the doc
+    migrates + re-align, the NEW owner resumes the doc's summary chain by
+    summary adoption (the post-move commit parents onto the pre-move
+    commit, no restart from zero)."""
+    from fluidframework_tpu.protocol.messages import SequencedMessage
+    from fluidframework_tpu.runtime.summary import parse_scribe_ack
+    from fluidframework_tpu.server.ordered_log import DurableTopic
+    from fluidframework_tpu.server.partition_manager import ScribePool
+    from fluidframework_tpu.server.scribe import ScribeConfig
+
+    topic = DurableTopic(
+        "deltas", 8, str(tmp_path / "log"),
+        encode=lambda m: m.to_json(), decode=SequencedMessage.from_json,
+    )
+    doc_keys = [f"doc{i}" for i in range(4)]
+    svc, _expected = drive_tree_docs(4, seed=1, steps=30)
+    logs = {i: list(svc.document(k).sequencer.log)
+            for i, k in enumerate(doc_keys)}
+    eng = TreeBatchEngine(4, mesh=doc_mesh(), spare_slots=8,
+                          doc_keys=doc_keys)
+    pool = ScribePool(topic, str(tmp_path / "scribe"),
+                      config=ScribeConfig(max_ops=5))
+    pool.add_member("m0")
+    pool.add_member("m1")
+    ownership = pool.align_to_placement(eng.placement())
+    # Every doc routes to its shard's partition — summary ownership
+    # follows tree-doc placement exactly as it does the string fleet's.
+    for i, doc in enumerate(doc_keys):
+        assert topic.partition_for(doc) == eng.shard_of(i)
+
+    def acks_for(doc):
+        out = []
+        for p in range(topic.n_partitions):
+            for rec in topic.partition(p).read(0):
+                ack = parse_scribe_ack(rec.payload)
+                if ack is not None and ack[0] == doc:
+                    out.append(ack)
+        return sorted(out, key=lambda a: a[1])  # by covered seq
+
+    moved, moved_key = 2, doc_keys[2]
+    half = len(logs[moved]) // 2
+    for i, doc in enumerate(doc_keys):
+        for msg in (logs[i][:half] if i == moved else logs[i]):
+            topic.produce(doc, msg)
+    pool.pump()
+    acks_pre = acks_for(moved_key)
+    assert acks_pre, "no pre-move summary ack"
+    old_owner = ownership[eng.shard_of(moved)]
+
+    # Live migration + re-align: the doc's FUTURE records route to the
+    # new shard's partition, owned by the other member.
+    dst = next(
+        s for s in range(eng.n_shards)
+        if ownership.get(s) not in (None, old_owner) and eng.free_slots(s)
+    )
+    assert eng.migrate_doc(moved, dst)
+    ownership = pool.align_to_placement(eng.placement())
+    new_owner = ownership[dst]
+    assert new_owner != old_owner
+    assert topic.partition_for(moved_key) == dst
+
+    for msg in logs[moved][half:]:
+        topic.produce(moved_key, msg)
+    pool.pump()
+    acks = acks_for(moved_key)
+    assert len(acks) > len(acks_pre)
+    # Chain continuity: the first post-move commit parents the last
+    # pre-move commit.
+    _k, payload = pool.store.get(acks[len(acks_pre)][2])
+    assert payload["parent"] == acks_pre[-1][2]
+    assert pool.members[new_owner].health()["summaries_adopted"] >= 1
+    pool.close()
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", [11, 12, 13, 14, 15, 16])
 def test_shard_invariance_multiseed(seed):

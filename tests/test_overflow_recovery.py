@@ -146,6 +146,22 @@ def test_oracle_route_recovers(name, session, geom, bit):
     _check(eng, expected, h_text, want_lane="oracle")
 
 
+def test_overflow_lane_doc_refuses_migration_loudly():
+    """An overflow-lane doc's serving state lives outside its fleet slot:
+    migrate_doc must refuse LOUDLY (PlacementError from the shared
+    placement plane), never silently strand the lane.  The healthy
+    sibling stays quietly migratable — the refusal is per-lane."""
+    from fluidframework_tpu.models.placement import PlacementError
+
+    log, _expected = _seg_overflow_session()
+    eng, _h_text = _run_engine(log, "grow", max_segments=4)
+    assert 0 in eng.overflow
+    with pytest.raises(PlacementError, match="overflow"):
+        eng.migrate_doc(0, 0)
+    # Same-shard move on the healthy doc: quiet no-op, not an error.
+    assert eng.migrate_doc(1, 0) is False
+
+
 def test_growth_exhaustion_falls_back_to_oracle():
     log, expected = _seg_overflow_session()
     h_log, h_text = _healthy_session()

@@ -599,6 +599,64 @@ def test_warm_standby_trails_and_promotes_byte_identical():
     assert eng.latency_histograms()["recovery_time"].count == 1
 
 
+def test_tree_warm_standby_reseed_in_place_byte_identical():
+    """Tree-family mirror of the warm-standby test: prepare() adopts the
+    checkpoint fleet LIVE (the refresh re-seed dispatches its staged
+    re-materialization — no step owed by the caller), trail() re-seeds a
+    doc's MATERIALIZED pooled columns in place from newer records (the
+    old 'cannot be overwritten in place' gap), and promote() hands back
+    an engine serving the full stream byte-identically."""
+    from test_tree_batch_engine import drive_tree_docs
+
+    svc, expected = drive_tree_docs(4, seed=3, steps=24)
+    logs = {d: list(svc.document(f"doc{d}").sequencer.log) for d in range(4)}
+    tmp = tempfile.mkdtemp()
+    store = CheckpointStore(tmp)
+    primary = TreeBatchEngine(
+        4, checkpoint_store=store, checkpoint_every=8,
+    )
+    for d in range(4):
+        for msg in logs[d][: len(logs[d]) // 2]:
+            primary.ingest(d, msg)
+    primary.step()
+    primary.maybe_checkpoint(force=True)
+
+    standby = WarmStandby(
+        TreeBatchEngine(4, checkpoint_store=CheckpointStore(tmp)),
+        CheckpointStore(tmp),
+        lease=None,
+    ).prepare()
+    # LIVE first adoption: observable values match the primary with NO
+    # extra step — the refresh re-seed dispatched its staged rows.
+    assert [standby.engine.values(d) for d in range(4)] == [
+        primary.values(d) for d in range(4)
+    ]
+
+    # Primary advances + checkpoints again; the trailing pass re-adopts
+    # every doc by re-seeding its materialized columns IN PLACE.
+    for d in range(4):
+        for msg in logs[d][len(logs[d]) // 2:]:
+            primary.ingest(d, msg)
+    primary.step()
+    primary.maybe_checkpoint(force=True)
+    assert standby.trail() == 4
+    assert standby.adoptions >= 4
+    got = [standby.engine.values(d) for d in range(4)]
+    assert got == [primary.values(d) for d in range(4)]
+    assert got == [expected[d] for d in range(4)]
+
+    # Supervisor-driven promotion (no lease plumbing): the engine comes
+    # back serving, with the incident clock opened at the kill time.
+    eng = standby.promote(incident_started_at=time.monotonic())
+    assert eng is standby.engine
+    assert eng.recovery_tracker.active
+    assert eng.health()["standby_promotions"] == 1
+    assert [eng.values(d) for d in range(4)] == [
+        expected[d] for d in range(4)
+    ]
+    assert not eng.errors().any()
+
+
 def test_recovery_tracker_earliest_begin_wins():
     tr = RecoveryTracker()
     t0 = time.monotonic() - 1.0

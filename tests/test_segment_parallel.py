@@ -314,6 +314,27 @@ def test_engine_hot_doc_auto_promotes():
     assert not eng.errors().any()
 
 
+def test_seg_lane_doc_refuses_migration_loudly():
+    """A segment-sharded doc's serving state lives outside its fleet slot:
+    migrate_doc must refuse LOUDLY (PlacementError from the shared plane)
+    before any handoff — never silently strand the lane.  Demoting back
+    onto the batch path clears the refusal."""
+    from fluidframework_tpu.models.placement import PlacementError
+
+    eng = DocBatchEngine(
+        4, max_segments=256, text_capacity=8192, max_insert_len=8,
+        ops_per_step=8, seg_shards=SEG_SHARDS,
+    )
+    _join(eng, 0)
+    assert eng.enable_segment_sharding(0)
+    with pytest.raises(PlacementError, match="segment"):
+        eng.migrate_doc(0, 0)
+    assert eng.disable_segment_sharding(0)
+    # Back on the batch path: no more refusal (same-shard move is just a
+    # quiet no-op, not an error).
+    assert eng.migrate_doc(0, 0) is False
+
+
 def test_engine_fleet_status_surfaces_2d_placement():
     from fluidframework_tpu.server.fleet_main import status_snapshot
 
@@ -328,26 +349,35 @@ def test_engine_fleet_status_surfaces_2d_placement():
     assert snap["health"]["segment_sharded_docs"] == 1
 
 
-def test_tree_engine_rebalance_is_counted_noop():
-    """TreeBatchEngine.rebalance_hot_shards: detects hot shards, migrates
-    nothing, and counts migrations_unsupported so supervisors can alarm
-    (was: no method at all — a silent parity gap with the string fleet)."""
+def test_tree_engine_rebalance_makes_real_move():
+    """TreeBatchEngine.rebalance_hot_shards: detects a hot shard and
+    live-migrates one of its docs to a cold shard with free slots — the
+    same shared-plane skeleton the string engine rides (was: a counted
+    no-op parity gap with the string fleet)."""
     from fluidframework_tpu.models.tree_batch_engine import TreeBatchEngine
 
-    eng = TreeBatchEngine(8, mesh=pm.doc_mesh())
-    assert eng.health()["migrations_unsupported"] == 0
-    if eng.n_shards > 1:
-        # Pile queued edits onto the docs of shard 0 via the raw queues
-        # (detection reads queue depth only).
-        for d in range(eng.docs_per_shard):
-            q = eng.hosts[d].queue
-            q.extend_block(
-                np.zeros((32, q.ops.shape[1]), np.int32),
-                np.zeros((32, q.payloads.shape[1]), np.int32),
-            )
-        moves = eng.rebalance_hot_shards()
-        assert moves == []
-        assert eng.health()["migrations_unsupported"] >= 1
+    eng = TreeBatchEngine(32, mesh=pm.doc_mesh(), spare_slots=8)
+    if eng.n_shards <= 1:
+        return
+    # Pile queued rows onto every doc of shard 0 via the raw queues
+    # (detection reads queue depth only); depths stay at the fleet mean
+    # so the docs remain placement candidates, not hot-doc promotions.
+    shard0 = [d for d in range(eng.n_docs) if eng.shard_of(d) == 0]
+    for d in shard0:
+        q = eng.hosts[d].queue
+        q.extend_block(
+            np.zeros((12, q.ops.shape[1]), np.int32),
+            np.zeros((12, q.payloads.shape[1]), np.int32),
+        )
+    moves = eng.rebalance_hot_shards()
+    assert len(moves) == 1
+    d, src, dst = moves[0]
+    assert src == 0 and dst != 0 and d in shard0
+    assert eng.shard_of(d) == dst
+    assert eng.counters.get("doc_migrations") == 1
+    assert eng.counters.get("hot_shard_rebalances") == 1
+    # The old counted-degradation counters are gone for good.
+    assert not [k for k in eng.health() if k.endswith("_unsupported")]
 
 
 def test_mesh_seg_program_defaults_donation_off():
