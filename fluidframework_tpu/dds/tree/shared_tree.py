@@ -497,8 +497,7 @@ class SharedTreeChannel(Channel):
         of content.  Safe because any structural root change dirties every
         chunk at/after it, so a clean chunk held identical content at the
         same chunk index in the covered summary."""
-        from ...runtime.snapshot_formats import current_format
-        from ...runtime.summary import blob, handle, tree
+        from ...protocol.snapshot_formats import blob, current_format, handle, tree
 
         if self._local_pending:
             raise RuntimeError("summarize with pending tree edits")

@@ -9,11 +9,11 @@ networked driver would implement.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import TYPE_CHECKING, Any, Callable
 
 from ..protocol.messages import Nack, SequencedMessage, SignalMessage, UnsequencedMessage
-from ..server.local_service import LocalDocument, LocalService
 from .definitions import (
+    AuthRejection,
     DeltaConnection,
     DeltaStorageService,
     DocumentService,
@@ -21,6 +21,12 @@ from .definitions import (
     DriverError,
     StorageService,
 )
+
+if TYPE_CHECKING:
+    # Annotation-only: the driver binds to whatever service the registry
+    # (driver.service_registry) resolved; the per-document backend surface
+    # it wraps is LocalDocument's.  No runtime edge into the server tier.
+    from ..server.local_service import LocalDocument, LocalService
 
 
 class LocalDeltaConnection(DeltaConnection):
@@ -46,13 +52,11 @@ class LocalDeltaConnection(DeltaConnection):
             if nack_listener is not None:
                 nack_listener(nack)
 
-        from ..server.auth import AuthError
-
         try:
             self.join_msg, self.checkpoint_seq = doc.connect_stream(
                 client_id, listener, on_nack, mode=mode, token=token
             )
-        except AuthError as e:
+        except AuthRejection as e:
             raise DriverError(f"connection rejected: {e}", can_retry=False) from e
         if signal_listener is not None:
             doc.subscribe_signals(client_id, signal_listener)

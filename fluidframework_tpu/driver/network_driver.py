@@ -52,6 +52,7 @@ class NetworkDeltaConnection(DeltaConnection):
         signal_listener: Callable[[SignalMessage], None] | None,
         token: str | None = None,
         boot_listener: Callable[[], None] | None = None,
+        interests: list | None = None,
     ) -> None:
         self.client_id = client_id
         self.mode = mode
@@ -68,16 +69,19 @@ class NetworkDeltaConnection(DeltaConnection):
         self._rfile = self._sock.makefile("r", encoding="utf-8")
         self._wlock = threading.Lock()
         try:
-            self._send(
-                {
-                    "t": "connect",
-                    "doc": doc_id,
-                    "client": client_id,
-                    "mode": mode,
-                    "token": token,
-                    "signals": signal_listener is not None,
-                }
-            )
+            connect_req = {
+                "t": "connect",
+                "doc": doc_id,
+                "client": client_id,
+                "mode": mode,
+                "token": token,
+                "signals": signal_listener is not None,
+            }
+            if interests is not None:
+                # Scoped presence workspace: only signals whose scope key
+                # is in this list (plus unscoped signals) are delivered.
+                connect_req["interests"] = list(interests)
+            self._send(connect_req)
             # Handshake: block for the joined ack.  Broadcasts for this
             # socket can land BEFORE it (e.g. our own audience clientJoin
             # signal fans out during connect) — buffer them for dispatch

@@ -76,7 +76,7 @@ class FanoutPeer:
 
     __slots__ = ("peer_id", "sock", "sink", "sub", "directs", "outbuf",
                  "dead", "sent_bytes", "sent_frames", "signal_drops",
-                 "resyncs", "signal_docs")
+                 "resyncs", "signal_docs", "signal_interests")
 
     def __init__(self, peer_id: int, sock=None, sink=None) -> None:
         self.peer_id = peer_id
@@ -94,6 +94,9 @@ class FanoutPeer:
         self.signal_drops = 0
         self.resyncs = 0
         self.signal_docs: set[str] = set()
+        # Per-doc scoped-presence interest sets: doc_id -> frozenset of
+        # scope keys (None = the unscoped firehose, every signal).
+        self.signal_interests: dict[str, frozenset | None] = {}
 
     @property
     def is_socket(self) -> bool:
@@ -165,6 +168,7 @@ class FanoutPlane:
         self.signals_published = 0
         self.signal_deliveries = 0
         self.signal_drops = 0
+        self.presence_scope_drops = 0
         self.directs_enqueued = 0
 
     # ------------------------------------------------------------------ wiring
@@ -194,6 +198,7 @@ class FanoutPlane:
                 if ring is not None and peer in ring.signal_peers:
                     ring.signal_peers.remove(peer)
             peer.signal_docs.clear()
+            peer.signal_interests.clear()
             peer.directs.clear()
             peer.outbuf = []
         if self._writer is not None:
@@ -292,12 +297,23 @@ class FanoutPlane:
             if peer.is_socket:
                 ring.socket_subs.append(peer)
 
-    def add_signal_peer(self, doc_id: str, peer: FanoutPeer) -> None:
+    def add_signal_peer(
+        self, doc_id: str, peer: FanoutPeer,
+        interests: Iterable[str] | None = None,
+    ) -> None:
+        """Subscribe a peer to a document's signal scatter.  ``interests``
+        narrows it to a scoped presence workspace: only signals published
+        with a scope key in the set reach this peer (unscoped signals —
+        joins/leaves/broadcast presence — always deliver).  ``None`` is the
+        legacy firehose.  Re-calling replaces the interest set in place."""
         with self._lock:
             ring = self._ring(doc_id)
             if peer not in ring.signal_peers:
                 ring.signal_peers.append(peer)
                 peer.signal_docs.add(doc_id)
+            peer.signal_interests[doc_id] = (
+                None if interests is None else frozenset(interests)
+            )
 
     # ---------------------------------------------------------------- directs
     def enqueue_direct(
@@ -330,13 +346,27 @@ class FanoutPlane:
         return True
 
     # ---------------------------------------------------------------- signals
-    def publish_signal(self, doc_id: str, client_id: str, contents: Any) -> int:
+    def publish_signal(
+        self, doc_id: str, client_id: str, contents: Any,
+        scope: str | None = None,
+    ) -> int:
         """Presence/signal scatter: ONE encode, N droppable enqueues, zero
-        sequencer interaction, zero blocking sends under any caller lock."""
+        sequencer interaction, zero blocking sends under any caller lock.
+        A ``scope`` key skips peers whose interest set for the doc excludes
+        it (scoped presence workspaces); unscoped signals reach everyone."""
         with self._lock:
             ring = self._docs.get(doc_id)
             peers = list(ring.signal_peers) if ring is not None else []
             self.signals_published += 1
+            if scope is not None and peers:
+                kept = []
+                for p in peers:
+                    interests = p.signal_interests.get(doc_id)
+                    if interests is None or scope in interests:
+                        kept.append(p)
+                    else:
+                        self.presence_scope_drops += 1
+                peers = kept
         if not peers:
             return 0
         data = (json.dumps(
@@ -528,5 +558,6 @@ class FanoutPlane:
                 "signals_published": self.signals_published,
                 "signal_deliveries": self.signal_deliveries,
                 "signal_drops": self.signal_drops,
+                "presence_scope_drops": self.presence_scope_drops,
                 "directs_enqueued": self.directs_enqueued,
             }

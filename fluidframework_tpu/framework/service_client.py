@@ -17,12 +17,15 @@ Three deployment shapes share one base:
 
 from __future__ import annotations
 
-from typing import Any
+from typing import TYPE_CHECKING, Any
 
 from ..driver.local_driver import LocalDocumentServiceFactory
+from ..driver.service_registry import local_service_class
 from ..driver.virtual_storage import VirtualizedDocumentServiceFactory
-from ..server.local_service import LocalService
 from .fluid_static import ContainerSchema, FluidContainer
+
+if TYPE_CHECKING:
+    from ..server.local_service import LocalService
 
 
 class Audience:
@@ -103,7 +106,9 @@ class LocalServiceClient(_ServiceClientBase):
         virtualize: bool = False,
         cache_dir: str | None = None,
     ) -> None:
-        self.service = service or LocalService()
+        # Default service resolves through the provider seam
+        # (driver.service_registry), not a direct server-tier import.
+        self.service = service or local_service_class()()
         super().__init__(
             LocalDocumentServiceFactory(self.service),
             virtualize=virtualize,

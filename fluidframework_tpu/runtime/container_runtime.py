@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import Any
 
-from ..driver.definitions import DriverError
+from ..protocol.driver_contracts import DriverError
 from ..protocol.messages import MessageType, Nack, SequencedMessage
 from ..protocol.channel import MessageEnvelope, bunch_contiguous
 from .datastore import DataStoreRuntime

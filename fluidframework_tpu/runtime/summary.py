@@ -24,6 +24,12 @@ from typing import Any
 
 from ..protocol.messages import MessageType, SequencedMessage
 
+# The ISummaryTree node builders moved to the contracts tier
+# (protocol.snapshot_formats) so DDS summarize paths can mint
+# blobs/handles without an upward edge into this layer; re-exported here
+# for the runtime/test callers.
+from ..protocol.snapshot_formats import blob, handle, tree
+
 
 # ---------------------------------------------------------------------------
 # Scribe summary-ack records (server half of the summary protocol)
@@ -61,19 +67,7 @@ def parse_scribe_ack(msg: Any) -> tuple[str, int, str] | None:
 # ---------------------------------------------------------------------------
 # ISummaryTree node builders + handle resolution
 # ---------------------------------------------------------------------------
-
-
-def blob(content: Any) -> dict:
-    return {"type": "blob", "content": content}
-
-
-def tree(entries: dict[str, Any]) -> dict:
-    return {"type": "tree", "entries": entries}
-
-
-def handle(path: str) -> dict:
-    """Reference to the same path in the previous acked summary."""
-    return {"type": "handle", "path": path}
+# blob/tree/handle: re-exported from protocol.snapshot_formats (see top).
 
 
 def count_nodes(node: dict) -> dict[str, int]:

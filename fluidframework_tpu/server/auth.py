@@ -12,9 +12,13 @@ import hashlib
 import hmac
 import secrets
 
+from ..protocol.driver_contracts import AuthRejection
 
-class AuthError(Exception):
-    pass
+
+class AuthError(AuthRejection):
+    """Token validation failure.  Subclasses the contracts-tier
+    ``AuthRejection`` so drivers can map admission rejections to
+    non-retryable errors without importing the service tier."""
 
 
 def _scope_bytes(tenant_id: str, doc_id: str, client_id: str) -> bytes:

@@ -53,8 +53,9 @@ def status_snapshot(eng, doc_ids, rows=0, bytes_consumed=0, **extra) -> dict:
         out["errorDocs"] = [
             doc_ids[i] for i in range(len(doc_ids)) if errs[i]
         ]
-    if eng.quarantine:
-        out["quarantinedDocs"] = sorted(doc_ids[d] for d in eng.quarantine)
+    quarantine = getattr(eng, "quarantine", None)
+    if quarantine:
+        out["quarantinedDocs"] = sorted(doc_ids[d] for d in quarantine)
     # 2-D docs x segs placement surface: which docs are segment-sharded and
     # over how many shards (supervisors pair this with eng.placement() —
     # a seg-sharded doc keeps its reserved batch slot, so scribe alignment
@@ -72,6 +73,19 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, required=True)
     p.add_argument("--docs", required=True, help="comma-separated doc ids")
+    p.add_argument("--family", choices=("string", "tree"), default="string",
+                   help="engine family for this shard: a string-doc "
+                        "DocBatchEngine (default) or a tree-doc "
+                        "TreeBatchEngine (the drain line then carries "
+                        "root-field node JSON instead of texts)")
+    p.add_argument("--pool-capacity", type=int, default=4096,
+                   help="tree family: shared columnar mark-pool capacity")
+    p.add_argument("--drain-file", default=None,
+                   help="coordinated drain: poll this path for a JSON "
+                        "object {\"want\": {doc: seq}}; once present, pump "
+                        "until every doc's applied seq reaches its target, "
+                        "checkpoint, emit the final texts/trees status line "
+                        "(done=true) and exit 0")
     p.add_argument("--capacity", type=int, default=4096)
     p.add_argument("--text-capacity", type=int, default=65536)
     p.add_argument("--ops-per-step", type=int, default=32)
@@ -193,7 +207,6 @@ def main(argv: list[str] | None = None) -> int:
 
         jax.config.update("jax_platforms", platform)
 
-    from ..models.doc_batch_engine import DocBatchEngine
     from .fleet_consumer import FleetConsumer
     from .ordered_log import CheckpointStore
 
@@ -215,25 +228,44 @@ def main(argv: list[str] | None = None) -> int:
             mesh = docs_segs_mesh(devices[:n_dev], args.seg_shards)
         else:
             mesh = doc_mesh(devices[:n_dev])
-    eng = DocBatchEngine(
-        len(doc_ids),
-        max_segments=args.capacity,
-        text_capacity=args.text_capacity,
-        max_insert_len=args.max_insert_len,
-        ops_per_step=args.ops_per_step,
-        use_mesh=mesh is not None,
-        mesh=mesh,
-        spare_slots=args.spare_slots,
-        recovery=args.recovery,
-        checkpoint_store=store,
-        checkpoint_every=args.checkpoint_every if store is not None else 0,
-        doc_keys=doc_ids,
-        watchdog_every=args.watchdog_every,
-        readmit_after_steps=args.readmit_after_steps,
-        poison_budget=args.poison_budget,
-        megastep_k=args.megastep_k,
-        seg_rebalance_every=args.seg_rebalance_every,
-    )
+    if args.family == "tree":
+        from ..models.tree_batch_engine import TreeBatchEngine
+
+        eng = TreeBatchEngine(
+            len(doc_ids),
+            capacity=args.capacity,
+            pool_capacity=args.pool_capacity,
+            max_insert_len=args.max_insert_len,
+            ops_per_step=args.ops_per_step,
+            mesh=mesh,
+            spare_slots=args.spare_slots,
+            checkpoint_store=store,
+            checkpoint_every=args.checkpoint_every if store is not None else 0,
+            doc_keys=doc_ids,
+            megastep_k=args.megastep_k,
+        )
+    else:
+        from ..models.doc_batch_engine import DocBatchEngine
+
+        eng = DocBatchEngine(
+            len(doc_ids),
+            max_segments=args.capacity,
+            text_capacity=args.text_capacity,
+            max_insert_len=args.max_insert_len,
+            ops_per_step=args.ops_per_step,
+            use_mesh=mesh is not None,
+            mesh=mesh,
+            spare_slots=args.spare_slots,
+            recovery=args.recovery,
+            checkpoint_store=store,
+            checkpoint_every=args.checkpoint_every if store is not None else 0,
+            doc_keys=doc_ids,
+            watchdog_every=args.watchdog_every,
+            readmit_after_steps=args.readmit_after_steps,
+            poison_budget=args.poison_budget,
+            megastep_k=args.megastep_k,
+            seg_rebalance_every=args.seg_rebalance_every,
+        )
     if store is not None and not args.standby:
         # Restart path: restore durable checkpoints BEFORE consuming, so
         # the firehose catch-up replay of already-checkpointed ops is
@@ -322,9 +354,24 @@ def main(argv: list[str] | None = None) -> int:
 
         plane = MetricsPlane()
         plane.register("fleet", fc.health)
-        plane.register("latency", eng.latency_histograms)
+        latency = getattr(eng, "latency_histograms", None)
+        if latency is not None:
+            plane.register("latency", latency)
         metrics_srv = MetricsServer(plane, port=args.metrics_port).start()
         print(json.dumps({"metricsPort": metrics_srv.port}), flush=True)
+    # Readiness line: everything a coordinator needs to attach — the shard
+    # this consumer rides, the doc set and family it serves, and (when on)
+    # the scrapeable metrics port.  Emitted AFTER the firehose attached, so
+    # a supervisor reading it knows the consume subscriptions exist.
+    ready = {
+        "ready": True,
+        "family": args.family,
+        "docs": doc_ids,
+        "port": args.port,
+    }
+    if metrics_srv is not None:
+        ready["metricsPort"] = metrics_srv.port
+    print(json.dumps(ready), flush=True)
     ckpt_writer = None
     if store is not None and (args.ckpt_stale_ops or args.ckpt_stale_seconds):
         # Bounded-staleness delta checkpoints: a background sweep keeps
@@ -357,6 +404,15 @@ def main(argv: list[str] | None = None) -> int:
             **extra,
         )), flush=True)
 
+    def final_state() -> dict:
+        """The per-family identity surface for the done=True status line."""
+        if args.family == "tree":
+            return {"trees": {d: eng.tree_json(i)
+                              for i, d in enumerate(doc_ids)}}
+        return {"texts": {d: eng.text(i) for i, d in enumerate(doc_ids)}}
+
+    drain_want: dict | None = None
+    last_drain_poll = 0.0
     last_status = time.monotonic()
     last_rebalance = time.monotonic()
     try:
@@ -413,11 +469,27 @@ def main(argv: list[str] | None = None) -> int:
                 status()
             if args.exit_after_rows and fc.rows_staged >= args.exit_after_rows:
                 eng.maybe_checkpoint(force=True)
-                status(
-                    texts={d: eng.text(i) for i, d in enumerate(doc_ids)},
-                    done=True,
-                )
+                status(done=True, **final_state())
                 return 0
+            if args.drain_file is not None:
+                # Coordinated drain: once the supervisor drops the drain
+                # file (per-doc target seqs), pump until every doc's
+                # applied floor reaches its target, then emit the final
+                # per-family state and exit cleanly.
+                if drain_want is None and now - last_drain_poll >= 0.1:
+                    last_drain_poll = now
+                    if _os.path.exists(args.drain_file):
+                        with open(args.drain_file) as f:
+                            drain_want = json.load(f)["want"]
+                if drain_want is not None:
+                    fc.step()
+                    if all(
+                        eng.hosts[i].last_seq >= int(drain_want.get(d, 0))
+                        for i, d in enumerate(doc_ids)
+                    ):
+                        eng.maybe_checkpoint(force=True)
+                        status(done=True, drained=True, **final_state())
+                        return 0
     except KeyboardInterrupt:
         eng.maybe_checkpoint(force=True)
         return 0

@@ -412,3 +412,11 @@ class LocalService:
         for doc in self._docs.values():
             n += doc.process_all()
         return n
+
+
+# Composition-root binding: importing this module installs LocalService as
+# the local-service provider the driver/framework layers resolve through
+# (the driver->server inversion; see driver.service_registry).
+from ..driver.service_registry import register_local_service  # noqa: E402
+
+register_local_service(LocalService)

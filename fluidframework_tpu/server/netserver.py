@@ -137,6 +137,8 @@ class _NexusHandler(socketserver.StreamRequestHandler):
             server.handle_submit(session, req)
         elif kind == "signal":
             server.handle_signal(session, req)
+        elif kind == "interests":
+            server.handle_interests(session, req)
         elif kind == "sync":
             # Echo AFTER everything already broadcast on this socket: the
             # echo rides the peer queue behind every frame already
@@ -237,8 +239,14 @@ class NetworkServer:
         )
         doc.subscribe_signals(
             tap_id,
+            # Scoped presence: a dict signal carrying a "scope" key fans
+            # out only to peers whose interest set covers it.
             lambda sig, d=doc_id: plane.publish_signal(
-                d, sig.client_id, sig.contents
+                d, sig.client_id, sig.contents,
+                scope=(
+                    sig.contents.get("scope")
+                    if isinstance(sig.contents, dict) else None
+                ),
             ),
         )
         # Pump-boundary flush: ANY driver of process_all (handlers here,
@@ -311,7 +319,12 @@ class NetworkServer:
                 last_seq=delivered_seq,
             )
             if req.get("signals"):
-                self.fanout.add_signal_peer(doc_id, session.peer)
+                # Optional "interests": a scoped presence workspace — only
+                # signals published with a scope key in the list (plus all
+                # unscoped signals) reach this session.
+                self.fanout.add_signal_peer(
+                    doc_id, session.peer, interests=req.get("interests"),
+                )
                 # Audience catch-up: current read membership, self included
                 # (the connect handshake's "initialClients") — enqueued
                 # without per-member wakes, ONE writer wake for the batch.
@@ -484,6 +497,16 @@ class NetworkServer:
             # can no longer stall op ticketing (at-most-once by contract).
             self.service.document(session.doc_id).submit_signal(
                 session.client_id, req.get("content")
+            )
+
+    def handle_interests(self, session: _ClientSession, req: dict) -> None:
+        """Replace the session's scoped-presence interest set in place
+        (None = back to the unscoped firehose)."""
+        with self.lock:
+            if session.doc_id is None:
+                return
+            self.fanout.add_signal_peer(
+                session.doc_id, session.peer, interests=req.get("interests"),
             )
 
     def drop_session(self, session: _ClientSession) -> None:

@@ -325,3 +325,30 @@ class Histogram:
             "p90": self.percentile(0.9),
             "p99": self.percentile(0.99),
         }
+
+    def to_wire(self) -> dict[str, Any]:
+        """Lossless JSON-serializable form: full bucket counts ride along
+        (unlike ``snapshot``), so a histogram shipped across a process
+        boundary merges on the far side exactly as if the samples had been
+        recorded there.  Bucket keys stringify for JSON object keys."""
+        return {
+            "base": self.base,
+            "growth": self.growth,
+            "count": self.count,
+            "sum": self.sum,
+            "min": None if self.count == 0 else self.min,
+            "max": None if self.count == 0 else self.max,
+            "buckets": {str(i): c for i, c in self._buckets.items()},
+        }
+
+    @classmethod
+    def from_wire(cls, wire: dict) -> "Histogram":
+        """Rebuild a histogram from ``to_wire`` output (JSON round-trip)."""
+        h = cls(base=wire["base"], growth=wire["growth"])
+        h.count = int(wire["count"])
+        h.sum = float(wire["sum"])
+        if h.count > 0:
+            h.min = float(wire["min"])
+            h.max = float(wire["max"])
+        h._buckets = {int(i): int(c) for i, c in wire["buckets"].items()}
+        return h

@@ -1635,6 +1635,12 @@ SEEDINGS = [
     ("utils/config.py",
      lambda s: s + "\nfrom ..server import scribe as _seeded\n",
      "layer-upward-import", "layer-check"),
+    # loadgen sits in the service layer: an upward import FROM a state-
+    # layer module INTO loadgen must trip the gate (proves the new
+    # subsystem is really declared, not silently outside the graph).
+    ("models/dispatch.py",
+     lambda s: s + "\nfrom ..loadgen import schedule as _seeded\n",
+     "layer-upward-import", "layer-check"),
     ("server/scribe.py",
      lambda s: s.replace("for doc in sorted(set(self.docs) | set(self.refs)):",
                          "for doc in set(self.docs) | set(self.refs):"),
