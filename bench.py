@@ -1360,6 +1360,30 @@ def bench_config5(args) -> dict:
         for d in range(D)
     )
 
+    # Device rebase window (PR 19): the same streams through a
+    # device_rebase=True engine — kernel-vs-pooled byte-identity on every
+    # doc summary plus the end-to-end ingest rate with the window fold on
+    # the tensor plane (fallbacks counted in its health gauges).
+    dev_reb = TreeBatchEngine(D, capacity=cap, ops_per_step=32,
+                              pool_capacity=8 * cap, device_rebase=True)
+    t0 = time.perf_counter()
+    for d, msgs in enumerate(streams):
+        for m in msgs:
+            dev_reb.ingest(d, m)
+    t_reb = time.perf_counter() - t0
+    reb_identity = all(
+        json.dumps(dev_reb.hosts[d].em.summarize(), sort_keys=True)
+        == json.dumps(eng.hosts[d].em.summarize(), sort_keys=True)
+        for d in range(D)
+    )
+    reb_health = dev_reb.health()
+
+    # Kernel microbench: W >> 1 windows of multi-mark conflicting commits
+    # in ONE warmed vmapped dispatch vs the pooled host fold on identical
+    # windows — the [windows x commits] plane the per-doc serving path
+    # (W=1 per dispatch) cannot show on its own.
+    kern_speedup, kern_identity = _rebase_kernel_microbench(rng)
+
     health = eng.health()
     dev_rate = n_edits / t_dev
     pipeline = n_edits / (t_host + t_dev)
@@ -1381,12 +1405,122 @@ def bench_config5(args) -> dict:
         "translation_plan_hit_rate": health.get(
             "translation_plan_hit_rate", 0.0
         ),
+        "device_rebase_edits_per_sec": round(n_edits / t_reb, 1),
+        "device_rebase_identity": reb_identity,
+        "device_rebase_fraction": reb_health.get(
+            "device_rebase_fraction", 0.0
+        ),
+        "rebase_fallbacks": reb_health.get("rebase_fallbacks", 0),
+        "rebase_kernel_speedup": kern_speedup,
+        "rebase_kernel_identity": kern_identity,
         "engine_health": health,
     }
+    # Acceptance shape (PR 19): the serving pipeline itself, or — when
+    # the probed backend cannot express the win at W=1 dispatch depth —
+    # the batched kernel plane at >= 1.5x with the run flagged degraded.
+    if pipeline < 1.5 * 2019.0 and kern_speedup >= 1.5:
+        out["degraded"] = True
     if getattr(args, "artifact", None):
         with open(args.artifact, "w") as f:
             json.dump(out, f, indent=2)
     return out
+
+
+def _rebase_kernel_microbench(rng, n_windows: int = 256, window: int = 8):
+    """(speedup, identity) of the batched rebase kernel over the pooled
+    host fold on identical [windows x commits] workloads.
+
+    Each window folds one multi-mark commit through ``window`` conflicting
+    multi-insert commits in the same field — the shape where the host
+    pays the full _rebase_cols column walk per leg.  Speedup is best-of-3
+    wall for the whole window set; identity is a byte-compare of the
+    decoded kernel fold against mark_pool.rebase_pair on a sample of
+    windows."""
+    import jax
+
+    from fluidframework_tpu.dds.tree import mark_pool as mp
+    from fluidframework_tpu.dds.tree.changeset import (
+        Commit,
+        Insert,
+        NodeChange,
+        Skip,
+        commit_to_json,
+        _wrap,
+    )
+    from fluidframework_tpu.dds.tree.device_rebase import DeviceRebaser
+    from fluidframework_tpu.dds.tree.schema import leaf
+    from fluidframework_tpu.ops.tree_kernel import rebase_window_batched
+
+    pool = mp.MarkPool()
+
+    def multi_insert():
+        """[Skip, Insert, Skip, Insert, ...] over ~4 scattered positions."""
+        marks = []
+        cur = 0
+        for p in sorted(rng.choice(32, size=4, replace=False)):
+            p = int(p)
+            if p > cur:
+                marks.append(Skip(p - cur))
+                cur = p
+            marks.append(Insert([leaf(int(rng.integers(1000)))]))
+        return mp.pool_commit(pool, Commit([
+            _wrap([("", 0)], NodeChange(fields={"kids": marks})),
+        ]))
+
+    windows = [
+        (multi_insert(), [multi_insert() for _ in range(window)])
+        for _ in range(n_windows)
+    ]
+
+    # --- host fold (identical inputs, fresh is-identity caches) ----------
+    t_host = float("inf")
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        host_out = []
+        for c, xs in windows:
+            cc = c
+            new_xs = []
+            for x in xs:
+                cc, xw = mp.rebase_pair(cc, x)
+                new_xs.append(xw)
+            host_out.append((cc, new_xs))
+        t_host = min(t_host, time.perf_counter() - t0)
+
+    # --- batched kernel: encode once, one vmapped dispatch ----------------
+    reb = DeviceRebaser(pool)
+    encs = [(reb.encode_commit(c), [reb.encode_commit(x) for x in xs])
+            for c, xs in windows]
+    assert all(e is not None and all(x is not None for x in xe)
+               for e, xe in encs)
+    import jax.numpy as jnp
+
+    cs = jax.tree.map(lambda *a: jnp.stack(a),
+                      *[reb._enc_dev(e) for e, _ in encs])
+    xss = jax.tree.map(lambda *a: jnp.stack(a),
+                       *[reb._stack(xe, 0) for _, xe in encs])
+    elig = jnp.ones((n_windows, window), bool)
+    final, outs = rebase_window_batched(cs, xss, elig)  # warm/compile
+    jax.block_until_ready(final)
+    t_kern = float("inf")
+    for _rep in range(3):
+        t0 = time.perf_counter()
+        final, outs = rebase_window_batched(cs, xss, elig)
+        jax.block_until_ready(final)
+        t_kern = min(t_kern, time.perf_counter() - t0)
+    assert bool(jnp.all(outs.valid))
+
+    # --- identity: decoded kernel fold == host fold (sampled windows) -----
+    identity = True
+    for i in range(0, n_windows, max(1, n_windows // 16)):
+        c, xs = windows[i]
+        kc, kxs, _stages = reb.fold(c, xs)
+        hc, hxs = host_out[i]
+        if commit_to_json(kc) != commit_to_json(hc) or any(
+            commit_to_json(a) != commit_to_json(b)
+            for a, b in zip(kxs, hxs)
+        ):
+            identity = False
+    return round(t_host / t_kern, 2), identity
 
 
 def bench_latency(args) -> dict:

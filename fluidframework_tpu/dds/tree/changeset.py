@@ -33,6 +33,17 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+# Kind codes are the protocol-layer mark schema (shared with the pooled
+# columns and the device kernels); re-exported here so dds-internal users
+# keep their historical import site.
+from ...protocol.mark_schema import (  # noqa: F401  (re-export shim)
+    K_INSERT,
+    K_MODIFY,
+    K_MOVEIN,
+    K_MOVEOUT,
+    K_REMOVE,
+    K_SKIP,
+)
 from .forest import Node
 
 
@@ -45,12 +56,16 @@ from .forest import Node
 class Skip:
     """Pass over ``count`` nodes unchanged (consumes N, produces N)."""
 
+    K = K_SKIP  # protocol mark-schema kind code (class-level, not a field)
+
     count: int
 
 
 @dataclass(slots=True)
 class Insert:
     """Insert ``content`` at the current position (consumes 0, produces N)."""
+
+    K = K_INSERT
 
     content: list[Node]
 
@@ -60,6 +75,8 @@ class Remove:
     """Remove ``count`` nodes (consumes N, produces 0). ``detached`` holds
     the removed subtrees once applied (repair data for invert/revive)."""
 
+    K = K_REMOVE
+
     count: int
     detached: Optional[list[Node]] = None
 
@@ -67,6 +84,8 @@ class Remove:
 @dataclass(slots=True)
 class Modify:
     """Apply a nested NodeChange to one node (consumes 1, produces 1)."""
+
+    K = K_MODIFY
 
     change: "NodeChange"
 
@@ -80,6 +99,8 @@ class MoveOut:
     where the pieces ended up (ref sequence-field moveOut/moveIn pair with
     cell ids)."""
 
+    K = K_MOVEOUT
+
     count: int
     id: int
     offset: int = 0
@@ -91,6 +112,8 @@ class MoveIn:
     ``count``).  ``offset`` selects which original-move offsets to attach
     (None = the whole register, sorted by offset) — needed when inverting a
     split move, whose inverse returns each piece to its own origin."""
+
+    K = K_MOVEIN
 
     id: int
     count: int

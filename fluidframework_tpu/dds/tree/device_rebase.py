@@ -1,0 +1,392 @@
+"""Device-side rebase window dispatch for the EditManager fold (PR 19).
+
+The pooled fold (mark_pool.rebase_pair) walks each window entry with
+Python column passes; this module moves the whole window onto the
+[windows x commits] tensor plane of ops/tree_kernel.rebase_window_kernel.
+The division of labour:
+
+* ``encode_commit`` walks one pooled single-change Commit into the
+  kernel's ``RebaseEnc`` columns — interior [Skip(p), Modify] levels as
+  (field, pos) pairs, the leaf as padded mark columns with
+  source-index handles into the commit's own span.  Anything the
+  columns cannot express (multi-change commits, constraints, moves,
+  multi-field levels, non-canonical spans, width/depth overflow) is
+  ineligible; the verdict is cached on the Commit (``_dev_enc``).
+* ``DeviceRebaser.fold`` dispatches the eligible window prefix in one
+  jitted scan, decodes the surviving prefix back to pooled Commits
+  (identity steps reuse the original objects outright; changed steps
+  reattach object payloads through the source handles), and finishes
+  the suffix on the pooled fold — the byte-identity oracle.  Every
+  host-finished step is counted in ``fallback_steps``, never silent.
+
+Object payloads (insert content, nested Modify changesets, detached
+Remove subtrees) never ride the device: the kernel carries source-index
+ranges and the decode re-attaches the original objects, so decoded
+commits serialize byte-identically to the pooled fold's outputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...observability.flight_recorder import span
+from ...ops.tree_kernel import (
+    REBASE_MAX_DEPTH,
+    REBASE_MAX_MARKS,
+    RebaseEnc,
+    rebase_window_jit,
+)
+from ...protocol.mark_schema import (
+    DEVICE_CODE_OFFSET,
+    F_CANONICAL,
+    F_INSERT,
+    F_MODIFY,
+    F_MOVE,
+    F_REMOVE,
+    K_INSERT,
+    K_MODIFY,
+    K_REMOVE,
+    K_SKIP,
+)
+from .changeset import Commit, NodeChange
+from .mark_pool import PooledMarks, rebase_pair
+
+_PD = REBASE_MAX_DEPTH
+_M = REBASE_MAX_MARKS
+_ARANGE = np.arange(_M, dtype=np.int32)
+_ZEROS = np.zeros((_M,), np.int32)
+
+# Sentinel distinguishing "never encoded" from "encoded: ineligible".
+_INELIGIBLE = False
+
+
+class CommitEncoding:
+    """Device columns for one eligible Commit plus the host-side keys
+    (field names, value tuples, nested NodeChanges, the leaf span) the
+    decode needs to rebuild byte-identical pooled commits."""
+
+    __slots__ = (
+        "dep", "fld", "pos", "val", "kind", "cnt", "det", "n",
+        "names", "vals", "nodes", "leaf",
+    )
+
+    def __init__(self, dep, fld, pos, val, kind, cnt, det, n,
+                 names, vals, nodes, leaf) -> None:
+        self.dep = dep
+        self.fld = fld
+        self.pos = pos
+        self.val = val
+        self.kind = kind
+        self.cnt = cnt
+        self.det = det
+        self.n = n
+        self.names = names
+        self.vals = vals
+        self.nodes = nodes
+        self.leaf = leaf
+
+
+class DeviceRebaser:
+    """Window dispatcher shared by a fleet's EditManagers (one instance
+    keeps the field-interning table and the health counters fleet-wide,
+    mirroring the engines' shared MarkPool)."""
+
+    def __init__(self, pool) -> None:
+        self.pool = pool
+        self._fields: dict[str, int] = {}
+        self.device_steps = 0     # window steps resolved on device
+        self.fallback_steps = 0   # window steps finished by the pooled fold
+        self.windows = 0          # folds that dispatched at least one step
+        self.encode_rejects = 0   # commits that failed the eligibility walk
+
+    # ------------------------------------------------------------- interning
+    def _field_id(self, key: str) -> int:
+        return self._fields.setdefault(key, len(self._fields))
+
+    # -------------------------------------------------------------- encoding
+    def encode_commit(self, commit):
+        """CommitEncoding for an eligible pooled Commit, else None.
+        The verdict (either way) is cached on the commit — pooled
+        commits are immutable, so the cache can never go stale."""
+        enc = getattr(commit, "_dev_enc", None)
+        if enc is not None:
+            return None if enc is _INELIGIBLE else enc
+        enc = self._encode(commit)
+        commit._dev_enc = _INELIGIBLE if enc is None else enc
+        if enc is None:
+            self.encode_rejects += 1
+        return enc
+
+    def _encode(self, commit):
+        if len(commit) != 1 or commit.constraints or commit.violated:
+            return None
+        nc = commit[0]
+        fld = np.full((_PD + 1,), -1, np.int32)
+        pos = np.zeros((_PD,), np.int32)
+        val = np.zeros((_PD + 1,), np.int32)
+        names: list = []
+        vals: list = []
+        nodes: list = []
+        level = 0
+        while True:
+            nodes.append(nc)
+            vals.append(nc.value)
+            if nc.value is not None:
+                val[level] = 1
+            fields = nc.fields
+            if not fields:
+                # value-only (or empty) leaf: fld stays -1
+                names.append(None)
+                return CommitEncoding(
+                    np.int32(level), fld, pos, val,
+                    _ZEROS, _ZEROS, _ZEROS, np.int32(0),
+                    names, vals, nodes, None,
+                )
+            if len(fields) != 1:
+                return None
+            (key, fc), = fields.items()
+            if type(fc) is not PooledMarks:
+                return None
+            if level < _PD:
+                # interior test: exactly [Skip(p), Modify] (the nested
+                # wire norm) keeps walking the spine
+                ks, as_, _bs, _cs, objs, s = fc.columns()
+                nested = None
+                if fc.n == 2 and ks[s] == K_SKIP and ks[s + 1] == K_MODIFY:
+                    nested = objs[s + 1]
+                    p = as_[s]
+                elif fc.n == 1 and ks[s] == K_MODIFY:
+                    nested = objs[s]
+                    p = 0
+                if type(nested) is NodeChange:
+                    fld[level] = self._field_id(key)
+                    pos[level] = p
+                    names.append(key)
+                    nc = nested
+                    level += 1
+                    continue
+            flags = fc.flags
+            if flags & F_MOVE or not flags & F_CANONICAL or fc.n > _M:
+                return None
+            kind, cnt, det = fc.columns_padded(_M)
+            fld[level] = self._field_id(key)
+            names.append(key)
+            return CommitEncoding(
+                np.int32(level), fld, pos, val, kind, cnt, det,
+                np.int32(fc.n), names, vals, nodes, fc,
+            )
+
+    # -------------------------------------------------------------- decoding
+    def _seal_interior(self, p: int, nested) -> PooledMarks:
+        """[Skip(p), Modify(nested)] (or bare [Modify]) as a fresh span."""
+        if p > 0:
+            return self.pool.seal(
+                [K_SKIP, K_MODIFY], [p, 1], [0, 0], [0, 0],
+                [None, nested], F_MODIFY | F_CANONICAL,
+            )
+        return self.pool.seal(
+            [K_MODIFY], [1], [0], [0], [nested], F_MODIFY | F_CANONICAL,
+        )
+
+    def _seal_leaf(self, enc: CommitEncoding, kindv, cntv, slov, shiv,
+                   nlive: int) -> PooledMarks:
+        """Device leaf columns -> pooled span, object payloads reattached
+        through the source-index handles into the ORIGINAL leaf span.
+        Raw rows + seal (no Mark objects): the kernel's coalescing
+        emission mirrors the host builder, so the columns are already
+        canonical."""
+        ks: list[int] = []
+        as_: list[int] = []
+        zs: list[int] = []
+        objs: list = []
+        flags = F_CANONICAL
+        if enc.leaf is not None:
+            sk, _sa, _sb, _sc, sobjs, ss = enc.leaf.columns()
+        else:
+            sk = sobjs = ()
+            ss = 0
+        for i in range(nlive):
+            k = int(kindv[i]) - DEVICE_CODE_OFFSET
+            a = int(cntv[i])
+            obj = None
+            if k == K_INSERT:
+                flags |= F_INSERT
+                lo = int(slov[i])
+                hi = int(shiv[i])
+                if lo == hi:
+                    obj = sobjs[ss + lo]  # shared, like the host emit
+                else:
+                    # merged insert group: concatenate the original
+                    # K_INSERT payloads in source order
+                    obj = []
+                    for j in range(lo, hi + 1):
+                        if sk[ss + j] == K_INSERT:
+                            obj = obj + sobjs[ss + j]
+            elif k == K_REMOVE:
+                # detached payloads only survive identity steps (which
+                # never decode) — the kernel's det gate guarantees it
+                flags |= F_REMOVE
+            elif k == K_MODIFY:
+                flags |= F_MODIFY
+                obj = sobjs[ss + int(slov[i])]
+            ks.append(k)
+            as_.append(a)
+            zs.append(0)
+            objs.append(obj)
+        return self.pool.seal(ks, as_, zs, list(zs), objs, flags)
+
+    def _decode_side(self, enc: CommitEncoding, out: RebaseEnc, i: int,
+                     drops=None):
+        """Rebuild one side's pooled Commit (+ fresh encoding stamp) from
+        step ``i`` of the window outputs."""
+        dep = int(np.asarray(out.dep)[i])
+        posv = np.asarray(out.pos)[i]
+        kindv = np.asarray(out.kind)[i]
+        cntv = np.asarray(out.cnt)[i]
+        nlive = int(np.asarray(out.n)[i])
+        slov = np.asarray(out.slo)[i]
+        shiv = np.asarray(out.shi)[i]
+        names = enc.names
+        vals = list(enc.vals[: dep + 1])
+        if drops is not None:
+            for lvl in range(dep + 1):
+                if drops[lvl]:
+                    vals[lvl] = None
+        # leaf level
+        leaf_span = None
+        if names[dep] is None:
+            fields: dict = {}
+        else:
+            leaf_span = self._seal_leaf(enc, kindv, cntv, slov, shiv, nlive)
+            fields = {names[dep]: leaf_span}
+        nc = NodeChange(value=vals[dep], fields=fields)
+        nodes = [nc]
+        for lvl in range(dep - 1, -1, -1):
+            nc = NodeChange(value=vals[lvl], fields={
+                names[lvl]: self._seal_interior(int(posv[lvl]), nc),
+            })
+            nodes.append(nc)
+        nodes.reverse()
+        out_commit = Commit([nc])
+        out_commit._pooled = True
+        new_enc = CommitEncoding(
+            np.int32(dep), enc.fld, posv.astype(np.int32),
+            np.asarray([1 if v is not None else 0
+                        for v in vals] + [0] * (_PD - dep), np.int32),
+            kindv.astype(np.int32), cntv.astype(np.int32), _ZEROS,
+            np.int32(nlive), names[: dep + 1], vals, nodes, leaf_span,
+        )
+        out_commit._dev_enc = new_enc
+        return out_commit
+
+    # ------------------------------------------------------------ dispatch
+    @staticmethod
+    def _stack(encs: list, pad: int) -> RebaseEnc:
+        """Window encodings -> one [C]-leading RebaseEnc (pads are zero
+        rows gated off by the eligibility mask)."""
+        import jax.numpy as jnp
+
+        deps = [e.dep for e in encs] + [np.int32(0)] * pad
+        z1 = np.full((_PD + 1,), -1, np.int32)
+        zp = np.zeros((_PD,), np.int32)
+        zv = np.zeros((_PD + 1,), np.int32)
+        flds = [e.fld for e in encs] + [z1] * pad
+        poss = [e.pos for e in encs] + [zp] * pad
+        valz = [e.val for e in encs] + [zv] * pad
+        kinds = [e.kind for e in encs] + [_ZEROS] * pad
+        cnts = [e.cnt for e in encs] + [_ZEROS] * pad
+        dets = [e.det for e in encs] + [_ZEROS] * pad
+        ns = [e.n for e in encs] + [np.int32(0)] * pad
+        slos = [_ARANGE] * (len(encs) + pad)
+        return RebaseEnc(
+            jnp.asarray(np.asarray(deps, np.int32)),
+            jnp.asarray(np.stack(flds)), jnp.asarray(np.stack(poss)),
+            jnp.asarray(np.stack(valz)), jnp.asarray(np.stack(kinds)),
+            jnp.asarray(np.stack(cnts)), jnp.asarray(np.stack(dets)),
+            jnp.asarray(np.asarray(ns, np.int32)),
+            jnp.asarray(np.stack(slos)), jnp.asarray(np.stack(slos)),
+        )
+
+    @staticmethod
+    def _enc_dev(e: CommitEncoding) -> RebaseEnc:
+        import jax.numpy as jnp
+
+        return RebaseEnc(
+            jnp.asarray(e.dep), jnp.asarray(e.fld), jnp.asarray(e.pos),
+            jnp.asarray(e.val), jnp.asarray(e.kind), jnp.asarray(e.cnt),
+            jnp.asarray(e.det), jnp.asarray(e.n),
+            jnp.asarray(_ARANGE), jnp.asarray(_ARANGE),
+        )
+
+    def fold(self, c: Commit, xs: list):
+        """One EditManager window fold: returns (final c, new xs values,
+        stage values), device prefix + pooled-fold suffix.  ``xs`` is the
+        list of window commits (tseq bookkeeping stays with the caller);
+        the three return lists line up with it."""
+        import jax
+
+        n = len(xs)
+        with span("rebase_kernel_encode", window=n):
+            enc_c = self.encode_commit(c)
+            encs: list = []
+            if enc_c is not None:
+                for x in xs:
+                    e = self.encode_commit(x)
+                    if e is None:
+                        break
+                    encs.append(e)
+        p = len(encs)
+        k = 0
+        new_xs: list = []
+        stages: list = []
+        if p:
+            self.windows += 1
+            cap = 1 << (p - 1).bit_length()
+            with span("rebase_kernel_dispatch", window=n, steps=p, cap=cap):
+                import jax.numpy as jnp
+
+                elig = jnp.asarray(
+                    np.asarray([True] * p + [False] * (cap - p)))
+                _final, outs = rebase_window_jit(
+                    self._enc_dev(enc_c), self._stack(encs, cap - p), elig)
+                outs = jax.device_get(outs)
+            with span("rebase_kernel_decode", window=n, steps=p):
+                valid = np.asarray(outs.valid)
+                while k < p and valid[k]:
+                    k += 1
+                id_c = np.asarray(outs.id_c)
+                id_x = np.asarray(outs.id_x)
+                drops = np.asarray(outs.x_drop)
+                for i in range(k):
+                    if id_x[i]:
+                        new_xs.append(xs[i])
+                    else:
+                        new_xs.append(self._decode_side(
+                            encs[i], outs.x, i, drops=drops[i]))
+                    if not id_c[i]:
+                        # stage source handles compose into the ORIGINAL
+                        # c across scan steps — decode against enc_c
+                        c = self._decode_side(enc_c, outs.stage, i)
+                    stages.append(c)
+        # pooled-fold suffix: ineligible entries, invalidated steps, and
+        # everything behind them (prefix-validity contract)
+        for i in range(k, n):
+            c, xw = rebase_pair(c, xs[i])
+            new_xs.append(xw)
+            stages.append(c)
+        self.device_steps += k
+        self.fallback_steps += n - k
+        return c, new_xs, stages
+
+    # --------------------------------------------------------------- gauges
+    def stats(self) -> dict:
+        total = self.device_steps + self.fallback_steps
+        return {
+            "device_rebase_steps": self.device_steps,
+            "rebase_fallbacks": self.fallback_steps,
+            "rebase_windows": self.windows,
+            "rebase_encode_rejects": self.encode_rejects,
+            "device_rebase_fraction": (
+                round(self.device_steps / total, 4) if total else 0.0
+            ),
+        }

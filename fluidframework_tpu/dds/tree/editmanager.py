@@ -138,13 +138,21 @@ class EditManager:
     (the caller apply-enriches that clone; pooled spans stay immutable).
     ``None``/falsy keeps the object fold — the byte-identity fuzz oracle.
     Pass a shared ``MarkPool`` so a fleet's gauges aggregate, or ``True``
-    for a private pool."""
+    for a private pool.
+
+    ``device_rebase`` (requires ``mark_pool``) dispatches each window
+    fold's eligible prefix through the batched device kernel
+    (dds/tree/device_rebase.py); ineligible or invalidated steps finish
+    on the pooled fold, counted in the rebaser's fallback gauges.  Pass
+    a shared ``DeviceRebaser`` so a fleet shares one interning table and
+    one set of counters, or ``True`` for a private one."""
 
     def __init__(
         self,
         encode_rev: Callable[[Any], Any] | None = None,
         decode_rev: Callable[[Any], Any] | None = None,
         mark_pool=None,
+        device_rebase=None,
     ) -> None:
         self.trunk: list[TrunkCommit] = []
         self.trunk_base = 0  # all commits with seq <= trunk_base are evicted
@@ -162,6 +170,14 @@ class EditManager:
             self._mp = mp
             self.pool = mark_pool if isinstance(mark_pool, mp.MarkPool) \
                 else mp.MarkPool()
+        self.rebaser = None
+        if device_rebase and self.pool is not None:
+            from .device_rebase import DeviceRebaser
+
+            self.rebaser = (
+                device_rebase if isinstance(device_rebase, DeviceRebaser)
+                else DeviceRebaser(self.pool)
+            )
 
     def _pool_commit(self, commit: Commit) -> Commit:
         """Pooled-mode conversion (idempotent); object mode passes through."""
@@ -250,14 +266,24 @@ class EditManager:
             # pair (columnar rebase + identity span reuse for disjoint
             # commits); the peer stream keeps sharing unchanged spans
             # instead of re-materializing every mark per window entry.
-            rebase_pair = self._mp.rebase_pair
             c = self._pool_commit(change)
-            for i in range(len(xs)):
-                tseq, x = xs[i]
-                nxt, xw = rebase_pair(c, x)
-                xs[i] = (tseq, xw)
-                c = nxt
-                stage_list.append((tseq, c))
+            if self.rebaser is not None and xs:
+                # Device window: eligible prefix in one jitted scan,
+                # pooled-fold suffix (byte-identical either way; every
+                # host-finished step counted in the rebaser's gauges).
+                c, new_xs, stage_vals = self.rebaser.fold(
+                    c, [x for _t, x in xs])
+                for i in range(len(xs)):
+                    xs[i] = (xs[i][0], new_xs[i])
+                    stage_list.append((xs[i][0], stage_vals[i]))
+            else:
+                rebase_pair = self._mp.rebase_pair
+                for i in range(len(xs)):
+                    tseq, x = xs[i]
+                    nxt, xw = rebase_pair(c, x)
+                    xs[i] = (tseq, xw)
+                    c = nxt
+                    stage_list.append((tseq, c))
             ret = self._mp.unpool_commit(c)
             pooled_ret = c
             br.inflight.append((revision, self._pool_commit(change)))

@@ -68,15 +68,25 @@ from .field_kinds import (
 )
 from .forest import Node
 
-# Column kind codes (int comparisons replace isinstance chains).
-K_SKIP, K_INSERT, K_REMOVE, K_MODIFY, K_MOVEOUT, K_MOVEIN = 0, 1, 2, 3, 4, 5
-
-# Structural flags per sealed span (computed once, read on every rebase).
-F_INSERT, F_REMOVE, F_MOVE, F_MODIFY, F_CANONICAL = 1, 2, 4, 8, 16
-_F_STRUCTURAL = F_INSERT | F_REMOVE | F_MOVE
-
-# MoveIn offset None sentinel (real offsets are >= 0).
-_NONE_OFF = -1
+# Kind codes, span flags and sentinels are the protocol-layer mark schema
+# (protocol/mark_schema.py) — shared with the device kernels.  Historical
+# local names kept: every pass below reads them, and dds-internal callers
+# import them from here.
+from ...protocol.mark_schema import (  # noqa: F401  (re-export shim)
+    F_CANONICAL,
+    F_INSERT,
+    F_MODIFY,
+    F_MOVE,
+    F_REMOVE,
+    F_STRUCTURAL as _F_STRUCTURAL,
+    K_INSERT,
+    K_MODIFY,
+    K_MOVEIN,
+    K_MOVEOUT,
+    K_REMOVE,
+    K_SKIP,
+    NONE_OFF as _NONE_OFF,
+)
 
 
 class _Block:
@@ -255,6 +265,39 @@ class PooledMarks:
         """(kind, a, b, c, obj, start) raw column views for one pass."""
         b = self.pool.blocks[self.blk]
         return b.kind, b.a, b.b, b.c, b.obj, self.start
+
+    def columns_padded(self, max_marks: int):
+        """Device-code padded columns ``(kind[M], count[M], det[M])`` as
+        int32 ndarrays — the kernel-encoding export.
+
+        Kinds are DEVICE codes (pool code + DEVICE_CODE_OFFSET; 0 pads),
+        counts are the ``a`` column, ``det`` flags Remove marks whose
+        detached payload is held host-side.  The int columns are read
+        through one ``np.frombuffer`` view over the pool block (no Mark
+        objects, no per-mark int boxing); only the object column needs a
+        short walk for the det flags.  Raises ValueError when the span is
+        wider than ``max_marks`` (callers treat that as kernel
+        ineligibility, not an error path)."""
+        import numpy as np
+
+        n = self.n
+        if n > max_marks:
+            raise ValueError(f"span width {n} exceeds kernel width {max_marks}")
+        blk = self.pool.blocks[self.blk]
+        s = self.start
+        kind = np.zeros((max_marks,), np.int32)
+        cnt = np.zeros((max_marks,), np.int32)
+        det = np.zeros((max_marks,), np.int32)
+        if n:
+            kv = np.frombuffer(blk.kind, dtype=np.intc)[s : s + n]
+            kind[:n] = kv
+            kind[:n] += 1  # DEVICE_CODE_OFFSET: 0 becomes the NOOP pad
+            cnt[:n] = np.frombuffer(blk.a, dtype=np.intc)[s : s + n]
+            objs = blk.obj
+            for i in range(n):
+                if kv[i] == K_REMOVE and objs[s + i] is not None:
+                    det[i] = 1
+        return kind, cnt, det
 
     def iter_runs(self):
         """Yield (kind, a, b, c, obj) per mark without materializing Mark
@@ -750,11 +793,15 @@ def _rebase_cols(pool: MarkPool, a: PooledMarks, b: PooledMarks,
         nonlocal ri
         while ri < nruns and runs[ri][1] < p:
             ri += 1
-        if ri < nruns and runs[ri][0] <= p:
+        if p == 0:
+            # Output before boundary 0 excluding productions AT 0 is
+            # definitionally 0 — a run starting at 0 has its out_start
+            # AFTER any leading-Insert production, so the generic
+            # run-relative formula below would double-count it.
+            before = 0
+        elif ri < nruns and runs[ri][0] <= p:
             s0, _e0, o0, gone, _n = runs[ri]
             before = o0 if gone else o0 + (p - s0)
-        elif p == 0:
-            before = 0
         else:
             return tail_out + (p - tail_in)  # beyond b: no productions
         return before + prods.get(p, 0) if after else before

@@ -246,6 +246,7 @@ class TreeBatchEngine:
         spare_slots: int = 0,
         plan_cache: bool = True,
         mark_pool: bool = True,
+        device_rebase: bool = False,
         native_wire: bool = True,
         telemetry=None,
         overload_high_watermark: int = 0,
@@ -273,12 +274,23 @@ class TreeBatchEngine:
         # fleet-wide.  ``mark_pool=False`` keeps the object-mark fold —
         # the byte-identity fuzz oracle, same pattern as plan_cache.
         self.markpool = MarkPool() if mark_pool else None
+        # Device rebase window (PR 19): one shared DeviceRebaser so the
+        # fleet shares the field-interning table and the health gauges
+        # (device_rebase_fraction / rebase_fallbacks), same pattern as
+        # the shared MarkPool.  Requires the pooled fold.
+        self.rebaser = None
+        if device_rebase and self.markpool is not None:
+            from ..dds.tree.device_rebase import DeviceRebaser
+
+            self.rebaser = DeviceRebaser(self.markpool)
         # ingest_lines rides the native tree decoder when its symbol is
         # present (stale prebuilt .so -> Python decode, never a crash).
         self.native_wire = native_wire
         self.hosts = [
             _TreeHost(
-                em=EditManager(mark_pool=self.markpool),
+                em=EditManager(
+                    mark_pool=self.markpool, device_rebase=self.rebaser,
+                ),
                 queue=RowQueue(tk.NESTED_OP_FIELDS, max_insert_len),
             )
             for _ in range(n_docs)
@@ -1347,6 +1359,12 @@ class TreeBatchEngine:
                 round(hits / total, 4) if total else 0.0,
             )
             for k, v in ps.items():
+                self.counters.gauge(k, v)
+        # Device-rebase surface: fraction of window steps resolved on
+        # the kernel plane; fallbacks are the pooled-fold remainder
+        # (ineligible commits + invalidated steps), counted never silent.
+        if self.rebaser is not None:
+            for k, v in self.rebaser.stats().items():
                 self.counters.gauge(k, v)
         self.counters.gauge("recompiles", self.recompile_watchdog.recompiles)
         self.counters.gauge(

@@ -29,33 +29,38 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# The mark kind numbering is the protocol-layer schema (shared with the
+# pooled columns); TreeMarkKind is re-exported here for existing callers.
+from ..protocol.mark_schema import (  # noqa: F401  (re-export shim)
+    DEVICE_CODE_OFFSET,
+    K_INSERT,
+    K_MODIFY,
+    K_REMOVE,
+    K_SKIP,
+    TreeMarkKind,
+)
+
 I32 = jnp.int32
-
-
-class TreeMarkKind:
-    NOOP = 0   # padding
-    SKIP = 1
-    INSERT = 2
-    REMOVE = 3
-    MODIFY = 4
 
 
 def encode_marks(marks, max_marks: int) -> tuple[np.ndarray, np.ndarray]:
     """Columnar encode a host mark list (changeset.py Mark objects) to
-    (kinds[M], counts[M]) int32 arrays. Insert counts are content lengths."""
-    from ..dds.tree.changeset import Insert, Modify, Remove, Skip
+    (kinds[M], counts[M]) int32 arrays. Insert counts are content lengths.
 
+    Dispatches on the protocol mark-schema class tag ``m.K`` — no upward
+    import of the dds changeset classes."""
     kinds = np.zeros((max_marks,), np.int32)
     counts = np.zeros((max_marks,), np.int32)
     assert len(marks) <= max_marks, "mark list exceeds kernel width"
     for i, m in enumerate(marks):
-        if isinstance(m, Skip):
+        k = m.K
+        if k == K_SKIP:
             kinds[i], counts[i] = TreeMarkKind.SKIP, m.count
-        elif isinstance(m, Insert):
+        elif k == K_INSERT:
             kinds[i], counts[i] = TreeMarkKind.INSERT, len(m.content)
-        elif isinstance(m, Remove):
+        elif k == K_REMOVE:
             kinds[i], counts[i] = TreeMarkKind.REMOVE, m.count
-        elif isinstance(m, Modify):
+        elif k == K_MODIFY:
             kinds[i], counts[i] = TreeMarkKind.MODIFY, 1
         else:
             raise TypeError(m)
@@ -793,3 +798,379 @@ def encode_pooled_words(v) -> tuple[int, int, list[int] | None]:
     if isinstance(v, str):
         return VKIND_STR, len(v), [ord(c) for c in v]
     raise ValueError(f"unsupported leaf value type: {v!r}")
+
+
+# ---------------------------------------------------------------------------
+# Batched rebase-window kernel (PR 19): the EditManager fold as a
+# [windows x commits] tensor program
+# ---------------------------------------------------------------------------
+#
+# The host fold (dds/tree/editmanager.py add_sequenced) threads one incoming
+# commit c through a peer's inflight window x_0..x_{C-1} via the mirrored
+# bridge pair rebase_pair(c, x_i) -> (c', x_i').  Here that whole window is
+# ONE lax.scan under jit, vmapped over windows: each commit is a bounded
+# path-shaped encoding (interior [Skip(p), Modify] levels as (field, pos)
+# pairs + one flat leaf mark list as padded int32 columns), and one pair
+# step runs the three rebase phases as masked column passes:
+#
+#   (1) fate-run decomposition of the "over" side: per-mark consume /
+#       produce geometry (in_start/in_end/out_start cumsums, gone and
+#       nested-Modify masks) — _b_runs without the Python walk;
+#   (2) the collision scan as batched segment intersection: every a-mark's
+#       input span against every b-run in one [M, M] overlap table (the
+#       per-span Modify-site comparison is the modA & modB & overlap mask);
+#   (3) the two-leg bridge fold: both rebase_pair legs (a_after=True for
+#       the incoming commit, False for the window entry) emitted from the
+#       same atom table by a coalescing scan, with the nonstructural-entry
+#       identity short-circuit preserved as a mask — an unchanged span
+#       compares columnar-equal and the host reuses the ORIGINAL span
+#       object, keeping the span-reuse cache valid.
+#
+# Object payloads (insert content, nested NodeChanges, detached subtrees)
+# never ride the device: every output mark carries a source-index range
+# into the ORIGINAL commit's columns (composed across scan steps for the
+# carried c), and the host decode re-attaches payloads from those handles.
+# Anything the columns cannot express — moves, Modify-vs-Modify payload
+# collisions, detached-payload Removes that actually shift, output
+# overflow — sets a per-step invalid flag; the host finishes the window on
+# the pooled fold (the fuzz oracle), counted in rebase_fallbacks and never
+# silent.
+
+REBASE_MAX_MARKS = 12   # M: widest leaf mark list a window entry may carry
+REBASE_MAX_DEPTH = 4    # PD: deepest interior [Skip, Modify] path
+
+
+class RebaseEnc(NamedTuple):
+    """Device encoding of one eligible single-change pooled Commit.
+
+    Interior levels 0..dep-1 are exactly [Skip(pos[l]), Modify] chains
+    (the nested-commit wire norm); level ``dep`` is the leaf: a flat mark
+    list over field ``fld[dep]``, or a value-only NodeChange when
+    ``fld[dep] < 0``.  ``val[l]`` flags a value overwrite at level l (the
+    value tuples themselves stay host-side).  ``slo/shi`` map each leaf
+    mark to its source-index range in the ORIGINAL commit's columns —
+    the object-payload handles."""
+
+    dep: jnp.ndarray   # [] int32   number of interior levels
+    fld: jnp.ndarray   # [PD+1]     interned field ids; fld[dep] < 0 = value leaf
+    pos: jnp.ndarray   # [PD]       interior skip offsets
+    val: jnp.ndarray   # [PD+1]     value-present flags
+    kind: jnp.ndarray  # [M]        leaf device-coded kinds (0 pads)
+    cnt: jnp.ndarray   # [M]        leaf counts (a column)
+    det: jnp.ndarray   # [M]        Remove-with-detached flags
+    n: jnp.ndarray     # [] int32   live leaf marks
+    slo: jnp.ndarray   # [M]        source range lo (original mark index)
+    shi: jnp.ndarray   # [M]        source range hi (inclusive)
+
+
+class _LegOut(NamedTuple):
+    kind: jnp.ndarray  # [M] rebased mark kinds
+    cnt: jnp.ndarray   # [M]
+    lo: jnp.ndarray    # [M] source range into the leg's own input marks
+    hi: jnp.ndarray    # [M]
+    n: jnp.ndarray     # []
+    bad: jnp.ndarray   # [] bool: collision / out-of-order / overflow
+    ident: jnp.ndarray  # [] bool: output columnar-equal to the input
+
+
+def _flat_leg(ak, ac, bk, bc, a_after: bool) -> _LegOut:
+    """One bridge leg over flat move-free columns: rebase a over b.
+
+    Byte-matches mark_pool._rebase_cols (itself byte-matched to
+    changeset.rebase_marks): fate runs for b, per-a-mark placements, and
+    the sorted gap-and-coalesce emission — but as one fixed-shape masked
+    program.  ``a_after`` is static (each bridge leg compiles once)."""
+    TK = TreeMarkKind
+    M = ak.shape[0]
+    a_live = ak != TK.NOOP
+    b_live = bk != TK.NOOP
+
+    # --- phase 1: fate-run decomposition of b ------------------------------
+    consB = jnp.where((bk == TK.SKIP) | (bk == TK.REMOVE), bc,
+                      jnp.where(bk == TK.MODIFY, 1, 0))
+    prodB = jnp.where((bk == TK.SKIP) | (bk == TK.INSERT), bc,
+                      jnp.where(bk == TK.MODIFY, 1, 0))
+    inS = jnp.cumsum(consB) - consB
+    inE = inS + consB
+    outS = jnp.cumsum(prodB) - prodB
+    tail_in = jnp.sum(consB)
+    tail_out = jnp.sum(prodB)
+    goneB = b_live & (bk == TK.REMOVE)
+    modB = b_live & (bk == TK.MODIFY)
+    runB = b_live & (consB > 0)  # input-consuming runs partition [0, tail_in)
+
+    consA = jnp.where((ak == TK.SKIP) | (ak == TK.REMOVE), ac,
+                      jnp.where(ak == TK.MODIFY, 1, 0))
+    a_in = jnp.cumsum(consA) - consA
+
+    # --- insert-boundary placement (the sided boundary map) ----------------
+    p = a_in[:, None]                                   # [M, 1]
+    covB = runB[None, :] & (inS[None, :] < p) & (p <= inE[None, :])
+    before_run = jnp.where(goneB[None, :], outS[None, :],
+                           outS[None, :] + (p - inS[None, :]))
+    has_cov = jnp.any(covB, axis=1)
+    before = jnp.sum(jnp.where(covB, before_run, 0), axis=1)
+    before = jnp.where(
+        a_in == 0, 0,
+        jnp.where(has_cov, before, tail_out + (a_in - tail_in)))
+    prods_at = jnp.sum(
+        jnp.where((bk == TK.INSERT)[None, :] & b_live[None, :]
+                  & (inS[None, :] == p), bc[None, :], 0), axis=1)
+    bp = before + (prods_at if a_after else 0)
+
+    # --- phase 2: node placement as batched segment intersection -----------
+    isnode = a_live & ((ak == TK.REMOVE) | (ak == TK.MODIFY))
+    modA = a_live & (ak == TK.MODIFY)
+    s_j = a_in[:, None]
+    e_j = (a_in + consA)[:, None]
+    lo = jnp.maximum(s_j, inS[None, :])
+    hi = jnp.minimum(e_j, inE[None, :])
+    overlap = runB[None, :] & (hi > lo)
+    seg_ok = overlap & isnode[:, None] & ~goneB[None, :]
+    seg_pos = outS[None, :] + (lo - inS[None, :])
+    seg_cnt = hi - lo
+    # Modify-site collision: nested payloads would have to rebase host-side.
+    coll = jnp.any(modA[:, None] & modB[None, :] & overlap)
+    # tail segment (beyond b's context: implicit trailing skip)
+    tlo = jnp.maximum(a_in, tail_in)
+    tail_ok = isnode & (e_j[:, 0] > tlo)
+    tail_pos = tail_out + (tlo - tail_in)
+    tail_cnt = e_j[:, 0] - tlo
+
+    # --- atom table: (a-mark j) x (insert | b-run segs | tail) -------------
+    # Row-major (j, slot) order IS the host placement sort order
+    # (out positions are monotone in input position; insert-before-node at
+    # ties is slot order; an out-of-order placement flags `bad` below).
+    NS = M + 2
+    atom_ok = jnp.concatenate([
+        (a_live & (ak == TK.INSERT))[:, None], seg_ok, tail_ok[:, None]],
+        axis=1)
+    atom_pos = jnp.concatenate([bp[:, None], seg_pos, tail_pos[:, None]],
+                               axis=1)
+    atom_cnt = jnp.concatenate([ac[:, None], seg_cnt, tail_cnt[:, None]],
+                               axis=1)
+    atom_kind = jnp.broadcast_to(ak[:, None], (M, NS))
+    atom_src = jnp.broadcast_to(jnp.arange(M, dtype=I32)[:, None], (M, NS))
+
+    flat = lambda x: x.reshape((M * NS,))
+
+    # --- phase 3: coalescing emission as parallel prefix passes ------------
+    # The _Builder walk (merge adjacent same-kind marks, write skip gaps)
+    # recast without a serial scan: forward-fill each live atom's
+    # PREDECESSOR, derive merge/start/skip-gap decisions per atom, take
+    # merge-group totals as cumsum differences, then match output slots
+    # against atoms in one [M, T] reduction.  Everything is a parallel
+    # prefix, a gather, or a small masked sum — no scatters (XLA CPU
+    # lowers those to per-index loops) and no serial scan; the kernel's
+    # only remaining serial axis is the window fold itself.
+    T = M * NS
+    ok0 = flat(atom_ok) & (flat(atom_cnt) > 0)
+    kk = flat(atom_kind)
+    pos_f = flat(atom_pos)
+    cnt_f = flat(atom_cnt)
+    j_f = flat(atom_src)
+    mc = jnp.where(ok0, cnt_f, 0)
+    consumed = jnp.where(kk == TK.REMOVE, cnt_f,
+                         jnp.where(kk == TK.MODIFY, 1, 0))
+    end_f = pos_f + consumed
+    ar = jnp.arange(T, dtype=I32)
+    # index of the last live atom STRICTLY before each position (-1: none,
+    # i.e. the builder's initial state — cursor 0, no pending kind)
+    lastok = jax.lax.cummax(jnp.where(ok0, ar, -1))
+    prev_idx = jnp.concatenate([jnp.full((1,), -1, I32), lastok[:-1]])
+    has_prev = prev_idx >= 0
+    safe = jnp.maximum(prev_idx, 0)
+    gap = pos_f - jnp.where(has_prev, end_f[safe], 0)
+    prev_kind = jnp.where(has_prev, kk[safe], TK.NOOP)
+    merge = ok0 & (prev_kind == kk) & (gap == 0) & \
+        ((kk == TK.REMOVE) | (kk == TK.INSERT))
+    start = ok0 & ~merge
+    wskip = start & (gap > 0)
+    grp = jnp.cumsum(start.astype(I32))   # 1-based merge-group ids
+    nsk = jnp.cumsum(wskip.astype(I32))   # skips emitted up to here
+    # merge groups are contiguous atom ranges: group totals fall out of
+    # inclusive cumsums between a start atom and the next start
+    csum = jnp.cumsum(mc)
+    nsa = jax.lax.cummin(jnp.where(start, ar, T), reverse=True)
+    gend = jnp.minimum(jnp.concatenate([nsa[1:], jnp.full((1,), T, I32)]) - 1,
+                       T - 1)
+    gsum = csum[gend] - csum + mc              # group cnt total (at starts)
+    ghi = jax.lax.cummax(jnp.where(ok0, j_f, -1))[gend]  # last source j
+    # output slots: group g's mark lands after g-1 marks and every skip
+    # gap at or before its start atom; its own gap skip sits one before
+    slot = grp - 1 + nsk
+    out_n = grp[-1] + nsk[-1]
+    # slot is monotone and only jumps at start atoms (by 2 over a skip
+    # gap), so each output slot s resolves to one atom by binary search:
+    # an exact hit is that slot's mark; an s+1 hit means s is the skip
+    # gap written just before that mark.
+    srange = jnp.arange(M, dtype=I32)
+    hit = jnp.minimum(jnp.searchsorted(slot, srange, side="left"), T - 1)
+    sl = slot[hit]
+    is_mark = start[hit] & (sl == srange)
+    is_skip = wskip[hit] & (sl == srange + 1)
+    ok_k = jnp.where(is_mark, kk[hit], jnp.where(is_skip, TK.SKIP, 0))
+    ok_c = jnp.where(is_mark, gsum[hit], jnp.where(is_skip, gap[hit], 0))
+    ok_lo = jnp.where(is_mark, j_f[hit], 0)    # first source j of the group
+    ok_hi = jnp.where(is_mark, ghi[hit], 0)
+    bad = coll | jnp.any(ok0 & (gap < 0)) | (out_n > M)
+    a_n = jnp.sum(a_live.astype(I32))
+    ident = (out_n == a_n) & jnp.all(ok_k == ak) & jnp.all(ok_c == ac)
+    return _LegOut(ok_k, ok_c, ok_lo, ok_hi, out_n, bad, ident)
+
+
+def _synth_interior(p):
+    """[Skip(p), Modify] (or [Modify] at p == 0) as padded columns."""
+    TK = TreeMarkKind
+    M = REBASE_MAX_MARKS
+    k0 = jnp.where(p > 0, TK.SKIP, TK.MODIFY)
+    k1 = jnp.where(p > 0, TK.MODIFY, TK.NOOP)
+    kind = jnp.zeros((M,), I32).at[0].set(k0).at[1].set(k1)
+    cnt = jnp.zeros((M,), I32).at[0].set(jnp.where(p > 0, p, 1)) \
+        .at[1].set(jnp.where(p > 0, 1, 0))
+    return kind, cnt
+
+
+class RebaseStepOut(NamedTuple):
+    valid: jnp.ndarray   # [] this step's device result is usable
+    id_c: jnp.ndarray    # [] c came through bit-identical
+    id_x: jnp.ndarray    # [] x came through bit-identical
+    x: "RebaseEnc"       # rebased window entry (src into its own marks)
+    stage: "RebaseEnc"   # c after this step (src into the ORIGINAL c)
+    x_drop: jnp.ndarray  # [PD+1] value-LWW drops applied to x
+
+
+def _pair_step(c: RebaseEnc, x: RebaseEnc, elig):
+    """One mirrored bridge pair rebase_pair(c, x) on encodings.
+
+    Walks the common interior path to the divergence level, then either
+    short-circuits (disjoint fields / positions / value-only leaves — the
+    identity mask) or runs both flat legs at the diverging field.  Returns
+    (c', step outputs, step_ok)."""
+    TK = TreeMarkKind
+    PD = REBASE_MAX_DEPTH
+    li = jnp.arange(PD, dtype=I32)
+    match = (li < c.dep) & (li < x.dep) & (c.fld[:PD] == x.fld[:PD]) & \
+        (c.pos == x.pos)
+    lstar = jnp.sum(jnp.cumprod(match.astype(I32)))
+    c_int = lstar < c.dep
+    x_int = lstar < x.dep
+    f_c = c.fld[lstar]
+    f_x = x.fld[lstar]
+    case_d = (f_c < 0) | (f_x < 0)
+    case_a = ~case_d & (f_c != f_x)
+    engage = ~case_d & ~case_a & ~(c_int & x_int)  # flat pair runs
+
+    # flat lists at the divergence level (interior side synthesized)
+    sk_c, sc_c = _synth_interior(c.pos[jnp.minimum(lstar, PD - 1)])
+    sk_x, sc_x = _synth_interior(x.pos[jnp.minimum(lstar, PD - 1)])
+    Ak = jnp.where(c_int, sk_c, c.kind)
+    Ac = jnp.where(c_int, sc_c, c.cnt)
+    Bk = jnp.where(x_int, sk_x, x.kind)
+    Bc = jnp.where(x_int, sc_x, x.cnt)
+
+    legC = _flat_leg(Ak, Ac, Bk, Bc, a_after=True)
+    legX = _flat_leg(Bk, Bc, Ak, Ac, a_after=False)
+
+    # detached-payload Removes may pass through untouched, never transform
+    det_c = ~c_int & jnp.any(c.det > 0) & ~legC.ident
+    det_x = ~x_int & jnp.any(x.det > 0) & ~legX.ident
+    step_bad = engage & (legC.bad | legX.bad | det_c | det_x)
+    step_ok = elig & ~step_bad
+
+    # value LWW along the shared spine (levels 0..lstar)
+    lvl = jnp.arange(PD + 1, dtype=I32)
+    drop_x = (c.val > 0) & (x.val > 0) & (lvl <= lstar)
+
+    # interior fate: did the synthesized Modify survive, and where?
+    surv_c = jnp.any((legC.kind == TK.MODIFY) & (jnp.arange(REBASE_MAX_MARKS)
+                                                 < legC.n))
+    surv_x = jnp.any((legX.kind == TK.MODIFY) & (jnp.arange(REBASE_MAX_MARKS)
+                                                 < legX.n))
+    npos_c = jnp.where(legC.kind[0] == TK.SKIP, legC.cnt[0], 0)
+    npos_x = jnp.where(legX.kind[0] == TK.SKIP, legX.cnt[0], 0)
+
+    def rebuild(side: RebaseEnc, leg: _LegOut, is_int, surv, npos, drops):
+        # interior side: position update or truncation to an empty leaf
+        t_dep = jnp.where(is_int & ~surv, lstar, side.dep)
+        t_pos = jnp.where(is_int & surv & (li == lstar), npos, side.pos)
+        t_val = jnp.where((lvl <= t_dep) & ~drops, side.val, 0)
+        # leaf side: the leg output with composed source ranges
+        glo = side.slo[leg.lo]
+        ghi = side.shi[leg.hi]
+        live = jnp.arange(REBASE_MAX_MARKS) < leg.n
+        leaf = ~is_int
+        t_kind = jnp.where(leaf, jnp.where(live, leg.kind, 0), side.kind)
+        t_cnt = jnp.where(leaf, jnp.where(live, leg.cnt, 0), side.cnt)
+        t_det = jnp.where(leaf, jnp.where(
+            live & (leg.kind == TK.REMOVE), side.det[leg.lo], 0), side.det)
+        t_n = jnp.where(leaf, leg.n, jnp.where(is_int & ~surv, 0, side.n))
+        t_slo = jnp.where(leaf, jnp.where(live, glo, 0), side.slo)
+        t_shi = jnp.where(leaf, jnp.where(live, ghi, 0), side.shi)
+        # truncated interior: empty leaf at lstar over the same field
+        t_kind = jnp.where(is_int & ~surv, 0, t_kind)
+        t_cnt = jnp.where(is_int & ~surv, 0, t_cnt)
+        t_det = jnp.where(is_int & ~surv, 0, t_det)
+        t_slo = jnp.where(is_int & ~surv, 0, t_slo)
+        t_shi = jnp.where(is_int & ~surv, 0, t_shi)
+        return RebaseEnc(t_dep, side.fld, t_pos, t_val, t_kind, t_cnt,
+                         t_det, t_n, t_slo, t_shi)
+
+    changed_c = engage & jnp.where(c_int, ~(surv_c & (npos_c == c.pos[
+        jnp.minimum(lstar, PD - 1)])), ~legC.ident)
+    changed_x = engage & jnp.where(x_int, ~(surv_x & (npos_x == x.pos[
+        jnp.minimum(lstar, PD - 1)])), ~legX.ident)
+
+    new_c = rebuild(c, legC, c_int, surv_c, npos_c,
+                    jnp.zeros((PD + 1,), jnp.bool_))
+    new_x = rebuild(x, legX, x_int, surv_x, npos_x, drop_x)
+
+    apply_c = step_ok & engage & changed_c
+    pick = lambda f, a, b: jax.tree_util.tree_map(
+        lambda u, v: jnp.where(f, u, v), a, b)
+    out_c = pick(apply_c, new_c, c)
+    # x's value drops apply in EVERY case; marks only when the pair engaged
+    base_x = RebaseEnc(x.dep, x.fld, x.pos,
+                       jnp.where(drop_x, 0, x.val), x.kind, x.cnt, x.det,
+                       x.n, x.slo, x.shi)
+    apply_x = step_ok & engage & changed_x
+    out_x = pick(apply_x, new_x, base_x)
+
+    any_drop = jnp.any(drop_x & (x.val > 0))
+    id_c = step_ok & ~(engage & changed_c)
+    id_x = step_ok & ~(engage & changed_x) & ~any_drop
+    return out_c, RebaseStepOut(step_ok, id_c, id_x, out_x, out_c,
+                                drop_x.astype(I32)), step_ok
+
+
+def rebase_window_kernel(c: RebaseEnc, xs: RebaseEnc, elig: jnp.ndarray):
+    """Fold one incoming commit through a whole inflight window on device.
+
+    ``xs`` fields carry a leading [C] axis; ``elig[i]`` gates each step
+    (host pads windows and marks host-only entries ineligible).  Prefix
+    validity: the first bad/ineligible step kills every later step's
+    ``valid`` bit — the host finishes the suffix on the pooled fold.
+    Returns (final c encoding, per-step RebaseStepOut stack)."""
+
+    def step(carry, inp):
+        cc, dead = carry
+        x, el = inp
+        nc, out, ok = _pair_step(cc, x, el & ~dead)
+        dead = dead | ~ok
+        return (nc, dead), out
+
+    (final_c, _dead), outs = jax.lax.scan(
+        step, (c, jnp.asarray(False)), (xs, elig.astype(jnp.bool_)))
+    return final_c, outs
+
+
+# One compiled program per (C,) window bucket; the W axis is vmapped so
+# thousands of windows ride one dispatch (bench config5's microbench).
+rebase_window_jit = jax.jit(rebase_window_kernel)
+rebase_window_batched = jax.jit(jax.vmap(rebase_window_kernel))
+
+
+def rebase_flat_pair_kernel(ak, ac, bk, bc):
+    """Both bridge legs of one flat pair (differential-test surface)."""
+    return (_flat_leg(ak, ac, bk, bc, a_after=True),
+            _flat_leg(bk, bc, ak, ac, a_after=False))

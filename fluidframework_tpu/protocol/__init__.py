@@ -22,6 +22,23 @@ from .messages import (
     UnsequencedMessage,
     Nack,
 )
+from .mark_schema import (
+    DEVICE_CODE_OFFSET,
+    F_CANONICAL,
+    F_INSERT,
+    F_MODIFY,
+    F_MOVE,
+    F_REMOVE,
+    F_STRUCTURAL,
+    K_INSERT,
+    K_MODIFY,
+    K_MOVEIN,
+    K_MOVEOUT,
+    K_REMOVE,
+    K_SKIP,
+    NONE_OFF,
+    TreeMarkKind,
+)
 
 __all__ = [
     "LOCAL_BASE",
@@ -37,4 +54,19 @@ __all__ = [
     "SequencedMessage",
     "UnsequencedMessage",
     "Nack",
+    "DEVICE_CODE_OFFSET",
+    "F_CANONICAL",
+    "F_INSERT",
+    "F_MODIFY",
+    "F_MOVE",
+    "F_REMOVE",
+    "F_STRUCTURAL",
+    "K_INSERT",
+    "K_MODIFY",
+    "K_MOVEIN",
+    "K_MOVEOUT",
+    "K_REMOVE",
+    "K_SKIP",
+    "NONE_OFF",
+    "TreeMarkKind",
 ]

@@ -1635,6 +1635,13 @@ SEEDINGS = [
     ("utils/config.py",
      lambda s: s + "\nfrom ..server import scribe as _seeded\n",
      "layer-upward-import", "layer-check"),
+    # PR 19 moved the mark schema to protocol.mark_schema precisely so the
+    # rebase kernel no longer imports the dds changeset classes — re-adding
+    # that upward edge from the kernel layer must fail loudly (the retired
+    # baseline entry no longer shields it).
+    ("ops/tree_kernel.py",
+     lambda s: s + "\nfrom ..dds.tree import changeset as _seeded\n",
+     "layer-upward-import", "layer-check"),
     # loadgen sits in the service layer: an upward import FROM a state-
     # layer module INTO loadgen must trip the gate (proves the new
     # subsystem is really declared, not silently outside the graph).

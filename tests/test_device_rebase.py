@@ -1,0 +1,297 @@
+"""Device rebase kernel: differential fuzz + byte-identity + fallbacks.
+
+Three layers of oracle discipline, mirroring how the kernel is wired in:
+
+* flat-leg differential fuzz — ``rebase_flat_pair_kernel`` columns vs the
+  object-level ``rebase_marks`` walk, on canonical move-free mark lists;
+* manager byte-identity — EditManager(device_rebase=True) vs the pooled
+  fold vs the object oracle on the shared fuzz streams (summaries, fold
+  stages, every trunk commit, the applied forest);
+* fallback accounting — ineligible work (moves, deep paths, collisions)
+  must be COUNTED into ``rebase_fallbacks``/``device_rebase_fraction``,
+  never silently absorbed.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from test_mark_pool import _engine_msgs, _fuzz_edits, _run_manager
+
+from fluidframework_tpu.dds.tree.changeset import (
+    Commit,
+    Insert,
+    Modify,
+    NodeChange,
+    Remove,
+    Skip,
+    apply_commit,
+    clone_commit,
+    commit_to_json,
+    rebase_marks,
+)
+from fluidframework_tpu.dds.tree.editmanager import EditManager
+from fluidframework_tpu.dds.tree.forest import Forest
+from fluidframework_tpu.dds.tree.mark_pool import (
+    F_CANONICAL,
+    MarkPool,
+    pool_commit_from_json,
+    pool_marks,
+)
+from fluidframework_tpu.dds.tree.schema import leaf
+from fluidframework_tpu.observability import flight_recorder as fr
+from fluidframework_tpu.ops import tree_kernel as tk
+
+M = tk.REBASE_MAX_MARKS
+
+
+# ---------------------------------------------------------------------------
+# Flat-leg differential fuzz: kernel columns vs the object-level walk
+# ---------------------------------------------------------------------------
+
+
+def _rand_marks(rng, n):
+    """Canonical-biased random mark list over an n-node context."""
+    marks, pos = [], 0
+    last = None
+    while pos < n:
+        r = rng.random()
+        if r < 0.25 and last != "S" and pos < n - 1:
+            k = rng.randint(1, n - pos - 1)
+            marks.append(Skip(k))
+            pos += k
+            last = "S"
+        elif r < 0.5 and last != "R":
+            k = rng.randint(1, n - pos)
+            marks.append(Remove(k))
+            pos += k
+            last = "R"
+        elif r < 0.75 and last != "I":
+            marks.append(Insert([
+                leaf(rng.randint(0, 99))
+                for _ in range(rng.randint(1, 3))
+            ]))
+            last = "I"
+        else:
+            marks.append(Modify(NodeChange(value=(rng.randint(0, 9),))))
+            pos += 1
+            last = "M"
+    if rng.random() < 0.4 and last != "I":
+        marks.append(Insert([leaf(7)]))
+    return marks
+
+
+def _leg_sweep(seeds):
+    """Both kernel legs vs rebase_marks on every canonical seed pair.
+
+    bad legs are allowed (that is the fallback contract) but a clean leg
+    must match the oracle's pooled columns exactly, and the identity bit
+    must equal true columnar equality."""
+    pool = MarkPool()
+    pair = jax.jit(tk.rebase_flat_pair_kernel)
+    total = bad_n = 0
+    for seed in seeds:
+        rng = random.Random(seed ^ 0x9E3779B9)
+        a = _rand_marks(rng, rng.randint(0, 7))
+        b = _rand_marks(rng, rng.randint(0, 7))
+        try:
+            pa = pool_marks(pool, a)
+            ak, ac, _ = pa.columns_padded(M)
+            pb = pool_marks(pool, b)
+            bk, bc, _ = pb.columns_padded(M)
+        except ValueError:
+            continue  # wider than the kernel: the encoder gates these out
+        if not (pa.flags & F_CANONICAL and pb.flags & F_CANONICAL):
+            continue
+        total += 1
+        legA, legB = pair(jnp.asarray(ak), jnp.asarray(ac),
+                          jnp.asarray(bk), jnp.asarray(bc))
+        for tag, leg, src, over, aft, pin in (
+            ("A", legA, a, b, True, pa),
+            ("B", legB, b, a, False, pb),
+        ):
+            if bool(leg.bad):
+                bad_n += 1
+                continue
+            want = rebase_marks(list(src), list(over), aft)
+            try:
+                wp = pool_marks(pool, want)
+                wk, wc, _ = wp.columns_padded(M)
+            except ValueError:
+                continue
+            gk = np.asarray(leg.kind)
+            gc = np.asarray(leg.cnt)
+            gn = int(leg.n)
+            assert gn == wp.n and (gk == wk).all() and (gc == wc).all(), (
+                f"leg {tag} seed={seed}: kernel columns diverge from "
+                f"rebase_marks\n  src={src}\n  over={over}\n"
+                f"  got k={gk[:gn]} c={gc[:gn]}\n  want={want}"
+            )
+            ik, ic, _ = pin.columns_padded(M)
+            ident_want = (gn == pin.n) and (gk == ik).all() \
+                and (gc == ic).all()
+            assert bool(leg.ident) == ident_want, (
+                f"leg {tag} seed={seed}: identity bit wrong "
+                f"(kernel={bool(leg.ident)}, columnar={ident_want})"
+            )
+    assert total > seeds.stop // 4 if isinstance(seeds, range) else total
+    return total, bad_n
+
+
+def test_flat_leg_differential_smoke():
+    total, bad_n = _leg_sweep(range(300))
+    # The generator is Modify-heavy: some collision fallbacks must appear
+    # (a zero here means the bad flag went dead, i.e. silent fallbacks).
+    assert bad_n > 0
+
+
+@pytest.mark.slow
+def test_flat_leg_differential_deep():
+    _leg_sweep(range(4000))
+
+
+# ---------------------------------------------------------------------------
+# Manager-level byte-identity: device == pooled == object oracle
+# ---------------------------------------------------------------------------
+
+
+def _run_device(edits):
+    """The _run_manager fold, but through EditManager(device_rebase=True);
+    also returns the rebaser's stats."""
+    em = EditManager(mark_pool=MarkPool(), device_rebase=True)
+    forest = Forest()
+    trunk_json = []
+    pool = em.pool
+    for w, ref, seq, min_seq, commit in edits:
+        wire = commit_to_json(clone_commit(commit))
+        change = pool_commit_from_json(pool, wire)
+        ret = em.add_sequenced(
+            client_id=f"w{w}", revision=(w, seq), change=change,
+            ref_seq=ref, seq=seq,
+        )
+        trunk_json.append(json.dumps(commit_to_json(clone_commit(ret))))
+        apply_commit(forest.root, ret)
+        em.advance_min_seq(min_seq)
+    stages = {
+        cid: [
+            [[tseq, commit_to_json(cm)] for tseq, cm in st]
+            for st in br.stages
+        ]
+        for cid, br in em.peers.items()
+    }
+    return (
+        json.dumps(em.summarize(), sort_keys=True),
+        json.dumps(stages, sort_keys=True),
+        trunk_json,
+        json.dumps(forest.to_json(), sort_keys=True),
+        em.rebaser.stats(),
+    )
+
+
+def _assert_identity(edits, expect_full_device=False):
+    sd, std, td, fd, stats = _run_device(edits)
+    s1, st1, t1, f1 = _run_manager(edits, mark_pool=True)
+    assert td == t1, "trunk commits diverge from the pooled fold"
+    assert std == st1, "fold stages diverge from the pooled fold"
+    assert sd == s1, "summary diverges from the pooled fold"
+    assert fd == f1, "applied forest diverges from the pooled fold"
+    steps = stats["device_rebase_steps"] + stats["rebase_fallbacks"]
+    if steps:
+        assert stats["device_rebase_fraction"] == round(
+            stats["device_rebase_steps"] / steps, 4
+        ), "fallbacks not accounted into the fraction gauge"
+    if expect_full_device:
+        assert stats["rebase_fallbacks"] == 0
+        assert stats["device_rebase_fraction"] == 1.0
+    return stats
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_manager_identity_mixed(seed):
+    """Mixed streams (moves, optional, undo, constraints): the ineligible
+    share falls back — counted — and bytes still match both oracles."""
+    stats = _assert_identity(_fuzz_edits(seed, rounds=6, writers=3))
+    assert stats["rebase_fallbacks"] + stats["rebase_encode_rejects"] > 0
+    assert 0.0 < stats["device_rebase_fraction"] < 1.0
+
+
+def test_manager_identity_clean_full_device():
+    """Insert/remove/set-only streams are fully eligible: no fallbacks,
+    and the device fold ALSO byte-matches the object oracle."""
+    edits = _fuzz_edits(1, rounds=5, writers=3, with_moves=False,
+                        with_optional=False, with_undo=False,
+                        with_constraints=False)
+    _assert_identity(edits, expect_full_device=True)
+    s1, st1, t1, f1 = _run_manager(edits, mark_pool=True)
+    s0, _st0, t0, f0 = _run_manager(edits, mark_pool=False)
+    assert (s1, t1, f1) == (s0, t0, f0)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(5, 13)))
+def test_manager_identity_sweep(seed):
+    _assert_identity(_fuzz_edits(seed, rounds=9, writers=4))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", list(range(6)))
+def test_manager_identity_clean_sweep(seed):
+    edits = _fuzz_edits(seed, rounds=8, writers=4, with_moves=False,
+                        with_optional=False, with_undo=False,
+                        with_constraints=False)
+    _assert_identity(edits, expect_full_device=True)
+
+
+# ---------------------------------------------------------------------------
+# Engine integration: gauges and flight-recorder spans
+# ---------------------------------------------------------------------------
+
+
+def test_engine_device_rebase_identity_and_gauges():
+    from fluidframework_tpu.models.tree_batch_engine import TreeBatchEngine
+
+    msgs = _engine_msgs(3)
+
+    def run(device_rebase):
+        eng = TreeBatchEngine(2, capacity=4096, ops_per_step=16,
+                              pool_capacity=32768, mark_pool=True,
+                              device_rebase=device_rebase)
+        for m in msgs:
+            eng.ingest(0, m)
+            eng.ingest(1, m)
+        sums = [json.dumps(eng.hosts[d].em.summarize(), sort_keys=True)
+                for d in range(2)]
+        eng.step()
+        trees = [json.dumps(eng.tree_json(d), sort_keys=True)
+                 for d in range(2)]
+        return eng, sums, trees
+
+    e1, s1, t1 = run(True)
+    e0, s0, t0 = run(False)
+    assert s1 == s0 and t1 == t0
+    h = e1.health()
+    assert h["device_rebase_fraction"] == 1.0
+    assert h["rebase_fallbacks"] == 0
+    assert h["rebase_windows"] > 0
+    assert "device_rebase_fraction" not in e0.health()
+
+
+def test_rebase_kernel_spans_recorded():
+    rec = fr.install(fr.FlightRecorder(capacity=4096))
+    try:
+        edits = _fuzz_edits(2, rounds=3, writers=2, with_moves=False,
+                            with_optional=False, with_undo=False,
+                            with_constraints=False)
+        _run_device(edits)
+        names = {ev.name for ev in rec.events()}
+    finally:
+        fr.uninstall()
+    assert {"rebase_kernel_encode", "rebase_kernel_dispatch",
+            "rebase_kernel_decode"} <= names
