@@ -495,15 +495,25 @@ class DocBatchEngine:
                 # Segment-lane programs: one donated dispatch applies a
                 # [K, B] op ring to one seg-sharded hot doc, per-segment
                 # work split over the segs axis (two collective hops
-                # inside — mk.apply_megastep_seg).
-                seg_specs = pm.seg_state_specs(self._proto)
-                self._seg_megastep = pm.mesh_seg_program(
-                    mk.apply_megastep_seg, self.mesh, seg_specs
-                )
-                self._seg_compact = pm.mesh_seg_program(
-                    mk.compact_seg, self.mesh, seg_specs,
-                    arg_specs=(pm.P(),),
-                )
+                # inside — mk.apply_megastep_seg).  A plane without
+                # seg-lane programs (the native CPU plane) raises a loud
+                # NotImplementedError here; the engine maps it to the
+                # doc-sharded path and counts the downgrade — never a
+                # silent degradation.
+                try:
+                    seg_specs = pm.seg_state_specs(self._proto)
+                    self._seg_megastep = pm.mesh_seg_program(
+                        mk.apply_megastep_seg, self.mesh, seg_specs
+                    )
+                    self._seg_compact = pm.mesh_seg_program(
+                        mk.compact_seg, self.mesh, seg_specs,
+                        arg_specs=(pm.P(),),
+                    )
+                except NotImplementedError:
+                    self._seg_megastep = None
+                    self._seg_compact = None
+                    self.seg_shards = 1
+                    self.counters.bump("seg_plane_unsupported")
         self._lane_apply = _lane_apply_jit
         self._lane_compact = _lane_compact_jit
         # Recompile watchdog: executable-cache growth on any fleet program
@@ -1572,7 +1582,7 @@ class DocBatchEngine:
         )
         try:
             blocked = mk.seg_shard_state(row, self.seg_shards, s_local, tc)
-        except ValueError:
+        except (ValueError, NotImplementedError):
             return False
         lane = _SegmentLane(
             state=self._pm.shard_seg_state(blocked, self.mesh),

@@ -44,6 +44,7 @@ import pytest  # noqa: E402
 # lane (`pytest -m "not device"`).
 _DEVICE_MODULES = {
     "test_columnar_ingest",
+    "test_dispatch_backends",
     "test_doc_batch_engine",
     "test_fleet_consumer",
     "test_kernel_channel",

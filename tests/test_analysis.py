@@ -1736,6 +1736,18 @@ SEEDINGS = [
          "    def require_migratable(",
      ),
      "blocking-under-lock", "blocking-under-lock"),
+    # A lazy native-plane g++ build planted under the serving lock in a
+    # NEW module: megastep_native.warm spawns a compiler subprocess
+    # (blocking_calls in layers.json), and ckpt_lock denies subprocess —
+    # the exact hazard the warm()/loaded() split keeps out of the native
+    # dispatch plane's serving path.
+    ("parallel/native_plane.py",
+     lambda s: s + (
+         "\n\ndef _seeded_lazy_build(engine):\n"
+         "    with engine.ckpt_lock:\n"
+         "        megastep_native.warm()\n"
+     ),
+     "blocking-under-lock", "blocking-under-lock"),
     # The "re-enable donation" edit on the declared replicated-out
     # program: flipping mesh_seg_program's default trips mesh-safety (and
     # the named regression test in test_segment_parallel.py).
